@@ -1,0 +1,15 @@
+"""automodel_tpu — TPU-native (JAX/XLA/Pallas) training framework.
+
+A brand-new framework with the capabilities of NVIDIA NeMo AutoModel
+(reference: /root/reference): day-0 fine-tuning / pretraining of Hugging Face
+LLMs & VLMs driven by YAML recipes, with every parallelism strategy (FSDP/HSDP,
+TP, SP, CP ring attention, PP, EP) expressed as mesh/sharding configuration
+rather than model rewrites.
+
+Where the reference builds on torch.distributed DTensor/FSDP2/NCCL/TE/DeepEP,
+this framework is TPU-first: a single `jax.sharding.Mesh` with GSPMD
+annotations, Pallas kernels for the hot ops, XLA collectives over ICI, and
+safetensors-interoperable checkpointing.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+from automodel_tpu.config.loader import ConfigNode, load_yaml_config, translate_value
+from automodel_tpu.config.arg_parser import parse_args_and_load_config, parse_cli_argv
+
+__all__ = [
+    "ConfigNode",
+    "load_yaml_config",
+    "translate_value",
+    "parse_args_and_load_config",
+    "parse_cli_argv",
+]

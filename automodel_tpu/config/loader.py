@@ -1,0 +1,228 @@
+"""YAML config tree with `_target_` instantiation.
+
+Capability parity with the reference config system
+(nemo_automodel/components/config/loader.py:325,433): a YAML file becomes a
+tree of `ConfigNode`s; any node carrying a `_target_` key instantiates the
+dotted-path callable with its sibling keys as kwargs; `${env:VAR}` /
+`${VAR}` interpolation; dotted-path get/set used by the CLI override layer.
+
+Design differences from the reference (TPU build): no import allowlist is
+needed for local use, but we keep one anyway as a guard; instantiation is
+purely functional (no global registry state).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import re
+from typing import Any, Iterator, Mapping
+
+import yaml
+
+_ENV_RE = re.compile(r"\$\{(?:env:)?([A-Za-z_][A-Za-z0-9_]*)(?::([^}]*))?\}")
+
+# Dotted-path prefixes that `_target_` may import. Mirrors the reference's
+# safety allowlist concept (config/loader.py:73) with TPU-world entries.
+_IMPORT_ALLOWLIST_PREFIXES = (
+    "automodel_tpu",
+    "jax",
+    "optax",
+    "flax",
+    "orbax",
+    "numpy",
+    "builtins",
+    "torch",  # cpu-only torch utilities (e.g. datasets interop)
+    "transformers",
+    "datasets",
+    "math",
+    "functools",
+)
+
+
+def _interp_env(value: str) -> str:
+    """Expand ``${VAR}`` / ``${env:VAR}`` / ``${VAR:default}`` in a string."""
+
+    def sub(m: re.Match) -> str:
+        name, default = m.group(1), m.group(2)
+        if name in os.environ:
+            return os.environ[name]
+        if default is not None:
+            return default
+        raise KeyError(f"Environment variable {name!r} referenced in config is not set")
+
+    return _ENV_RE.sub(sub, value)
+
+
+def translate_value(v: str) -> Any:
+    """Parse a CLI override string into a Python value (YAML semantics)."""
+    try:
+        return yaml.safe_load(v)
+    except yaml.YAMLError:
+        return v
+
+
+def resolve_target(path: str) -> Any:
+    """Resolve a dotted path ``pkg.mod.attr`` to the attribute."""
+    if not any(path == p or path.startswith(p + ".") for p in _IMPORT_ALLOWLIST_PREFIXES):
+        raise ValueError(
+            f"_target_ {path!r} is outside the import allowlist {_IMPORT_ALLOWLIST_PREFIXES}"
+        )
+    parts = path.split(".")
+    # Longest importable module prefix, then getattr the rest.
+    for i in range(len(parts), 0, -1):
+        mod_path = ".".join(parts[:i])
+        try:
+            obj = importlib.import_module(mod_path)
+        except ModuleNotFoundError as e:
+            # Only tolerate "this prefix is not a module"; an ImportError
+            # raised while *executing* the module is a real failure.
+            if e.name is not None and (mod_path == e.name or mod_path.startswith(e.name + ".") or e.name.startswith(mod_path + ".")):
+                continue
+            raise
+        for attr in parts[i:]:
+            obj = getattr(obj, attr)
+        return obj
+    raise ImportError(f"Could not resolve _target_ {path!r}")
+
+
+class ConfigNode(Mapping):
+    """A nested attribute-accessible config tree.
+
+    ``node.key`` and ``node["key"]`` both work; missing keys raise
+    AttributeError/KeyError. ``get("a.b.c", default)`` walks dotted paths.
+    ``instantiate(**overrides)`` builds the object named by ``_target_``.
+    """
+
+    def __init__(self, data: dict | None = None):
+        object.__setattr__(self, "_data", {})
+        for k, v in (data or {}).items():
+            self._data[k] = self._wrap(v)
+
+    @staticmethod
+    def _wrap(v: Any) -> Any:
+        if isinstance(v, ConfigNode):
+            return v
+        if isinstance(v, dict):
+            return ConfigNode(v)
+        if isinstance(v, (list, tuple)):
+            return [ConfigNode._wrap(x) for x in v]
+        if isinstance(v, str) and "${" in v:
+            whole = _ENV_RE.fullmatch(v) is not None
+            expanded = _interp_env(v)
+            if whole and expanded != v:
+                # Only type-coerce a value that was entirely one interpolation,
+                # and only to scalars — "8080"→int, "true"→bool, but "a: b"
+                # stays the literal string it was in the environment.
+                parsed = translate_value(expanded)
+                return parsed if not isinstance(parsed, (dict, list)) else expanded
+            return expanded
+        return v
+
+    # -- mapping protocol ---------------------------------------------------
+    def __getitem__(self, key: str) -> Any:
+        return self._data[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key: object) -> bool:
+        return key in self._data
+
+    # -- attribute access ---------------------------------------------------
+    def __getattr__(self, key: str) -> Any:
+        if key.startswith("_"):
+            raise AttributeError(key)
+        try:
+            return self._data[key]
+        except KeyError:
+            raise AttributeError(f"Config has no key {key!r}; keys: {list(self._data)}")
+
+    def __setattr__(self, key: str, value: Any) -> None:
+        self._data[key] = self._wrap(value)
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        self._data[key] = self._wrap(value)
+
+    # -- dotted paths -------------------------------------------------------
+    def get(self, path: str, default: Any = None) -> Any:
+        node: Any = self
+        for part in path.split("."):
+            if isinstance(node, ConfigNode) and part in node._data:
+                node = node._data[part]
+            else:
+                return default
+        return node
+
+    def set_by_path(self, path: str, value: Any) -> None:
+        parts = path.split(".")
+        node = self
+        for part in parts[:-1]:
+            if part not in node._data or not isinstance(node._data[part], ConfigNode):
+                node._data[part] = ConfigNode()
+            node = node._data[part]
+        node._data[parts[-1]] = self._wrap(value)
+
+    def delete_by_path(self, path: str) -> None:
+        parts = path.split(".")
+        node = self
+        for part in parts[:-1]:
+            node = node._data[part]
+        del node._data[parts[-1]]
+
+    # -- conversion ---------------------------------------------------------
+    def to_dict(self) -> dict:
+        def unwrap(v: Any) -> Any:
+            if isinstance(v, ConfigNode):
+                return v.to_dict()
+            if isinstance(v, list):
+                return [unwrap(x) for x in v]
+            return v
+
+        return {k: unwrap(v) for k, v in self._data.items()}
+
+    def __repr__(self) -> str:
+        return f"ConfigNode({self.to_dict()!r})"
+
+    # -- instantiation ------------------------------------------------------
+    def instantiate(self, *args: Any, **overrides: Any) -> Any:
+        """Build the object described by this node's ``_target_``.
+
+        Sibling keys become kwargs; nested nodes with their own ``_target_``
+        are instantiated recursively unless the key is listed in
+        ``_no_instantiate_``. ``overrides`` win over config keys.
+        """
+        if "_target_" not in self._data:
+            raise ValueError(f"Node has no _target_: {self!r}")
+        target = self._data["_target_"]
+        fn = resolve_target(target) if isinstance(target, str) else target
+        no_inst = set(self._data.get("_no_instantiate_", []) or [])
+
+        def build(v: Any) -> Any:
+            if isinstance(v, ConfigNode) and "_target_" in v:
+                return v.instantiate()
+            if isinstance(v, list):
+                return [build(x) for x in v]
+            return v
+
+        kwargs: dict[str, Any] = {}
+        for k, v in self._data.items():
+            if k in ("_target_", "_no_instantiate_"):
+                continue
+            kwargs[k] = v if k in no_inst else build(v)
+        kwargs.update(overrides)
+        return fn(*args, **kwargs)
+
+    def maybe_instantiate(self, *args: Any, **overrides: Any) -> Any:
+        if "_target_" in self._data:
+            return self.instantiate(*args, **overrides)
+        return self
+
+
+def load_yaml_config(path: str | os.PathLike) -> ConfigNode:
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    return ConfigNode(raw)

@@ -1,0 +1,17 @@
+from automodel_tpu.parallel.mesh import (
+    LOGICAL_AXIS_RULES,
+    MeshAxisName,
+    MeshConfig,
+    MeshContext,
+    build_mesh,
+    initialize_distributed,
+)
+
+__all__ = [
+    "LOGICAL_AXIS_RULES",
+    "MeshAxisName",
+    "MeshConfig",
+    "MeshContext",
+    "build_mesh",
+    "initialize_distributed",
+]

@@ -1,0 +1,241 @@
+"""Device mesh construction and distributed context.
+
+Capability parity with the reference mesh layer
+(components/distributed/mesh.py:55-72, mesh_utils.py:46,190-228,302-334):
+canonical axis names, dp inference from world size, flattened axis groupings
+for param/loss sharding, and a MoE expert axis — but expressed TPU-natively.
+
+TPU-first design (NOT a port):
+
+* ONE `jax.sharding.Mesh` instead of the reference's separate 5-D dense mesh +
+  3-D MoE mesh.  Axis order (outer→inner) = ``(pp, dp_replicate, dp_shard,
+  ep, cp, tp)`` so that the most communication-intensive axes (tp, cp) map to
+  the innermost / fastest ICI dimensions. The reference's derived submeshes
+  (``dp``, ``dp_shard_cp``, ``dp_cp``, ``ep_shard``) become *logical axis
+  groupings* — tuples of mesh axes inside a PartitionSpec — because GSPMD
+  shards an array dim over the product of listed axes. No submesh objects,
+  no DTensor placements.
+
+* Expert parallelism is a factor of the data-shard product
+  (``dp_shard_total = dp_shard * ep``), mirroring the reference invariant
+  ``ep_shard = dp*cp/ep`` (mesh_utils.py:179-187): expert weights shard their
+  expert dim on ``ep`` and their FSDP dim on ``(dp_shard, cp)``; dense params
+  shard on ``(dp_shard, ep, cp)``; batches shard on
+  ``(dp_replicate, dp_shard, ep)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+class MeshAxisName:
+    """Canonical mesh axis names (reference: distributed/mesh.py:55-72)."""
+
+    PP = "pp"
+    DP_REPLICATE = "dp_replicate"
+    DP_SHARD = "dp_shard"
+    EP = "ep"
+    CP = "cp"
+    TP = "tp"
+
+    ALL = (PP, DP_REPLICATE, DP_SHARD, EP, CP, TP)
+
+
+# Logical axis → physical mesh axes. These are the reference's flattened
+# submeshes (mesh_utils.py:210-228) re-expressed as PartitionSpec groupings.
+LOGICAL_AXIS_RULES: dict[str, tuple[str, ...]] = {
+    "batch": (MeshAxisName.DP_REPLICATE, MeshAxisName.DP_SHARD, MeshAxisName.EP),
+    # param sharding dim ("fsdp"): the reference's dp_shard_cp submesh.
+    "fsdp": (MeshAxisName.DP_SHARD, MeshAxisName.EP, MeshAxisName.CP),
+    # loss all-reduce group: the reference's dp_cp submesh.
+    "loss_dp": (
+        MeshAxisName.DP_REPLICATE,
+        MeshAxisName.DP_SHARD,
+        MeshAxisName.EP,
+        MeshAxisName.CP,
+    ),
+    "seq": (MeshAxisName.CP,),
+    "tensor": (MeshAxisName.TP,),
+    "expert": (MeshAxisName.EP,),
+    # the reference's ep_shard: FSDP dim for expert weights.
+    "expert_fsdp": (MeshAxisName.DP_SHARD, MeshAxisName.CP),
+    "stage": (MeshAxisName.PP,),
+    "vocab": (MeshAxisName.TP,),
+    None: (),
+}
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Parallelism degrees. -1 for dp_shard means 'infer from world size'
+    (reference: mesh_utils.py:160-168)."""
+
+    dp_replicate: int = 1
+    dp_shard: int = -1  # total data-shard degree INCLUDING ep (dp_shard_total)
+    tp: int = 1
+    cp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    def validate(self, world_size: int) -> "MeshConfig":
+        cfg = dataclasses.replace(self)
+        known = cfg.dp_replicate * cfg.tp * cfg.cp * cfg.pp
+        if cfg.dp_shard == -1:
+            if world_size % known != 0:
+                raise ValueError(
+                    f"world_size {world_size} not divisible by dp_replicate*tp*cp*pp={known}"
+                )
+            cfg.dp_shard = world_size // known
+        total = known * cfg.dp_shard
+        if total != world_size:
+            raise ValueError(
+                f"Mesh degrees {cfg} product {total} != world size {world_size}"
+            )
+        if cfg.ep < 1 or cfg.dp_shard % cfg.ep != 0:
+            raise ValueError(
+                f"ep={cfg.ep} must divide dp_shard_total={cfg.dp_shard} "
+                f"(reference invariant ep_shard = dp*cp/ep, mesh_utils.py:179-187)"
+            )
+        return cfg
+
+
+class MeshContext:
+    """Single source of truth for distributed state (reference: mesh.py:79).
+
+    Wraps the jax Mesh plus the logical-axis mapping; all sharding rules in
+    the framework go through :meth:`resolve` / :meth:`sharding` so that a
+    logical spec like ``("fsdp", "tensor")`` is portable across mesh shapes.
+    """
+
+    def __init__(self, mesh: Mesh, config: MeshConfig):
+        self.mesh = mesh
+        self.config = config
+        self.rules = dict(LOGICAL_AXIS_RULES)
+
+    # -- sizes --------------------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return self.mesh.size
+
+    def size(self, axis: str) -> int:
+        return self.mesh.shape[axis]
+
+    @property
+    def dp_size(self) -> int:
+        return (
+            self.size(MeshAxisName.DP_REPLICATE)
+            * self.size(MeshAxisName.DP_SHARD)
+            * self.size(MeshAxisName.EP)
+        )
+
+    @property
+    def dp_cp_size(self) -> int:
+        return self.dp_size * self.size(MeshAxisName.CP)
+
+    @property
+    def tp_size(self) -> int:
+        return self.size(MeshAxisName.TP)
+
+    @property
+    def cp_size(self) -> int:
+        return self.size(MeshAxisName.CP)
+
+    @property
+    def pp_size(self) -> int:
+        return self.size(MeshAxisName.PP)
+
+    @property
+    def ep_size(self) -> int:
+        return self.size(MeshAxisName.EP)
+
+    # -- sharding -----------------------------------------------------------
+    def resolve(self, logical: Sequence[Any] | None) -> P:
+        """Map a logical spec (tuple of logical axis names / None / tuples of
+        logical names) to a physical PartitionSpec, dropping size-1 axes."""
+        if logical is None:
+            return P()
+        phys: list[Any] = []
+        for dim in logical:
+            names: list[str] = []
+            for lg in (dim if isinstance(dim, (tuple, list)) else (dim,)):
+                if lg is None:
+                    continue
+                for ax in self.rules[lg]:
+                    if self.mesh.shape[ax] > 1:
+                        names.append(ax)
+            if not names:
+                phys.append(None)
+            elif len(names) == 1:
+                phys.append(names[0])
+            else:
+                phys.append(tuple(names))
+        while phys and phys[-1] is None:
+            phys.pop()
+        return P(*phys)
+
+    def sharding(self, *logical: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, self.resolve(logical))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def __repr__(self) -> str:
+        return f"MeshContext(shape={dict(self.mesh.shape)})"
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    devices: Sequence[jax.Device] | None = None,
+    **degrees: int,
+) -> MeshContext:
+    """Build the device mesh (reference: create_device_mesh, mesh_utils.py:46).
+
+    The mesh axis ``dp_shard`` holds ``dp_shard_total // ep`` so the flat
+    product over ``(dp_shard, ep)`` equals the configured data-shard degree.
+    """
+    if config is None:
+        config = MeshConfig(**degrees)
+    devices = list(devices if devices is not None else jax.devices())
+    config = config.validate(len(devices))
+    shape = (
+        config.pp,
+        config.dp_replicate,
+        config.dp_shard // config.ep,
+        config.ep,
+        config.cp,
+        config.tp,
+    )
+    try:
+        from jax.experimental import mesh_utils as jmu
+
+        dev_array = jmu.create_device_mesh(shape, devices=devices)
+    except (ValueError, NotImplementedError, AssertionError) as e:
+        # CPU/host platforms without torus assignment. On real TPU this
+        # fallback loses topology-aware placement — make it loud.
+        logger.warning(
+            "create_device_mesh failed (%s); falling back to flat device order. "
+            "On TPU hardware this loses ICI-aware placement.", e
+        )
+        dev_array = np.array(devices).reshape(shape)
+    mesh = Mesh(dev_array, MeshAxisName.ALL)
+    logger.info("Built mesh %s", dict(mesh.shape))
+    return MeshContext(mesh, config)
+
+
+def initialize_distributed(**kwargs: Any) -> None:
+    """Multi-host init (reference: init_utils.py:90 NCCL init → here
+    `jax.distributed.initialize` over the TPU runtime; single-process is a
+    no-op because JAX is single-controller)."""
+    import os
+
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or kwargs.get("coordinator_address"):
+        jax.distributed.initialize(**kwargs)

@@ -1,0 +1,40 @@
+"""Test harness: 8 virtual CPU devices for SPMD tests.
+
+Mirrors the reference's test split (SURVEY.md §4): pure-Python unit tests on a
+fake mesh. 8 host devices exercise real dp/tp/cp/ep/pp SPMD semantics without
+TPU hardware — strictly more than the reference's 2-GPU cap.
+
+Note: this image's sitecustomize registers an `axon` TPU backend in every
+process and pins JAX_PLATFORMS=axon, so we cannot simply set JAX_PLATFORMS=cpu;
+instead we allow all platforms, force 8 host devices, and pin the default
+device to CPU.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = ""  # allow cpu alongside any preregistered backend
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+try:
+    _cpus = jax.devices("cpu")
+    jax.config.update("jax_default_device", _cpus[0])
+except RuntimeError:  # pragma: no cover - cpu always present
+    _cpus = jax.devices()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    assert len(_cpus) >= 8, f"expected 8 virtual CPU devices, got {len(_cpus)}"
+    return _cpus[:8]
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    return _cpus

@@ -1,0 +1,93 @@
+import os
+
+import pytest
+
+from automodel_tpu.config import ConfigNode, parse_cli_argv, parse_args_and_load_config
+
+
+def test_attr_and_item_access():
+    cfg = ConfigNode({"a": {"b": 1}, "c": [1, {"d": 2}]})
+    assert cfg.a.b == 1
+    assert cfg["a"]["b"] == 1
+    assert cfg.c[1].d == 2
+    assert cfg.get("a.b") == 1
+    assert cfg.get("a.missing", 42) == 42
+
+
+def test_set_by_path_and_delete():
+    cfg = ConfigNode({})
+    cfg.set_by_path("x.y.z", 3)
+    assert cfg.x.y.z == 3
+    cfg.delete_by_path("x.y.z")
+    assert cfg.get("x.y.z") is None
+
+
+def test_env_interpolation(monkeypatch):
+    monkeypatch.setenv("MY_TEST_VAR", "123")
+    cfg = ConfigNode({"a": "${MY_TEST_VAR}", "b": "${env:MY_TEST_VAR}", "c": "${NOPE:fallback}"})
+    assert cfg.a == 123
+    assert cfg.b == 123
+    assert cfg.c == "fallback"
+
+
+def test_instantiate_target():
+    cfg = ConfigNode({"_target_": "builtins.dict", "a": 1, "b": {"c": 2}})
+    out = cfg.instantiate()
+    assert out["a"] == 1
+    assert out["b"]["c"] == 2
+
+
+def test_instantiate_nested_target():
+    cfg = ConfigNode(
+        {"_target_": "builtins.dict", "inner": {"_target_": "builtins.list"}}
+    )
+    out = cfg.instantiate()
+    assert out["inner"] == []
+
+
+def test_instantiate_allowlist():
+    cfg = ConfigNode({"_target_": "os.system", "command": "true"})
+    with pytest.raises(ValueError):
+        cfg.instantiate()
+
+
+def test_cli_overrides(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text("model:\n  lr: 1.0\n  name: foo\nkeep: 1\n")
+    cfg = parse_args_and_load_config(
+        ["-c", str(p), "--model.lr=2.5", "--model.extra", "7", "--flag", "--del", "keep"]
+    )
+    assert cfg.model.lr == 2.5
+    assert cfg.model.extra == 7
+    assert cfg.flag is True
+    assert cfg.get("keep") is None
+
+
+def test_env_interpolation_stays_scalar(monkeypatch):
+    monkeypatch.setenv("COLONV", "a: b")
+    monkeypatch.setenv("PORTV", "8080")
+    cfg = ConfigNode({"x": "${COLONV}", "z": "lr_${COLONV}", "p": "${PORTV}"})
+    assert cfg.x == "a: b" and cfg.z == "lr_a: b" and cfg.p == 8080
+
+
+def test_flag_before_config():
+    path, ov, _ = parse_cli_argv(["--verbose", "-c", "cfg.yaml"])
+    assert path == "cfg.yaml" and ("verbose", "true") in ov
+
+
+def test_dangling_option_errors():
+    with pytest.raises(ValueError, match="requires an argument"):
+        parse_cli_argv(["-c"])
+
+
+def test_instantiate_inside_lists():
+    out = ConfigNode(
+        {"_target_": "builtins.dict", "items": [{"_target_": "builtins.list"}]}
+    ).instantiate()
+    assert out["items"] == [[]]
+
+
+def test_parse_cli_argv_forms():
+    path, ov, dels = parse_cli_argv(["--a.b=1", "--c", "x", "--d"])
+    assert path is None
+    assert ("a.b", "1") in ov and ("c", "x") in ov and ("d", "true") in ov

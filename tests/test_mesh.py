@@ -1,0 +1,52 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from automodel_tpu.parallel import MeshConfig, build_mesh
+
+
+def test_infer_dp_shard(devices8):
+    ctx = build_mesh(MeshConfig(tp=2), devices=devices8)
+    assert ctx.size("dp_shard") == 4
+    assert ctx.tp_size == 2
+    assert ctx.world_size == 8
+
+
+def test_full_degrees(devices8):
+    ctx = build_mesh(MeshConfig(pp=2, tp=2, cp=1, dp_shard=2), devices=devices8)
+    assert ctx.pp_size == 2 and ctx.dp_size == 2
+
+
+def test_ep_factorization(devices8):
+    ctx = build_mesh(MeshConfig(dp_shard=8, ep=4), devices=devices8)
+    assert ctx.size("dp_shard") == 2 and ctx.ep_size == 4
+    assert ctx.dp_size == 8  # ep devices still contribute to data parallel
+    # expert weights shard expert dim on ep, fsdp dim on (dp_shard, cp)
+    assert ctx.resolve(("expert", "expert_fsdp")) == P("ep", "dp_shard")
+
+
+def test_invalid_ep(devices8):
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(dp_shard=8, ep=3), devices=devices8)
+
+
+def test_resolve_drops_unit_axes(devices8):
+    ctx = build_mesh(MeshConfig(tp=2), devices=devices8)  # cp=1, ep=1
+    spec = ctx.resolve(("batch", "seq", None))
+    assert spec == P("dp_shard")  # dp_replicate=1, ep=1, cp=1 dropped
+    spec2 = ctx.resolve(("fsdp", "tensor"))
+    assert spec2 == P("dp_shard", "tp")
+
+
+def test_loss_dp_grouping(devices8):
+    ctx = build_mesh(MeshConfig(dp_shard=2, cp=2, tp=2), devices=devices8)
+    assert ctx.resolve(("loss_dp",)) == P(("dp_shard", "cp"))
+    assert ctx.dp_cp_size == 4
+
+
+def test_sharded_array_placement(devices8):
+    ctx = build_mesh(MeshConfig(dp_shard=4, tp=2), devices=devices8)
+    x = np.zeros((8, 16), dtype=np.float32)
+    arr = jax.device_put(x, ctx.sharding("batch", "tensor"))
+    assert arr.sharding.spec == P("dp_shard", "tp")
