@@ -1,0 +1,124 @@
+"""Generic dense-transformer config + per-module kernel backend selection.
+
+Parity: the reference's `BackendConfig` (components/models/common/utils.py:139)
+selects per-module kernels (attn ∈ {te, sdpa, flex}, linear, rms_norm,
+experts, dispatcher). TPU equivalents: attn ∈ {sdpa, flash, ring}, rms_norm ∈
+{xla}, plus XLA-level knobs the reference expresses through torch.compile
+(remat policy, scan over layers, dtypes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from automodel_tpu.ops.rope import RopeConfig
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+def dtype_from_str(s: str | Any) -> Any:
+    """Parity: shared/utils.py dtype_from_str."""
+    if not isinstance(s, str):
+        return s
+    return _DTYPES[s.replace("torch.", "").replace("jnp.", "")]
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendConfig:
+    """Per-module kernel/backing choices (reference: common/utils.py:98-225)."""
+
+    attn: str = "flash"  # sdpa | flash | ring
+    rms_norm: str = "xla"
+    experts: str = "ragged_dot"  # ragged_dot | dense_einsum (MoE models)
+    dispatcher: str = "gspmd"  # gspmd | a2a (MoE token routing)
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    remat: str = "none"  # none | full | selective
+    scan_layers: bool = True
+    attn_block_q: int = 512
+    attn_block_kv: int = 512
+
+    def __post_init__(self):
+        if self.attn not in ("sdpa", "flash", "ring"):
+            raise ValueError(f"Unknown attn backend {self.attn!r}")
+        if self.remat not in ("none", "full", "selective"):
+            raise ValueError(f"Unknown remat policy {self.remat!r}")
+
+    @property
+    def param_jnp_dtype(self):
+        return dtype_from_str(self.param_dtype)
+
+    @property
+    def compute_jnp_dtype(self):
+        return dtype_from_str(self.compute_dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Llama-family dense transformer hyperparameters, HF-ingestible."""
+
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope: RopeConfig = RopeConfig()
+    rms_eps: float = 1e-6
+    max_position_embeddings: int = 8192
+    tie_embeddings: bool = False
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    qk_norm: bool = False  # qwen3-style per-head RMSNorm on q/k
+    act: str = "silu"
+    embed_scale: float = 1.0  # gemma multiplies embeddings by sqrt(hidden)
+    logits_soft_cap: Optional[float] = None
+    attn_soft_cap: Optional[float] = None
+    sliding_window: Optional[int] = None
+    attn_scale: Optional[float] = None  # override 1/sqrt(head_dim)
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "TransformerConfig":
+        """Ingest an HF transformers config (LlamaConfig/Qwen2Config/...)."""
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        heads = get("num_attention_heads")
+        hidden = get("hidden_size")
+        model_type = get("model_type", "llama")
+        return cls(
+            vocab_size=get("vocab_size"),
+            hidden_size=hidden,
+            intermediate_size=get("intermediate_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=heads,
+            num_kv_heads=get("num_key_value_heads", heads),
+            head_dim=get("head_dim") or hidden // heads,
+            rope=RopeConfig.from_hf(hf_cfg),
+            rms_eps=get("rms_norm_eps", 1e-6),
+            max_position_embeddings=get("max_position_embeddings", 8192),
+            tie_embeddings=bool(get("tie_word_embeddings", False)),
+            attention_bias=bool(
+                get("attention_bias", model_type in ("qwen2",))
+            ),
+            mlp_bias=bool(get("mlp_bias", False)),
+            qk_norm=model_type in ("qwen3", "qwen3_moe"),
+            act=get("hidden_act", "silu"),
+            sliding_window=get("sliding_window", None) if get("use_sliding_window", False) else None,
+        )
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim

@@ -1,0 +1,20 @@
+from automodel_tpu.models.llama.model import (
+    LlamaForCausalLM,
+    SHARDING_RULES,
+    forward,
+    forward_hidden,
+    init_params,
+)
+from automodel_tpu.models.llama.state_dict_adapter import LlamaStateDictAdapter
+
+ModelClass = LlamaForCausalLM
+
+__all__ = [
+    "LlamaForCausalLM",
+    "LlamaStateDictAdapter",
+    "ModelClass",
+    "SHARDING_RULES",
+    "forward",
+    "forward_hidden",
+    "init_params",
+]

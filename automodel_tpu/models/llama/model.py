@@ -1,0 +1,249 @@
+"""Dense Llama-family causal LM, TPU-native.
+
+Covers the reference's dense families llama/qwen2/qwen3
+(components/models/llama/model.py:526, qwen2, qwen3 — config flags select
+attention bias / qk-norm / tied embeddings) as ONE functional implementation:
+
+- params are a plain pytree; every per-layer leaf is stacked on a leading
+  layer axis so the whole decoder runs under `lax.scan` (one XLA While op —
+  constant compile time in depth, PP-splittable by slicing the layer axis);
+- compute follows BackendConfig (attention backend, remat policy, dtypes);
+- parallelism is applied from outside via sharding rules on param paths and
+  an activation-constraint callback — the model stays pure (the reference
+  enforces the same split: model code pure torch, parallelism in config,
+  README.md:59-66).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.ops.attention import attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, rope_table
+
+Constrain = Callable[[jnp.ndarray, tuple], jnp.ndarray]
+_noop_constrain: Constrain = lambda x, spec: x
+
+ACT_FNS = {
+    "silu": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu2": lambda x: jnp.square(jax.nn.relu(x)),
+}
+
+
+def _dense_init(key, shape, dtype, in_axis: int = 0):
+    fan_in = shape[in_axis]
+    return jax.random.normal(key, shape, dtype=jnp.float32).astype(dtype) / jnp.sqrt(
+        jnp.asarray(fan_in, jnp.float32)
+    ).astype(dtype)
+
+
+def init_params(cfg: TransformerConfig, backend: BackendConfig, key: jax.Array) -> dict:
+    """Random init (pretraining); layer leaves stacked [L, ...]."""
+    pd = backend.param_jnp_dtype
+    L, D, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    keys = jax.random.split(key, 10)
+
+    def stack(k, shape, in_axis=0):
+        return _dense_init(k, (L, *shape), pd, in_axis=in_axis + 1)
+
+    layers = {
+        "attn": {
+            "q_proj": {"kernel": stack(keys[0], (D, cfg.q_dim))},
+            "k_proj": {"kernel": stack(keys[1], (D, cfg.kv_dim))},
+            "v_proj": {"kernel": stack(keys[2], (D, cfg.kv_dim))},
+            "o_proj": {"kernel": stack(keys[3], (cfg.q_dim, D))},
+        },
+        "mlp": {
+            "gate_proj": {"kernel": stack(keys[4], (D, I))},
+            "up_proj": {"kernel": stack(keys[5], (D, I))},
+            "down_proj": {"kernel": stack(keys[6], (I, D))},
+        },
+        "input_norm": {"scale": jnp.ones((L, D), pd)},
+        "post_attn_norm": {"scale": jnp.ones((L, D), pd)},
+    }
+    if cfg.attention_bias:
+        layers["attn"]["q_proj"]["bias"] = jnp.zeros((L, cfg.q_dim), pd)
+        layers["attn"]["k_proj"]["bias"] = jnp.zeros((L, cfg.kv_dim), pd)
+        layers["attn"]["v_proj"]["bias"] = jnp.zeros((L, cfg.kv_dim), pd)
+    if cfg.mlp_bias:
+        layers["mlp"]["gate_proj"]["bias"] = jnp.zeros((L, I), pd)
+        layers["mlp"]["up_proj"]["bias"] = jnp.zeros((L, I), pd)
+        layers["mlp"]["down_proj"]["bias"] = jnp.zeros((L, D), pd)
+    if cfg.qk_norm:
+        layers["attn"]["q_norm"] = {"scale": jnp.ones((L, cfg.head_dim), pd)}
+        layers["attn"]["k_norm"] = {"scale": jnp.ones((L, cfg.head_dim), pd)}
+    params = {
+        "embed": {"embedding": jax.random.normal(keys[7], (cfg.vocab_size, D)).astype(pd) * 0.02},
+        "layers": layers,
+        "final_norm": {"scale": jnp.ones((D,), pd)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _dense_init(keys[8], (D, cfg.vocab_size), pd)}
+    return params
+
+
+def _proj(x: jnp.ndarray, p: dict) -> jnp.ndarray:
+    y = x @ p["kernel"].astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def decoder_layer(
+    cfg: TransformerConfig,
+    backend: BackendConfig,
+    h: jnp.ndarray,
+    lp: dict,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    segment_ids: Optional[jnp.ndarray],
+    constrain: Constrain,
+) -> jnp.ndarray:
+    B, S, D = h.shape
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
+    q = _proj(x, lp["attn"]["q_proj"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = _proj(x, lp["attn"]["k_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = _proj(x, lp["attn"]["v_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["attn"]["q_norm"]["scale"], cfg.rms_eps)
+        k = rms_norm(k, lp["attn"]["k_norm"]["scale"], cfg.rms_eps)
+    q, k = apply_rope(q, k, cos, sin)
+    attn_out = attention(
+        q,
+        k,
+        v,
+        backend=backend.attn,
+        causal=True,
+        scale=cfg.attn_scale,
+        segment_ids=segment_ids,
+        logits_soft_cap=cfg.attn_soft_cap,
+        sliding_window=cfg.sliding_window,
+        **(
+            {"block_q": backend.attn_block_q, "block_kv": backend.attn_block_kv}
+            if backend.attn == "flash"
+            else {}
+        ),
+    )
+    h = h + _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"])
+    h = constrain(h, ("batch", "seq", None))
+    x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+    act = ACT_FNS[cfg.act]
+    mlp = _proj(act(_proj(x, lp["mlp"]["gate_proj"])) * _proj(x, lp["mlp"]["up_proj"]), lp["mlp"]["down_proj"])
+    h = h + mlp
+    return constrain(h, ("batch", "seq", None))
+
+
+def forward_hidden(
+    cfg: TransformerConfig,
+    backend: BackendConfig,
+    params: dict,
+    input_ids: jnp.ndarray,
+    position_ids: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    constrain: Constrain = _noop_constrain,
+) -> jnp.ndarray:
+    """Embed + decoder stack → final-norm hidden states [B, S, D]."""
+    cd = backend.compute_jnp_dtype
+    if position_ids is None:
+        position_ids = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
+        position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
+    h = params["embed"]["embedding"].astype(cd)[input_ids]
+    if cfg.embed_scale != 1.0:
+        h = h * jnp.asarray(cfg.embed_scale, cd)
+    h = constrain(h, ("batch", "seq", None))
+    cos, sin = rope_table(position_ids, cfg.head_dim, cfg.rope)
+
+    def layer_fn(carry, lp):
+        out = decoder_layer(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
+        return out, None
+
+    if backend.remat == "full":
+        layer_fn = jax.checkpoint(layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
+    elif backend.remat == "selective":
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+
+    if backend.scan_layers:
+        h, _ = jax.lax.scan(layer_fn, h, params["layers"])
+    else:
+        L = cfg.num_layers
+        for i in range(L):
+            lp = jax.tree.map(lambda x: x[i], params["layers"])
+            h, _ = layer_fn(h, lp)
+    return rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
+
+
+def lm_head_kernel(cfg: TransformerConfig, params: dict) -> jnp.ndarray:
+    if cfg.tie_embeddings:
+        return params["embed"]["embedding"].T
+    return params["lm_head"]["kernel"]
+
+
+def forward(
+    cfg: TransformerConfig,
+    backend: BackendConfig,
+    params: dict,
+    input_ids: jnp.ndarray,
+    position_ids: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    constrain: Constrain = _noop_constrain,
+) -> jnp.ndarray:
+    """Full forward → logits [B, S, V] (compute dtype)."""
+    h = forward_hidden(cfg, backend, params, input_ids, position_ids, segment_ids, constrain)
+    logits = h @ lm_head_kernel(cfg, params).astype(h.dtype)
+    if cfg.logits_soft_cap is not None:
+        logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
+    return constrain(logits, ("batch", "seq", "vocab"))
+
+
+# -- sharding rules ---------------------------------------------------------
+# Logical dim specs per param-path regex; resolved against the MeshContext by
+# automodel_tpu.parallel.plans. This is the reference's "TP plan" concept
+# (distributed/optimized_tp_plans.py) as pure annotation.
+SHARDING_RULES: list[tuple[str, tuple]] = [
+    (r"embed/embedding$", ("tensor", "fsdp")),
+    (r"layers/attn/[qkv]_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"layers/attn/[qkv]_proj/bias$", (None, "tensor")),
+    (r"layers/attn/o_proj/kernel$", (None, "tensor", "fsdp")),
+    (r"layers/attn/[qk]_norm/scale$", (None, None)),
+    (r"layers/mlp/(gate|up)_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"layers/mlp/(gate|up)_proj/bias$", (None, "tensor")),
+    (r"layers/mlp/down_proj/kernel$", (None, "tensor", "fsdp")),
+    (r"layers/mlp/down_proj/bias$", (None, None)),
+    (r"layers/.*norm/scale$", (None, "fsdp")),
+    (r"final_norm/scale$", ("fsdp",)),
+    (r"lm_head/kernel$", ("fsdp", "tensor")),
+]
+
+
+@dataclasses.dataclass
+class LlamaForCausalLM:
+    """Bundled config + backend with the functional API underneath."""
+
+    config: TransformerConfig
+    backend: BackendConfig = BackendConfig()
+
+    def init(self, key: jax.Array) -> dict:
+        return init_params(self.config, self.backend, key)
+
+    def __call__(self, params: dict, input_ids: jnp.ndarray, **kw: Any) -> jnp.ndarray:
+        return forward(self.config, self.backend, params, input_ids, **kw)
+
+    def hidden(self, params: dict, input_ids: jnp.ndarray, **kw: Any) -> jnp.ndarray:
+        return forward_hidden(self.config, self.backend, params, input_ids, **kw)
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        return lm_head_kernel(self.config, params)
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return SHARDING_RULES

@@ -1,0 +1,154 @@
+"""HF ⇄ native state-dict adapter for the dense Llama family.
+
+Parity: the reference gives every model family a StateDictAdapter
+(components/checkpoint/state_dict_adapter.py:22) translating between HF
+per-layer keys and the native layout. Differences here are TPU-native by
+design:
+
+- native kernels are [in, out] (x @ W) → HF torch Linear weights [out, in]
+  are transposed;
+- per-layer leaves are STACKED on a leading layer axis (for lax.scan), so
+  ``model.layers.{i}.self_attn.q_proj.weight`` maps to row i of
+  ``layers/attn/q_proj/kernel``.
+
+The adapter exposes a per-leaf key plan so the checkpoint layer can stream
+shard-by-shard without materializing the whole model on host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.common.config import TransformerConfig
+
+Transform = Callable[[np.ndarray], np.ndarray]
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+def _id(x: np.ndarray) -> np.ndarray:
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafPlan:
+    """How one native leaf maps onto HF keys.
+
+    hf_key: template with ``{i}`` for the layer index when stacked.
+    transform: HF tensor → native tensor (e.g. transpose); invert for save.
+    stacked: leaf carries a leading layer axis assembled from per-layer keys.
+    """
+
+    path: tuple[str, ...]
+    hf_key: str
+    transform: Transform
+    inverse: Transform
+    stacked: bool = False
+
+
+class LlamaStateDictAdapter:
+    """Key plan for llama/qwen2/qwen3-style HF checkpoints."""
+
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+
+    def leaf_plans(self) -> list[LeafPlan]:
+        c = self.config
+        plans: list[LeafPlan] = [
+            LeafPlan(("embed", "embedding"), "model.embed_tokens.weight", _id, _id),
+            LeafPlan(("final_norm", "scale"), "model.norm.weight", _id, _id),
+        ]
+        if not c.tie_embeddings:
+            plans.append(LeafPlan(("lm_head", "kernel"), "lm_head.weight", _t, _t))
+        L = [("attn", "q_proj"), ("attn", "k_proj"), ("attn", "v_proj"), ("attn", "o_proj"),
+             ("mlp", "gate_proj"), ("mlp", "up_proj"), ("mlp", "down_proj")]
+        hf_mod = {
+            "q_proj": "self_attn.q_proj", "k_proj": "self_attn.k_proj",
+            "v_proj": "self_attn.v_proj", "o_proj": "self_attn.o_proj",
+            "gate_proj": "mlp.gate_proj", "up_proj": "mlp.up_proj",
+            "down_proj": "mlp.down_proj",
+        }
+        for grp, name in L:
+            plans.append(
+                LeafPlan(
+                    ("layers", grp, name, "kernel"),
+                    f"model.layers.{{i}}.{hf_mod[name]}.weight",
+                    _t, _t, stacked=True,
+                )
+            )
+            has_bias = (grp == "attn" and name != "o_proj" and c.attention_bias) or (
+                grp == "mlp" and c.mlp_bias
+            )
+            if has_bias:
+                plans.append(
+                    LeafPlan(
+                        ("layers", grp, name, "bias"),
+                        f"model.layers.{{i}}.{hf_mod[name]}.bias",
+                        _id, _id, stacked=True,
+                    )
+                )
+        plans.append(
+            LeafPlan(("layers", "input_norm", "scale"),
+                     "model.layers.{i}.input_layernorm.weight", _id, _id, stacked=True)
+        )
+        plans.append(
+            LeafPlan(("layers", "post_attn_norm", "scale"),
+                     "model.layers.{i}.post_attention_layernorm.weight", _id, _id, stacked=True)
+        )
+        if c.qk_norm:
+            plans.append(LeafPlan(("layers", "attn", "q_norm", "scale"),
+                                  "model.layers.{i}.self_attn.q_norm.weight", _id, _id, stacked=True))
+            plans.append(LeafPlan(("layers", "attn", "k_norm", "scale"),
+                                  "model.layers.{i}.self_attn.k_norm.weight", _id, _id, stacked=True))
+        return plans
+
+    # -- load ---------------------------------------------------------------
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        """Assemble the native param tree by pulling HF tensors on demand.
+
+        ``get_tensor(hf_key)`` may stream from safetensors shards; stacked
+        leaves are assembled layer by layer.
+        """
+        out: dict = {}
+        for plan in self.leaf_plans():
+            if plan.stacked:
+                rows = [
+                    plan.transform(get_tensor(plan.hf_key.format(i=i)))
+                    for i in range(self.config.num_layers)
+                ]
+                leaf = np.stack(rows, axis=0)
+            else:
+                leaf = plan.transform(get_tensor(plan.hf_key))
+            node = out
+            for k in plan.path[:-1]:
+                node = node.setdefault(k, {})
+            node[plan.path[-1]] = leaf
+        return out
+
+    # -- save ---------------------------------------------------------------
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        """Yield (hf_key, tensor) pairs from the native tree."""
+        for plan in self.leaf_plans():
+            node = params
+            for k in plan.path:
+                node = node[k]
+            leaf = np.asarray(node)
+            if plan.stacked:
+                for i in range(self.config.num_layers):
+                    yield plan.hf_key.format(i=i), plan.inverse(leaf[i])
+            else:
+                yield plan.hf_key, plan.inverse(leaf)
+
+    def hf_keys(self) -> list[str]:
+        keys = []
+        for plan in self.leaf_plans():
+            if plan.stacked:
+                keys.extend(plan.hf_key.format(i=i) for i in range(self.config.num_layers))
+            else:
+                keys.append(plan.hf_key)
+        return keys

@@ -1,0 +1,187 @@
+"""Attention backends.
+
+Parity: the reference switches attn ∈ {te, sdpa, flex} per model
+(components/attention/utils.py:25-65). TPU-native backends:
+
+- ``"sdpa"``  — pure-XLA scaled dot-product attention (always available;
+  reference-quality numerics; used on CPU tests).
+- ``"flash"`` — Pallas TPU flash attention (jax.experimental.pallas.ops.tpu),
+  the MXU-tiled kernel path. Falls back to sdpa off-TPU.
+- ``"ring"``  — context-parallel ring attention over the ``cp`` mesh axis
+  (automodel_tpu.parallel.cp), selected by the parallelism layer.
+
+All backends take BSNH layout (batch, seq, heads, head_dim) and support GQA
+via n_kv_heads < n_heads, causal masking, and optional segment ids for packed
+(THD-equivalent) sequences — the reference handles packed sequences via TE THD
+kernels (cp_utils.py:187-337); here segment ids express the same block-causal
+structure.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[B, S, N_kv, H] → [B, S, N_kv*n_rep, H] (GQA expansion)."""
+    if n_rep == 1:
+        return x
+    b, s, nkv, h = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, nkv, n_rep, h)).reshape(
+        b, s, nkv * n_rep, h
+    )
+
+
+def sdpa(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """XLA scaled dot-product attention. q: [B,S,N,H], k/v: [B,S,Nkv,H]."""
+    b, sq, n, h = q.shape
+    n_kv = k.shape[2]
+    k = repeat_kv(k, n // n_kv)
+    v = repeat_kv(v, n // n_kv)
+    scale = scale if scale is not None else 1.0 / (h**0.5)
+    logits = jnp.einsum("bqnh,bknh->bnqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+    sk = k.shape[1]
+    mask = jnp.ones((sq, sk), dtype=bool)
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+    if sliding_window is not None:
+        pos_q = jnp.arange(sq)[:, None] + (sk - sq)
+        pos_k = jnp.arange(sk)[None, :]
+        mask = mask & (pos_q - pos_k < sliding_window)
+    mask = mask[None, None]
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = mask & seg
+    logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "logits_soft_cap", "sliding_window", "block_q", "block_kv"),
+)
+def _pallas_flash(
+    q, k, v, segment_ids, *, causal, scale, logits_soft_cap, sliding_window, block_q, block_kv
+):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention,
+        SegmentIds,
+    )
+
+    # pallas kernel wants BNSH layout
+    qt = q.transpose(0, 2, 1, 3)
+    n, n_kv = q.shape[2], k.shape[2]
+    kt = repeat_kv(k, n // n_kv).transpose(0, 2, 1, 3)
+    vt = repeat_kv(v, n // n_kv).transpose(0, 2, 1, 3)
+    seg = SegmentIds(q=segment_ids, kv=segment_ids) if segment_ids is not None else None
+    sq, skv = qt.shape[2], kt.shape[2]
+    bs = BlockSizes(
+        block_q=min(block_q, sq),
+        block_k_major=min(block_kv, skv),
+        block_k=min(block_kv, skv),
+        block_b=1,
+        block_q_major_dkv=min(block_q, sq),
+        block_k_major_dkv=min(block_kv, skv),
+        block_k_dkv=min(block_kv, skv),
+        block_q_dkv=min(block_q, sq),
+        block_k_major_dq=min(block_kv, skv),
+        block_k_dq=min(block_kv, skv),
+        block_q_dq=min(block_q, sq),
+    )
+    out = flash_attention(
+        qt, kt, vt, segment_ids=seg, causal=causal, sm_scale=scale, block_sizes=bs
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def flash(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jnp.ndarray:
+    """Pallas TPU flash attention; transparently falls back to sdpa when the
+    kernel does not apply (non-TPU backend, soft cap, sliding window, or
+    head_dim not MXU-tileable)."""
+    h = q.shape[-1]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if (
+        not on_tpu
+        or logits_soft_cap is not None
+        or sliding_window is not None
+        or h % 128 != 0
+        or q.shape[1] % 128 != 0
+    ):
+        return sdpa(
+            q,
+            k,
+            v,
+            causal=causal,
+            scale=scale,
+            segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap,
+            sliding_window=sliding_window,
+        )
+    scale = scale if scale is not None else 1.0 / (h**0.5)
+    return _pallas_flash(
+        q,
+        k,
+        v,
+        segment_ids,
+        causal=causal,
+        scale=scale,
+        logits_soft_cap=logits_soft_cap,
+        sliding_window=sliding_window,
+        block_q=block_q,
+        block_kv=block_kv,
+    )
+
+
+ATTENTION_BACKENDS = {
+    "sdpa": sdpa,
+    "flash": flash,
+}
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    backend: str = "sdpa",
+    **kwargs,
+) -> jnp.ndarray:
+    try:
+        fn = ATTENTION_BACKENDS[backend]
+    except KeyError:
+        raise ValueError(
+            f"Unknown attention backend {backend!r}; available: {sorted(ATTENTION_BACKENDS)}"
+        )
+    return fn(q, k, v, **kwargs)

@@ -1,0 +1,143 @@
+"""Loss functions.
+
+Parity with the reference loss zoo (components/loss/): MaskedCrossEntropy
+(masked_ce.py:22), ChunkedCrossEntropy (chunked_ce.py:43), and
+FusedLinearCrossEntropy (linear_ce.py:119 — cut-cross-entropy that never
+materializes full logits). TPU-native formulations:
+
+- masked CE: one fused XLA softmax-CE over fp32 logits.
+- chunked CE: lax.scan over vocab— no wait, over sequence chunks, so the
+  [tokens, vocab] logits buffer never exceeds chunk_size×vocab.
+- linear CE: the chunked formulation but taking hidden states + lm_head and
+  doing the final projection inside the chunk loop — the memory win of
+  cut-cross-entropy without a custom kernel, letting XLA fuse projection and
+  log-softmax per chunk.
+
+All losses return (summed_loss, num_valid_tokens) so callers can normalize
+globally across the dp_cp mesh group (reference: reduce_loss,
+distributed/utils.py:185) — per-token mean requires the GLOBAL token count.
+
+Labels use the HF convention: ignore_index (-100) marks padding; callers
+pre-shift labels for next-token prediction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def _ce_sum(logits: jnp.ndarray, labels: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Summed CE over valid tokens. logits [T, V] (any float dtype), labels [T]."""
+    valid = labels != IGNORE_INDEX
+    safe_labels = jnp.where(valid, labels, 0)
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits32, axis=-1)
+    picked = jnp.take_along_axis(logits32, safe_labels[:, None], axis=-1)[:, 0]
+    loss = jnp.where(valid, lse - picked, 0.0)
+    return loss.sum(), valid.sum()
+
+
+def masked_cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(sum_loss, n_valid). logits [..., V], labels [...]."""
+    v = logits.shape[-1]
+    return _ce_sum(logits.reshape(-1, v), labels.reshape(-1))
+
+
+def chunked_cross_entropy(
+    logits: jnp.ndarray, labels: jnp.ndarray, num_chunks: int = 8
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """CE over token chunks; bounds the fp32 logits working set.
+
+    Token count must be divisible by num_chunks (pad batches accordingly).
+    """
+    v = logits.shape[-1]
+    flat_logits = logits.reshape(-1, v)
+    flat_labels = labels.reshape(-1)
+    t = flat_logits.shape[0]
+    if t % num_chunks != 0:
+        return _ce_sum(flat_logits, flat_labels)
+    flat_logits = flat_logits.reshape(num_chunks, t // num_chunks, v)
+    flat_labels = flat_labels.reshape(num_chunks, t // num_chunks)
+
+    def body(carry, chunk):
+        lg, lb = chunk
+        s, n = _ce_sum(lg, lb)
+        return (carry[0] + s, carry[1] + n), None
+
+    (loss, n), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), (flat_logits, flat_labels))
+    return loss, n
+
+
+def fused_linear_cross_entropy(
+    hidden: jnp.ndarray,
+    lm_head_kernel: jnp.ndarray,
+    labels: jnp.ndarray,
+    num_chunks: int = 16,
+    logits_soft_cap: float | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """CE from hidden states + lm_head without materializing [T, V] logits.
+
+    hidden [..., D], lm_head_kernel [D, V], labels [...]. The projection runs
+    inside the chunk scan so peak memory is chunk×V (reference capability:
+    FusedLinearCrossEntropy via cut-cross-entropy, loss/linear_ce.py:119).
+    """
+    d = hidden.shape[-1]
+    flat_h = hidden.reshape(-1, d)
+    flat_labels = labels.reshape(-1)
+    t = flat_h.shape[0]
+    if t % num_chunks != 0:
+        num_chunks = 1
+    flat_h = flat_h.reshape(num_chunks, t // num_chunks, d)
+    flat_labels = flat_labels.reshape(num_chunks, t // num_chunks)
+
+    def body(carry, chunk):
+        h, lb = chunk
+        logits = h @ lm_head_kernel
+        if logits_soft_cap is not None:
+            logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+        s, n = _ce_sum(logits, lb)
+        return (carry[0] + s, carry[1] + n), None
+
+    (loss, n), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), (flat_h, flat_labels))
+    return loss, n
+
+
+def kd_loss(
+    student_logits: jnp.ndarray,
+    teacher_logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    temperature: float = 1.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward-KL knowledge distillation (reference: loss/kd_loss.py:21).
+
+    Returns (sum over valid tokens of KL(teacher || student), n_valid).
+    """
+    v = student_logits.shape[-1]
+    s = student_logits.reshape(-1, v).astype(jnp.float32) / temperature
+    t = teacher_logits.reshape(-1, v).astype(jnp.float32) / temperature
+    lb = labels.reshape(-1)
+    valid = lb != IGNORE_INDEX
+    t_logp = jax.nn.log_softmax(t, axis=-1)
+    s_logp = jax.nn.log_softmax(s, axis=-1)
+    kl = jnp.sum(jnp.exp(t_logp) * (t_logp - s_logp), axis=-1) * (temperature**2)
+    return jnp.where(valid, kl, 0.0).sum(), valid.sum()
+
+
+LOSS_REGISTRY = {
+    "masked_ce": masked_cross_entropy,
+    "chunked_ce": chunked_cross_entropy,
+    "fused_linear_ce": fused_linear_cross_entropy,
+    "kd": kd_loss,
+}
+
+
+def build_loss(name: str = "masked_ce", **kwargs):
+    fn = LOSS_REGISTRY[name]
+    return functools.partial(fn, **kwargs) if kwargs else fn

@@ -1,0 +1,30 @@
+"""RMSNorm.
+
+Parity: reference selects rms_norm ∈ {torch, torch_fp32, te} per model
+(components/models/common/utils.py:139). Here the XLA formulation is the
+default — XLA fuses it into neighbouring ops, which is what TE's fused kernel
+buys on GPU — with fp32 accumulation always on (the `torch_fp32` behavior).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """RMSNorm with fp32 accumulation, cast back to x.dtype."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(dtype)
+
+
+def rms_norm_gemma(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """Gemma-style RMSNorm: (1 + scale) multiplier, fp32 accumulation."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * (1.0 + scale.astype(jnp.float32))).astype(dtype)
