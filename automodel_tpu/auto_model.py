@@ -1,0 +1,125 @@
+"""AutoModel entry points.
+
+Parity: NeMoAutoModelForCausalLM.from_pretrained/from_config
+(_transformers/auto_model.py:582,339,479) — drop-in HF-style constructors
+that ALSO apply the model infrastructure (sharding plan, dtype policy,
+checkpoint streaming). TPU-native flow (SURVEY.md §3.4 simplified by
+single-controller):
+
+    from_pretrained(path, mesh) =
+        read HF config → registry → abstract init (eval_shape, no memory)
+        → param shardings from the family plan → stream safetensors leaves
+        → device_put each leaf to its target shard
+
+so a 70B model never materializes unsharded anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.registry import resolve_architecture
+from automodel_tpu.parallel.mesh import MeshContext
+from automodel_tpu.parallel.plans import make_constrain, make_param_shardings, shard_params
+
+
+@dataclasses.dataclass
+class AutoModel:
+    """A built model + its params + everything needed to train it."""
+
+    model: Any
+    params: Any
+    adapter: Any
+    mesh_ctx: Optional[MeshContext]
+
+    @property
+    def config(self):
+        return self.model.config
+
+    @property
+    def constrain(self):
+        return make_constrain(self.mesh_ctx)
+
+    def __call__(self, params: Any, *args: Any, **kw: Any):
+        return self.model(params, *args, constrain=self.constrain, **kw)
+
+
+def _read_hf_config(path: str | Path) -> dict:
+    cfg_file = Path(path) / "config.json"
+    if cfg_file.exists():
+        return json.loads(cfg_file.read_text())
+    # a transformers hub id — config resolution via transformers cache
+    from transformers import AutoConfig
+
+    return AutoConfig.from_pretrained(path).to_dict()
+
+
+def from_config(
+    hf_config: Any,
+    mesh_ctx: Optional[MeshContext] = None,
+    backend: BackendConfig | dict | None = None,
+    seed: int = 0,
+) -> AutoModel:
+    """Random-init (pretraining) constructor (reference: from_config,
+    auto_model.py:479). Params materialize directly sharded via jit+out_shardings."""
+    backend = _as_backend(backend)
+    builder = resolve_architecture(hf_config)
+    model, adapter = builder(hf_config, backend)
+    key = jax.random.key(seed)
+    if mesh_ctx is None:
+        params = model.init(key)
+    else:
+        shardings = make_param_shardings(
+            mesh_ctx, jax.eval_shape(model.init, key), model.sharding_rules
+        )
+        params = jax.jit(model.init, out_shardings=shardings)(key)
+    return AutoModel(model=model, params=params, adapter=adapter, mesh_ctx=mesh_ctx)
+
+
+def from_pretrained(
+    pretrained_model_name_or_path: str,
+    mesh_ctx: Optional[MeshContext] = None,
+    backend: BackendConfig | dict | None = None,
+) -> AutoModel:
+    """Load an HF checkpoint directory into a sharded native model
+    (reference: from_pretrained, auto_model.py:339 + load_base_model)."""
+    from automodel_tpu.checkpoint.hf_io import load_params_from_hf
+
+    backend = _as_backend(backend)
+    hf_config = _read_hf_config(pretrained_model_name_or_path)
+    builder = resolve_architecture(hf_config)
+    model, adapter = builder(hf_config, backend)
+    shardings = None
+    if mesh_ctx is not None:
+        abstract = jax.eval_shape(model.init, jax.random.key(0))
+        shardings = make_param_shardings(mesh_ctx, abstract, model.sharding_rules)
+    params = load_params_from_hf(
+        adapter,
+        pretrained_model_name_or_path,
+        shardings=shardings,
+        dtype=_np_dtype(backend.param_dtype),
+    )
+    return AutoModel(model=model, params=params, adapter=adapter, mesh_ctx=mesh_ctx)
+
+
+def _as_backend(backend: BackendConfig | dict | None) -> BackendConfig:
+    if backend is None:
+        return BackendConfig()
+    if isinstance(backend, BackendConfig):
+        return backend
+    return BackendConfig(**dict(backend))
+
+
+def _np_dtype(name: str):
+    import jax.numpy as jnp
+    import numpy as np
+
+    if name == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(name)

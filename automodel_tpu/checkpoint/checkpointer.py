@@ -1,0 +1,125 @@
+"""Distributed training checkpointer.
+
+Parity: reference Checkpointer/CheckpointingConfig
+(components/checkpoint/checkpointing.py:142,100) + BaseRecipe save/load
+(recipes/base_recipe.py:241-545): epoch/step dirs, latest symlink, model in
+either native sharded or consolidated-HF format, optimizer state, per-run
+extra Statefuls (dataloader, RNG, step scheduler), config snapshot.
+
+TPU-native: orbax handles sharded async array IO (the DCP equivalent);
+consolidated HF safetensors goes through checkpoint/hf_io.py. Restoring
+reshards automatically to the current mesh — orbax restores to the target
+shardings we pass, so elastic re-layout (reference: DCP resharding) is free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+@dataclasses.dataclass
+class CheckpointingConfig:
+    enabled: bool = True
+    checkpoint_dir: str = "checkpoints"
+    model_save_format: str = "sharded"  # sharded | safetensors (consolidated HF)
+    save_consolidated: bool = False
+    keep_last_k: int = 0  # 0 = keep all
+    restore_from: Optional[str] = None
+
+
+class Checkpointer:
+    def __init__(self, config: CheckpointingConfig):
+        self.config = config
+        self.root = Path(config.checkpoint_dir)
+
+    # -- paths --------------------------------------------------------------
+    def step_dir(self, epoch: int, step: int) -> Path:
+        return self.root / f"epoch_{epoch}_step_{step}"
+
+    def latest_dir(self) -> Path | None:
+        if self.config.restore_from:
+            return Path(self.config.restore_from)
+        if not self.root.exists():
+            return None
+        cands = [p for p in self.root.iterdir() if p.is_dir() and p.name.startswith("epoch_")]
+        if not cands:
+            return None
+        return max(cands, key=lambda p: int(p.name.rsplit("_", 1)[1]))
+
+    # -- save ---------------------------------------------------------------
+    def save(
+        self,
+        state: Any,
+        epoch: int,
+        step: int,
+        extra_state: dict[str, dict] | None = None,
+        hf_export: Any = None,  # (adapter, params) for consolidated HF save
+        config_snapshot: dict | None = None,
+    ) -> Path:
+        out = self.step_dir(epoch, step)
+        out.mkdir(parents=True, exist_ok=True)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save((out / "state").absolute(), state)
+        if extra_state:
+            (out / "extra_state.json").write_text(json.dumps(extra_state, default=_json_default))
+        if config_snapshot:
+            (out / "config.json").write_text(json.dumps(config_snapshot, indent=2, default=str))
+        if hf_export is not None and (
+            self.config.save_consolidated or self.config.model_save_format == "safetensors"
+        ):
+            from automodel_tpu.checkpoint.hf_io import save_hf_checkpoint
+
+            adapter, params = hf_export
+            host_params = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+            save_hf_checkpoint(out / "hf", adapter.to_hf(host_params))
+        self._prune()
+        return out
+
+    def _prune(self) -> None:
+        k = self.config.keep_last_k
+        if k <= 0 or not self.root.exists():
+            return
+        cands = sorted(
+            (p for p in self.root.iterdir() if p.is_dir() and p.name.startswith("epoch_")),
+            key=lambda p: int(p.name.rsplit("_", 1)[1]),
+        )
+        for p in cands[:-k]:
+            shutil.rmtree(p)
+
+    # -- load ---------------------------------------------------------------
+    def load(self, abstract_state: Any, path: str | os.PathLike | None = None) -> tuple[Any, dict]:
+        """Restore (state, extra_state). `abstract_state` is a pytree of
+        jax.ShapeDtypeStruct with shardings (from eval_shape + plan) so orbax
+        reshards onto the current mesh."""
+        d = Path(path) if path else self.latest_dir()
+        if d is None:
+            raise FileNotFoundError(f"No checkpoint found under {self.root}")
+        with ocp.StandardCheckpointer() as ckptr:
+            state = ckptr.restore((d / "state").absolute(), abstract_state)
+        extra_file = d / "extra_state.json"
+        extra = json.loads(extra_file.read_text()) if extra_file.exists() else {}
+        return state, extra
+
+    def has_checkpoint(self) -> bool:
+        return self.latest_dir() is not None
+
+
+def _json_default(o: Any):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, tuple):
+        return list(o)
+    raise TypeError(f"not JSON serializable: {type(o)}")

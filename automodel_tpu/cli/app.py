@@ -1,0 +1,65 @@
+"""CLI entry point.
+
+Parity: the reference `automodel` CLI (_cli/app.py:202-245):
+``automodel <command> <domain> -c cfg.yaml [--dotted.overrides]``. On TPU
+there is no torchrun spawn — single-controller JAX runs the recipe in-process
+(multi-host via `jax.distributed.initialize` when coordinator env vars are
+present). Slurm/k8s submission lives in automodel_tpu.launcher.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from automodel_tpu.config.arg_parser import parse_args_and_load_config
+
+COMMANDS = ("finetune", "pretrain", "kd", "benchmark")
+DOMAINS = ("llm", "vlm")
+
+
+def _usage() -> str:
+    return (
+        "usage: automodel_tpu <finetune|pretrain|kd|benchmark> <llm|vlm> "
+        "-c config.yaml [--dotted.key=value ...]"
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) < 2 or argv[0] in ("-h", "--help"):
+        print(_usage())
+        return 0 if argv and argv[0] in ("-h", "--help") else 2
+    command, domain = argv[0], argv[1]
+    if command not in COMMANDS:
+        print(f"Unknown command {command!r}. {_usage()}")
+        return 2
+    if domain not in DOMAINS:
+        print(f"Unknown domain {domain!r}. {_usage()}")
+        return 2
+    cfg = parse_args_and_load_config(argv[2:])
+
+    from automodel_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed()
+
+    if command in ("finetune", "pretrain") and domain == "llm":
+        from automodel_tpu.recipes.train_ft import main as recipe_main
+
+        recipe_main(cfg)
+        return 0
+    if command == "benchmark" and domain == "llm":
+        from automodel_tpu.recipes.benchmark import main as bench_main
+
+        bench_main(cfg)
+        return 0
+    if command == "kd" and domain == "llm":
+        from automodel_tpu.recipes.kd import main as kd_main
+
+        kd_main(cfg)
+        return 0
+    print(f"{command} {domain} is not implemented yet")
+    return 3
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

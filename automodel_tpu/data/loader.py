@@ -1,0 +1,121 @@
+"""Data loading: epoch iteration, shuffling, microbatching, device placement.
+
+Parity: the reference uses torch DataLoader + StatefulDataLoader with per-dp
+rank sharding. Single-controller JAX inverts that: ONE loader produces the
+GLOBAL microbatch; `place_batch` device_puts it with the (batch, seq) sharding
+so each device receives only its slice. Multi-host: the loader yields
+host-local slices and `jax.make_array_from_process_local_data` assembles the
+global array.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import jax
+import numpy as np
+
+from automodel_tpu.data.collators import default_collater, stack_microbatches
+from automodel_tpu.parallel.mesh import MeshContext
+
+BATCH_KEY_SPECS: dict[str, tuple] = {
+    "input_ids": ("batch", "seq"),
+    "labels": ("batch", "seq"),
+    "position_ids": ("batch", "seq"),
+    "segment_ids": ("batch", "seq"),
+}
+
+
+class DataLoader:
+    """Map-style dataset → shuffled epochs of collated global microbatches.
+
+    Stateful: `state_dict`/`load_state_dict` resume mid-epoch (parity with the
+    reference's StatefulDataLoader usage, base_recipe.py:541).
+    """
+
+    def __init__(
+        self,
+        dataset: Any,
+        global_batch_size: int,
+        collate_fn: Callable | None = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        infinite: bool = False,
+        **collate_kwargs: Any,
+    ):
+        self.dataset = dataset
+        self.global_batch_size = global_batch_size
+        self.collate_fn = collate_fn or default_collater
+        self.collate_kwargs = collate_kwargs
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.infinite = infinite
+        self.epoch = 0
+        self.batch_in_epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.dataset) // self.global_batch_size
+        if not self.drop_last and len(self.dataset) % self.global_batch_size:
+            n += 1
+        return n
+
+    def _epoch_order(self) -> np.ndarray:
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.default_rng(self.seed * 1000003 + self.epoch).shuffle(order)
+        return order
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            order = self._epoch_order()
+            nb = len(self)
+            while self.batch_in_epoch < nb:
+                i = self.batch_in_epoch
+                idx = order[i * self.global_batch_size : (i + 1) * self.global_batch_size]
+                examples = [self.dataset[int(j)] for j in idx]
+                batch = self.collate_fn(examples, **self.collate_kwargs)
+                self.batch_in_epoch += 1
+                yield batch
+            self.epoch += 1
+            self.batch_in_epoch = 0
+            if not self.infinite:
+                return
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "batch_in_epoch": self.batch_in_epoch, "seed": self.seed}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = state["epoch"]
+        self.batch_in_epoch = state["batch_in_epoch"]
+        self.seed = state.get("seed", self.seed)
+
+
+def place_batch(ctx: MeshContext | None, batch: dict, microbatched: bool = True) -> dict:
+    """device_put a (possibly [A]-stacked) numpy batch with (batch, seq)
+    sharding. Non-array keys pass through."""
+    out: dict = {}
+    for k, v in batch.items():
+        if not isinstance(v, np.ndarray):
+            continue  # host-side scalars (num_label_tokens) stay off-device
+        if ctx is None:
+            out[k] = jax.numpy.asarray(v)
+            continue
+        spec = BATCH_KEY_SPECS.get(k, ("batch",))
+        if microbatched:
+            spec = (None, *spec)
+        out[k] = jax.device_put(v, ctx.sharding(*spec))
+    return out
+
+
+def microbatch_iterator(
+    loader_iter: Iterator[dict], accum_steps: int
+) -> Iterator[dict]:
+    """Group `accum_steps` microbatches into one [A]-stacked optimizer batch."""
+    group: list[dict] = []
+    for batch in loader_iter:
+        group.append(batch)
+        if len(group) == accum_steps:
+            yield stack_microbatches(group)
+            group = []

@@ -1,0 +1,64 @@
+"""Sequence packing.
+
+Parity: reference packed sequences (datasets/llm/packed_sequence.py:202) —
+greedy packing of tokenized examples into fixed-size buffers with
+block-causal attention. TPU-native: instead of THD/cu_seqlens kernels,
+packing emits `segment_ids` (+ per-segment restarting position_ids); the
+attention backends apply the block-causal mask from segment equality, which
+is what the flash kernel's SegmentIds path consumes directly.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+def pack_sequences(
+    examples: Iterable[dict],
+    packed_sequence_size: int,
+    pad_token_id: int = 0,
+    drop_overlong: bool = True,
+) -> Iterator[dict]:
+    """Greedy first-fit packing → examples of exactly `packed_sequence_size`.
+
+    Segment id 0 marks padding; real segments are 1-indexed so padding never
+    attends to (or is attended by) anything.
+    """
+    buf_ids: list[int] = []
+    buf_labels: list[int] = []
+    buf_pos: list[int] = []
+    buf_seg: list[int] = []
+    seg = 1
+
+    def flush():
+        nonlocal buf_ids, buf_labels, buf_pos, buf_seg, seg
+        pad = packed_sequence_size - len(buf_ids)
+        yield {
+            "input_ids": buf_ids + [pad_token_id] * pad,
+            "labels": buf_labels + [IGNORE_INDEX] * pad,
+            "position_ids": buf_pos + [0] * pad,
+            "segment_ids": buf_seg + [0] * pad,
+        }
+        buf_ids, buf_labels, buf_pos, buf_seg, seg = [], [], [], [], 1
+
+    for ex in examples:
+        ids = list(ex["input_ids"])
+        labels = list(ex.get("labels", ids))
+        if len(ids) > packed_sequence_size:
+            if drop_overlong:
+                continue
+            ids = ids[:packed_sequence_size]
+            labels = labels[:packed_sequence_size]
+        if len(buf_ids) + len(ids) > packed_sequence_size:
+            yield from flush()
+        buf_ids += ids
+        buf_labels += labels
+        buf_pos += list(range(len(ids)))
+        buf_seg += [seg] * len(ids)
+        seg += 1
+    if buf_ids:
+        yield from flush()

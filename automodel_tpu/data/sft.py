@@ -1,0 +1,164 @@
+"""SFT datasets.
+
+Parity: the reference dataset zoo (components/datasets/llm/): HellaSwag
+(hellaswag.py), SQuAD (squad.py), ColumnMappedTextInstructionDataset
+(column_mapped_text_instruction_dataset.py:321), chat datasets, and mock
+data. All are thin maps from records → {input_ids, labels} with prompt
+tokens masked; heavy lifting (padding/shift/packing) lives in collators.
+
+Each builder accepts either a HuggingFace `datasets` path+split (network or
+local cache) or `records=` (a list of dicts) for offline/test use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+def _load_records(
+    path_or_dataset: Any = None, split: str | None = None, records: Sequence[dict] | None = None
+):
+    if records is not None:
+        return list(records)
+    if isinstance(path_or_dataset, (list, tuple)):
+        return list(path_or_dataset)
+    import datasets as hf_datasets
+
+    return hf_datasets.load_dataset(path_or_dataset, split=split or "train")
+
+
+class ColumnMappedTextInstructionDataset:
+    """Map arbitrary record columns onto prompt/completion SFT examples
+    (reference: column_mapped_text_instruction_dataset.py:321).
+
+    column_mapping: {"context": col, "question": col, "answer": col} — any
+    subset; present prompt columns are concatenated with newlines.
+    """
+
+    def __init__(
+        self,
+        path_or_dataset: Any = None,
+        tokenizer: Any = None,
+        column_mapping: dict[str, str] | None = None,
+        split: str | None = None,
+        records: Sequence[dict] | None = None,
+        answer_only_loss_mask: bool = True,
+        prompt_template: str | None = None,
+        seq_length: int | None = None,
+        limit_dataset_samples: int | None = None,
+    ):
+        self.tokenizer = tokenizer
+        self.column_mapping = column_mapping or {"question": "question", "answer": "answer"}
+        self.answer_only_loss_mask = answer_only_loss_mask
+        self.prompt_template = prompt_template
+        self.seq_length = seq_length
+        self.records = _load_records(path_or_dataset, split, records)
+        if limit_dataset_samples:
+            self.records = self.records[:limit_dataset_samples] if isinstance(
+                self.records, list
+            ) else self.records.select(range(limit_dataset_samples))
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def _format(self, rec: dict) -> tuple[str, str]:
+        cm = self.column_mapping
+        answer = str(rec[cm["answer"]])
+        prompt_cols = [k for k in ("system", "context", "question", "instruction") if k in cm]
+        if self.prompt_template:
+            prompt = self.prompt_template.format(**{k: rec[cm[k]] for k in prompt_cols})
+        else:
+            prompt = "\n".join(str(rec[cm[k]]) for k in prompt_cols) + " "
+        return prompt, answer
+
+    def __getitem__(self, idx: int) -> dict:
+        rec = self.records[idx]
+        prompt, answer = self._format(rec)
+        tok = self.tokenizer
+        prompt_ids = tok(prompt, add_special_tokens=False)["input_ids"]
+        answer_ids = tok(answer, add_special_tokens=False)["input_ids"]
+        bos = [tok.bos_token_id] if getattr(tok, "bos_token_id", None) is not None else []
+        eos = [tok.eos_token_id] if getattr(tok, "eos_token_id", None) is not None else []
+        input_ids = bos + prompt_ids + answer_ids + eos
+        if self.answer_only_loss_mask:
+            n_prompt = len(bos) + len(prompt_ids)
+            labels = [IGNORE_INDEX] * n_prompt + answer_ids + eos
+        else:
+            labels = list(input_ids)
+        if self.seq_length:
+            input_ids = input_ids[: self.seq_length]
+            labels = labels[: self.seq_length]
+        return {"input_ids": input_ids, "labels": labels}
+
+    def __iter__(self) -> Iterator[dict]:
+        for i in range(len(self)):
+            yield self[i]
+
+
+def HellaSwag(tokenizer: Any, path_or_dataset: Any = "rowan/hellaswag", split: str = "train",
+              records: Sequence[dict] | None = None, **kw: Any) -> ColumnMappedTextInstructionDataset:
+    """HellaSwag as SFT: ctx → correct ending (reference: hellaswag.py)."""
+    recs = _load_records(path_or_dataset, split, records)
+    mapped = [
+        {"question": r["ctx"], "answer": r["endings"][int(r["label"])]}
+        for r in recs
+    ]
+    return ColumnMappedTextInstructionDataset(
+        tokenizer=tokenizer, records=mapped,
+        column_mapping={"question": "question", "answer": "answer"}, **kw,
+    )
+
+
+def SQuAD(tokenizer: Any, path_or_dataset: Any = "rajpurkar/squad", split: str = "train",
+          records: Sequence[dict] | None = None, **kw: Any) -> ColumnMappedTextInstructionDataset:
+    """SQuAD QA SFT (reference: squad.py)."""
+    recs = _load_records(path_or_dataset, split, records)
+    mapped = [
+        {
+            "context": r["context"],
+            "question": r["question"],
+            "answer": r["answers"]["text"][0] if r["answers"]["text"] else "",
+        }
+        for r in recs
+    ]
+    return ColumnMappedTextInstructionDataset(
+        tokenizer=tokenizer, records=mapped,
+        column_mapping={"context": "context", "question": "question", "answer": "answer"}, **kw,
+    )
+
+
+class MockSFTDataset:
+    """Deterministic random-token dataset (reference: datasets/llm/mock*.py)
+    for tests and benchmarks — no tokenizer, no network."""
+
+    def __init__(
+        self,
+        vocab_size: int = 32000,
+        seq_length: int = 512,
+        num_samples: int = 1024,
+        seed: int = 0,
+        mask_ratio: float = 0.25,
+    ):
+        self.vocab_size = vocab_size
+        self.seq_length = seq_length
+        self.num_samples = num_samples
+        self.seed = seed
+        self.mask_ratio = mask_ratio
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        rng = np.random.default_rng(self.seed * 100003 + idx)
+        ids = rng.integers(3, self.vocab_size, size=self.seq_length).tolist()
+        n_mask = int(self.seq_length * self.mask_ratio)
+        labels = [IGNORE_INDEX] * n_mask + ids[n_mask:]
+        return {"input_ids": ids, "labels": labels}
+
+    def __iter__(self) -> Iterator[dict]:
+        for i in range(len(self)):
+            yield self[i]

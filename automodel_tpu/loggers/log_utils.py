@@ -1,0 +1,22 @@
+"""Logging setup (parity: components/loggers/log_utils.py:171 — rank-filtered
+colored logging; single-controller JAX filters on process_index)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def setup_logging(level: int = logging.INFO, rank0_only: bool = True) -> None:
+    import jax
+
+    root = logging.getLogger()
+    if rank0_only and jax.process_index() != 0:
+        level = logging.WARNING
+    root.setLevel(level)
+    if not any(isinstance(h, logging.StreamHandler) for h in root.handlers):
+        h = logging.StreamHandler(sys.stdout)
+        h.setFormatter(
+            logging.Formatter("%(asctime)s [%(levelname)s] %(name)s: %(message)s", "%H:%M:%S")
+        )
+        root.addHandler(h)

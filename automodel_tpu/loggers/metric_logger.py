@@ -1,0 +1,43 @@
+"""JSONL metric logging (parity: components/loggers/metric_logger.py:83) with
+optional wandb passthrough (wandb_utils.py)."""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+
+def _to_scalar(v: Any) -> Any:
+    if hasattr(v, "item"):
+        try:
+            return v.item()
+        except Exception:
+            return np.asarray(v).tolist()
+    return v
+
+
+class MetricLogger:
+    """Append-only JSONL metrics file; one record per call."""
+
+    def __init__(self, path: str, wandb_run: Any = None):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = open(self.path, "a")
+        self.wandb_run = wandb_run
+
+    def log(self, metrics: dict[str, Any], step: int | None = None) -> None:
+        rec = {k: _to_scalar(v) for k, v in metrics.items()}
+        rec.setdefault("ts", time.time())
+        if step is not None:
+            rec.setdefault("step", step)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        if self.wandb_run is not None:
+            self.wandb_run.log(rec, step=step)
+
+    def close(self) -> None:
+        self._f.close()

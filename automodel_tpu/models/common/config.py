@@ -34,7 +34,7 @@ def dtype_from_str(s: str | Any) -> Any:
 class BackendConfig:
     """Per-module kernel/backing choices (reference: common/utils.py:98-225)."""
 
-    attn: str = "flash"  # sdpa | flash | ring
+    attn: str = "flash"  # any key of ops.attention.ATTENTION_BACKENDS
     rms_norm: str = "xla"
     experts: str = "ragged_dot"  # ragged_dot | dense_einsum (MoE models)
     dispatcher: str = "gspmd"  # gspmd | a2a (MoE token routing)
@@ -46,8 +46,12 @@ class BackendConfig:
     attn_block_kv: int = 512
 
     def __post_init__(self):
-        if self.attn not in ("sdpa", "flash", "ring"):
-            raise ValueError(f"Unknown attn backend {self.attn!r}")
+        from automodel_tpu.ops.attention import ATTENTION_BACKENDS
+
+        if self.attn not in ATTENTION_BACKENDS:
+            raise ValueError(
+                f"Unknown attn backend {self.attn!r}; available: {sorted(ATTENTION_BACKENDS)}"
+            )
         if self.remat not in ("none", "full", "selective"):
             raise ValueError(f"Unknown remat policy {self.remat!r}")
 
@@ -83,6 +87,9 @@ class TransformerConfig:
     logits_soft_cap: Optional[float] = None
     attn_soft_cap: Optional[float] = None
     sliding_window: Optional[int] = None
+    # HF qwen2 convention: the first `max_window_layers` layers use FULL
+    # attention; layers >= max_window_layers use the sliding window.
+    max_window_layers: int = 0
     attn_scale: Optional[float] = None  # override 1/sqrt(head_dim)
 
     @classmethod
@@ -113,6 +120,7 @@ class TransformerConfig:
             qk_norm=model_type in ("qwen3", "qwen3_moe"),
             act=get("hidden_act", "silu"),
             sliding_window=get("sliding_window", None) if get("use_sliding_window", False) else None,
+            max_window_layers=get("max_window_layers", 0) or 0,
         )
 
     @property

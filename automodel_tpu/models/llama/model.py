@@ -97,6 +97,15 @@ def _proj(x: jnp.ndarray, p: dict) -> jnp.ndarray:
     return y
 
 
+def _layer_sliding_window(cfg: TransformerConfig, layer_idx: int) -> Optional[int]:
+    """HF qwen2 semantics: layers < max_window_layers attend fully."""
+    if cfg.sliding_window is None:
+        return None
+    if cfg.max_window_layers and layer_idx < cfg.max_window_layers:
+        return None
+    return cfg.sliding_window
+
+
 def decoder_layer(
     cfg: TransformerConfig,
     backend: BackendConfig,
@@ -106,6 +115,7 @@ def decoder_layer(
     sin: jnp.ndarray,
     segment_ids: Optional[jnp.ndarray],
     constrain: Constrain,
+    sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
     B, S, D = h.shape
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
@@ -125,7 +135,7 @@ def decoder_layer(
         scale=cfg.attn_scale,
         segment_ids=segment_ids,
         logits_soft_cap=cfg.attn_soft_cap,
-        sliding_window=cfg.sliding_window,
+        sliding_window=sliding_window,
         **(
             {"block_q": backend.attn_block_q, "block_kv": backend.attn_block_kv}
             if backend.attn == "flash"
@@ -161,24 +171,34 @@ def forward_hidden(
     h = constrain(h, ("batch", "seq", None))
     cos, sin = rope_table(position_ids, cfg.head_dim, cfg.rope)
 
-    def layer_fn(carry, lp):
-        out = decoder_layer(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
-        return out, None
+    def make_layer_fn(sliding_window):
+        def layer_fn(carry, lp):
+            out = decoder_layer(
+                cfg, backend, carry, lp, cos, sin, segment_ids, constrain,
+                sliding_window=sliding_window,
+            )
+            return out, None
 
-    if backend.remat == "full":
-        layer_fn = jax.checkpoint(layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
-    elif backend.remat == "selective":
-        layer_fn = jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        if backend.remat == "full":
+            return jax.checkpoint(layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        if backend.remat == "selective":
+            return jax.checkpoint(
+                layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        return layer_fn
+
+    L = cfg.num_layers
+    # mixed full/windowed layers force per-layer calls; the homogeneous case
+    # (every layer same window) keeps the single lax.scan over stacked params.
+    homogeneous = cfg.sliding_window is None or cfg.max_window_layers in (0, None)
+    if backend.scan_layers and homogeneous:
+        h, _ = jax.lax.scan(
+            make_layer_fn(_layer_sliding_window(cfg, 0)), h, params["layers"]
         )
-
-    if backend.scan_layers:
-        h, _ = jax.lax.scan(layer_fn, h, params["layers"])
     else:
-        L = cfg.num_layers
         for i in range(L):
             lp = jax.tree.map(lambda x: x[i], params["layers"])
-            h, _ = layer_fn(h, lp)
+            h, _ = make_layer_fn(_layer_sliding_window(cfg, i))(h, lp)
     return rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
 
 

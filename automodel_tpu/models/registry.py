@@ -1,0 +1,57 @@
+"""HF architecture → native model-family registry.
+
+Parity: _transformers/registry.py:33 maps HF ``architectures[0]`` to in-tree
+ModelClass. Families register a builder returning (model, adapter) from an HF
+config. Out-of-tree registration mirrors the reference's decorator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register_architecture(*names: str):
+    def deco(builder: Callable):
+        for n in names:
+            _REGISTRY[n] = builder
+        return builder
+
+    return deco
+
+
+def resolve_architecture(hf_config: Any) -> Callable:
+    archs = (
+        hf_config.get("architectures")
+        if isinstance(hf_config, dict)
+        else getattr(hf_config, "architectures", None)
+    ) or []
+    for a in archs:
+        if a in _REGISTRY:
+            return _REGISTRY[a]
+    # generic llama-style fallback (SURVEY.md §7 hard part 6): any dense
+    # architecture matching the llama layout trains via the generic family.
+    from automodel_tpu.models.registry import _llama_builder
+
+    return _llama_builder
+
+
+def available_architectures() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+@register_architecture(
+    "LlamaForCausalLM",
+    "Qwen2ForCausalLM",
+    "Qwen3ForCausalLM",
+    "MistralForCausalLM",
+    "Gemma2ForCausalLM",
+)
+def _llama_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.llama import LlamaForCausalLM, LlamaStateDictAdapter
+
+    cfg = TransformerConfig.from_hf(hf_config)
+    return LlamaForCausalLM(cfg, backend), LlamaStateDictAdapter(cfg)

@@ -24,11 +24,30 @@ pre-shift labels for next-token prediction.
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
 
+logger = logging.getLogger(__name__)
+
 IGNORE_INDEX = -100
+
+
+def _usable_chunks(t: int, requested: int) -> int:
+    """Largest divisor of t that is <= requested; warns (at trace time) when
+    the memory bound degrades from what the caller asked for."""
+    nc = 1
+    for d in range(min(requested, t), 0, -1):
+        if t % d == 0:
+            nc = d
+            break
+    if nc != requested:
+        logger.warning(
+            "token count %d not divisible by num_chunks=%d; using %d chunks "
+            "(pad the batch for the full memory bound)", t, requested, nc,
+        )
+    return nc
 
 
 def _ce_sum(logits: jnp.ndarray, labels: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -61,8 +80,7 @@ def chunked_cross_entropy(
     flat_logits = logits.reshape(-1, v)
     flat_labels = labels.reshape(-1)
     t = flat_logits.shape[0]
-    if t % num_chunks != 0:
-        return _ce_sum(flat_logits, flat_labels)
+    num_chunks = _usable_chunks(t, num_chunks)
     flat_logits = flat_logits.reshape(num_chunks, t // num_chunks, v)
     flat_labels = flat_labels.reshape(num_chunks, t // num_chunks)
 
@@ -92,8 +110,7 @@ def fused_linear_cross_entropy(
     flat_h = hidden.reshape(-1, d)
     flat_labels = labels.reshape(-1)
     t = flat_h.shape[0]
-    if t % num_chunks != 0:
-        num_chunks = 1
+    num_chunks = _usable_chunks(t, num_chunks)
     flat_h = flat_h.reshape(num_chunks, t // num_chunks, d)
     flat_labels = flat_labels.reshape(num_chunks, t // num_chunks)
 

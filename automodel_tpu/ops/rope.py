@@ -31,6 +31,16 @@ class RopeConfig:
     beta_slow: float = 1.0
     mscale: float = 1.0
     mscale_all_dim: float = 0.0
+    attention_factor: float | None = None  # HF yarn cos/sin multiplier
+
+    SUPPORTED_SCALINGS = (None, "llama3", "linear", "yarn")
+
+    def __post_init__(self):
+        if self.scaling not in self.SUPPORTED_SCALINGS:
+            raise ValueError(
+                f"Unsupported rope_scaling type {self.scaling!r}; "
+                f"supported: {self.SUPPORTED_SCALINGS}"
+            )
 
     @staticmethod
     def from_hf(cfg) -> "RopeConfig":
@@ -51,6 +61,7 @@ class RopeConfig:
             beta_slow=rs.get("beta_slow", 1.0),
             mscale=rs.get("mscale", 1.0),
             mscale_all_dim=rs.get("mscale_all_dim", 0.0),
+            attention_factor=rs.get("attention_factor"),
         )
 
 
@@ -87,7 +98,22 @@ def _inv_freq(head_dim: int, cfg: RopeConfig) -> jnp.ndarray:
             (jnp.arange(dim // 2, dtype=jnp.float32) - low) / max(high - low, 1e-3), 0, 1
         )
         inv = inv / cfg.factor * ramp + inv * (1 - ramp)
+    elif cfg.scaling is not None:
+        raise ValueError(f"Unsupported rope scaling {cfg.scaling!r}")
     return inv
+
+
+def _attention_factor(cfg: RopeConfig) -> float:
+    """HF yarn multiplies cos/sin by attention_factor (0.1·ln(factor)+1 when
+    unset). Models that fold the correction into the softmax scale instead
+    (DeepSeek MLA) use yarn_mscale() and a RopeConfig with factor<=1 here."""
+    if cfg.scaling != "yarn":
+        return 1.0
+    if cfg.attention_factor is not None:
+        return cfg.attention_factor
+    if cfg.factor > 1.0:
+        return 0.1 * math.log(cfg.factor) + 1.0
+    return 1.0
 
 
 def yarn_mscale(cfg: RopeConfig) -> float:
@@ -108,7 +134,8 @@ def rope_table(
     inv = _inv_freq(head_dim, cfg)
     freqs = position_ids[..., None].astype(jnp.float32) * inv  # [..., S, hd/2]
     emb = jnp.concatenate([freqs, freqs], axis=-1)
-    return jnp.cos(emb), jnp.sin(emb)
+    f = _attention_factor(cfg)
+    return jnp.cos(emb) * f, jnp.sin(emb) * f
 
 
 def apply_rope(

@@ -1,0 +1,63 @@
+"""Optimizer construction from config.
+
+Parity: the reference instantiates plain ``_target_: torch.optim.*`` from
+YAML (SURVEY.md §2.7). Here optimizers are optax chains; a YAML node like
+
+    optimizer:
+      _target_: automodel_tpu.optim.build_optimizer
+      name: adamw
+      lr: 1.e-4
+      weight_decay: 0.01
+      betas: [0.9, 0.95]
+      grad_clip_norm: 1.0
+      lr_schedule: {style: cosine, warmup_steps: 100, decay_steps: 1000}
+
+builds clip → scale_by_adam → weight-decay → schedule. ``_target_:
+optax.adamw``-style direct nodes also work through ConfigNode.instantiate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import optax
+
+from automodel_tpu.optim.scheduler import build_lr_schedule
+
+_SCALERS = {
+    "adamw": lambda betas, eps: optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps),
+    "adam": lambda betas, eps: optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps),
+    "lion": lambda betas, eps: optax.scale_by_lion(b1=betas[0], b2=betas[1]),
+    "sgd": lambda betas, eps: optax.trace(decay=betas[0]),
+    "adafactor": None,  # handled specially
+}
+
+
+def build_optimizer(
+    name: str = "adamw",
+    lr: float = 1e-4,
+    weight_decay: float = 0.0,
+    betas: Sequence[float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    grad_clip_norm: float | None = None,
+    lr_schedule: Any | None = None,
+    **sched_kwargs: Any,
+) -> optax.GradientTransformation:
+    if lr_schedule is not None:
+        sched_kwargs = dict(lr_schedule)
+    schedule = (
+        build_lr_schedule(lr=lr, **sched_kwargs) if sched_kwargs else optax.constant_schedule(lr)
+    )
+    parts: list[optax.GradientTransformation] = []
+    if grad_clip_norm:
+        parts.append(optax.clip_by_global_norm(grad_clip_norm))
+    if name == "adafactor":
+        parts.append(optax.adafactor(learning_rate=schedule, weight_decay_rate=weight_decay or None))
+        return optax.chain(*parts)
+    if name not in _SCALERS:
+        raise ValueError(f"Unknown optimizer {name!r}; available: {sorted(_SCALERS)}")
+    parts.append(_SCALERS[name](tuple(betas), eps))
+    if weight_decay and name in ("adamw", "lion"):
+        parts.append(optax.add_decayed_weights(weight_decay))
+    parts.append(optax.scale_by_learning_rate(schedule))
+    return optax.chain(*parts)

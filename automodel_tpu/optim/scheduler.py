@@ -1,0 +1,53 @@
+"""LR / weight-decay schedules.
+
+Parity: the reference's Megatron-style OptimizerParamScheduler
+(components/optim/scheduler.py:14) — warmup + {constant, linear, cosine, WSD}
+decay with min-lr floor — expressed as optax schedules (pure functions of the
+step, jit-friendly).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import optax
+
+
+def build_lr_schedule(
+    lr: float,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+    style: str = "constant",
+    min_lr: float = 0.0,
+    wsd_decay_steps: int | None = None,
+) -> Callable:
+    """Warmup-then-decay schedule.
+
+    style ∈ {constant, linear, cosine, wsd}. `decay_steps` counts steps after
+    warmup. WSD (warmup-stable-decay) holds lr constant then decays linearly
+    over the final `wsd_decay_steps`.
+    """
+    if style == "constant":
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, max(warmup_steps, 1)), optax.constant_schedule(lr)],
+            [warmup_steps],
+        ) if warmup_steps else optax.constant_schedule(lr)
+    if style == "linear":
+        decay = optax.linear_schedule(lr, min_lr, max(decay_steps, 1))
+    elif style == "cosine":
+        decay = optax.cosine_decay_schedule(lr, max(decay_steps, 1), alpha=min_lr / lr if lr else 0.0)
+    elif style == "wsd":
+        wsd_decay = wsd_decay_steps or max(decay_steps // 10, 1)
+        stable = max(decay_steps - wsd_decay, 0)
+        decay = optax.join_schedules(
+            [optax.constant_schedule(lr), optax.linear_schedule(lr, min_lr, wsd_decay)],
+            [stable],
+        )
+    else:
+        raise ValueError(f"Unknown lr decay style {style!r}")
+    if warmup_steps:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, warmup_steps), decay], [warmup_steps]
+        )
+    return decay

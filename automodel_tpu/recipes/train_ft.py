@@ -1,0 +1,214 @@
+"""LLM finetune / pretrain recipe.
+
+Parity: TrainFinetuneRecipeForNextTokenPrediction
+(recipes/llm/train_ft.py:803) — YAML-driven setup of mesh, model, data,
+optimizer, step scheduler, checkpointing, metric logging, then the
+train/validation loop. The torch version's hot loop
+(_run_train_optim_step:1284) is here ONE jitted step: microbatch scan +
+global token-count normalization + clip + optimizer update (see
+training/train_step.py).
+
+YAML sections (format-compatible in spirit with the reference recipes):
+  model.pretrained_model_name_or_path | model.hf_config, model.backend
+  distributed.{tp,cp,pp,ep,dp_shard,dp_replicate}
+  dataset._target_ ..., validation_dataset (optional)
+  dataloader.{global_batch_size, shuffle, ...}
+  step_scheduler.{grad_acc_steps,num_epochs,max_steps,ckpt_every_steps,...}
+  optimizer.{name,lr,...}   loss_fn.{name,...}
+  checkpoint.{enabled,checkpoint_dir,...}   logging.{metrics_path}   seed
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from automodel_tpu import auto_model
+from automodel_tpu.checkpoint.checkpointer import Checkpointer, CheckpointingConfig
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.data.collators import stack_microbatches
+from automodel_tpu.data.loader import DataLoader, place_batch
+from automodel_tpu.loggers.log_utils import setup_logging
+from automodel_tpu.loggers.metric_logger import MetricLogger
+from automodel_tpu.optim.builders import build_optimizer
+from automodel_tpu.optim.scheduler import build_lr_schedule
+from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+from automodel_tpu.training.rng import StatefulRNG
+from automodel_tpu.training.step_scheduler import StepScheduler
+from automodel_tpu.training.train_state import TrainState
+from automodel_tpu.training.train_step import (
+    build_eval_step,
+    build_train_step,
+    make_causal_lm_loss,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class TrainFinetuneRecipeForNextTokenPrediction:
+    def __init__(self, cfg: ConfigNode):
+        self.cfg = cfg
+
+    # -- setup --------------------------------------------------------------
+    def setup(self) -> None:
+        cfg = self.cfg
+        setup_logging()
+        self.rng = StatefulRNG(seed=cfg.get("seed", 42))
+
+        dist = cfg.get("distributed", ConfigNode())
+        mesh_degrees = {
+            k: dist.get(k, -1 if k == "dp_shard" else 1)
+            for k in ("dp_replicate", "dp_shard", "tp", "cp", "pp", "ep")
+        }
+        self.mesh_ctx = build_mesh(MeshConfig(**mesh_degrees))
+        logger.info("mesh: %s", dict(self.mesh_ctx.mesh.shape))
+
+        # model
+        mcfg = cfg.model
+        backend = dict(mcfg.get("backend", {}) or {})
+        if mcfg.get("pretrained_model_name_or_path"):
+            self.auto = auto_model.from_pretrained(
+                mcfg.pretrained_model_name_or_path, self.mesh_ctx, backend
+            )
+        else:
+            hf_config = mcfg.get("hf_config")
+            self.auto = auto_model.from_config(
+                hf_config.to_dict() if isinstance(hf_config, ConfigNode) else hf_config,
+                self.mesh_ctx,
+                backend,
+                seed=cfg.get("seed", 42),
+            )
+        self.model = self.auto.model
+
+        # optimizer + schedule
+        ocfg = dict(cfg.get("optimizer", {}) or {"name": "adamw"})
+        ocfg.pop("_target_", None)
+        sched_cfg = dict(ocfg.get("lr_schedule") or {})
+        self.lr_schedule = build_lr_schedule(lr=ocfg.get("lr", 1e-4), **sched_cfg)
+        self.optimizer = build_optimizer(**ocfg)
+        opt_state = jax.jit(self.optimizer.init)(self.auto.params)
+        self.state = TrainState.create(self.auto.params, opt_state)
+
+        # loss + steps
+        lcfg = dict(cfg.get("loss_fn", {}) or {})
+        lcfg.pop("_target_", None)
+        loss_name = lcfg.pop("name", "masked_ce")
+        self.loss_fn = make_causal_lm_loss(
+            self.model, loss=loss_name, constrain=self.auto.constrain, **lcfg
+        )
+        self.train_step = build_train_step(self.loss_fn, self.optimizer, self.lr_schedule)
+        self.eval_step = build_eval_step(self.loss_fn)
+
+        # data
+        self.dataloader = self._build_dataloader(cfg.get("dataset"), cfg.get("dataloader", {}))
+        self.val_dataloader = None
+        if cfg.get("validation_dataset") is not None:
+            self.val_dataloader = self._build_dataloader(
+                cfg.get("validation_dataset"), cfg.get("validation_dataloader", cfg.get("dataloader", {}))
+            )
+
+        # step scheduler
+        scfg = dict(cfg.get("step_scheduler", {}) or {})
+        self.step_scheduler = StepScheduler(dataloader=self.dataloader, **scfg)
+        self.step_scheduler.install_signal_handler()
+
+        # checkpointing
+        ccfg = dict(cfg.get("checkpoint", {}) or {})
+        self.checkpointer = Checkpointer(CheckpointingConfig(**ccfg)) if ccfg.get(
+            "enabled", False
+        ) else None
+        if self.checkpointer and self.checkpointer.has_checkpoint():
+            self._restore()
+
+        # metrics
+        log_cfg = cfg.get("logging", ConfigNode())
+        self.metric_logger = MetricLogger(log_cfg.get("metrics_path", "train_metrics.jsonl"))
+
+    def _build_dataloader(self, dataset_cfg: Any, dl_cfg: Any) -> DataLoader:
+        if dataset_cfg is None:
+            raise ValueError("A `dataset:` section is required")
+        dataset = dataset_cfg.instantiate() if isinstance(dataset_cfg, ConfigNode) else dataset_cfg
+        dl = dict(dl_cfg or {})
+        dl.pop("_target_", None)
+        return DataLoader(dataset, seed=self.cfg.get("seed", 42), **dl)
+
+    # -- checkpoint ---------------------------------------------------------
+    def save_checkpoint(self) -> None:
+        if not self.checkpointer:
+            return
+        extra = {
+            "dataloader": self.dataloader.state_dict(),
+            "step_scheduler": self.step_scheduler.state_dict(),
+            "rng": self.rng.state_dict(),
+        }
+        hf_export = (self.auto.adapter, self.state.params)
+        self.checkpointer.save(
+            self.state,
+            epoch=self.step_scheduler.epoch,
+            step=self.step_scheduler.step,
+            extra_state=extra,
+            hf_export=hf_export,
+            config_snapshot=self.cfg.to_dict(),
+        )
+        logger.info("saved checkpoint at step %d", self.step_scheduler.step)
+
+    def _restore(self) -> None:
+        state, extra = self.checkpointer.load(jax.eval_shape(lambda: self.state))
+        # re-place restored arrays on the current mesh with plan shardings
+        from automodel_tpu.parallel.plans import shard_params
+
+        params = shard_params(self.mesh_ctx, state.params, self.model.sharding_rules)
+        self.state = TrainState(params=params, opt_state=state.opt_state, step=state.step)
+        if "dataloader" in extra:
+            self.dataloader.load_state_dict(extra["dataloader"])
+        if "step_scheduler" in extra:
+            self.step_scheduler.load_state_dict(extra["step_scheduler"])
+        if "rng" in extra:
+            self.rng.load_state_dict(extra["rng"])
+        logger.info("restored checkpoint at step %d", int(self.state.step))
+
+    # -- train loop ---------------------------------------------------------
+    def run_train_validation_loop(self) -> dict:
+        last: dict = {}
+        t0 = time.perf_counter()
+        for group in self.step_scheduler:
+            stacked = stack_microbatches(group)
+            n_tokens_batch = int(np.prod(stacked["input_ids"].shape))
+            batch = place_batch(self.mesh_ctx, stacked)
+            self.state, metrics = self.train_step(self.state, batch)
+            if self.step_scheduler.is_log_step:
+                metrics = {k: v for k, v in jax.device_get(metrics).items()}
+                dt = time.perf_counter() - t0
+                metrics["tps"] = n_tokens_batch / max(dt, 1e-9)
+                metrics["tps_per_device"] = metrics["tps"] / self.mesh_ctx.world_size
+                metrics["step_time_s"] = dt
+                self.metric_logger.log(metrics, step=int(metrics["step"]))
+                last = metrics
+            if self.step_scheduler.is_val_step and self.val_dataloader is not None:
+                val = self.run_validation()
+                self.metric_logger.log(val, step=self.step_scheduler.step)
+            if self.step_scheduler.is_ckpt_step:
+                self.save_checkpoint()
+            t0 = time.perf_counter()
+        if self.checkpointer:
+            self.save_checkpoint()
+        return last
+
+    def run_validation(self) -> dict:
+        tot_loss, tot_n = 0.0, 0
+        for vb in self.val_dataloader:
+            batch = place_batch(self.mesh_ctx, stack_microbatches([vb]))
+            out = jax.device_get(self.eval_step(self.state, batch))
+            tot_loss += float(out["loss_sum"])
+            tot_n += int(out["num_label_tokens"])
+        return {"val_loss": tot_loss / max(tot_n, 1), "val_tokens": tot_n}
+
+
+def main(cfg: ConfigNode) -> dict:
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(cfg)
+    recipe.setup()
+    return recipe.run_train_validation_loop()

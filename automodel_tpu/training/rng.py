@@ -1,0 +1,52 @@
+"""Checkpointable RNG state.
+
+Parity: the reference's StatefulRNG / ScopedRNG (training/rng.py:83,115)
+capture python/numpy/torch generator states. Here device-side randomness is a
+jax PRNG key threaded through TrainState (functional, already checkpointable);
+this class covers the HOST side (python/numpy used by data pipelines).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+from typing import Any
+
+import numpy as np
+
+
+class StatefulRNG:
+    def __init__(self, seed: int = 0, ranked: bool = False, rank: int = 0):
+        seed = seed + (rank if ranked else 0)
+        self.python = random.Random(seed)
+        self.numpy = np.random.default_rng(seed)
+
+    def state_dict(self) -> dict[str, Any]:
+        return {
+            "python": self.python.getstate(),
+            "numpy": self.numpy.bit_generator.state,
+        }
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        pystate = state["python"]
+        # JSON round-trips tuples as lists; random.setstate needs tuples.
+        if isinstance(pystate, list):
+            pystate = tuple(
+                tuple(p) if isinstance(p, list) else p for p in pystate
+            )
+        self.python.setstate(pystate)
+        self.numpy.bit_generator.state = state["numpy"]
+
+
+@contextlib.contextmanager
+def scoped_rng(seed: int):
+    """Temporarily seed global python/numpy RNGs (reference ScopedRNG)."""
+    py_state = random.getstate()
+    np_state = np.random.get_state()
+    random.seed(seed)
+    np.random.seed(seed)
+    try:
+        yield
+    finally:
+        random.setstate(py_state)
+        np.random.set_state(np_state)

@@ -1,0 +1,91 @@
+"""Step scheduling: epochs, grad accumulation, checkpoint/val cadence.
+
+Parity: reference StepScheduler (components/training/step_scheduler.py:48) —
+iterates (epoch, grad-acc batch group) pairs, exposes ckpt/val/log cadence
+predicates, is checkpointable, and stops cleanly on a shutdown signal
+(DistributedSignalHandler, training/signal_handler.py:91; single-controller
+JAX needs only a host-side SIGTERM hook).
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Any, Iterator, Optional
+
+
+class StepScheduler:
+    def __init__(
+        self,
+        grad_acc_steps: int = 1,
+        ckpt_every_steps: int = 0,
+        val_every_steps: int = 0,
+        log_every_steps: int = 1,
+        num_epochs: int = 1,
+        max_steps: Optional[int] = None,
+        dataloader: Any = None,
+    ):
+        self.grad_acc_steps = grad_acc_steps
+        self.ckpt_every_steps = ckpt_every_steps
+        self.val_every_steps = val_every_steps
+        self.log_every_steps = log_every_steps
+        self.num_epochs = num_epochs
+        self.max_steps = max_steps
+        self.dataloader = dataloader
+        self.step = 0  # optimizer steps taken
+        self.epoch = 0
+        self._shutdown = False
+
+    # -- graceful shutdown --------------------------------------------------
+    def install_signal_handler(self, signals: tuple = (signal.SIGTERM,)) -> None:
+        for sig in signals:
+            signal.signal(sig, self._on_signal)
+
+    def _on_signal(self, signum, frame) -> None:
+        self._shutdown = True
+
+    @property
+    def shutdown_requested(self) -> bool:
+        return self._shutdown
+
+    # -- iteration ----------------------------------------------------------
+    def __iter__(self) -> Iterator[list]:
+        """Yield lists of `grad_acc_steps` microbatches (one optimizer step)."""
+        from automodel_tpu.data.collators import stack_microbatches  # noqa: F401
+
+        while self.epoch < self.num_epochs:
+            group: list = []
+            for batch in self.dataloader:
+                group.append(batch)
+                if len(group) == self.grad_acc_steps:
+                    yield group
+                    group = []
+                    self.step += 1
+                    if self.max_steps is not None and self.step >= self.max_steps:
+                        return
+                    if self._shutdown:
+                        return
+            self.epoch += 1
+            if getattr(self.dataloader, "epoch", None) is not None:
+                # map-style loader already advanced its own epoch counter
+                pass
+
+    # -- cadence ------------------------------------------------------------
+    @property
+    def is_ckpt_step(self) -> bool:
+        return self.ckpt_every_steps > 0 and self.step % self.ckpt_every_steps == 0
+
+    @property
+    def is_val_step(self) -> bool:
+        return self.val_every_steps > 0 and self.step % self.val_every_steps == 0
+
+    @property
+    def is_log_step(self) -> bool:
+        return self.log_every_steps > 0 and self.step % self.log_every_steps == 0
+
+    # -- state --------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"step": self.step, "epoch": self.epoch}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.step = state["step"]
+        self.epoch = state["epoch"]

@@ -1,0 +1,19 @@
+"""Train state pytree."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray  # int32 scalar
+
+    @classmethod
+    def create(cls, params: Any, opt_state: Any) -> "TrainState":
+        return cls(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))

@@ -1,0 +1,136 @@
+"""The jitted training step.
+
+Parity with the reference hot path (recipes/llm/train_ft.py:1284
+_run_train_optim_step): microbatch grad accumulation, GLOBAL label-token
+normalization across the dp_cp group and all microbatches
+(train_ft.py:1292-1303), grad clip, optimizer step, loss/grad-norm metrics.
+
+TPU-native structure: ONE `jax.jit` covers the whole optimizer step —
+the microbatch loop is a `lax.scan` over a leading accumulation axis, so
+FSDP all-gathers, loss collectives, and the optimizer update are all
+scheduled by XLA inside a single program (the reference needs
+MoEFSDPSyncMixin + no_sync contexts to get this right; here it falls out
+of functional grads). Collectives are implicit: batches arrive sharded over
+(dp, cp); `jnp.sum` of loss/token-count is a global reduction XLA lowers to
+psum over the data axes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from automodel_tpu.training.train_state import TrainState
+
+
+def build_train_step(
+    loss_fn: Callable[[Any, dict], tuple[jnp.ndarray, jnp.ndarray]],
+    optimizer: optax.GradientTransformation,
+    lr_schedule: Optional[Callable] = None,
+    donate: bool = True,
+) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
+    """Build the jitted (state, batch) → (state, metrics) step.
+
+    ``loss_fn(params, microbatch) -> (loss_sum, n_valid_tokens)`` where
+    loss_sum is the UN-normalized token-loss sum (normalization happens here,
+    globally). ``batch`` leaves carry a leading microbatch axis [A, ...]; A=1
+    for no accumulation.
+    """
+
+    def mb_value_and_grad(params, mb):
+        def wrapped(p):
+            loss_sum, n = loss_fn(p, mb)
+            return loss_sum.astype(jnp.float32), n
+        return jax.value_and_grad(wrapped, has_aux=True)(params)
+
+    def step_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        grads0 = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params)
+        carry0 = (grads0, jnp.float32(0.0), jnp.int32(0))
+
+        def body(carry, mb):
+            g_acc, l_acc, n_acc = carry
+            (loss_sum, n), grads = mb_value_and_grad(state.params, mb)
+            g_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), g_acc, grads
+            )
+            return (g_acc, l_acc + loss_sum, n_acc + n), None
+
+        (grads, loss_sum, n_tokens), _ = jax.lax.scan(body, carry0, batch)
+        denom = jnp.maximum(n_tokens, 1).astype(jnp.float32)
+        grads = jax.tree.map(lambda g: g / denom, grads)
+        grad_norm = optax.global_norm(grads)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        # keep params in their original dtype (apply_updates may upcast)
+        new_params = jax.tree.map(
+            lambda new, old: new.astype(old.dtype), new_params, state.params
+        )
+        metrics = {
+            "loss": loss_sum / denom,
+            "grad_norm": grad_norm,
+            "num_label_tokens": n_tokens,
+            "step": state.step + 1,
+        }
+        if lr_schedule is not None:
+            metrics["lr"] = lr_schedule(state.step)
+        new_state = TrainState(
+            params=new_params, opt_state=new_opt_state, step=state.step + 1
+        )
+        return new_state, metrics
+
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+
+def build_eval_step(
+    loss_fn: Callable[[Any, dict], tuple[jnp.ndarray, jnp.ndarray]],
+) -> Callable[[TrainState, dict], dict]:
+    """Validation step: microbatch-scanned loss sum + token count."""
+
+    def step_fn(state: TrainState, batch: dict) -> dict:
+        def body(carry, mb):
+            l_acc, n_acc = carry
+            loss_sum, n = loss_fn(state.params, mb)
+            return (l_acc + loss_sum.astype(jnp.float32), n_acc + n), None
+
+        (loss_sum, n), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), batch)
+        return {"loss_sum": loss_sum, "num_label_tokens": n}
+
+    return jax.jit(step_fn)
+
+
+def make_causal_lm_loss(
+    model: Any,
+    loss: str = "masked_ce",
+    constrain: Callable = lambda x, s: x,
+    **loss_kwargs: Any,
+) -> Callable[[Any, dict], tuple[jnp.ndarray, jnp.ndarray]]:
+    """Standard next-token-prediction loss over a causal LM.
+
+    Labels follow the HF convention (already shifted by the collator:
+    labels[t] is the target for position t, ignore_index=-100 padding).
+    ``loss='fused_linear_ce'`` skips logits materialization (reference:
+    FusedLinearCrossEntropy, loss/linear_ce.py:119).
+    """
+    from automodel_tpu.ops import losses as L
+
+    def loss_fn(params, mb):
+        kw = {
+            k: mb[k]
+            for k in ("position_ids", "segment_ids")
+            if k in mb and mb[k] is not None
+        }
+        if loss == "fused_linear_ce":
+            hidden = model.hidden(params, mb["input_ids"], constrain=constrain, **kw)
+            kernel = model.lm_head(params).astype(hidden.dtype)
+            return L.fused_linear_cross_entropy(
+                hidden, kernel, mb["labels"],
+                logits_soft_cap=model.config.logits_soft_cap, **loss_kwargs,
+            )
+        logits = model(params, mb["input_ids"], constrain=constrain, **kw)
+        return L.build_loss(loss, **loss_kwargs)(logits, mb["labels"])
+
+    return loss_fn
