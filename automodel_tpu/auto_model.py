@@ -50,14 +50,24 @@ class AutoModel:
         return self.model(params, *args, constrain=self.constrain, **kw)
 
 
-def _read_hf_config(path: str | Path) -> dict:
-    cfg_file = Path(path) / "config.json"
-    if cfg_file.exists():
-        return json.loads(cfg_file.read_text())
-    # a transformers hub id — config resolution via transformers cache
-    from transformers import AutoConfig
+def _resolve_checkpoint_dir(path_or_id: str | Path) -> Path:
+    """Local dir as-is; otherwise resolve a hub id to a local snapshot
+    (cache-first; downloads weights too so the later safetensors read works)."""
+    p = Path(path_or_id)
+    if p.is_dir():
+        return p
+    from huggingface_hub import snapshot_download
 
-    return AutoConfig.from_pretrained(path).to_dict()
+    return Path(
+        snapshot_download(
+            str(path_or_id),
+            allow_patterns=["*.safetensors", "*.safetensors.index.json", "config.json"],
+        )
+    )
+
+
+def _read_hf_config(path: str | Path) -> dict:
+    return json.loads((Path(path) / "config.json").read_text())
 
 
 def from_config(
@@ -92,7 +102,8 @@ def from_pretrained(
     from automodel_tpu.checkpoint.hf_io import load_params_from_hf
 
     backend = _as_backend(backend)
-    hf_config = _read_hf_config(pretrained_model_name_or_path)
+    ckpt_dir = _resolve_checkpoint_dir(pretrained_model_name_or_path)
+    hf_config = _read_hf_config(ckpt_dir)
     builder = resolve_architecture(hf_config)
     model, adapter = builder(hf_config, backend)
     shardings = None
@@ -101,7 +112,7 @@ def from_pretrained(
         shardings = make_param_shardings(mesh_ctx, abstract, model.sharding_rules)
     params = load_params_from_hf(
         adapter,
-        pretrained_model_name_or_path,
+        ckpt_dir,
         shardings=shardings,
         dtype=_np_dtype(backend.param_dtype),
     )

@@ -67,6 +67,10 @@ class Checkpointer:
     ) -> Path:
         out = self.step_dir(epoch, step)
         out.mkdir(parents=True, exist_ok=True)
+        # saving the same step twice (cadence save + end-of-loop save) is
+        # idempotent: replace the previous state dir
+        if (out / "state").exists():
+            shutil.rmtree(out / "state")
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save((out / "state").absolute(), state)
         if extra_state:
@@ -79,8 +83,10 @@ class Checkpointer:
             from automodel_tpu.checkpoint.hf_io import save_hf_checkpoint
 
             adapter, params = hf_export
-            host_params = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
-            save_hf_checkpoint(out / "hf", adapter.to_hf(host_params))
+            # adapter.to_hf is a generator that np.asarray's one leaf at a
+            # time — device→host transfer streams per leaf, and
+            # save_hf_checkpoint flushes shard files as they fill.
+            save_hf_checkpoint(out / "hf", adapter.to_hf(params))
         self._prune()
         return out
 

@@ -1,42 +1,66 @@
-"""HF safetensors checkpoint IO.
+"""HF safetensors checkpoint IO — self-contained, torch-free.
 
 Parity: the reference's HF-storage layer (components/checkpoint/_backports/
 hf_storage.py, consolidate_hf_safetensors.py) reads/writes sharded
 ``model-0000x-of-0000y.safetensors`` + ``model.safetensors.index.json``.
 TPU-native: single-controller JAX needs no multi-rank consolidation dance —
-we stream tensors shard-file by shard-file on the host and device_put each
-leaf directly to its target sharding (SURVEY.md §7: "single-controller makes
-this simpler than the reference's rank dance").
+tensors stream shard-file by shard-file on the host and each leaf is
+device_put directly to its target sharding.
+
+The safetensors container format is parsed/emitted directly ([8-byte LE u64
+header length][JSON header][raw data]) because the `safetensors` numpy
+front-end cannot represent bf16 — `ml_dtypes.bfloat16` (bundled with jax)
+can, so bf16 checkpoints round-trip without a torch dependency or an f32
+upcast.
 """
 
 from __future__ import annotations
 
 import json
+import mmap
 import os
+import struct
 from pathlib import Path
-from typing import Any, Callable, Iterable, Iterator
+from typing import Any, Iterable, Iterator
 
+import ml_dtypes
 import numpy as np
 
 SAFETENSORS_INDEX = "model.safetensors.index.json"
 MAX_SHARD_BYTES = 5 * 1024**3
 
-# torch-free dtype mapping for reading HF checkpoints via numpy
-_ST_DTYPES = {
-    "F64": np.float64, "F32": np.float32, "F16": np.float16,
-    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
-    "U8": np.uint8, "BOOL": np.bool_,
+_ST_TO_NP = {
+    "F64": np.dtype(np.float64),
+    "F32": np.dtype(np.float32),
+    "F16": np.dtype(np.float16),
+    "BF16": np.dtype(ml_dtypes.bfloat16),
+    "F8_E4M3": np.dtype(ml_dtypes.float8_e4m3fn),
+    "F8_E5M2": np.dtype(ml_dtypes.float8_e5m2),
+    "I64": np.dtype(np.int64),
+    "I32": np.dtype(np.int32),
+    "I16": np.dtype(np.int16),
+    "I8": np.dtype(np.int8),
+    "U64": np.dtype(np.uint64),
+    "U32": np.dtype(np.uint32),
+    "U16": np.dtype(np.uint16),
+    "U8": np.dtype(np.uint8),
+    "BOOL": np.dtype(np.bool_),
 }
+_NP_TO_ST = {v: k for k, v in _ST_TO_NP.items()}
 
 
-def _bf16_to_f32(raw: np.ndarray) -> np.ndarray:
-    """View uint16 bf16 payload as float32 (shift into high mantissa bits)."""
-    u32 = raw.astype(np.uint32) << 16
-    return u32.view(np.float32)
+def _read_header(path: Path) -> tuple[dict, int]:
+    """(header dict, data section offset)."""
+    with open(path, "rb") as f:
+        (n,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(n))
+    return header, 8 + n
 
 
 class HFCheckpointReader:
-    """Lazy reader over a HF checkpoint dir (single file or sharded+index)."""
+    """Lazy mmap reader over an HF checkpoint dir (single file or
+    sharded+index). Tensors are copied out of the mmap on access, so each
+    `get_tensor` touches only that tensor's bytes."""
 
     def __init__(self, path: str | os.PathLike):
         self.path = Path(path)
@@ -46,50 +70,72 @@ class HFCheckpointReader:
             index = json.loads(index_file.read_text())
             self.weight_map = dict(index["weight_map"])
         else:
-            single = self.path / "model.safetensors"
-            if not single.exists():
-                cands = sorted(self.path.glob("*.safetensors"))
-                if not cands:
-                    raise FileNotFoundError(f"No safetensors checkpoint under {self.path}")
-                single = cands[0]
-            from safetensors import safe_open
-
-            with safe_open(str(single), framework="numpy") as f:
-                for k in f.keys():
-                    self.weight_map[k] = single.name
-        self._open_files: dict[str, Any] = {}
+            cands = sorted(self.path.glob("*.safetensors"))
+            if not cands:
+                raise FileNotFoundError(f"No safetensors checkpoint under {self.path}")
+            for c in cands:
+                header, _ = _read_header(c)
+                for k in header:
+                    if k != "__metadata__":
+                        self.weight_map[k] = c.name
+        # per shard file: (header, data_offset, mmap)
+        self._files: dict[str, tuple[dict, int, Any]] = {}
 
     def keys(self) -> list[str]:
         return list(self.weight_map)
 
-    def _file(self, name: str):
-        if name not in self._open_files:
-            from safetensors import safe_open
+    def _file(self, name: str) -> tuple[dict, int, Any]:
+        if name not in self._files:
+            p = self.path / name
+            header, data_off = _read_header(p)
+            f = open(p, "rb")
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            f.close()
+            self._files[name] = (header, data_off, mm)
+        return self._files[name]
 
-            self._open_files[name] = safe_open(str(self.path / name), framework="numpy")
-        return self._open_files[name]
+    def info(self, key: str) -> tuple[str, tuple[int, ...]]:
+        """(safetensors dtype string, shape) without reading data."""
+        header, _, _ = self._file(self.weight_map[key])
+        meta = header[key]
+        return meta["dtype"], tuple(meta["shape"])
 
     def get_tensor(self, key: str) -> np.ndarray:
-        f = self._file(self.weight_map[key])
-        try:
-            return f.get_tensor(key)
-        except Exception:
-            # numpy framework can't decode bf16; read the slice raw and widen.
-            sl = f.get_slice(key)
-            dtype = sl.get_dtype()
-            if str(dtype).upper() in ("BF16", "BFLOAT16"):
-                import torch
-
-                with_safe = self.path / self.weight_map[key]
-                from safetensors import safe_open as so
-
-                with so(str(with_safe), framework="pt") as tf:
-                    t = tf.get_tensor(key)
-                return t.float().numpy()
-            raise
+        header, data_off, mm = self._file(self.weight_map[key])
+        meta = header[key]
+        dtype = _ST_TO_NP[meta["dtype"]]
+        start, end = meta["data_offsets"]
+        buf = mm[data_off + start : data_off + end]
+        return np.frombuffer(buf, dtype=dtype).reshape(meta["shape"])
 
     def close(self) -> None:
-        self._open_files.clear()
+        for _, _, mm in self._files.values():
+            mm.close()
+        self._files.clear()
+
+
+def _write_safetensors(path: Path, tensors: dict[str, np.ndarray]) -> None:
+    header: dict[str, Any] = {}
+    offset = 0
+    for k, arr in tensors.items():
+        st_dtype = _NP_TO_ST.get(arr.dtype)
+        if st_dtype is None:
+            raise TypeError(f"{k}: dtype {arr.dtype} has no safetensors encoding")
+        header[k] = {
+            "dtype": st_dtype,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + arr.nbytes],
+        }
+        offset += arr.nbytes
+    hbytes = json.dumps(header, separators=(",", ":")).encode()
+    # safetensors spec: pad header with spaces to 8-byte alignment
+    pad = (8 - (len(hbytes) % 8)) % 8
+    hbytes += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hbytes)))
+        f.write(hbytes)
+        for k, arr in tensors.items():
+            f.write(np.ascontiguousarray(arr).tobytes())
 
 
 def save_hf_checkpoint(
@@ -102,40 +148,52 @@ def save_hf_checkpoint(
     """Write sharded safetensors + index (consolidated-HF layout the
     reference produces via _HuggingFaceStorageWriter, checkpointing.py:733).
 
-    `tensors` is an iterator so callers can stream device shards → host
-    without holding the full model in RAM.
+    Streams: each shard file is written and released as soon as it reaches
+    `max_shard_bytes`, so peak host memory is one shard, not the model.
+    Shards get temporary names until the total count is known, then are
+    renamed to ``model-0000x-of-0000y.safetensors``.
     """
-    from safetensors.numpy import save_file
-
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
-    shards: list[dict[str, np.ndarray]] = [{}]
-    sizes = [0]
-    weight_map: dict[str, str] = {}
+    shard: dict[str, np.ndarray] = {}
+    shard_size = 0
+    shard_keys: list[list[str]] = []
     total = 0
+
+    def flush():
+        nonlocal shard, shard_size
+        if not shard:
+            return
+        _write_safetensors(path / f"shard-{len(shard_keys):05d}.tmp", shard)
+        shard_keys.append(list(shard))
+        shard = {}
+        shard_size = 0
+
     for key, arr in tensors:
         arr = np.asarray(arr)
         if dtype is not None:
             arr = arr.astype(dtype)
-        nbytes = arr.nbytes
-        if sizes[-1] + nbytes > max_shard_bytes and shards[-1]:
-            shards.append({})
-            sizes.append(0)
-        shards[-1][key] = arr
-        sizes[-1] += nbytes
-        total += nbytes
-    n = len(shards)
-    if n == 1:
-        fname = "model.safetensors"
-        save_file(shards[0], str(path / fname))
-        weight_map = {k: fname for k in shards[0]}
-    else:
-        for i, shard in enumerate(shards):
-            fname = f"model-{i + 1:05d}-of-{n:05d}.safetensors"
-            save_file(shard, str(path / fname))
-            weight_map.update({k: fname for k in shard})
-    index = {"metadata": {"total_size": total, **(metadata or {})}, "weight_map": weight_map}
-    (path / SAFETENSORS_INDEX).write_text(json.dumps(index, indent=2))
+        if shard_size + arr.nbytes > max_shard_bytes and shard:
+            flush()
+        shard[key] = arr
+        shard_size += arr.nbytes
+        total += arr.nbytes
+    flush()
+
+    n = len(shard_keys)
+    weight_map: dict[str, str] = {}
+    for i, keys in enumerate(shard_keys):
+        fname = (
+            "model.safetensors" if n == 1 else f"model-{i + 1:05d}-of-{n:05d}.safetensors"
+        )
+        (path / f"shard-{i:05d}.tmp").rename(path / fname)
+        weight_map.update({k: fname for k in keys})
+    if n != 1:
+        index = {
+            "metadata": {"total_size": total, **(metadata or {})},
+            "weight_map": weight_map,
+        }
+        (path / SAFETENSORS_INDEX).write_text(json.dumps(index, indent=2))
 
 
 def load_params_from_hf(

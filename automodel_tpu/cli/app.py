@@ -42,21 +42,26 @@ def main(argv: list[str] | None = None) -> int:
 
     initialize_distributed()
 
-    if command in ("finetune", "pretrain") and domain == "llm":
-        from automodel_tpu.recipes.train_ft import main as recipe_main
+    recipe_modules = {
+        ("finetune", "llm"): "automodel_tpu.recipes.train_ft",
+        ("pretrain", "llm"): "automodel_tpu.recipes.train_ft",
+        ("benchmark", "llm"): "automodel_tpu.recipes.benchmark",
+        ("kd", "llm"): "automodel_tpu.recipes.kd",
+        ("finetune", "vlm"): "automodel_tpu.recipes.finetune_vlm",
+    }
+    module_name = recipe_modules.get((command, domain))
+    if module_name is not None:
+        import importlib
 
-        recipe_main(cfg)
-        return 0
-    if command == "benchmark" and domain == "llm":
-        from automodel_tpu.recipes.benchmark import main as bench_main
-
-        bench_main(cfg)
-        return 0
-    if command == "kd" and domain == "llm":
-        from automodel_tpu.recipes.kd import main as kd_main
-
-        kd_main(cfg)
-        return 0
+        try:
+            module = importlib.import_module(module_name)
+        except ModuleNotFoundError as e:
+            if e.name != module_name:
+                raise
+            module = None
+        if module is not None:
+            module.main(cfg)
+            return 0
     print(f"{command} {domain} is not implemented yet")
     return 3
 

@@ -119,7 +119,13 @@ class TransformerConfig:
             mlp_bias=bool(get("mlp_bias", False)),
             qk_norm=model_type in ("qwen3", "qwen3_moe"),
             act=get("hidden_act", "silu"),
-            sliding_window=get("sliding_window", None) if get("use_sliding_window", False) else None,
+            # qwen2 gates the window behind use_sliding_window; mistral-style
+            # configs apply sliding_window unconditionally when present.
+            sliding_window=(
+                get("sliding_window", None)
+                if get("use_sliding_window", model_type == "mistral")
+                else None
+            ),
             max_window_layers=get("max_window_layers", 0) or 0,
         )
 

@@ -48,7 +48,6 @@ def available_architectures() -> list[str]:
     "Qwen2ForCausalLM",
     "Qwen3ForCausalLM",
     "MistralForCausalLM",
-    "Gemma2ForCausalLM",
 )
 def _llama_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.llama import LlamaForCausalLM, LlamaStateDictAdapter

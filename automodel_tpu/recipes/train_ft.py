@@ -157,12 +157,22 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         logger.info("saved checkpoint at step %d", self.step_scheduler.step)
 
     def _restore(self) -> None:
-        state, extra = self.checkpointer.load(jax.eval_shape(lambda: self.state))
-        # re-place restored arrays on the current mesh with plan shardings
-        from automodel_tpu.parallel.plans import shard_params
+        # Abstract target WITH shardings so orbax restores every array —
+        # params AND optimizer moments — directly onto its current-mesh shard
+        # (adam state is 2x model size; restoring it replicated would OOM).
+        # Param-path regexes match opt_state paths too (mu/nu mirror the param
+        # tree as subtrees), so one rule set covers both.
+        from automodel_tpu.parallel.plans import make_param_shardings
 
-        params = shard_params(self.mesh_ctx, state.params, self.model.sharding_rules)
-        self.state = TrainState(params=params, opt_state=state.opt_state, step=state.step)
+        abstract = jax.eval_shape(lambda: self.state)
+        shardings = make_param_shardings(self.mesh_ctx, abstract, self.model.sharding_rules)
+        abstract = jax.tree.map(
+            lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+            abstract,
+            shardings,
+        )
+        state, extra = self.checkpointer.load(abstract)
+        self.state = state
         if "dataloader" in extra:
             self.dataloader.load_state_dict(extra["dataloader"])
         if "step_scheduler" in extra:

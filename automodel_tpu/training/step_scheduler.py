@@ -57,17 +57,18 @@ class StepScheduler:
             for batch in self.dataloader:
                 group.append(batch)
                 if len(group) == self.grad_acc_steps:
-                    yield group
-                    group = []
-                    self.step += 1
                     if self.max_steps is not None and self.step >= self.max_steps:
                         return
+                    # increment BEFORE yielding so the consumer's loop body
+                    # (cadence predicates, checkpoint naming) sees the step
+                    # number of the optimizer step it is currently taking,
+                    # matching TrainState.step after train_step.
+                    self.step += 1
+                    yield group
+                    group = []
                     if self._shutdown:
                         return
             self.epoch += 1
-            if getattr(self.dataloader, "epoch", None) is not None:
-                # map-style loader already advanced its own epoch counter
-                pass
 
     # -- cadence ------------------------------------------------------------
     @property

@@ -1,0 +1,145 @@
+"""FLOPs formulas and MFU computation.
+
+Parity: the reference's per-arch FLOPs formulas and `calculate_mfu`
+(components/utils/flops_utils.py:18-172). TPU-native addition: a peak-FLOPs
+table keyed by `jax.Device.device_kind` instead of GPU SKUs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+# Peak dense BF16 TFLOPs per chip. Sources: public TPU spec sheets.
+# device_kind strings as reported by the JAX runtime.
+TPU_PEAK_BF16_TFLOPS: dict[str, float] = {
+    "TPU v4": 275.0,
+    "TPU v5": 459.0,  # v5p
+    "TPU v5p": 459.0,
+    "TPU v5 lite": 197.0,  # v5e
+    "TPU v5e": 197.0,
+    "TPU v6 lite": 918.0,  # v6e / Trillium
+    "TPU v6e": 918.0,
+    "TPU7x": 2307.0,  # ironwood
+}
+_H100_PEAK_TFLOPS = 989.0  # the reference's MFU basis (performance-summary.md:70)
+
+
+def device_peak_tflops(device: Optional[jax.Device] = None) -> float:
+    """Peak BF16 TFLOPs of `device` (default: first local device).
+    Unknown kinds return float('nan') rather than a silent wrong basis."""
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "")
+    if kind in TPU_PEAK_BF16_TFLOPS:
+        return TPU_PEAK_BF16_TFLOPS[kind]
+    for k, v in TPU_PEAK_BF16_TFLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return float("nan")
+
+
+def dense_transformer_flops_per_token(
+    hidden_size: int,
+    num_layers: int,
+    intermediate_size: int,
+    vocab_size: int,
+    seq_len: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    *,
+    num_gated_linear: int = 3,
+    causal: bool = True,
+) -> float:
+    """Training FLOPs per token (fwd+bwd = 3x fwd matmul FLOPs) for a dense
+    llama-style decoder (reference: llama2/llama3 formulas,
+    utils/flops_utils.py:60-100).
+    """
+    q_dim = num_heads * head_dim
+    kv_dim = num_kv_heads * head_dim
+    # per-token fwd matmul MACs ×2 = FLOPs
+    attn_proj = 2 * (hidden_size * (q_dim + 2 * kv_dim) + q_dim * hidden_size)
+    # attention scores+values: 2 matmuls of [S, H]x[H, S]; causal halves it
+    attn_sdp = 2 * 2 * q_dim * seq_len * (0.5 if causal else 1.0)
+    mlp = 2 * num_gated_linear * hidden_size * intermediate_size
+    per_layer = attn_proj + attn_sdp + mlp
+    lm_head = 2 * hidden_size * vocab_size
+    fwd = num_layers * per_layer + lm_head
+    return 3.0 * fwd  # fwd + bwd(2x)
+
+
+def moe_transformer_flops_per_token(
+    hidden_size: int,
+    num_layers: int,
+    moe_intermediate_size: int,
+    num_active_experts: int,
+    shared_expert_intermediate: int,
+    vocab_size: int,
+    seq_len: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dense_intermediate_size: int = 0,
+    num_dense_layers: int = 0,
+    causal: bool = True,
+) -> float:
+    """Training FLOPs per token for a MoE decoder: only ACTIVE experts count
+    (reference mixtral/qwen3 formulas, utils/flops_utils.py:120-172)."""
+    q_dim = num_heads * head_dim
+    kv_dim = num_kv_heads * head_dim
+    attn = 2 * (hidden_size * (q_dim + 2 * kv_dim) + q_dim * hidden_size)
+    attn += 2 * 2 * q_dim * seq_len * (0.5 if causal else 1.0)
+    moe_mlp = 2 * 3 * hidden_size * (
+        moe_intermediate_size * num_active_experts + shared_expert_intermediate
+    )
+    dense_mlp = 2 * 3 * hidden_size * dense_intermediate_size
+    n_moe = num_layers - num_dense_layers
+    fwd = (
+        num_layers * attn
+        + n_moe * moe_mlp
+        + num_dense_layers * dense_mlp
+        + 2 * hidden_size * vocab_size
+    )
+    return 3.0 * fwd
+
+
+def flops_per_token_for_config(cfg: Any, seq_len: int) -> float:
+    """Dispatch on a TransformerConfig-like object (dense or MoE)."""
+    moe = getattr(cfg, "moe", None)
+    if moe is not None:
+        return moe_transformer_flops_per_token(
+            hidden_size=cfg.hidden_size,
+            num_layers=cfg.num_layers,
+            moe_intermediate_size=moe.moe_intermediate_size,
+            num_active_experts=moe.num_experts_per_tok,
+            shared_expert_intermediate=moe.shared_expert_intermediate_size,
+            vocab_size=cfg.vocab_size,
+            seq_len=seq_len,
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            dense_intermediate_size=cfg.intermediate_size,
+            num_dense_layers=getattr(moe, "num_dense_layers", 0),
+        )
+    return dense_transformer_flops_per_token(
+        hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers,
+        intermediate_size=cfg.intermediate_size,
+        vocab_size=cfg.vocab_size,
+        seq_len=seq_len,
+        num_heads=cfg.num_heads,
+        num_kv_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+    )
+
+
+def calculate_mfu(
+    tokens_per_second_per_chip: float,
+    flops_per_token: float,
+    peak_tflops: Optional[float] = None,
+) -> float:
+    """Model FLOPs utilization in [0, 1] (reference: calculate_mfu,
+    utils/flops_utils.py:18)."""
+    peak = peak_tflops if peak_tflops is not None else device_peak_tflops()
+    return tokens_per_second_per_chip * flops_per_token / (peak * 1e12)

@@ -1,0 +1,150 @@
+"""Headline benchmark: dense Llama-family SFT train-step MFU on one chip.
+
+Mirrors the reference benchmark conditions (docs/performance-summary.md:66-72;
+BenchmarkingRecipeForNextTokenPrediction, recipes/llm/benchmark.py:34): mock
+data, no validation, warmup steps excluded, MFU = achieved model FLOPs /
+device peak. Baseline: the reference's best single-GPU dense SFT MFU — Llama3
+8B LoRA at 402 TFLOPs/s on H100 (989 peak) = 40.6% MFU (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+BASELINE_MFU = 402.0 / 989.0  # reference Llama3-8B SFT, H100
+
+
+def _bench_config(on_tpu: bool, device_kind: str = "") -> tuple[dict, dict, int, int, int]:
+    """(hf_config, backend, global_batch, seq_len, steps)."""
+    if on_tpu:
+        # ~16GB-HBM chips (v5e, v4) get a ~0.9B model; bigger chips ~3B.
+        small_hbm = any(k in device_kind for k in ("lite", "v5e", "v4"))
+        hf = {
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "vocab_size": 32768,
+            "hidden_size": 2048 if small_hbm else 3072,
+            "intermediate_size": 5632 if small_hbm else 8192,
+            "num_hidden_layers": 16 if small_hbm else 26,
+            "num_attention_heads": 16 if small_hbm else 24,
+            "num_key_value_heads": 8,
+            "head_dim": 128,
+            "rms_norm_eps": 1e-5,
+            "max_position_embeddings": 8192,
+            "rope_theta": 500000.0,
+            "tie_word_embeddings": False,
+        }
+        backend = {
+            "attn": "flash",
+            "param_dtype": "bfloat16",
+            "compute_dtype": "bfloat16",
+            "remat": "full" if small_hbm else "selective",
+        }
+        return hf, backend, 4 if small_hbm else 8, 4096, 8
+    # CPU smoke path so the bench is runnable anywhere
+    hf = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": 1024,
+        "hidden_size": 128,
+        "intermediate_size": 352,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "head_dim": 32,
+    }
+    backend = {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "bfloat16"}
+    return hf, backend, 4, 256, 2
+
+
+def main() -> None:
+    from automodel_tpu import auto_model
+    from automodel_tpu.data.loader import place_batch
+    from automodel_tpu.optim.builders import build_optimizer
+    from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+    from automodel_tpu.training.train_state import TrainState
+    from automodel_tpu.training.train_step import build_train_step, make_causal_lm_loss
+    from automodel_tpu.utils.flops_utils import (
+        calculate_mfu,
+        device_peak_tflops,
+        flops_per_token_for_config,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    hf, backend, batch, seq, steps = _bench_config(
+        on_tpu, getattr(jax.devices()[0], "device_kind", "")
+    )
+    n_chips = len(jax.devices())
+    ctx = build_mesh(MeshConfig(dp_shard=-1))
+
+    auto = auto_model.from_config(hf, ctx, backend, seed=0)
+    optimizer = build_optimizer(name="adamw", lr=1e-4, betas=(0.9, 0.95))
+    opt_state = jax.jit(optimizer.init)(auto.params)
+    state = TrainState.create(auto.params, opt_state)
+    loss_fn = make_causal_lm_loss(
+        auto.model, loss="fused_linear_ce", constrain=auto.constrain
+    )
+    train_step = build_train_step(loss_fn, optimizer)
+
+    rng = np.random.default_rng(0)
+    vocab = hf["vocab_size"]
+
+    def make_batch():
+        ids = rng.integers(0, vocab, size=(1, batch, seq))
+        return place_batch(
+            ctx,
+            {
+                "input_ids": np.asarray(ids, np.int32),
+                "labels": np.asarray(ids, np.int32),
+            },
+        )
+
+    # warmup (compile). device_get (not block_until_ready) is the sync point:
+    # on tunneled/remote backends only a value transfer is a true barrier.
+    b = make_batch()
+    for _ in range(2):
+        state, metrics = train_step(state, b)
+    jax.device_get(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, b)
+    jax.device_get(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = steps * batch * seq
+    tps_chip = tokens / dt / n_chips
+    fpt = flops_per_token_for_config(auto.model.config, seq)
+    peak = device_peak_tflops()
+    mfu = calculate_mfu(tps_chip, fpt, peak) if peak == peak else float("nan")
+    achieved_tflops = tps_chip * fpt / 1e12
+
+    print(
+        f"[bench] chips={n_chips} platform={jax.devices()[0].device_kind} "
+        f"tok/s/chip={tps_chip:,.0f} TFLOPs/s/chip={achieved_tflops:.1f} "
+        f"MFU={mfu:.3f} loss={float(jax.device_get(metrics['loss'])):.3f}",
+        file=sys.stderr,
+    )
+    value = mfu * 100 if mfu == mfu else achieved_tflops
+    print(
+        json.dumps(
+            {
+                "metric": "llama_dense_sft_mfu" if mfu == mfu else "llama_dense_sft_tflops",
+                "value": round(value, 2),
+                "unit": "%MFU" if mfu == mfu else "TFLOPs/s/chip",
+                "vs_baseline": round((mfu / BASELINE_MFU) if mfu == mfu else 0.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
