@@ -78,7 +78,7 @@ def from_config(
 ) -> AutoModel:
     """Random-init (pretraining) constructor (reference: from_config,
     auto_model.py:479). Params materialize directly sharded via jit+out_shardings."""
-    backend = _as_backend(backend)
+    backend = _as_backend(backend, mesh_ctx)
     builder = resolve_architecture(hf_config)
     model, adapter = builder(hf_config, backend)
     key = jax.random.key(seed)
@@ -101,7 +101,7 @@ def from_pretrained(
     (reference: from_pretrained, auto_model.py:339 + load_base_model)."""
     from automodel_tpu.checkpoint.hf_io import load_params_from_hf
 
-    backend = _as_backend(backend)
+    backend = _as_backend(backend, mesh_ctx)
     ckpt_dir = _resolve_checkpoint_dir(pretrained_model_name_or_path)
     hf_config = _read_hf_config(ckpt_dir)
     builder = resolve_architecture(hf_config)
@@ -119,12 +119,20 @@ def from_pretrained(
     return AutoModel(model=model, params=params, adapter=adapter, mesh_ctx=mesh_ctx)
 
 
-def _as_backend(backend: BackendConfig | dict | None) -> BackendConfig:
+def _as_backend(
+    backend: BackendConfig | dict | None, mesh_ctx: Optional[MeshContext] = None
+) -> BackendConfig:
     if backend is None:
-        return BackendConfig()
-    if isinstance(backend, BackendConfig):
-        return backend
-    return BackendConfig(**dict(backend))
+        backend = BackendConfig()
+    elif not isinstance(backend, BackendConfig):
+        backend = BackendConfig(**dict(backend))
+    if backend.attn == "ring":
+        if mesh_ctx is None:
+            raise ValueError("attn='ring' (context parallel) requires a mesh")
+        from automodel_tpu.parallel.cp import install_ring_backend
+
+        install_ring_backend(mesh_ctx)
+    return backend
 
 
 def _np_dtype(name: str):

@@ -165,9 +165,18 @@ def flash(
     )
 
 
+def _ring_not_installed(*args, **kwargs):
+    raise RuntimeError(
+        "attention backend 'ring' needs a mesh: call "
+        "automodel_tpu.parallel.cp.install_ring_backend(mesh_ctx) first "
+        "(auto_model does this when backend.attn == 'ring')."
+    )
+
+
 ATTENTION_BACKENDS = {
     "sdpa": sdpa,
     "flash": flash,
+    "ring": _ring_not_installed,
 }
 
 

@@ -1,0 +1,174 @@
+"""Context parallelism: ring attention over the ``cp`` mesh axis.
+
+Parity: the reference's CP paths (distributed/cp_utils.py:68-184 — torch
+experimental `context_parallel` ring SDPA with allgather KV rotation; and the
+TE `cp_comm_type="p2p"` ring, moe/parallelizer.py:279-297). TPU-native
+design (SURVEY.md §7): `shard_map` over the cp axis with `lax.ppermute` KV
+rotation and online-softmax (flash-style) merging of per-block partial
+results, so each device only ever holds ``S/cp`` keys/values — the
+long-context mechanism.
+
+Two layers:
+
+- :func:`ring_attention_shard` — per-device ring loop; runs INSIDE a
+  shard_map region (or any context where ``axis_name`` is bound).
+- :func:`make_ring_attention` — wraps it in `shard_map` with specs resolved
+  from the MeshContext and registers it as the ``"ring"`` backend in
+  `ops.attention.ATTENTION_BACKENDS` via :func:`install_ring_backend`.
+
+Sharding is CONTIGUOUS on the seq dim (rank r holds positions
+[r·S/cp, (r+1)·S/cp)). With causal masking this is load-imbalanced (later
+ranks do more real work; every rank computes every block and masks) — the
+reference balances via THD round-robin partitioning (cp_utils.py:296-337).
+A zigzag layout is a planned perf upgrade; correctness and O(S/cp) memory
+hold either way.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from automodel_tpu.ops.attention import repeat_kv
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def ring_attention_shard(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "cp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Ring attention on per-device shards. q/k/v: [B, S_loc, N(,kv), H],
+    segment_ids: [B, S_loc]. Requires `axis_name` bound (shard_map)."""
+    b, s_loc, n, h = q.shape
+    n_kv = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (h**0.5)
+    cp = jax.lax.psum(1, axis_name)
+    my_rank = jax.lax.axis_index(axis_name)
+
+    q32 = q.astype(jnp.float32)
+    q_pos = my_rank * s_loc + jnp.arange(s_loc)  # global q positions
+
+    # online-softmax accumulators
+    o = jnp.zeros((b, s_loc, n, h), jnp.float32)
+    m = jnp.full((b, n, s_loc), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, n, s_loc), jnp.float32)
+
+    if segment_ids is None:
+        seg = jnp.zeros((b, s_loc), jnp.int32)
+    else:
+        seg = segment_ids.astype(jnp.int32)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def body(step, carry):
+        o, m, l, k_blk, v_blk, seg_blk = carry
+        src_rank = (my_rank - step) % cp
+        kv_pos = src_rank * s_loc + jnp.arange(s_loc)
+
+        k_exp = repeat_kv(k_blk, n // n_kv).astype(jnp.float32)
+        v_exp = repeat_kv(v_blk, n // n_kv).astype(jnp.float32)
+        logits = jnp.einsum("bqnh,bknh->bnqk", q32, k_exp) * scale
+        if logits_soft_cap is not None:
+            logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+
+        mask = jnp.ones((s_loc, s_loc), bool)
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+        if sliding_window is not None:
+            mask = mask & (q_pos[:, None] - kv_pos[None, :] < sliding_window)
+        mask = mask[None, None]  # [1,1,sq,sk]
+        if segment_ids is not None:
+            mask = mask & (seg[:, None, :, None] == seg_blk[:, None, None, :])
+        logits = jnp.where(mask, logits, _NEG_INF)
+
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bnqk,bknh->bqnh", p, v_exp
+        )
+
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        seg_nxt = jax.lax.ppermute(seg_blk, axis_name, perm)
+        return o_new, m_new, l_new, k_nxt, v_nxt, seg_nxt
+
+    o, m, l, *_ = jax.lax.fori_loop(0, cp, body, (o, m, l, k, v, seg))
+    l_t = l.transpose(0, 2, 1)[..., None]  # [b,s,n,1]
+    out = jnp.where(l_t > 0, o / jnp.maximum(l_t, 1e-30), 0.0)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(mesh_ctx):
+    """Drop-in attention over GLOBAL arrays: shard_map'd ring over cp, with
+    batch sharded on the data axes and heads on tp (the GSPMD layout the rest
+    of the model uses)."""
+    mesh = mesh_ctx.mesh
+    bspec = mesh_ctx.resolve(("batch",))  # P over batch axes
+    batch_axes = bspec[0] if len(bspec) else None
+    cp_ax = "cp" if mesh.shape["cp"] > 1 else None
+    tp_ax = "tp" if mesh.shape["tp"] > 1 else None
+    qkv_spec = P(batch_axes, cp_ax, tp_ax, None)
+    seg_spec = P(batch_axes, cp_ax)
+
+    def ring(
+        q,
+        k,
+        v,
+        *,
+        causal: bool = True,
+        scale: Optional[float] = None,
+        segment_ids: Optional[jnp.ndarray] = None,
+        logits_soft_cap: Optional[float] = None,
+        sliding_window: Optional[int] = None,
+        **_ignored,
+    ):
+        has_seg = segment_ids is not None
+        in_specs = (qkv_spec, qkv_spec, qkv_spec) + ((seg_spec,) if has_seg else ())
+        inner = functools.partial(
+            ring_attention_shard,
+            axis_name="cp",
+            causal=causal,
+            scale=scale,
+            logits_soft_cap=logits_soft_cap,
+            sliding_window=sliding_window,
+        )
+
+        def fn(*args):
+            if has_seg:
+                q_, k_, v_, s_ = args
+                return inner(q_, k_, v_, segment_ids=s_)
+            q_, k_, v_ = args
+            return inner(q_, k_, v_)
+
+        mapped = shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
+        )
+        args = (q, k, v) + ((segment_ids,) if has_seg else ())
+        return mapped(*args)
+
+    return ring
+
+
+def install_ring_backend(mesh_ctx) -> None:
+    """Register ``"ring"`` in the attention-backend registry, bound to this
+    mesh. One mesh at a time (module-global registry) — matches the
+    one-mesh-per-process training model."""
+    from automodel_tpu.ops.attention import ATTENTION_BACKENDS
+
+    ATTENTION_BACKENDS["ring"] = make_ring_attention(mesh_ctx)

@@ -1,0 +1,88 @@
+"""Ring (context-parallel) attention vs the sdpa reference.
+
+Mirrors the reference's CP functional tests (tests/functional_tests/
+context_parallel/run_attention_cp.py — 2-GPU torchrun runs comparing CP
+attention against single-device attention); here 8 virtual CPU devices give
+cp=4 with dp and tp alongside.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.parallel.cp import make_ring_attention
+from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def _mk(b, s, n, nkv, h, seed=0):
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((b, s, n, h), dtype=np.float32)
+    k = rng.standard_normal((b, s, nkv, h), dtype=np.float32)
+    v = rng.standard_normal((b, s, nkv, h), dtype=np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.fixture(scope="module")
+def cp_ctx(devices8):
+    return build_mesh(MeshConfig(dp_shard=2, cp=4, tp=1), devices=devices8)
+
+
+def test_ring_matches_sdpa_causal(cp_ctx):
+    q, k, v = _mk(2, 64, 4, 2, 16)
+    ring = make_ring_attention(cp_ctx)
+    out_ref = sdpa(q, k, v, causal=True)
+    out_ring = jax.jit(lambda *a: ring(*a, causal=True))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_segment_ids_and_gqa(cp_ctx):
+    q, k, v = _mk(2, 64, 8, 2, 16, seed=1)
+    seg = jnp.asarray(
+        np.repeat(np.arange(4), 16)[None, :].repeat(2, axis=0).astype(np.int32)
+    )
+    ring = make_ring_attention(cp_ctx)
+    out_ref = sdpa(q, k, v, causal=True, segment_ids=seg)
+    out_ring = jax.jit(lambda *a: ring(*a, causal=True, segment_ids=seg))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_sliding_window(cp_ctx):
+    q, k, v = _mk(2, 64, 4, 4, 16, seed=2)
+    ring = make_ring_attention(cp_ctx)
+    out_ref = sdpa(q, k, v, causal=True, sliding_window=24)
+    out_ring = jax.jit(lambda *a: ring(*a, causal=True, sliding_window=24))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_in_model_via_backend(devices8):
+    """End-to-end: model forward with attn='ring' on a cp mesh matches the
+    sdpa forward on the same weights."""
+    from automodel_tpu import auto_model
+
+    hf = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": 128,
+        "hidden_size": 64,
+        "intermediate_size": 128,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "head_dim": 16,
+    }
+    ctx = build_mesh(MeshConfig(dp_shard=2, cp=2, tp=2), devices=jax.devices("cpu")[:8])
+    base = {"param_dtype": "float32", "compute_dtype": "float32"}
+    auto_ring = auto_model.from_config(hf, ctx, {**base, "attn": "ring"}, seed=3)
+    auto_ref = auto_model.from_config(hf, ctx, {**base, "attn": "sdpa"}, seed=3)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, size=(2, 32)), jnp.int32)
+    out_ring = np.asarray(auto_ring(auto_ring.params, ids))
+    out_ref = np.asarray(auto_ref(auto_ref.params, ids))
+    np.testing.assert_allclose(out_ring, out_ref, rtol=2e-4, atol=2e-4)
