@@ -36,8 +36,8 @@ class BackendConfig:
 
     attn: str = "flash"  # any key of ops.attention.ATTENTION_BACKENDS
     rms_norm: str = "xla"
-    experts: str = "ragged_dot"  # ragged_dot | dense_einsum (MoE models)
-    dispatcher: str = "gspmd"  # gspmd | a2a (MoE token routing)
+    experts: str = "gspmd"  # gspmd | ragged | dense (moe.experts backends)
+    fake_balanced_gate: bool = False  # deterministic routing for benchmarks
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     remat: str = "none"  # none | full | selective
@@ -54,6 +54,12 @@ class BackendConfig:
             )
         if self.remat not in ("none", "full", "selective"):
             raise ValueError(f"Unknown remat policy {self.remat!r}")
+        from automodel_tpu.moe.experts import EXPERT_BACKENDS
+
+        if self.experts not in EXPERT_BACKENDS:
+            raise ValueError(
+                f"Unknown experts backend {self.experts!r}; available: {sorted(EXPERT_BACKENDS)}"
+            )
 
     @property
     def param_jnp_dtype(self):

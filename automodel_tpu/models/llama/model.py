@@ -106,7 +106,7 @@ def _layer_sliding_window(cfg: TransformerConfig, layer_idx: int) -> Optional[in
     return cfg.sliding_window
 
 
-def decoder_layer(
+def attention_block(
     cfg: TransformerConfig,
     backend: BackendConfig,
     h: jnp.ndarray,
@@ -117,6 +117,7 @@ def decoder_layer(
     constrain: Constrain,
     sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
+    """Pre-norm attention + residual; shared across dense and MoE families."""
     B, S, D = h.shape
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
     q = _proj(x, lp["attn"]["q_proj"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
@@ -143,7 +144,23 @@ def decoder_layer(
         ),
     )
     h = h + _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"])
-    h = constrain(h, ("batch", "seq", None))
+    return constrain(h, ("batch", "seq", None))
+
+
+def decoder_layer(
+    cfg: TransformerConfig,
+    backend: BackendConfig,
+    h: jnp.ndarray,
+    lp: dict,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    segment_ids: Optional[jnp.ndarray],
+    constrain: Constrain,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    h = attention_block(
+        cfg, backend, h, lp, cos, sin, segment_ids, constrain, sliding_window
+    )
     x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_eps)
     act = ACT_FNS[cfg.act]
     mlp = _proj(act(_proj(x, lp["mlp"]["gate_proj"])) * _proj(x, lp["mlp"]["up_proj"]), lp["mlp"]["down_proj"])

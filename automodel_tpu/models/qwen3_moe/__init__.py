@@ -1,0 +1,13 @@
+from automodel_tpu.models.qwen3_moe.model import (
+    MoEForCausalLM,
+    MoEModelAux,
+    MoETransformerConfig,
+)
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import MoEStateDictAdapter
+
+__all__ = [
+    "MoEForCausalLM",
+    "MoEModelAux",
+    "MoETransformerConfig",
+    "MoEStateDictAdapter",
+]

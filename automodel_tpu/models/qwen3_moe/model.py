@@ -1,0 +1,281 @@
+"""MoE causal LM, TPU-native — the Qwen3-MoE-shaped family.
+
+Covers the reference's qwen3_moe (components/models/qwen3_moe/, ~500 LoC) and
+generalizes to any "dense-attention + per-layer routed-FFN" decoder: optional
+dense prefix layers (DeepSeek's first_k_dense_replace), shared experts, and
+every Gate feature in automodel_tpu.moe.
+
+Structure follows the dense family (stacked layer leaves under `lax.scan`);
+the attention block is literally the llama one. A layer's params are
+{attn, input_norm, post_attn_norm, moe} with the dense prefix (if any) kept
+as a separate stacked tree so each stack scans homogeneously.
+
+Forward returns (logits, MoEModelAux) — aux carries per-layer expert counts
+and the summed aux loss for the load-balance metrics and aux-free bias
+updates (reference: moe/load_balance_metrics.py, train_ft.py:1341).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.models.llama.model import (
+    ACT_FNS,
+    SHARDING_RULES as DENSE_RULES,
+    Constrain,
+    _dense_init,
+    _noop_constrain,
+    attention_block,
+    decoder_layer,
+)
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.gate import update_gate_bias
+from automodel_tpu.moe.layer import init_moe_params, moe_block
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import rope_table
+
+
+@dataclasses.dataclass(frozen=True)
+class MoETransformerConfig(TransformerConfig):
+    moe: Optional[MoEConfig] = None
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "MoETransformerConfig":
+        base = TransformerConfig.from_hf(hf_cfg)
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        moe = MoEConfig(
+            num_experts=get("num_experts", None) or get("n_routed_experts"),
+            num_experts_per_tok=get("num_experts_per_tok", 8),
+            moe_intermediate_size=get("moe_intermediate_size"),
+            num_shared_experts=get("n_shared_experts", 0) or 0,
+            shared_expert_intermediate_size=get("shared_expert_intermediate_size", 0)
+            or get("moe_intermediate_size"),
+            score_func=get("scoring_func", "softmax"),
+            route_scale=get("routed_scaling_factor", 1.0) or 1.0,
+            norm_topk_prob=bool(get("norm_topk_prob", True)),
+            n_group=get("n_group", 1) or 1,
+            topk_group=get("topk_group", 1) or 1,
+            aux_loss_coeff=get("router_aux_loss_coef", 0.0) or 0.0,
+            num_dense_layers=get("first_k_dense_replace", 0) or 0,
+            expert_bias=get("topk_method", None) == "noaux_tc",
+            bias_update_factor=0.001 if get("topk_method", None) == "noaux_tc" else 0.0,
+        )
+        fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
+        fields["moe"] = moe
+        # qwen3_moe uses qk per-head norms like qwen3
+        if get("model_type") in ("qwen3_moe", "qwen3moe"):
+            fields["qk_norm"] = True
+        return cls(**fields)
+
+
+class MoEModelAux(NamedTuple):
+    expert_counts: jnp.ndarray  # [L_moe, E]
+    aux_loss: jnp.ndarray  # scalar
+
+
+def _init_attn_layer(cfg: TransformerConfig, backend: BackendConfig, key, L: int) -> dict:
+    """Stacked attention + norm params for L layers (llama layout)."""
+    pd = backend.param_jnp_dtype
+    D = cfg.hidden_size
+    keys = jax.random.split(key, 4)
+
+    def stack(k, shape, in_axis=0):
+        return _dense_init(k, (L, *shape), pd, in_axis=in_axis + 1)
+
+    attn = {
+        "q_proj": {"kernel": stack(keys[0], (D, cfg.q_dim))},
+        "k_proj": {"kernel": stack(keys[1], (D, cfg.kv_dim))},
+        "v_proj": {"kernel": stack(keys[2], (D, cfg.kv_dim))},
+        "o_proj": {"kernel": stack(keys[3], (cfg.q_dim, D))},
+    }
+    if cfg.attention_bias:
+        attn["q_proj"]["bias"] = jnp.zeros((L, cfg.q_dim), pd)
+        attn["k_proj"]["bias"] = jnp.zeros((L, cfg.kv_dim), pd)
+        attn["v_proj"]["bias"] = jnp.zeros((L, cfg.kv_dim), pd)
+    if cfg.qk_norm:
+        attn["q_norm"] = {"scale": jnp.ones((L, cfg.head_dim), pd)}
+        attn["k_norm"] = {"scale": jnp.ones((L, cfg.head_dim), pd)}
+    return {
+        "attn": attn,
+        "input_norm": {"scale": jnp.ones((L, D), pd)},
+        "post_attn_norm": {"scale": jnp.ones((L, D), pd)},
+    }
+
+
+def init_params(cfg: MoETransformerConfig, backend: BackendConfig, key: jax.Array) -> dict:
+    pd = backend.param_jnp_dtype
+    D, I = cfg.hidden_size, cfg.intermediate_size
+    moe = cfg.moe
+    nd = moe.num_dense_layers
+    nm = cfg.num_layers - nd
+    keys = jax.random.split(key, 8)
+
+    params: dict = {
+        "embed": {
+            "embedding": jax.random.normal(keys[0], (cfg.vocab_size, D)).astype(pd)
+            * 0.02
+        },
+        "final_norm": {"scale": jnp.ones((D,), pd)},
+    }
+    if nd > 0:
+        dense = _init_attn_layer(cfg, backend, keys[1], nd)
+        dk = jax.random.split(keys[2], 3)
+        dense["mlp"] = {
+            "gate_proj": {"kernel": _dense_init(dk[0], (nd, D, I), pd, in_axis=1)},
+            "up_proj": {"kernel": _dense_init(dk[1], (nd, D, I), pd, in_axis=1)},
+            "down_proj": {"kernel": _dense_init(dk[2], (nd, I, D), pd, in_axis=1)},
+        }
+        params["dense_layers"] = dense
+    moe_layers = _init_attn_layer(cfg, backend, keys[3], nm)
+    moe_layers["moe"] = init_moe_params(keys[4], moe, D, pd, n_layers=nm)
+    params["moe_layers"] = moe_layers
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _dense_init(keys[5], (D, cfg.vocab_size), pd)}
+    return params
+
+
+def forward_hidden(
+    cfg: MoETransformerConfig,
+    backend: BackendConfig,
+    params: dict,
+    input_ids: jnp.ndarray,
+    position_ids: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    constrain: Constrain = _noop_constrain,
+) -> tuple[jnp.ndarray, MoEModelAux]:
+    cd = backend.compute_jnp_dtype
+    moe = cfg.moe
+    if position_ids is None:
+        position_ids = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
+        position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
+    h = params["embed"]["embedding"].astype(cd)[input_ids]
+    h = constrain(h, ("batch", "seq", None))
+    cos, sin = rope_table(position_ids, cfg.head_dim, cfg.rope)
+
+    def maybe_remat(fn):
+        if backend.remat == "full":
+            return jax.checkpoint(fn, policy=jax.checkpoint_policies.nothing_saveable)
+        if backend.remat == "selective":
+            return jax.checkpoint(
+                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        return fn
+
+    if "dense_layers" in params:
+        def dense_fn(carry, lp):
+            out = decoder_layer(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
+            return out, None
+
+        h, _ = jax.lax.scan(maybe_remat(dense_fn), h, params["dense_layers"])
+
+    def moe_fn(carry, lp):
+        hh = attention_block(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
+        x = rms_norm(hh, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+        out, aux = moe_block(
+            x,
+            lp["moe"],
+            moe,
+            ACT_FNS[cfg.act],
+            experts_backend=backend.experts,
+            fake_gate=backend.fake_balanced_gate,
+            constrain=constrain,
+        )
+        hh = hh + out
+        return constrain(hh, ("batch", "seq", None)), aux
+
+    if backend.scan_layers:
+        h, auxs = jax.lax.scan(maybe_remat(moe_fn), h, params["moe_layers"])
+        counts, aux_losses = auxs.expert_counts, auxs.aux_loss
+    else:
+        counts_l, aux_l = [], []
+        nm = cfg.num_layers - moe.num_dense_layers
+        for i in range(nm):
+            lp = jax.tree.map(lambda x: x[i], params["moe_layers"])
+            h, aux = moe_fn(h, lp)
+            counts_l.append(aux.expert_counts)
+            aux_l.append(aux.aux_loss)
+        counts = jnp.stack(counts_l)
+        aux_losses = jnp.stack(aux_l)
+
+    h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
+    return h, MoEModelAux(counts, aux_losses.sum())
+
+
+def forward(
+    cfg: MoETransformerConfig,
+    backend: BackendConfig,
+    params: dict,
+    input_ids: jnp.ndarray,
+    **kw: Any,
+) -> tuple[jnp.ndarray, MoEModelAux]:
+    h, aux = forward_hidden(cfg, backend, params, input_ids, **kw)
+    kernel = (
+        params["embed"]["embedding"].T
+        if cfg.tie_embeddings
+        else params["lm_head"]["kernel"]
+    )
+    logits = h @ kernel.astype(h.dtype)
+    if cfg.logits_soft_cap is not None:
+        logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
+    return logits, aux
+
+
+# dense rules match here too ("layers/attn/..." regexes find
+# "moe_layers/attn/..." and "dense_layers/mlp/..." via re.search); MoE leaves
+# get explicit stacked rules (leading layer dim unsharded).
+SHARDING_RULES: list[tuple[str, tuple]] = [
+    (r"moe/router/weight$", (None, None, None)),
+    (r"moe/router/bias$", (None, None)),
+    (r"moe/experts/gate_up$", (None, "expert", "expert_fsdp", "tensor")),
+    (r"moe/experts/down$", (None, "expert", "tensor", "expert_fsdp")),
+    (r"moe/shared/(gate|up)_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"moe/shared/down_proj/kernel$", (None, "tensor", "fsdp")),
+    (r"moe/shared_gate/kernel$", (None, None, None)),
+    *DENSE_RULES,
+]
+
+
+@dataclasses.dataclass
+class MoEForCausalLM:
+    """Bundled config + backend with the functional API underneath."""
+
+    config: MoETransformerConfig
+    backend: BackendConfig = BackendConfig()
+
+    def init(self, key: jax.Array) -> dict:
+        return init_params(self.config, self.backend, key)
+
+    def __call__(self, params: dict, input_ids: jnp.ndarray, **kw: Any):
+        return forward(self.config, self.backend, params, input_ids, **kw)
+
+    def hidden(self, params: dict, input_ids: jnp.ndarray, **kw: Any):
+        return forward_hidden(self.config, self.backend, params, input_ids, **kw)
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        if self.config.tie_embeddings:
+            return params["embed"]["embedding"].T
+        return params["lm_head"]["kernel"]
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return SHARDING_RULES
+
+    # -- aux-free balancing hook (post-optimizer-step) -----------------------
+    def post_step_fn(self, params: dict, extras: dict) -> dict:
+        u = self.config.moe.bias_update_factor
+        if u <= 0 or "expert_counts" not in extras:
+            return params
+        bias = params["moe_layers"]["moe"]["router"].get("bias")
+        if bias is None:
+            return params
+        counts = extras["expert_counts"]  # [L, E] summed over microbatches
+        new_bias = jax.vmap(lambda b, c: update_gate_bias(b, c, u))(bias, counts)
+        params["moe_layers"]["moe"]["router"]["bias"] = new_bias
+        return params

@@ -1,0 +1,205 @@
+"""HF ⇄ native state-dict adapter for MoE families (Qwen3-MoE shaped).
+
+Parity: the reference's MoE state-dict mixins (components/moe/
+state_dict_mixin.py:431) split/merge between native stacked expert tensors
+``gate_up [L, E, D, 2I]`` and HF per-expert keys
+``model.layers.{i}.mlp.experts.{j}.{gate,up,down}_proj.weight``.
+
+Native layout notes (see models/qwen3_moe/model.py): layers split into a
+dense prefix stack and a MoE stack; kernels are [in, out] (transposed vs
+torch Linear); per-layer leaves stacked on a leading axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.qwen3_moe.model import MoETransformerConfig
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+class MoEStateDictAdapter:
+    def __init__(self, config: MoETransformerConfig):
+        self.config = config
+
+    # ---- key helpers -------------------------------------------------------
+    def _attn_keys(self, i: int) -> dict[tuple[str, ...], tuple[str, bool]]:
+        """native subpath → (hf key, transpose)."""
+        c = self.config
+        m: dict[tuple[str, ...], tuple[str, bool]] = {
+            ("attn", "q_proj", "kernel"): (f"model.layers.{i}.self_attn.q_proj.weight", True),
+            ("attn", "k_proj", "kernel"): (f"model.layers.{i}.self_attn.k_proj.weight", True),
+            ("attn", "v_proj", "kernel"): (f"model.layers.{i}.self_attn.v_proj.weight", True),
+            ("attn", "o_proj", "kernel"): (f"model.layers.{i}.self_attn.o_proj.weight", True),
+            ("input_norm", "scale"): (f"model.layers.{i}.input_layernorm.weight", False),
+            ("post_attn_norm", "scale"): (
+                f"model.layers.{i}.post_attention_layernorm.weight",
+                False,
+            ),
+        }
+        if c.attention_bias:
+            for p in ("q_proj", "k_proj", "v_proj"):
+                m[("attn", p, "bias")] = (f"model.layers.{i}.self_attn.{p}.bias", False)
+        if c.qk_norm:
+            m[("attn", "q_norm", "scale")] = (f"model.layers.{i}.self_attn.q_norm.weight", False)
+            m[("attn", "k_norm", "scale")] = (f"model.layers.{i}.self_attn.k_norm.weight", False)
+        return m
+
+    # ---- load --------------------------------------------------------------
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        c = self.config
+        moe = c.moe
+        nd, L = moe.num_dense_layers, c.num_layers
+        nm = L - nd
+
+        out: dict = {
+            "embed": {"embedding": get_tensor("model.embed_tokens.weight")},
+            "final_norm": {"scale": get_tensor("model.norm.weight")},
+        }
+        if not c.tie_embeddings:
+            out["lm_head"] = {"kernel": _t(get_tensor("lm_head.weight"))}
+
+        def assemble_stack(layer_ids: list[int]) -> dict:
+            tree: dict = {}
+            for row, i in enumerate(layer_ids):
+                for path, (hf_key, tr) in self._attn_keys(i).items():
+                    arr = get_tensor(hf_key)
+                    if tr:
+                        arr = _t(arr)
+                    node = tree
+                    for k in path[:-1]:
+                        node = node.setdefault(k, {})
+                    node.setdefault(path[-1], [None] * len(layer_ids))[row] = arr
+            return tree
+
+        def finalize(tree: dict) -> dict:
+            return {
+                k: (finalize(v) if isinstance(v, dict) else np.stack(v, 0))
+                for k, v in tree.items()
+            }
+
+        if nd > 0:
+            dense = assemble_stack(list(range(nd)))
+            for name in ("gate_proj", "up_proj", "down_proj"):
+                rows = [
+                    _t(get_tensor(f"model.layers.{i}.mlp.{name}.weight"))
+                    for i in range(nd)
+                ]
+                dense.setdefault("mlp", {})[name] = {"kernel": rows}
+                dense["mlp"][name] = {"kernel": np.stack(rows, 0)}
+            out["dense_layers"] = finalize(
+                {k: v for k, v in dense.items() if k != "mlp"}
+            )
+            out["dense_layers"]["mlp"] = dense["mlp"]
+
+        moe_ids = list(range(nd, L))
+        ml = assemble_stack(moe_ids)
+        routers, gate_ups, downs = [], [], []
+        for i in moe_ids:
+            routers.append(_t(get_tensor(f"model.layers.{i}.mlp.gate.weight")))
+            g = [
+                _t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.gate_proj.weight"))
+                for j in range(moe.num_experts)
+            ]
+            u = [
+                _t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.up_proj.weight"))
+                for j in range(moe.num_experts)
+            ]
+            d = [
+                _t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.down_proj.weight"))
+                for j in range(moe.num_experts)
+            ]
+            gate_ups.append(
+                np.stack([np.concatenate([gj, uj], axis=-1) for gj, uj in zip(g, u)], 0)
+            )
+            downs.append(np.stack(d, 0))
+        ml = finalize(ml)
+        ml["moe"] = {
+            "router": {"weight": np.stack(routers, 0)},
+            "experts": {
+                "gate_up": np.stack(gate_ups, 0),
+                "down": np.stack(downs, 0),
+            },
+        }
+        if moe.expert_bias or moe.bias_update_factor > 0:
+            rows = [
+                get_tensor(f"model.layers.{i}.mlp.gate.e_score_correction_bias").astype(
+                    np.float32
+                )
+                for i in moe_ids
+            ]
+            ml["moe"]["router"]["bias"] = np.stack(rows, 0)
+        if moe.num_shared_experts > 0:
+            sh: dict = {}
+            for name in ("gate_proj", "up_proj", "down_proj"):
+                rows = [
+                    _t(get_tensor(f"model.layers.{i}.mlp.shared_experts.{name}.weight"))
+                    for i in moe_ids
+                ]
+                sh[name] = {"kernel": np.stack(rows, 0)}
+            ml["moe"]["shared"] = sh
+        out["moe_layers"] = ml
+        return out
+
+    # ---- save --------------------------------------------------------------
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        c = self.config
+        moe = c.moe
+        nd, L = moe.num_dense_layers, c.num_layers
+
+        yield "model.embed_tokens.weight", np.asarray(params["embed"]["embedding"])
+        yield "model.norm.weight", np.asarray(params["final_norm"]["scale"])
+        if not c.tie_embeddings:
+            yield "lm_head.weight", _t(np.asarray(params["lm_head"]["kernel"]))
+
+        def emit_stack(tree: dict, layer_ids: list[int]):
+            for row, i in enumerate(layer_ids):
+                for path, (hf_key, tr) in self._attn_keys(i).items():
+                    node = tree
+                    for k in path:
+                        node = node[k]
+                    arr = np.asarray(node[row])
+                    yield hf_key, (_t(arr) if tr else arr)
+
+        if nd > 0:
+            dense = params["dense_layers"]
+            yield from emit_stack(dense, list(range(nd)))
+            for i in range(nd):
+                for name in ("gate_proj", "up_proj", "down_proj"):
+                    yield (
+                        f"model.layers.{i}.mlp.{name}.weight",
+                        _t(np.asarray(dense["mlp"][name]["kernel"][i])),
+                    )
+
+        ml = params["moe_layers"]
+        moe_ids = list(range(nd, L))
+        yield from emit_stack(ml, moe_ids)
+        for row, i in enumerate(moe_ids):
+            yield (
+                f"model.layers.{i}.mlp.gate.weight",
+                _t(np.asarray(ml["moe"]["router"]["weight"][row])),
+            )
+            if "bias" in ml["moe"]["router"]:
+                yield (
+                    f"model.layers.{i}.mlp.gate.e_score_correction_bias",
+                    np.asarray(ml["moe"]["router"]["bias"][row]),
+                )
+            gu = np.asarray(ml["moe"]["experts"]["gate_up"][row])  # [E, D, 2I]
+            dn = np.asarray(ml["moe"]["experts"]["down"][row])  # [E, I, D]
+            I = dn.shape[1]
+            for j in range(moe.num_experts):
+                yield f"model.layers.{i}.mlp.experts.{j}.gate_proj.weight", _t(gu[j, :, :I])
+                yield f"model.layers.{i}.mlp.experts.{j}.up_proj.weight", _t(gu[j, :, I:])
+                yield f"model.layers.{i}.mlp.experts.{j}.down_proj.weight", _t(dn[j])
+            if "shared" in ml["moe"]:
+                for name in ("gate_proj", "up_proj", "down_proj"):
+                    yield (
+                        f"model.layers.{i}.mlp.shared_experts.{name}.weight",
+                        _t(np.asarray(ml["moe"]["shared"][name]["kernel"][row])),
+                    )
+

@@ -54,3 +54,15 @@ def _llama_builder(hf_config: Any, backend: BackendConfig):
 
     cfg = TransformerConfig.from_hf(hf_config)
     return LlamaForCausalLM(cfg, backend), LlamaStateDictAdapter(cfg)
+
+
+@register_architecture("Qwen3MoeForCausalLM")
+def _moe_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.qwen3_moe import (
+        MoEForCausalLM,
+        MoEStateDictAdapter,
+        MoETransformerConfig,
+    )
+
+    cfg = MoETransformerConfig.from_hf(hf_config)
+    return MoEForCausalLM(cfg, backend), MoEStateDictAdapter(cfg)

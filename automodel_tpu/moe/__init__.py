@@ -1,0 +1,22 @@
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.gate import (
+    GateOutput,
+    fake_balanced_gate,
+    gate,
+    update_gate_bias,
+)
+from automodel_tpu.moe.experts import EXPERT_BACKENDS
+from automodel_tpu.moe.layer import MOE_SHARDING_RULES, MoEAux, init_moe_params, moe_block
+
+__all__ = [
+    "MoEConfig",
+    "GateOutput",
+    "gate",
+    "fake_balanced_gate",
+    "update_gate_bias",
+    "EXPERT_BACKENDS",
+    "MOE_SHARDING_RULES",
+    "MoEAux",
+    "init_moe_params",
+    "moe_block",
+]

@@ -1,0 +1,73 @@
+"""MoE configuration.
+
+Parity: reference `MoEConfig` (components/moe/config.py:88) — routed/shared
+expert counts, top-k, grouped routing, score function, aux-loss and aux-free
+balancing knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int
+    num_experts_per_tok: int
+    moe_intermediate_size: int
+    num_shared_experts: int = 0
+    shared_expert_intermediate_size: int = 0
+    # routing
+    score_func: str = "softmax"  # softmax | sigmoid
+    route_scale: float = 1.0
+    norm_topk_prob: bool = False
+    softmax_before_topk: bool = True  # score then pick (False: softmax over picked)
+    n_group: int = 1  # node-limited (grouped) routing
+    topk_group: int = 1
+    # balancing
+    aux_loss_coeff: float = 0.0  # sequence-level aux loss (DeepSeek style)
+    bias_update_factor: float = 0.0  # aux-free bias balancing (V3); 0 = off
+    expert_bias: bool = False  # e_score_correction_bias present
+    # which layers are MoE: first `num_dense_layers` stay dense MLP
+    num_dense_layers: int = 0
+    # shared-expert gating (qwen2-moe style sigmoid gate on shared output)
+    shared_expert_gate: bool = False
+    # dispatch capacity factor for the gspmd (einsum) dispatcher
+    capacity_factor: float = 1.25
+
+    def __post_init__(self):
+        if self.score_func not in ("softmax", "sigmoid"):
+            raise ValueError(f"score_func {self.score_func!r}")
+        if self.num_experts % self.n_group != 0:
+            raise ValueError("num_experts must divide into n_group groups")
+        if self.topk_group > self.n_group:
+            raise ValueError("topk_group > n_group")
+        if self.expert_bias and self.score_func == "softmax":
+            # V3 pairs the correction bias with sigmoid scoring
+            pass
+
+    @classmethod
+    def from_hf(cls, get: Any) -> "Optional[MoEConfig]":
+        """Build from an HF config getter fn (model-family adapters call this
+        with their own field-name mapping on top)."""
+        n = get("num_experts", None) or get("n_routed_experts", None)
+        if not n:
+            return None
+        return cls(
+            num_experts=n,
+            num_experts_per_tok=get("num_experts_per_tok", None)
+            or get("num_experts_per_token", 2),
+            moe_intermediate_size=get("moe_intermediate_size", None)
+            or get("intermediate_size"),
+            num_shared_experts=get("n_shared_experts", 0) or 0,
+            shared_expert_intermediate_size=get("shared_expert_intermediate_size", 0)
+            or 0,
+            score_func=get("scoring_func", "softmax"),
+            route_scale=get("routed_scaling_factor", 1.0) or 1.0,
+            norm_topk_prob=bool(get("norm_topk_prob", False)),
+            n_group=get("n_group", 1) or 1,
+            topk_group=get("topk_group", 1) or 1,
+            aux_loss_coeff=get("router_aux_loss_coef", 0.0) or 0.0,
+            num_dense_layers=get("first_k_dense_replace", 0) or 0,
+        )

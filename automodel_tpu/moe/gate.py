@@ -1,0 +1,139 @@
+"""MoE router (gate).
+
+Parity: reference `Gate` (components/moe/layers.py:202) — softmax/sigmoid
+scoring, grouped top-k with node-limited routing, aux-free bias balancing
+(`update_bias`), sequence-level aux loss — and `FakeBalancedGate`
+(layers.py:117) for deterministic balanced-routing benchmarks.
+
+Functional: `gate(...)` is pure; the aux-free bias is a non-trainable leaf in
+the param tree updated OUTSIDE the gradient (update_gate_bias), mirroring the
+reference's buffer + post-optimizer-step update (train_ft.py:1341).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.moe.config import MoEConfig
+
+
+class GateOutput(NamedTuple):
+    topk_idx: jnp.ndarray  # [T, K] int32 expert ids
+    topk_weights: jnp.ndarray  # [T, K] combine weights (compute dtype)
+    expert_counts: jnp.ndarray  # [E] int32 tokens routed per expert (pre-drop)
+    aux_loss: jnp.ndarray  # scalar f32 (0 when disabled)
+
+
+def _score(logits: jnp.ndarray, cfg: MoEConfig) -> jnp.ndarray:
+    if cfg.score_func == "sigmoid":
+        return jax.nn.sigmoid(logits)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def gate(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    cfg: MoEConfig,
+    bias: Optional[jnp.ndarray] = None,
+    seq_len: Optional[int] = None,
+) -> GateOutput:
+    """Route tokens. x: [T, D], weight: [D, E], bias: [E] aux-free correction.
+
+    Returns combine weights built from the ORIGINAL scores (the bias only
+    affects selection — reference layers.py:202 semantics).
+    """
+    T = x.shape[0]
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    logits = (x.astype(jnp.float32) @ weight.astype(jnp.float32))  # [T, E]
+
+    if cfg.softmax_before_topk or cfg.score_func == "sigmoid":
+        scores = _score(logits, cfg)
+        choice = scores + bias.astype(jnp.float32) if bias is not None else scores
+    else:
+        scores = logits
+        choice = logits + bias.astype(jnp.float32) if bias is not None else logits
+
+    if cfg.n_group > 1:
+        # node-limited routing: keep only the best `topk_group` groups per
+        # token (group score = sum of its top-2 choice scores)
+        g = cfg.n_group
+        grouped = choice.reshape(T, g, E // g)
+        top2 = jax.lax.top_k(grouped, min(2, E // g))[0].sum(axis=-1)  # [T, g]
+        _, top_groups = jax.lax.top_k(top2, cfg.topk_group)  # [T, topk_group]
+        group_mask = jnp.zeros((T, g), bool).at[
+            jnp.arange(T)[:, None], top_groups
+        ].set(True)
+        choice = jnp.where(
+            jnp.repeat(group_mask, E // g, axis=1), choice, -jnp.inf
+        )
+
+    _, topk_idx = jax.lax.top_k(choice, K)  # [T, K]
+    topk_scores = jnp.take_along_axis(scores, topk_idx, axis=1)
+
+    if not cfg.softmax_before_topk and cfg.score_func == "softmax":
+        topk_weights = jax.nn.softmax(topk_scores, axis=-1)
+    else:
+        topk_weights = topk_scores
+        if cfg.norm_topk_prob:
+            topk_weights = topk_weights / jnp.maximum(
+                topk_weights.sum(axis=-1, keepdims=True), 1e-20
+            )
+    topk_weights = topk_weights * cfg.route_scale
+
+    one_hot = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [T, K, E]
+    counts = one_hot.sum(axis=(0, 1))  # [E]
+
+    aux = jnp.float32(0.0)
+    if cfg.aux_loss_coeff > 0:
+        # sequence-level aux loss (DeepSeek style): within each sequence,
+        # fraction routed to expert e × mean prob of e; reduce over experts.
+        probs = (
+            scores if cfg.score_func == "softmax" else jax.nn.softmax(logits, axis=-1)
+        )
+        if seq_len is not None and T % seq_len == 0:
+            S = seq_len
+            B = T // S
+            f = one_hot.reshape(B, S, K, E).sum(axis=(1, 2)) * (E / (K * S))  # [B,E]
+            p = probs.reshape(B, S, E).mean(axis=1)  # [B, E]
+            aux = (f * p).sum(axis=-1).mean() * cfg.aux_loss_coeff
+        else:
+            f = counts * (E / (K * T))
+            p = probs.mean(axis=0)
+            aux = (f * p).sum() * cfg.aux_loss_coeff
+
+    return GateOutput(
+        topk_idx.astype(jnp.int32),
+        topk_weights.astype(x.dtype),
+        counts.astype(jnp.int32),
+        aux,
+    )
+
+
+def fake_balanced_gate(
+    x: jnp.ndarray, cfg: MoEConfig, offset: int = 0
+) -> GateOutput:
+    """Deterministic perfectly-balanced routing for perf benchmarking
+    (reference: FakeBalancedGate, moe/layers.py:117). Token t goes to experts
+    (t*K + j + offset) mod E with uniform weights 1/K."""
+    T = x.shape[0]
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    t = jnp.arange(T, dtype=jnp.int32)[:, None]
+    j = jnp.arange(K, dtype=jnp.int32)[None, :]
+    idx = (t * K + j + offset) % E
+    w = jnp.full((T, K), 1.0 / K, x.dtype) * cfg.route_scale
+    counts = jnp.zeros((E,), jnp.int32).at[idx.reshape(-1)].add(1)
+    return GateOutput(idx, w, counts, jnp.float32(0.0))
+
+
+def update_gate_bias(
+    bias: jnp.ndarray, expert_counts: jnp.ndarray, update_factor: float
+) -> jnp.ndarray:
+    """Aux-free load balancing (reference: Gate.update_bias, layers.py:202;
+    applied post-optimizer-step, train_ft.py:1341): push the selection bias
+    of under-loaded experts up and over-loaded experts down by sign(error)."""
+    c = expert_counts.astype(jnp.float32)
+    err = c.mean() - c
+    return bias + jnp.sign(err) * update_factor

@@ -1,0 +1,126 @@
+"""The MoE block: gate → experts → (shared experts) → combine.
+
+Parity: reference `MoE` (components/moe/layers.py:516) — routed experts plus
+optional always-on shared experts (with optional sigmoid shared-expert gate),
+gate aux outputs surfaced for load-balance metrics and aux-free bias updates.
+The reference overlaps shared experts on a second CUDA stream (layers.py:41);
+here both branches sit in one XLA program and the scheduler overlaps them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.experts import EXPERT_BACKENDS, gspmd_experts
+from automodel_tpu.moe.gate import GateOutput, fake_balanced_gate, gate
+
+
+class MoEAux(NamedTuple):
+    expert_counts: jnp.ndarray  # [E] int32
+    aux_loss: jnp.ndarray  # scalar f32
+
+
+def moe_block(
+    x: jnp.ndarray,  # [B, S, D]
+    mp: dict,
+    cfg: MoEConfig,
+    act: Callable,
+    experts_backend: str = "gspmd",
+    fake_gate: bool = False,
+    constrain: Callable = lambda a, s: a,
+) -> tuple[jnp.ndarray, MoEAux]:
+    B, S, D = x.shape
+    xt = x.reshape(-1, D)
+
+    if fake_gate:
+        gout = fake_balanced_gate(xt, cfg)
+    else:
+        gout = gate(
+            xt,
+            mp["router"]["weight"],
+            cfg,
+            bias=mp["router"].get("bias"),
+            seq_len=S,
+        )
+
+    gu, dn = mp["experts"]["gate_up"], mp["experts"]["down"]
+    if experts_backend == "gspmd":
+        routed = gspmd_experts(x, gout, gu, dn, cfg, act, constrain=constrain)
+    else:
+        fn = EXPERT_BACKENDS[experts_backend]
+        routed = fn(xt, gout, gu, dn, cfg, act).reshape(B, S, D)
+
+    out = routed
+    if "shared" in mp:
+        sp = mp["shared"]
+        g = xt @ sp["gate_proj"]["kernel"].astype(xt.dtype)
+        u = xt @ sp["up_proj"]["kernel"].astype(xt.dtype)
+        shared = (act(g) * u) @ sp["down_proj"]["kernel"].astype(xt.dtype)
+        if "shared_gate" in mp:
+            sg = jnp.asarray(xt @ mp["shared_gate"]["kernel"].astype(xt.dtype))
+            shared = shared * jnp.asarray(jnp.reciprocal(1 + jnp.exp(-sg)))
+        out = out + shared.reshape(B, S, D)
+
+    return out, MoEAux(gout.expert_counts, gout.aux_loss)
+
+
+def init_moe_params(
+    key,
+    cfg: MoEConfig,
+    hidden_size: int,
+    dtype,
+    n_layers: Optional[int] = None,
+) -> dict:
+    """Init one MoE block's params; with n_layers, leaves get a leading
+    stacked layer axis (lax.scan layout shared with the dense family)."""
+    import jax
+
+    def shape(*s):
+        return (n_layers, *s) if n_layers else s
+
+    D, E, I = hidden_size, cfg.num_experts, cfg.moe_intermediate_size
+    k = jax.random.split(key, 6)
+
+    def init(kk, *s, fan_in):
+        return (
+            jax.random.normal(kk, shape(*s), jnp.float32) / (fan_in**0.5)
+        ).astype(dtype)
+
+    p = {
+        "router": {"weight": init(k[0], D, E, fan_in=D)},
+        "experts": {
+            "gate_up": init(k[1], E, D, 2 * I, fan_in=D),
+            "down": init(k[2], E, I, D, fan_in=I),
+        },
+    }
+    if cfg.bias_update_factor > 0 or cfg.expert_bias:
+        p["router"]["bias"] = jnp.zeros(shape(E), jnp.float32)
+    if cfg.num_shared_experts > 0:
+        SI = cfg.shared_expert_intermediate_size or cfg.moe_intermediate_size
+        SI = SI * cfg.num_shared_experts
+        p["shared"] = {
+            "gate_proj": {"kernel": init(k[3], D, SI, fan_in=D)},
+            "up_proj": {"kernel": init(k[4], D, SI, fan_in=D)},
+            "down_proj": {"kernel": init(k[5], SI, D, fan_in=SI)},
+        }
+        if cfg.shared_expert_gate:
+            p["shared_gate"] = {"kernel": jnp.zeros(shape(D, 1), dtype)}
+    return p
+
+
+# Sharding rules for MoE params (logical dims → mesh axes via MeshContext):
+# expert dim on `expert` (=ep), expert-FSDP on `expert_fsdp` (=dp_shard,cp),
+# expert intermediate on `tensor` — mirrors the reference's dual-mesh design
+# (experts on (ep, ep_shard); moe/parallelizer.py:159-277) as pure annotation.
+MOE_SHARDING_RULES: list[tuple[str, tuple]] = [
+    (r"router/weight$", (None, None)),
+    (r"router/bias$", (None,)),
+    (r"experts/gate_up$", ("expert", "expert_fsdp", "tensor")),
+    (r"experts/down$", ("expert", "tensor", "expert_fsdp")),
+    (r"shared/(gate|up)_proj/kernel$", ("fsdp", "tensor")),
+    (r"shared/down_proj/kernel$", ("tensor", "fsdp")),
+    (r"shared_gate/kernel$", (None, None)),
+]

@@ -139,15 +139,30 @@ def rope_table(
 
 
 def apply_rope(
-    q: jnp.ndarray, k: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    interleave: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Apply rotate-half RoPE. q/k: [B, S, N, H]; cos/sin: [B, S, H]."""
+    """Apply rotate-half RoPE. q/k: [B, S, N, H]; cos/sin: [B, S, H].
+
+    ``interleave``: checkpoint stores pair-interleaved rope dims (DeepSeek
+    MLA, HF `rope_interleave` / apply_rotary_pos_emb_interleave) — deinterleave
+    [x0,y0,x1,y1,...] → [x0,x1,...,y0,y1,...] before the rotation.
+    """
+
+    def deint(x: jnp.ndarray) -> jnp.ndarray:
+        *lead, d = x.shape
+        return x.reshape(*lead, d // 2, 2).swapaxes(-1, -2).reshape(*lead, d)
 
     def rot(x: jnp.ndarray) -> jnp.ndarray:
         half = x.shape[-1] // 2
         x1, x2 = x[..., :half], x[..., half:]
         return jnp.concatenate([-x2, x1], axis=-1)
 
+    if interleave:
+        q, k = deint(q), deint(k)
     c = cos[..., None, :].astype(q.dtype)
     s = sin[..., None, :].astype(q.dtype)
     return q * c + rot(q) * s, k * c + rot(k) * s

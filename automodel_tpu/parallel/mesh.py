@@ -68,6 +68,10 @@ LOGICAL_AXIS_RULES: dict[str, tuple[str, ...]] = {
     "expert": (MeshAxisName.EP,),
     # the reference's ep_shard: FSDP dim for expert weights.
     "expert_fsdp": (MeshAxisName.DP_SHARD, MeshAxisName.CP),
+    # batch dim INSIDE the expert-parallel region: ep has moved to the expert
+    # dim (the dispatch all-to-all), so tokens shard over the remaining data
+    # axes only.
+    "expert_batch": (MeshAxisName.DP_REPLICATE, MeshAxisName.DP_SHARD),
     "stage": (MeshAxisName.PP,),
     "vocab": (MeshAxisName.TP,),
     None: (),

@@ -28,23 +28,37 @@ from automodel_tpu.training.train_state import TrainState
 
 
 def build_train_step(
-    loss_fn: Callable[[Any, dict], tuple[jnp.ndarray, jnp.ndarray]],
+    loss_fn: Callable[[Any, dict], tuple],
     optimizer: optax.GradientTransformation,
     lr_schedule: Optional[Callable] = None,
     donate: bool = True,
+    post_step_fn: Optional[Callable[[Any, dict], Any]] = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted (state, batch) → (state, metrics) step.
 
-    ``loss_fn(params, microbatch) -> (loss_sum, n_valid_tokens)`` where
-    loss_sum is the UN-normalized token-loss sum (normalization happens here,
-    globally). ``batch`` leaves carry a leading microbatch axis [A, ...]; A=1
-    for no accumulation.
+    ``loss_fn(params, microbatch) -> (loss_sum, n_valid_tokens[, extras])``
+    where loss_sum is the UN-normalized token-loss sum (normalization happens
+    here, globally) and `extras` is an optional pytree of per-microbatch
+    auxiliaries (MoE expert counts, aux losses) summed across microbatches.
+    ``batch`` leaves carry a leading microbatch axis [A, ...]; A=1 for no
+    accumulation.
+
+    ``post_step_fn(new_params, extras_sum) -> new_params`` runs AFTER the
+    optimizer update, outside the gradient — the reference's
+    update_moe_gate_bias slot (train_ft.py:1341, aux-free load balancing).
     """
+
+    def call_loss(params, mb):
+        out = loss_fn(params, mb)
+        if len(out) == 3:
+            return out
+        loss_sum, n = out
+        return loss_sum, n, {}
 
     def mb_value_and_grad(params, mb):
         def wrapped(p):
-            loss_sum, n = loss_fn(p, mb)
-            return loss_sum.astype(jnp.float32), n
+            loss_sum, n, extras = call_loss(p, mb)
+            return loss_sum.astype(jnp.float32), (n, extras)
         return jax.value_and_grad(wrapped, has_aux=True)(params)
 
     def step_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
@@ -53,13 +67,14 @@ def build_train_step(
 
         def body(carry, mb):
             g_acc, l_acc, n_acc = carry
-            (loss_sum, n), grads = mb_value_and_grad(state.params, mb)
+            (loss_sum, (n, extras)), grads = mb_value_and_grad(state.params, mb)
             g_acc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), g_acc, grads
             )
-            return (g_acc, l_acc + loss_sum, n_acc + n), None
+            return (g_acc, l_acc + loss_sum, n_acc + n), extras
 
-        (grads, loss_sum, n_tokens), _ = jax.lax.scan(body, carry0, batch)
+        (grads, loss_sum, n_tokens), extras_stacked = jax.lax.scan(body, carry0, batch)
+        extras_sum = jax.tree.map(lambda x: x.sum(axis=0), extras_stacked)
         denom = jnp.maximum(n_tokens, 1).astype(jnp.float32)
         grads = jax.tree.map(lambda g: g / denom, grads)
         grad_norm = optax.global_norm(grads)
@@ -69,18 +84,31 @@ def build_train_step(
         new_params = jax.tree.map(
             lambda new, old: new.astype(old.dtype), new_params, state.params
         )
+        if post_step_fn is not None:
+            new_params = post_step_fn(new_params, extras_sum)
         metrics = {
             "loss": loss_sum / denom,
             "grad_norm": grad_norm,
             "num_label_tokens": n_tokens,
             "step": state.step + 1,
         }
+        if "moe_aux_loss" in extras_sum:
+            metrics["moe_aux_loss"] = extras_sum["moe_aux_loss"] / batch_size(batch)
+        if "expert_counts" in extras_sum:
+            c = extras_sum["expert_counts"].astype(jnp.float32)  # [L, E]
+            metrics["expert_load_imbalance"] = (
+                c.max(axis=-1) / jnp.maximum(c.mean(axis=-1), 1.0)
+            ).mean()
         if lr_schedule is not None:
             metrics["lr"] = lr_schedule(state.step)
         new_state = TrainState(
             params=new_params, opt_state=new_opt_state, step=state.step + 1
         )
         return new_state, metrics
+
+    def batch_size(batch) -> jnp.ndarray:
+        leaf = jax.tree.leaves(batch)[0]
+        return jnp.float32(leaf.shape[0])
 
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
@@ -93,7 +121,7 @@ def build_eval_step(
     def step_fn(state: TrainState, batch: dict) -> dict:
         def body(carry, mb):
             l_acc, n_acc = carry
-            loss_sum, n = loss_fn(state.params, mb)
+            loss_sum, n = loss_fn(state.params, mb)[:2]
             return (l_acc + loss_sum.astype(jnp.float32), n_acc + n), None
 
         (loss_sum, n), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), batch)
@@ -124,13 +152,28 @@ def make_causal_lm_loss(
             if k in mb and mb[k] is not None
         }
         if loss == "fused_linear_ce":
-            hidden = model.hidden(params, mb["input_ids"], constrain=constrain, **kw)
+            out = model.hidden(params, mb["input_ids"], constrain=constrain, **kw)
+            hidden, maux = out if isinstance(out, tuple) else (out, None)
             kernel = model.lm_head(params).astype(hidden.dtype)
-            return L.fused_linear_cross_entropy(
+            loss_sum, n = L.fused_linear_cross_entropy(
                 hidden, kernel, mb["labels"],
                 logits_soft_cap=model.config.logits_soft_cap, **loss_kwargs,
             )
-        logits = model(params, mb["input_ids"], constrain=constrain, **kw)
-        return L.build_loss(loss, **loss_kwargs)(logits, mb["labels"])
+        else:
+            out = model(params, mb["input_ids"], constrain=constrain, **kw)
+            logits, maux = out if isinstance(out, tuple) else (out, None)
+            loss_sum, n = L.build_loss(loss, **loss_kwargs)(logits, mb["labels"])
+        if maux is None:
+            return loss_sum, n
+        # MoE models return (output, aux). The aux loss is a per-batch mean;
+        # weighting by this microbatch's token count makes the global
+        # normalization (divide by total tokens) produce the correct
+        # token-weighted average across microbatches and the dp_cp group.
+        loss_sum = loss_sum + maux.aux_loss * n.astype(jnp.float32)
+        extras = {
+            "moe_aux_loss": maux.aux_loss,
+            "expert_counts": maux.expert_counts,
+        }
+        return loss_sum, n, extras
 
     return loss_fn

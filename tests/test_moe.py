@@ -1,0 +1,162 @@
+"""MoE subsystem tests.
+
+Mirrors the reference's unit-test strategy for components/moe (SURVEY.md §4):
+gate semantics, backend equivalence against the dense reference, aux-free
+bias balancing, and EP-sharded execution on the 8-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.moe import (
+    MoEConfig,
+    fake_balanced_gate,
+    gate,
+    init_moe_params,
+    moe_block,
+    update_gate_bias,
+)
+from automodel_tpu.moe.experts import dense_experts, gspmd_experts, ragged_experts
+from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+from automodel_tpu.parallel.plans import make_constrain
+
+
+CFG = MoEConfig(
+    num_experts=8,
+    num_experts_per_tok=2,
+    moe_intermediate_size=32,
+    norm_topk_prob=True,
+    capacity_factor=8.0,  # no drops → exact match with dense
+)
+
+
+def _params(cfg=CFG, d=16, seed=0):
+    return init_moe_params(jax.random.key(seed), cfg, d, jnp.float32)
+
+
+def _x(t=24, d=16, seed=1):
+    return jnp.asarray(np.random.default_rng(seed).standard_normal((t, d)), jnp.float32)
+
+
+def test_gate_topk_and_norm():
+    p, x = _params(), _x()
+    out = gate(x, p["router"]["weight"], CFG)
+    assert out.topk_idx.shape == (24, 2)
+    # top-k ids unique per token, weights normalized
+    assert all(len(set(row)) == 2 for row in np.asarray(out.topk_idx))
+    np.testing.assert_allclose(np.asarray(out.topk_weights.sum(-1)), 1.0, rtol=1e-5)
+    assert int(out.expert_counts.sum()) == 24 * 2
+
+
+def test_gate_grouped_routing_limits_groups():
+    cfg = MoEConfig(
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+        n_group=4, topk_group=2,
+    )
+    p, x = _params(cfg), _x()
+    out = gate(x, p["router"]["weight"], cfg)
+    # every token's experts come from at most 2 distinct groups (of size 2)
+    groups = np.asarray(out.topk_idx) // 2
+    assert (np.array([len(set(g)) for g in groups]) <= 2).all()
+
+
+def test_gate_sigmoid_bias_affects_selection_not_weights():
+    cfg = MoEConfig(
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+        score_func="sigmoid", expert_bias=True,
+    )
+    p, x = _params(cfg), _x()
+    w = p["router"]["weight"]
+    bias = jnp.zeros(8).at[3].set(1e3)  # force expert 3 into every selection
+    out = gate(x, w, cfg, bias=bias)
+    assert (np.asarray(out.topk_idx) == 3).any(axis=1).all()
+    # combine weights are original sigmoid scores of the chosen experts
+    scores = jax.nn.sigmoid(x @ w)
+    picked = np.take_along_axis(np.asarray(scores), np.asarray(out.topk_idx), 1)
+    np.testing.assert_allclose(np.asarray(out.topk_weights), picked, rtol=1e-5)
+
+
+def test_fake_balanced_gate_is_balanced():
+    out = fake_balanced_gate(_x(t=32), CFG)
+    counts = np.asarray(out.expert_counts)
+    assert counts.min() == counts.max() == 32 * 2 // 8
+
+
+def test_update_gate_bias_pushes_toward_balance():
+    bias = jnp.zeros(4)
+    counts = jnp.asarray([10, 2, 4, 0])
+    new = update_gate_bias(bias, counts, 0.1)
+    assert new[0] < 0 and new[3] > 0  # overloaded down, starved up
+
+
+def test_expert_backends_match_dense():
+    p, x = _params(), _x()
+    gout = gate(x, p["router"]["weight"], CFG)
+    gu, dn = p["experts"]["gate_up"], p["experts"]["down"]
+    ref = dense_experts(x, gout, gu, dn, CFG, jax.nn.silu)
+    rag = ragged_experts(x, gout, gu, dn, CFG, jax.nn.silu)
+    np.testing.assert_allclose(np.asarray(rag), np.asarray(ref), rtol=1e-4, atol=1e-5)
+    gsp = gspmd_experts(x.reshape(2, 12, 16), gout, gu, dn, CFG, jax.nn.silu)
+    np.testing.assert_allclose(
+        np.asarray(gsp).reshape(24, 16), np.asarray(ref), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_gspmd_capacity_drops_lowest_priority():
+    cfg = MoEConfig(
+        num_experts=4, num_experts_per_tok=1, moe_intermediate_size=8,
+        capacity_factor=0.25,  # cap = max(K, S*K/E*0.25) → heavy drops
+    )
+    p = _params(cfg, d=8)
+    x = _x(t=16, d=8)
+    gout = gate(x, p["router"]["weight"], cfg)
+    out = gspmd_experts(
+        x.reshape(1, 16, 8), gout, p["experts"]["gate_up"], p["experts"]["down"],
+        cfg, jax.nn.silu,
+    )
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_moe_block_shared_experts_and_aux():
+    cfg = MoEConfig(
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+        num_shared_experts=1, shared_expert_intermediate_size=32,
+        aux_loss_coeff=0.01, bias_update_factor=0.001,
+    )
+    p = _params(cfg)
+    x = _x(t=24).reshape(2, 12, 16)
+    out, aux = moe_block(x, p, cfg, jax.nn.silu, experts_backend="dense")
+    assert out.shape == x.shape
+    assert float(aux.aux_loss) > 0
+    assert int(aux.expert_counts.sum()) == 48
+
+
+def test_moe_block_ep_sharded_matches_unsharded(devices8):
+    """gspmd dispatch on an ep=4 mesh == single-device result."""
+    cfg = MoEConfig(
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+        capacity_factor=8.0,
+    )
+    p = _params(cfg)
+    x = _x(t=64).reshape(4, 16, 16)
+    ref, _ = moe_block(x, p, cfg, jax.nn.silu, experts_backend="gspmd")
+
+    ctx = build_mesh(MeshConfig(dp_shard=4, ep=4), devices=devices8[:4])
+    constrain = make_constrain(ctx)
+    from automodel_tpu.parallel.plans import shard_params
+    from automodel_tpu.moe.layer import MOE_SHARDING_RULES
+
+    ps = shard_params(ctx, p, MOE_SHARDING_RULES)
+    xs = jax.device_put(x, ctx.sharding("batch", None, None))
+
+    @jax.jit
+    def f(p_, x_):
+        out, aux = moe_block(
+            x_, p_, cfg, jax.nn.silu, experts_backend="gspmd", constrain=constrain
+        )
+        return out
+
+    out = f(ps, xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
