@@ -81,6 +81,7 @@ def from_config(
     backend = _as_backend(backend, mesh_ctx)
     builder = resolve_architecture(hf_config)
     model, adapter = builder(hf_config, backend)
+    model = _maybe_pp(model, mesh_ctx, backend)
     key = jax.random.key(seed)
     if mesh_ctx is None:
         params = model.init(key)
@@ -106,6 +107,7 @@ def from_pretrained(
     hf_config = _read_hf_config(ckpt_dir)
     builder = resolve_architecture(hf_config)
     model, adapter = builder(hf_config, backend)
+    model = _maybe_pp(model, mesh_ctx, backend)
     shardings = None
     if mesh_ctx is not None:
         abstract = jax.eval_shape(model.init, jax.random.key(0))
@@ -133,6 +135,14 @@ def _as_backend(
 
         install_ring_backend(mesh_ctx)
     return backend
+
+
+def _maybe_pp(model: Any, mesh_ctx: Optional[MeshContext], backend: BackendConfig):
+    if mesh_ctx is None or mesh_ctx.pp_size == 1:
+        return model
+    from automodel_tpu.parallel.pp import maybe_pipeline
+
+    return maybe_pipeline(model, mesh_ctx, backend.pp_microbatches)
 
 
 def _np_dtype(name: str):

@@ -42,6 +42,7 @@ class BackendConfig:
     compute_dtype: str = "bfloat16"
     remat: str = "none"  # none | full | selective
     scan_layers: bool = True
+    pp_microbatches: int = 4  # pipeline microbatches when mesh pp > 1
     attn_block_q: int = 512
     attn_block_kv: int = 512
 

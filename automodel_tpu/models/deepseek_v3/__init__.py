@@ -1,0 +1,9 @@
+from automodel_tpu.models.deepseek_v3.model import (
+    DeepseekV3Config,
+    DeepseekV3ForCausalLM,
+)
+from automodel_tpu.models.deepseek_v3.state_dict_adapter import (
+    DeepseekV3StateDictAdapter,
+)
+
+__all__ = ["DeepseekV3Config", "DeepseekV3ForCausalLM", "DeepseekV3StateDictAdapter"]

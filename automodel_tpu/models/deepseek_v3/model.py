@@ -1,0 +1,262 @@
+"""DeepSeek-V3 family: MLA attention + sigmoid-gated MoE, TPU-native.
+
+Parity: reference models/deepseek_v3 (model.py:346, layers.py:37-220 — MLA
+multi-head latent attention with q/kv low-rank compression + decoupled RoPE;
+sigmoid gate with grouped routing + aux-free bias, model.py:121-136).
+
+Reuses the MoE decoder scaffolding (models/qwen3_moe/model.py) with the
+attention block swapped for MLA; the MoE stack, shared experts, dense prefix,
+aux plumbing, and EP sharding rules are identical.
+
+MLA layout (names follow the HF checkpoint):
+  q: x → q_a_proj [D,qr] → rmsnorm → q_b_proj [qr, N*(nope+rope)]
+     (or a single q_proj when q_lora_rank is null)
+  kv: x → kv_a_proj_with_mqa [D, kvr+rope]; split; rmsnorm(kv part)
+      → kv_b_proj [kvr, N*(nope+v)]; rope part is a single shared head
+  attention over concat(nope, rope) dims; v_head_dim may differ from qk dim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.llama.model import Constrain, _dense_init
+from automodel_tpu.models.qwen3_moe.model import (
+    MoEModelAux,
+    MoETransformerConfig,
+    SHARDING_RULES as MOE_RULES,
+    forward_hidden as moe_forward_hidden,
+    init_params as moe_init_params,
+)
+from automodel_tpu.moe.gate import update_gate_bias
+from automodel_tpu.ops.attention import attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope, yarn_mscale
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepseekV3Config(MoETransformerConfig):
+    q_lora_rank: Optional[int] = None
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    rope_interleave: bool = True
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "DeepseekV3Config":
+        base = MoETransformerConfig.from_hf(hf_cfg)
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
+        fields.update(
+            q_lora_rank=get("q_lora_rank"),
+            kv_lora_rank=get("kv_lora_rank", 512),
+            qk_nope_head_dim=get("qk_nope_head_dim", 128),
+            qk_rope_head_dim=get("qk_rope_head_dim", 64),
+            v_head_dim=get("v_head_dim", 128),
+            rope_interleave=bool(get("rope_interleave", True)),
+            qk_norm=False,
+            # V3's router always carries e_score_correction_bias (zero-init
+            # buffer) and balances aux-free (modeling_deepseek_v3.py:121)
+            moe=dataclasses.replace(
+                fields["moe"],
+                # sigmoid scoring is hardcoded in V3 (modeling_deepseek_v3.py:
+                # forward: router_logits.sigmoid()), not a config field
+                score_func=get("scoring_func", None) or "sigmoid",
+                expert_bias=True,
+                bias_update_factor=fields["moe"].bias_update_factor or 1e-3,
+            ),
+        )
+        return cls(**fields)
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def mla_attn_scale(self) -> float:
+        # HF DeepseekV3Attention: qk_head_dim^-0.5 × yarn mscale² folded into
+        # the softmax scale (mscale_all_dim variant)
+        import math
+
+        scale = self.qk_head_dim**-0.5
+        r = self.rope
+        if r.scaling == "yarn" and r.factor > 1.0 and r.mscale_all_dim:
+            m = 0.1 * r.mscale_all_dim * math.log(r.factor) + 1.0
+            scale = scale * m * m
+        return scale
+
+
+def init_mla_layer(cfg: DeepseekV3Config, backend: BackendConfig, key, L: int) -> dict:
+    pd = backend.param_jnp_dtype
+    D, N = cfg.hidden_size, cfg.num_heads
+    qk, rope, v = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    keys = jax.random.split(key, 6)
+
+    def stack(k, shape, in_axis=0):
+        return _dense_init(k, (L, *shape), pd, in_axis=in_axis + 1)
+
+    attn: dict = {
+        "kv_a_proj": {"kernel": stack(keys[2], (D, cfg.kv_lora_rank + rope))},
+        "kv_a_norm": {"scale": jnp.ones((L, cfg.kv_lora_rank), pd)},
+        "kv_b_proj": {"kernel": stack(keys[3], (cfg.kv_lora_rank, N * (qk + v)))},
+        "o_proj": {"kernel": stack(keys[4], (N * v, D))},
+    }
+    if cfg.q_lora_rank:
+        attn["q_a_proj"] = {"kernel": stack(keys[0], (D, cfg.q_lora_rank))}
+        attn["q_a_norm"] = {"scale": jnp.ones((L, cfg.q_lora_rank), pd)}
+        attn["q_b_proj"] = {"kernel": stack(keys[1], (cfg.q_lora_rank, N * (qk + rope)))}
+    else:
+        attn["q_proj"] = {"kernel": stack(keys[0], (D, N * (qk + rope)))}
+    return {
+        "attn": attn,
+        "input_norm": {"scale": jnp.ones((L, D), pd)},
+        "post_attn_norm": {"scale": jnp.ones((L, D), pd)},
+    }
+
+
+def mla_block(
+    cfg: DeepseekV3Config,
+    backend: BackendConfig,
+    h: jnp.ndarray,
+    lp: dict,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    segment_ids: Optional[jnp.ndarray],
+    constrain: Constrain,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    B, S, D = h.shape
+    N = cfg.num_heads
+    nope, rope, vdim = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    ap = lp["attn"]
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
+
+    if cfg.q_lora_rank:
+        qa = x @ ap["q_a_proj"]["kernel"].astype(x.dtype)
+        qa = rms_norm(qa, ap["q_a_norm"]["scale"], cfg.rms_eps)
+        q = qa @ ap["q_b_proj"]["kernel"].astype(x.dtype)
+    else:
+        q = x @ ap["q_proj"]["kernel"].astype(x.dtype)
+    q = q.reshape(B, S, N, nope + rope)
+    q_pass, q_rot = q[..., :nope], q[..., nope:]
+
+    ckv = x @ ap["kv_a_proj"]["kernel"].astype(x.dtype)  # [B,S,kvr+rope]
+    k_pass_c, k_rot = ckv[..., : cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank :]
+    k_pass_c = rms_norm(k_pass_c, ap["kv_a_norm"]["scale"], cfg.rms_eps)
+    kv = (k_pass_c @ ap["kv_b_proj"]["kernel"].astype(x.dtype)).reshape(
+        B, S, N, nope + vdim
+    )
+    k_pass, v = kv[..., :nope], kv[..., nope:]
+
+    k_rot = k_rot[:, :, None, :]  # single shared rope head [B,S,1,rope]
+    q_rot, k_rot = apply_rope(q_rot, k_rot, cos, sin, interleave=cfg.rope_interleave)
+    k_rot = jnp.broadcast_to(k_rot, (B, S, N, rope))
+
+    qh = jnp.concatenate([q_pass, q_rot], axis=-1)
+    kh = jnp.concatenate([k_pass, k_rot], axis=-1)
+
+    pad_v = backend.attn == "flash" and vdim != cfg.qk_head_dim
+    if pad_v:
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, cfg.qk_head_dim - vdim)))
+    out = attention(
+        qh,
+        kh,
+        v,
+        backend=backend.attn,
+        causal=True,
+        scale=cfg.mla_attn_scale,
+        segment_ids=segment_ids,
+        **(
+            {"block_q": backend.attn_block_q, "block_kv": backend.attn_block_kv}
+            if backend.attn == "flash"
+            else {}
+        ),
+    )
+    if pad_v:
+        out = out[..., :vdim]
+    h = h + out.reshape(B, S, N * vdim) @ ap["o_proj"]["kernel"].astype(x.dtype)
+    return constrain(h, ("batch", "seq", None))
+
+
+def init_params(cfg: DeepseekV3Config, backend: BackendConfig, key: jax.Array) -> dict:
+    params = moe_init_params(cfg, backend, key)
+    # replace llama attention params with MLA in both stacks
+    k1, k2 = jax.random.split(jax.random.fold_in(key, 7))
+    nd = cfg.moe.num_dense_layers
+    nm = cfg.num_layers - nd
+    if nd > 0:
+        mla = init_mla_layer(cfg, backend, k1, nd)
+        params["dense_layers"]["attn"] = mla["attn"]
+    params["moe_layers"]["attn"] = init_mla_layer(cfg, backend, k2, nm)["attn"]
+    return params
+
+
+SHARDING_RULES: list[tuple[str, tuple]] = [
+    (r"attn/q_a_proj/kernel$", (None, "fsdp", None)),
+    (r"attn/q_a_norm/scale$", (None, None)),
+    (r"attn/q_b_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"attn/q_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"attn/kv_a_proj/kernel$", (None, "fsdp", None)),
+    (r"attn/kv_a_norm/scale$", (None, None)),
+    (r"attn/kv_b_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"attn/o_proj/kernel$", (None, "tensor", "fsdp")),
+    *MOE_RULES,
+]
+
+
+@dataclasses.dataclass
+class DeepseekV3ForCausalLM:
+    config: DeepseekV3Config
+    backend: BackendConfig = BackendConfig()
+
+    def init(self, key: jax.Array) -> dict:
+        return init_params(self.config, self.backend, key)
+
+    def _fwd_hidden(self, params, input_ids, **kw):
+        return moe_forward_hidden(
+            self.config,
+            self.backend,
+            params,
+            input_ids,
+            attn_block=mla_block,
+            rope_dim=self.config.qk_rope_head_dim,
+            **kw,
+        )
+
+    def __call__(self, params: dict, input_ids: jnp.ndarray, **kw: Any):
+        h, aux = self._fwd_hidden(params, input_ids, **kw)
+        logits = h @ self.lm_head(params).astype(h.dtype)
+        return logits, aux
+
+    def hidden(self, params: dict, input_ids: jnp.ndarray, **kw: Any):
+        return self._fwd_hidden(params, input_ids, **kw)
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        if self.config.tie_embeddings:
+            return params["embed"]["embedding"].T
+        return params["lm_head"]["kernel"]
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return SHARDING_RULES
+
+    def post_step_fn(self, params: dict, extras: dict) -> dict:
+        u = self.config.moe.bias_update_factor
+        if u <= 0 or "expert_counts" not in extras:
+            return params
+        bias = params["moe_layers"]["moe"]["router"].get("bias")
+        if bias is None:
+            return params
+        counts = extras["expert_counts"]
+        params["moe_layers"]["moe"]["router"]["bias"] = jax.vmap(
+            lambda b, c: update_gate_bias(b, c, u)
+        )(bias, counts)
+        return params

@@ -1,0 +1,61 @@
+"""HF ⇄ native adapter for DeepSeek-V3 (MLA keys on the MoE scaffolding).
+
+Parity: reference models/deepseek_v3/state_dict_adapter.py (FP8-blockwise
+dequant lives in checkpoint/quant_io.py; this adapter consumes already-
+dequantized tensors via the reader's dequant hook).
+"""
+
+from __future__ import annotations
+
+from automodel_tpu.models.deepseek_v3.model import DeepseekV3Config
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import MoEStateDictAdapter
+
+
+class DeepseekV3StateDictAdapter(MoEStateDictAdapter):
+    def __init__(self, config: DeepseekV3Config):
+        super().__init__(config)
+
+    def _attn_keys(self, i: int):
+        c = self.config
+        m = {
+            ("attn", "kv_a_proj", "kernel"): (
+                f"model.layers.{i}.self_attn.kv_a_proj_with_mqa.weight",
+                True,
+            ),
+            ("attn", "kv_a_norm", "scale"): (
+                f"model.layers.{i}.self_attn.kv_a_layernorm.weight",
+                False,
+            ),
+            ("attn", "kv_b_proj", "kernel"): (
+                f"model.layers.{i}.self_attn.kv_b_proj.weight",
+                True,
+            ),
+            ("attn", "o_proj", "kernel"): (
+                f"model.layers.{i}.self_attn.o_proj.weight",
+                True,
+            ),
+            ("input_norm", "scale"): (f"model.layers.{i}.input_layernorm.weight", False),
+            ("post_attn_norm", "scale"): (
+                f"model.layers.{i}.post_attention_layernorm.weight",
+                False,
+            ),
+        }
+        if c.q_lora_rank:
+            m[("attn", "q_a_proj", "kernel")] = (
+                f"model.layers.{i}.self_attn.q_a_proj.weight",
+                True,
+            )
+            m[("attn", "q_a_norm", "scale")] = (
+                f"model.layers.{i}.self_attn.q_a_layernorm.weight",
+                False,
+            )
+            m[("attn", "q_b_proj", "kernel")] = (
+                f"model.layers.{i}.self_attn.q_b_proj.weight",
+                True,
+            )
+        else:
+            m[("attn", "q_proj", "kernel")] = (
+                f"model.layers.{i}.self_attn.q_proj.weight",
+                True,
+            )
+        return m

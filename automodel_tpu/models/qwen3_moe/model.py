@@ -31,7 +31,6 @@ from automodel_tpu.models.llama.model import (
     _dense_init,
     _noop_constrain,
     attention_block,
-    decoder_layer,
 )
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.gate import update_gate_bias
@@ -149,6 +148,8 @@ def forward_hidden(
     position_ids: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     constrain: Constrain = _noop_constrain,
+    attn_block: Any = attention_block,
+    rope_dim: Optional[int] = None,
 ) -> tuple[jnp.ndarray, MoEModelAux]:
     cd = backend.compute_jnp_dtype
     moe = cfg.moe
@@ -157,7 +158,7 @@ def forward_hidden(
         position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
     h = params["embed"]["embedding"].astype(cd)[input_ids]
     h = constrain(h, ("batch", "seq", None))
-    cos, sin = rope_table(position_ids, cfg.head_dim, cfg.rope)
+    cos, sin = rope_table(position_ids, rope_dim or cfg.head_dim, cfg.rope)
 
     def maybe_remat(fn):
         if backend.remat == "full":
@@ -170,13 +171,19 @@ def forward_hidden(
 
     if "dense_layers" in params:
         def dense_fn(carry, lp):
-            out = decoder_layer(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
-            return out, None
+            hh = attn_block(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
+            x = rms_norm(hh, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+            act = ACT_FNS[cfg.act]
+            mlp = (
+                act(x @ lp["mlp"]["gate_proj"]["kernel"].astype(x.dtype))
+                * (x @ lp["mlp"]["up_proj"]["kernel"].astype(x.dtype))
+            ) @ lp["mlp"]["down_proj"]["kernel"].astype(x.dtype)
+            return constrain(hh + mlp, ("batch", "seq", None)), None
 
         h, _ = jax.lax.scan(maybe_remat(dense_fn), h, params["dense_layers"])
 
     def moe_fn(carry, lp):
-        hh = attention_block(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
+        hh = attn_block(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
         x = rms_norm(hh, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         out, aux = moe_block(
             x,
@@ -213,9 +220,13 @@ def forward(
     backend: BackendConfig,
     params: dict,
     input_ids: jnp.ndarray,
+    attn_block: Any = attention_block,
+    rope_dim: Optional[int] = None,
     **kw: Any,
 ) -> tuple[jnp.ndarray, MoEModelAux]:
-    h, aux = forward_hidden(cfg, backend, params, input_ids, **kw)
+    h, aux = forward_hidden(
+        cfg, backend, params, input_ids, attn_block=attn_block, rope_dim=rope_dim, **kw
+    )
     kernel = (
         params["embed"]["embedding"].T
         if cfg.tie_embeddings

@@ -56,6 +56,18 @@ def _llama_builder(hf_config: Any, backend: BackendConfig):
     return LlamaForCausalLM(cfg, backend), LlamaStateDictAdapter(cfg)
 
 
+@register_architecture("DeepseekV3ForCausalLM")
+def _deepseek_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.deepseek_v3 import (
+        DeepseekV3Config,
+        DeepseekV3ForCausalLM,
+        DeepseekV3StateDictAdapter,
+    )
+
+    cfg = DeepseekV3Config.from_hf(hf_config)
+    return DeepseekV3ForCausalLM(cfg, backend), DeepseekV3StateDictAdapter(cfg)
+
+
 @register_architecture("Qwen3MoeForCausalLM")
 def _moe_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.qwen3_moe import (

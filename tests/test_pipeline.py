@@ -1,0 +1,94 @@
+"""Pipeline parallelism: forward/grad parity vs non-PP, and e2e training.
+
+The reference validates PP via 3D (PP+FSDP+TP) composition tests (SURVEY.md
+§2.10); here the 8-device mesh gives pp=2 × dp=2 × tp=2.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu import auto_model
+from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+
+HF = {
+    "architectures": ["LlamaForCausalLM"],
+    "model_type": "llama",
+    "vocab_size": 128,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 4,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+}
+FP32 = {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32"}
+
+
+@pytest.fixture(scope="module")
+def pp_setup(devices8):
+    ctx = build_mesh(MeshConfig(pp=2, dp_shard=2, tp=2), devices=devices8)
+    auto_pp = auto_model.from_config(HF, ctx, {**FP32, "pp_microbatches": 4}, seed=0)
+    auto_ref = auto_model.from_config(HF, None, FP32, seed=0)
+    return ctx, auto_pp, auto_ref
+
+
+def test_pp_forward_matches_unpipelined(pp_setup):
+    ctx, auto_pp, auto_ref = pp_setup
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, size=(8, 16)), jnp.int32
+    )
+    out_pp = np.asarray(jax.jit(auto_pp.model.__call__)(auto_pp.params, ids))
+    out_ref = np.asarray(auto_ref.model(auto_ref.params, ids))
+    np.testing.assert_allclose(out_pp, out_ref, atol=2e-4, rtol=2e-3)
+
+
+def test_pp_grads_match_unpipelined(pp_setup):
+    ctx, auto_pp, auto_ref = pp_setup
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, 128, size=(8, 16)), jnp.int32
+    )
+
+    def loss(model):
+        def f(p):
+            return model(p, ids).astype(jnp.float32).sum()
+
+        return f
+
+    g_pp = jax.jit(jax.grad(loss(auto_pp.model)))(auto_pp.params)
+    g_ref = jax.grad(loss(auto_ref.model))(auto_ref.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3
+        ),
+        jax.device_get(g_pp),
+        jax.device_get(g_ref),
+    )
+
+
+def test_pp_train_step_learns(pp_setup):
+    from automodel_tpu.data.loader import place_batch
+    from automodel_tpu.optim.builders import build_optimizer
+    from automodel_tpu.training.train_state import TrainState
+    from automodel_tpu.training.train_step import build_train_step, make_causal_lm_loss
+
+    ctx, auto_pp, _ = pp_setup
+    opt = build_optimizer(name="adamw", lr=1e-3, grad_clip_norm=1.0)
+    state = TrainState.create(auto_pp.params, jax.jit(opt.init)(auto_pp.params))
+    loss_fn = make_causal_lm_loss(auto_pp.model, constrain=auto_pp.constrain)
+    step = build_train_step(loss_fn, opt)
+    ids = np.random.default_rng(0).integers(0, 128, size=(1, 8, 16)).astype(np.int32)
+    batch = place_batch(ctx, {"input_ids": ids, "labels": ids})
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_pp_requires_divisible_layers(devices8):
+    ctx = build_mesh(MeshConfig(pp=2, dp_shard=4), devices=devices8)
+    bad = dict(HF, num_hidden_layers=3)
+    with pytest.raises(ValueError, match="divide"):
+        auto_model.from_config(bad, ctx, FP32, seed=0)
