@@ -1,0 +1,19 @@
+from automodel_tpu.peft.lora import (
+    PeftConfig,
+    export_hf_peft,
+    init_lora_params,
+    lora_sharding_rules,
+    make_lora_loss_fn,
+    merge_lora,
+    num_trainable,
+)
+
+__all__ = [
+    "PeftConfig",
+    "export_hf_peft",
+    "init_lora_params",
+    "lora_sharding_rules",
+    "make_lora_loss_fn",
+    "merge_lora",
+    "num_trainable",
+]

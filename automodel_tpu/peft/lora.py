@@ -1,0 +1,200 @@
+"""LoRA — parameter-efficient finetuning.
+
+Parity: reference `_peft/lora.py` (PeftConfig:42, LinearLoRA patching:76,
+MoE expert LoRA via patch_moe_module:420, apply_lora_to_linear_modules:463)
+plus the Triton fused kernels (lora_kernel.py). TPU-native design: no module
+surgery and no custom kernel —
+
+- the adapter is a SEPARATE pytree mirroring the matched kernel leaves
+  (`{path: {lora_A [..,in,r], lora_B [..,r,out]}}`);
+- the train step closes over the FROZEN base params and differentiates only
+  the adapter tree: `merge_lora(base, adapters)` adds `scale·A@B` on the fly
+  inside jit, XLA fuses the rank-r update into the surrounding matmuls;
+- optimizer state exists only for adapter leaves (the LoRA memory win), and
+  checkpoints store just the adapter tree.
+
+Stacked leaves work unchanged: a scan-stacked [L, in, out] kernel gets
+[L, in, r]/[L, r, out] factors; MoE expert tensors [L, E, D, 2I] get
+[L, E, D, r]/[L, E, r, 2I] (reference: GroupedExpertsLoRA, lora_moe.py:116).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.parallel.plans import path_str
+
+
+@dataclasses.dataclass(frozen=True)
+class PeftConfig:
+    """Reference: _peft/lora.py:42. target_modules are wildcard patterns
+    matched against native param paths (e.g. "*attn/[qkv]_proj*",
+    "*mlp*", "*experts*")."""
+
+    target_modules: Sequence[str] = ("*attn/q_proj*", "*attn/v_proj*")
+    dim: int = 8
+    alpha: float = 16.0
+    dropout: float = 0.0
+    use_rslora: bool = False  # scale = alpha/sqrt(dim) instead of alpha/dim
+
+    def __post_init__(self):
+        if self.dropout:
+            raise NotImplementedError(
+                "LoRA dropout requires activation-side application; the "
+                "merged formulation supports dropout=0 only (the reference "
+                "default)."
+            )
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / (self.dim**0.5 if self.use_rslora else self.dim)
+
+
+def _matches(path: str, cfg: PeftConfig) -> bool:
+    return any(fnmatch.fnmatch(path, pat) for pat in cfg.target_modules)
+
+
+def init_lora_params(key: jax.Array, base_params: Any, cfg: PeftConfig) -> dict:
+    """Build the adapter tree for every matched >=2-D weight leaf.
+
+    A ~ N(0, 1/in_dim) (kaiming-style), B = 0 → adapted model starts exactly
+    at the base model (reference init, _peft/lora.py:76).
+    """
+    flat: dict = {}
+
+    def visit(path, leaf):
+        p = path_str(path)
+        if leaf.ndim < 2 or not _matches(p, cfg):
+            return
+        *lead, fan_in, fan_out = leaf.shape
+        k = jax.random.fold_in(key, len(flat))
+        a = jax.random.normal(k, (*lead, fan_in, cfg.dim), jnp.float32) / (fan_in**0.5)
+        flat[p] = {
+            "lora_A": a.astype(leaf.dtype),
+            "lora_B": jnp.zeros((*lead, cfg.dim, fan_out), leaf.dtype),
+        }
+
+    jax.tree_util.tree_map_with_path(visit, base_params)
+    if not flat:
+        raise ValueError(
+            f"PeftConfig.target_modules {list(cfg.target_modules)} matched no params"
+        )
+    return flat
+
+
+def merge_lora(base_params: Any, lora_params: dict, cfg: PeftConfig) -> Any:
+    """base + scale·A@B on matched leaves (called inside jit; XLA fuses)."""
+    scale = jnp.asarray(cfg.scale)
+
+    def visit(path, leaf):
+        p = path_str(path)
+        if p not in lora_params:
+            return leaf
+        ab = lora_params[p]
+        delta = jnp.einsum(
+            "...ir,...ro->...io",
+            ab["lora_A"].astype(jnp.float32),
+            ab["lora_B"].astype(jnp.float32),
+        )
+        return (leaf.astype(jnp.float32) + scale * delta).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(visit, base_params)
+
+
+def make_lora_loss_fn(base_loss_fn, base_params: Any, cfg: PeftConfig):
+    """Wrap a (params, mb) loss into an (adapters, mb) loss. The base tree is
+    captured as a closure constant — never differentiated, never donated."""
+    frozen = jax.lax.stop_gradient(base_params)
+
+    def loss_fn(lora_params, mb):
+        return base_loss_fn(merge_lora(frozen, lora_params, cfg), mb)
+
+    return loss_fn
+
+
+def lora_sharding_rules(base_rules: list, lora_params: dict) -> list:
+    """Adapter shardings derived from the base plan: A keeps the base leaf's
+    input-dim sharding with the rank dim replicated; B mirrors for output."""
+    from automodel_tpu.parallel.plans import match_rule
+
+    rules = []
+    for p in lora_params:
+        spec = match_rule(p, base_rules)
+        if spec is None:
+            continue
+        lead = tuple(spec[:-2]) if len(spec) >= 2 else ()
+        in_ax = spec[-2] if len(spec) >= 2 else None
+        out_ax = spec[-1] if len(spec) >= 1 else None
+        rules.append((f"^{_re_escape(p)}/lora_A$", (*lead, in_ax, None)))
+        rules.append((f"^{_re_escape(p)}/lora_B$", (*lead, None, out_ax)))
+    return rules
+
+
+def _re_escape(s: str) -> str:
+    import re
+
+    return re.escape(s)
+
+
+def num_trainable(lora_params: dict) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(lora_params))
+
+
+# ---- HF PEFT interop -------------------------------------------------------
+def export_hf_peft(
+    lora_params: dict, cfg: PeftConfig, adapter: Any, out_dir: str
+) -> None:
+    """Write adapter_model.safetensors + adapter_config.json in HF PEFT
+    layout (reference: PeftAddon, checkpoint/addons.py). Only leaves whose
+    native path maps to an HF module via the family adapter's plans are
+    exported; others keep their native path as key."""
+    import json
+    from pathlib import Path
+
+    import numpy as np
+
+    from automodel_tpu.checkpoint.hf_io import save_hf_checkpoint
+
+    # native path prefix → HF module name, via the family leaf plans if available
+    path_to_hf: dict[str, str] = {}
+    if hasattr(adapter, "leaf_plans"):
+        for plan in adapter.leaf_plans():
+            hf_mod = plan.hf_key.rsplit(".weight", 1)[0]
+            path_to_hf["/".join(plan.path)] = hf_mod
+
+    def tensors():
+        for p, ab in lora_params.items():
+            hf_mod = path_to_hf.get(p)
+            for which in ("lora_A", "lora_B"):
+                arr = np.asarray(ab[which])
+                if hf_mod is not None and arr.ndim == 3 and "{i}" in hf_mod:
+                    for i in range(arr.shape[0]):
+                        key = f"base_model.model.{hf_mod.format(i=i)}.{which}.weight"
+                        yield key, np.ascontiguousarray(arr[i].T)
+                elif hf_mod is not None and arr.ndim == 2:
+                    key = f"base_model.model.{hf_mod}.{which}.weight"
+                    yield key, np.ascontiguousarray(arr.T)
+                else:
+                    yield f"{p}/{which}", arr
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    save_hf_checkpoint(out, tensors())
+    (out / "adapter_config.json").write_text(
+        json.dumps(
+            {
+                "peft_type": "LORA",
+                "r": cfg.dim,
+                "lora_alpha": cfg.alpha,
+                "lora_dropout": cfg.dropout,
+                "use_rslora": cfg.use_rslora,
+                "target_modules": list(cfg.target_modules),
+            },
+            indent=2,
+        )
+    )

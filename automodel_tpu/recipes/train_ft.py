@@ -84,14 +84,43 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             )
         self.model = self.auto.model
 
+        # peft (LoRA): trainable tree = adapters only; base closed over frozen
+        pcfg = cfg.get("peft")
+        self.peft_config = None
+        if pcfg is not None:
+            from automodel_tpu.peft import (
+                PeftConfig,
+                init_lora_params,
+                lora_sharding_rules,
+                num_trainable,
+            )
+
+            pkw = dict(pcfg or {})
+            pkw.pop("_target_", None)
+            self.peft_config = PeftConfig(**pkw)
+            lora = init_lora_params(
+                jax.random.key(cfg.get("seed", 42) + 1), self.auto.params, self.peft_config
+            )
+            from automodel_tpu.parallel.plans import shard_params
+
+            lora = shard_params(
+                self.mesh_ctx,
+                lora,
+                lora_sharding_rules(self.model.sharding_rules, lora),
+            )
+            logger.info("LoRA: %d trainable params", num_trainable(lora))
+            trainable = lora
+        else:
+            trainable = self.auto.params
+
         # optimizer + schedule
         ocfg = dict(cfg.get("optimizer", {}) or {"name": "adamw"})
         ocfg.pop("_target_", None)
         sched_cfg = dict(ocfg.get("lr_schedule") or {})
         self.lr_schedule = build_lr_schedule(lr=ocfg.get("lr", 1e-4), **sched_cfg)
         self.optimizer = build_optimizer(**ocfg)
-        opt_state = jax.jit(self.optimizer.init)(self.auto.params)
-        self.state = TrainState.create(self.auto.params, opt_state)
+        opt_state = jax.jit(self.optimizer.init)(trainable)
+        self.state = TrainState.create(trainable, opt_state)
 
         # loss + steps
         lcfg = dict(cfg.get("loss_fn", {}) or {})
@@ -100,7 +129,16 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self.loss_fn = make_causal_lm_loss(
             self.model, loss=loss_name, constrain=self.auto.constrain, **lcfg
         )
-        self.train_step = build_train_step(self.loss_fn, self.optimizer, self.lr_schedule)
+        if self.peft_config is not None:
+            from automodel_tpu.peft import make_lora_loss_fn
+
+            self.loss_fn = make_lora_loss_fn(
+                self.loss_fn, self.auto.params, self.peft_config
+            )
+        post_step = getattr(self.model, "post_step_fn", None) if self.peft_config is None else None
+        self.train_step = build_train_step(
+            self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step
+        )
         self.eval_step = build_eval_step(self.loss_fn)
 
         # data
@@ -145,8 +183,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             "step_scheduler": self.step_scheduler.state_dict(),
             "rng": self.rng.state_dict(),
         }
-        hf_export = (self.auto.adapter, self.state.params)
-        self.checkpointer.save(
+        # with LoRA, state.params is the adapter tree: export HF-PEFT adapter
+        # artifacts instead of a consolidated base model (reference: PeftAddon)
+        hf_export = None if self.peft_config else (self.auto.adapter, self.state.params)
+        out = self.checkpointer.save(
             self.state,
             epoch=self.step_scheduler.epoch,
             step=self.step_scheduler.step,
@@ -154,6 +194,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             hf_export=hf_export,
             config_snapshot=self.cfg.to_dict(),
         )
+        if self.peft_config is not None:
+            from automodel_tpu.peft import export_hf_peft
+
+            export_hf_peft(
+                jax.device_get(self.state.params),
+                self.peft_config,
+                self.auto.adapter,
+                out / "hf_adapter",
+            )
         logger.info("saved checkpoint at step %d", self.step_scheduler.step)
 
     def _restore(self) -> None:

@@ -1,0 +1,162 @@
+"""LoRA: init/merge semantics, training, MoE expert adapters, HF export."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu import auto_model
+from automodel_tpu.peft import (
+    PeftConfig,
+    export_hf_peft,
+    init_lora_params,
+    make_lora_loss_fn,
+    merge_lora,
+    num_trainable,
+)
+
+HF = {
+    "architectures": ["LlamaForCausalLM"],
+    "model_type": "llama",
+    "vocab_size": 128,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+}
+FP32 = {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32"}
+
+
+def test_init_matches_targets_and_starts_at_base():
+    auto = auto_model.from_config(HF, None, FP32, seed=0)
+    cfg = PeftConfig(target_modules=("*attn/q_proj*", "*attn/v_proj*"), dim=4)
+    lora = init_lora_params(jax.random.key(0), auto.params, cfg)
+    assert set(lora) == {
+        "layers/attn/q_proj/kernel",
+        "layers/attn/v_proj/kernel",
+    }
+    # stacked leaves: [L, in, r] factors
+    assert lora["layers/attn/q_proj/kernel"]["lora_A"].shape == (2, 64, 4)
+    # B=0 → merge is identity
+    merged = merge_lora(auto.params, lora, cfg)
+    ids = jnp.arange(16).reshape(1, 16) % 128
+    np.testing.assert_allclose(
+        np.asarray(auto.model(merged, ids)),
+        np.asarray(auto.model(auto.params, ids)),
+        atol=1e-6,
+    )
+
+
+def test_lora_grads_only_adapters_and_learns():
+    from automodel_tpu.optim.builders import build_optimizer
+    from automodel_tpu.training.train_state import TrainState
+    from automodel_tpu.training.train_step import build_train_step, make_causal_lm_loss
+
+    auto = auto_model.from_config(HF, None, FP32, seed=0)
+    cfg = PeftConfig(target_modules=("*_proj*",), dim=4, alpha=8)
+    lora = init_lora_params(jax.random.key(0), auto.params, cfg)
+    base_loss = make_causal_lm_loss(auto.model)
+    loss_fn = make_lora_loss_fn(base_loss, auto.params, cfg)
+    opt = build_optimizer(name="adamw", lr=5e-3)
+    state = TrainState.create(lora, jax.jit(opt.init)(lora))
+    step = build_train_step(loss_fn, opt)
+    ids = np.random.default_rng(0).integers(0, 128, size=(1, 4, 16)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(ids)}
+    losses = []
+    base_before = jax.device_get(auto.params)
+    for _ in range(5):
+        state, metrics = step(state, batch)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    assert losses[-1] < losses[0]
+    # the base tree is untouched (trainable = adapters only)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        base_before,
+        jax.device_get(auto.params),
+    )
+    # B actually moved
+    b = np.asarray(state.params["layers/attn/q_proj/kernel"]["lora_B"])
+    assert np.abs(b).max() > 0
+
+
+def test_moe_expert_lora():
+    moe_hf = {
+        "architectures": ["Qwen3MoeForCausalLM"],
+        "model_type": "qwen3_moe",
+        "vocab_size": 128,
+        "hidden_size": 64,
+        "intermediate_size": 128,
+        "moe_intermediate_size": 32,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "head_dim": 16,
+        "num_experts": 4,
+        "num_experts_per_tok": 2,
+        "norm_topk_prob": True,
+    }
+    auto = auto_model.from_config(moe_hf, None, FP32, seed=0)
+    cfg = PeftConfig(target_modules=("*moe/experts*",), dim=4)
+    lora = init_lora_params(jax.random.key(0), auto.params, cfg)
+    # expert leaves [L, E, D, 2I] → A [L, E, D, r]
+    assert lora["moe_layers/moe/experts/gate_up"]["lora_A"].shape == (2, 4, 64, 4)
+    merged = merge_lora(auto.params, lora, cfg)
+    ids = jnp.arange(16).reshape(1, 16) % 128
+    out_m, _ = auto.model(merged, ids)
+    out_b, _ = auto.model(auto.params, ids)
+    np.testing.assert_allclose(np.asarray(out_m), np.asarray(out_b), atol=1e-6)
+
+
+def test_export_hf_peft(tmp_path):
+    from automodel_tpu.checkpoint.hf_io import HFCheckpointReader
+
+    auto = auto_model.from_config(HF, None, FP32, seed=0)
+    cfg = PeftConfig(target_modules=("*attn/q_proj*",), dim=4)
+    lora = init_lora_params(jax.random.key(0), auto.params, cfg)
+    export_hf_peft(jax.device_get(lora), cfg, auto.adapter, tmp_path / "adapter")
+    acfg = json.loads((tmp_path / "adapter" / "adapter_config.json").read_text())
+    assert acfg["peft_type"] == "LORA" and acfg["r"] == 4
+    reader = HFCheckpointReader(tmp_path / "adapter")
+    keys = reader.keys()
+    # per-layer unstacked HF PEFT keys, torch [out, in] layout
+    assert "base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight" in keys
+    a0 = reader.get_tensor("base_model.model.model.layers.0.self_attn.q_proj.lora_A.weight")
+    assert a0.shape == (4, 64)  # [r, in] torch layout
+
+
+def test_recipe_with_peft(tmp_path):
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+    cfg = ConfigNode(
+        {
+            "seed": 3,
+            "model": {"hf_config": HF, "backend": FP32},
+            "distributed": {"dp_shard": 1},
+            "peft": {"target_modules": ["*attn/[qv]_proj*"], "dim": 4},
+            "dataset": {
+                "_target_": "automodel_tpu.data.sft.MockSFTDataset",
+                "num_samples": 32,
+                "seq_length": 16,
+                "vocab_size": 128,
+            },
+            "dataloader": {"global_batch_size": 8},
+            "step_scheduler": {"max_steps": 3, "grad_acc_steps": 1},
+            "optimizer": {"name": "adamw", "lr": 1e-3},
+            "checkpoint": {
+                "enabled": True,
+                "checkpoint_dir": str(tmp_path / "ckpt"),
+            },
+            "logging": {"metrics_path": str(tmp_path / "m.jsonl")},
+        }
+    )
+    r = TrainFinetuneRecipeForNextTokenPrediction(cfg)
+    r.setup()
+    last = r.run_train_validation_loop()
+    assert np.isfinite(last["loss"])
+    adapters = list((tmp_path / "ckpt").glob("*/hf_adapter/adapter_config.json"))
+    assert adapters, "HF PEFT adapter export missing"
