@@ -1,0 +1,97 @@
+"""Benchmarking recipe.
+
+Parity: BenchmarkingRecipeForNextTokenPrediction (recipes/llm/benchmark.py:
+34-100) — reuses the finetune recipe's setup and step, adds warmup gating,
+per-step timers, profiler windows, MFU via the FLOPs formulas, and a JSON
+result. Reference benchmark conditions (docs/performance-summary.md:66-72):
+mock data, fake balanced gate for MoE, no validation.
+
+YAML additions over train_ft:
+  benchmark: {warmup_steps: 3, measure_steps: 10, profile: {enabled, ...}}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+import jax
+import numpy as np
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.data.collators import stack_microbatches
+from automodel_tpu.data.loader import place_batch
+from automodel_tpu.recipes.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+from automodel_tpu.training.timers import Timers
+from automodel_tpu.utils.flops_utils import (
+    calculate_mfu,
+    device_peak_tflops,
+    flops_per_token_for_config,
+)
+from automodel_tpu.utils.profiler import ProfilerConfig, StepProfiler
+
+logger = logging.getLogger(__name__)
+
+
+class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
+    def run_benchmark(self) -> dict:
+        bcfg = dict(self.cfg.get("benchmark", {}) or {})
+        warmup = int(bcfg.get("warmup_steps", 3))
+        measure = int(bcfg.get("measure_steps", 10))
+        prof = StepProfiler(ProfilerConfig(**dict(bcfg.get("profile", {}) or {})))
+        timers = Timers()
+
+        it = iter(self.step_scheduler)
+        group = next(it)
+        stacked = stack_microbatches(group)
+        batch = place_batch(self.mesh_ctx, stacked)
+        tokens_per_step = int(np.prod(stacked["input_ids"].shape))
+
+        state = self.state
+        for i in range(warmup):
+            state, metrics = self.train_step(state, batch)
+        jax.device_get(metrics["loss"])  # true barrier (tunneled backends)
+
+        for i in range(measure):
+            prof.on_step(i)
+            timers("step").start()
+            state, metrics = self.train_step(state, batch)
+            jax.device_get(metrics["loss"])
+            timers("step").stop()
+        prof.close()
+        self.state = state
+
+        n_chips = self.mesh_ctx.world_size
+        mean_s = timers("step").mean()
+        tps = tokens_per_step / mean_s
+        seq_len = stacked["input_ids"].shape[-1]
+        fpt = flops_per_token_for_config(self.model.config, seq_len)
+        peak = device_peak_tflops()
+        tflops_chip = tps / n_chips * fpt / 1e12
+        result = {
+            "tokens_per_second": tps,
+            "tokens_per_second_per_chip": tps / n_chips,
+            "tflops_per_second_per_chip": tflops_chip,
+            "mfu": calculate_mfu(tps / n_chips, fpt, peak) if peak == peak else None,
+            "step_time_mean_s": mean_s,
+            "step_time_min_s": timers("step").min(),
+            "step_time_max_s": timers("step").max(),
+            "n_chips": n_chips,
+            "tokens_per_step": tokens_per_step,
+            "loss": float(jax.device_get(metrics["loss"])),
+            "timers": timers.summary(),
+        }
+        out_path = bcfg.get("output_json")
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2)
+        logger.info("benchmark: %s", json.dumps({k: v for k, v in result.items() if k != "timers"}))
+        print(json.dumps(result))
+        return result
+
+
+def main(cfg: ConfigNode) -> dict:
+    recipe = BenchmarkingRecipeForNextTokenPrediction(cfg)
+    recipe.setup()
+    return recipe.run_benchmark()

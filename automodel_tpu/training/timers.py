@@ -1,0 +1,69 @@
+"""Named timers for benchmarking.
+
+Parity: Megatron-style `Timers` (reference: components/training/timers.py:
+257-346 — barriered start/stop with min/max across ranks). Single-controller
+JAX needs no cross-rank reduction: one process observes the whole step. The
+device sync happens by blocking on a data transfer (`jax.device_get`), which
+is the only true barrier on tunneled/remote backends.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+
+
+class Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+        self.elapsed_history: list[float] = []
+
+    def start(self, barrier_on: Any = None) -> None:
+        if barrier_on is not None:
+            jax.block_until_ready(barrier_on)
+        self._start = time.perf_counter()
+
+    def stop(self, barrier_on: Any = None) -> float:
+        if barrier_on is not None:
+            jax.block_until_ready(barrier_on)
+        assert self._start is not None, f"timer {self.name} not started"
+        dt = time.perf_counter() - self._start
+        self.elapsed_history.append(dt)
+        self._start = None
+        return dt
+
+    def mean(self, skip_first: int = 0) -> float:
+        h = self.elapsed_history[skip_first:]
+        return sum(h) / max(len(h), 1)
+
+    def min(self, skip_first: int = 0) -> float:
+        h = self.elapsed_history[skip_first:]
+        return min(h) if h else 0.0
+
+    def max(self, skip_first: int = 0) -> float:
+        h = self.elapsed_history[skip_first:]
+        return max(h) if h else 0.0
+
+
+class Timers:
+    def __init__(self):
+        self._timers: dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self._timers:
+            self._timers[name] = Timer(name)
+        return self._timers[name]
+
+    def summary(self, skip_first: int = 0) -> dict[str, dict[str, float]]:
+        return {
+            n: {
+                "mean_s": t.mean(skip_first),
+                "min_s": t.min(skip_first),
+                "max_s": t.max(skip_first),
+                "count": len(t.elapsed_history),
+            }
+            for n, t in self._timers.items()
+        }

@@ -1,0 +1,57 @@
+"""Profiling hooks.
+
+Parity: the reference's NVTX/nsys tracing (autonvtx/__init__.py:33-60
+recursive fwd/bwd range hooks; nsys windows by step, _cli/app.py:160-172,
+benchmark.py:66-70). TPU-native: `jax.profiler` traces (viewable in
+XProf/TensorBoard, incl. per-op HLO timing — strictly more detail than NVTX
+ranges) opened/closed on a configured step window, plus `jax.named_scope`
+for model-code annotations (scan-stacked layers appear as one scanned region
+by construction, so no recursive patcher is needed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ProfilerConfig:
+    enabled: bool = False
+    trace_dir: str = "/tmp/automodel_tpu_trace"
+    start_step: int = 3
+    end_step: int = 5
+
+
+class StepProfiler:
+    """Opens a jax.profiler trace for steps in [start_step, end_step)."""
+
+    def __init__(self, config: ProfilerConfig):
+        self.config = config
+        self._active = False
+
+    def on_step(self, step: int) -> None:
+        c = self.config
+        if not c.enabled:
+            return
+        if not self._active and step == c.start_step:
+            jax.profiler.start_trace(c.trace_dir)
+            self._active = True
+            logger.info("profiler: trace started at step %d → %s", step, c.trace_dir)
+        elif self._active and step >= c.end_step:
+            jax.profiler.stop_trace()
+            self._active = False
+            logger.info("profiler: trace stopped at step %d", step)
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+annotate = jax.named_scope  # model-code annotation (NVTX range equivalent)

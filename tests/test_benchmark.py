@@ -1,0 +1,76 @@
+"""Benchmark recipe + timers + FLOPs utils."""
+
+import json
+
+import numpy as np
+
+from automodel_tpu.training.timers import Timers
+from automodel_tpu.utils.flops_utils import (
+    calculate_mfu,
+    dense_transformer_flops_per_token,
+)
+
+
+def test_timers():
+    t = Timers()
+    t("a").start()
+    dt = t("a").stop()
+    assert dt >= 0 and t.summary()["a"]["count"] == 1
+
+
+def test_dense_flops_sane():
+    # ~6N per token rule of thumb for short seq: llama-8b-ish config
+    fpt = dense_transformer_flops_per_token(
+        hidden_size=4096, num_layers=32, intermediate_size=14336,
+        vocab_size=128256, seq_len=1, num_heads=32, num_kv_heads=8, head_dim=128,
+    )
+    n_params = 8.0e9
+    assert 0.8 * 6 * n_params < fpt < 1.3 * 6 * n_params
+    assert 0 < calculate_mfu(10_000, fpt, peak_tflops=459.0) < 1.5
+
+
+def test_benchmark_recipe_cli(tmp_path):
+    from automodel_tpu.cli.app import main as cli_main
+
+    recipe = {
+        "seed": 1,
+        "model": {
+            "hf_config": {
+                "architectures": ["LlamaForCausalLM"],
+                "model_type": "llama",
+                "vocab_size": 128,
+                "hidden_size": 64,
+                "intermediate_size": 128,
+                "num_hidden_layers": 2,
+                "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+                "head_dim": 16,
+            },
+            "backend": {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32"},
+        },
+        "distributed": {"dp_shard": 1},
+        "dataset": {
+            "_target_": "automodel_tpu.data.sft.MockSFTDataset",
+            "num_samples": 64,
+            "seq_length": 16,
+            "vocab_size": 128,
+        },
+        "dataloader": {"global_batch_size": 8},
+        "step_scheduler": {"max_steps": 100},
+        "optimizer": {"name": "adamw", "lr": 1e-3},
+        "benchmark": {
+            "warmup_steps": 1,
+            "measure_steps": 2,
+            "output_json": str(tmp_path / "bench.json"),
+        },
+    }
+    import yaml
+
+    cfg_path = tmp_path / "bench.yaml"
+    cfg_path.write_text(yaml.safe_dump(recipe))
+    rc = cli_main(["benchmark", "llm", "-c", str(cfg_path)])
+    assert rc == 0
+    result = json.loads((tmp_path / "bench.json").read_text())
+    assert result["tokens_per_second"] > 0
+    assert np.isfinite(result["loss"])
+    assert result["timers"]["step"]["count"] == 2
