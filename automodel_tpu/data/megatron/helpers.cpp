@@ -1,0 +1,85 @@
+// Native index builders for the pretraining data pipeline.
+//
+// Parity: the reference's pybind11 helpers
+// (components/datasets/llm/megatron/helpers.cpp — build_sample_idx:143,
+// build_blending_indices:75): O(total_docs·epochs) index-construction loops
+// that are orders of magnitude too slow in Python for billion-document
+// corpora. Rebuilt here as plain extern-C functions bound via ctypes
+// (pybind11 is not in this image); same algorithms, new code.
+//
+// Build: g++ -O3 -shared -fPIC helpers.cpp -o helpers.so   (done lazily by
+// helpers.py at first import, mirroring the reference's runtime Makefile).
+
+#include <cstdint>
+#include <cmath>
+
+extern "C" {
+
+// Map each training sample of (seq_length+1) tokens onto (document index,
+// token offset) pairs. sizes: per-document token counts; doc_idx: epoch-
+// repeated shuffled document ids. Output sample_idx: [(num_samples+1) x 2]
+// int64 (doc_idx position, offset into that document).
+// Returns the number of samples written (excluding the leading sentinel),
+// or -1 if doc_idx was exhausted early.
+int64_t build_sample_idx(const int32_t* sizes,
+                         const int64_t* doc_idx,
+                         int64_t doc_idx_len,
+                         int64_t* sample_idx /* [(max_samples+1)*2] */,
+                         int64_t max_samples,
+                         int32_t seq_length) {
+  int64_t doc_pos = 0;      // position in doc_idx
+  int32_t doc_offset = 0;   // token offset within current document
+  sample_idx[0] = 0;
+  sample_idx[1] = 0;
+  int64_t n = 0;
+  while (n < max_samples) {
+    int32_t remaining = seq_length + 1;  // +1: labels are inputs shifted
+    while (remaining > 0) {
+      if (doc_pos >= doc_idx_len) return -1;
+      int32_t doc_len = sizes[doc_idx[doc_pos]] - doc_offset;
+      if (doc_len > remaining) {
+        // sample ends inside this document; next sample starts at the
+        // overlapping last token (Megatron convention)
+        doc_offset += remaining - 1;
+        remaining = 0;
+      } else {
+        remaining -= doc_len;
+        ++doc_pos;
+        doc_offset = 0;
+      }
+    }
+    ++n;
+    sample_idx[2 * n] = doc_pos;
+    sample_idx[2 * n + 1] = doc_offset;
+  }
+  return n;
+}
+
+// Interleave samples from weighted datasets so that after k draws each
+// dataset i has received ~weights[i]*k of them (error-greedy assignment,
+// the reference's build_blending_indices algorithm).
+void build_blending_indices(int16_t* dataset_index,   // [size]
+                            int64_t* dataset_sample_index,  // [size]
+                            const double* weights,
+                            int32_t num_datasets,
+                            int64_t size) {
+  int64_t* current = new int64_t[num_datasets]();
+  for (int64_t i = 0; i < size; ++i) {
+    // pick the dataset with the largest deficit weight*(i+1) - drawn
+    double max_err = -1e300;
+    int32_t pick = 0;
+    for (int32_t d = 0; d < num_datasets; ++d) {
+      double err = weights[d] * (double)(i + 1) - (double)current[d];
+      if (err > max_err) {
+        max_err = err;
+        pick = d;
+      }
+    }
+    dataset_index[i] = (int16_t)pick;
+    dataset_sample_index[i] = current[pick];
+    ++current[pick];
+  }
+  delete[] current;
+}
+
+}  // extern "C"

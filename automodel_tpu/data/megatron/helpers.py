@@ -1,0 +1,135 @@
+"""ctypes bindings for the native index builders, with numpy fallbacks.
+
+Parity: the reference compiles its pybind11 helpers at runtime via Makefile
+with a pure-Python fallback (components/datasets/llm/megatron/helpers.py:20,
+Makefile). Same pattern: g++ -O3 -shared -fPIC at first use, cached next to
+the source; `numpy` fallbacks keep everything working without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HERE = Path(__file__).parent
+_SO = _HERE / "helpers.so"
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        src = _HERE / "helpers.cpp"
+        if not _SO.exists() or _SO.stat().st_mtime < src.stat().st_mtime:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", str(src), "-o", str(_SO)],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(str(_SO))
+        lib.build_sample_idx.restype = ctypes.c_int64
+        lib.build_sample_idx.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        lib.build_blending_indices.restype = None
+        lib.build_blending_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_int16),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32,
+            ctypes.c_int64,
+        ]
+        _lib = lib
+    except Exception as e:  # toolchain missing → numpy fallback
+        logger.warning("native helpers unavailable (%s); using Python fallback", e)
+    return _lib
+
+
+def build_sample_idx(
+    sizes: np.ndarray, doc_idx: np.ndarray, seq_length: int, max_samples: int
+) -> np.ndarray:
+    """[(num_samples+1), 2] int64 (doc_idx position, in-document offset)."""
+    sizes = np.ascontiguousarray(sizes, np.int32)
+    doc_idx = np.ascontiguousarray(doc_idx, np.int64)
+    out = np.zeros((max_samples + 1, 2), np.int64)
+    lib = _load()
+    if lib is not None:
+        n = lib.build_sample_idx(
+            sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            doc_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(doc_idx),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            max_samples,
+            seq_length,
+        )
+        if n < 0:
+            raise ValueError(
+                f"doc_idx exhausted: {max_samples} samples of {seq_length + 1} "
+                f"tokens need more than {int(sizes[doc_idx].sum())} tokens"
+            )
+        return out[: n + 1]
+    return _build_sample_idx_py(sizes, doc_idx, seq_length, max_samples)
+
+
+def _build_sample_idx_py(sizes, doc_idx, seq_length, max_samples):
+    out = [(0, 0)]
+    doc_pos, doc_offset = 0, 0
+    for _ in range(max_samples):
+        remaining = seq_length + 1
+        while remaining > 0:
+            if doc_pos >= len(doc_idx):
+                raise ValueError("doc_idx exhausted")
+            doc_len = int(sizes[doc_idx[doc_pos]]) - doc_offset
+            if doc_len > remaining:
+                doc_offset += remaining - 1
+                remaining = 0
+            else:
+                remaining -= doc_len
+                doc_pos += 1
+                doc_offset = 0
+        out.append((doc_pos, doc_offset))
+    return np.asarray(out, np.int64)
+
+
+def build_blending_indices(
+    weights: np.ndarray, size: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """(dataset_index int16 [size], dataset_sample_index int64 [size])."""
+    w = np.ascontiguousarray(weights, np.float64)
+    w = w / w.sum()
+    d_idx = np.zeros(size, np.int16)
+    s_idx = np.zeros(size, np.int64)
+    lib = _load()
+    if lib is not None:
+        lib.build_blending_indices(
+            d_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+            s_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            w.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(w),
+            size,
+        )
+        return d_idx, s_idx
+    current = np.zeros(len(w), np.int64)
+    for i in range(size):
+        err = w * (i + 1) - current
+        pick = int(err.argmax())
+        d_idx[i] = pick
+        s_idx[i] = current[pick]
+        current[pick] += 1
+    return d_idx, s_idx

@@ -1,0 +1,47 @@
+"""nanoGPT-style streaming dataset: flat memory-mapped token shards.
+
+Parity: reference nanogpt_dataset.py (components/datasets/llm/
+nanogpt_dataset.py, 454 LoC) — .bin files of uint16 tokens, samples are
+random/strided windows. Pairs with tools/nanogpt_data_processor.py.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Sequence
+
+import numpy as np
+
+
+class NanogptDataset:
+    def __init__(
+        self,
+        paths: Sequence[str] | str,
+        seq_length: int,
+        dtype=np.uint16,
+        stride: int | None = None,
+    ):
+        if isinstance(paths, (str, Path)):
+            p = Path(paths)
+            paths = sorted(p.glob("*.bin")) if p.is_dir() else [p]
+        self.shards = [np.memmap(f, dtype=dtype, mode="r") for f in paths]
+        if not self.shards:
+            raise FileNotFoundError(f"no .bin shards in {paths}")
+        self.seq_length = seq_length
+        self.stride = stride or seq_length
+        self._counts = [
+            max((len(s) - seq_length - 1) // self.stride + 1, 0) for s in self.shards
+        ]
+        self._cum = np.cumsum([0] + self._counts)
+
+    def __len__(self) -> int:
+        return int(self._cum[-1])
+
+    def __getitem__(self, idx: int) -> dict:
+        shard_i = int(np.searchsorted(self._cum, idx, side="right") - 1)
+        local = idx - self._cum[shard_i]
+        start = int(local * self.stride)
+        window = np.asarray(
+            self.shards[shard_i][start : start + self.seq_length + 1], np.int32
+        )
+        return {"input_ids": window[:-1], "labels": window[1:]}
