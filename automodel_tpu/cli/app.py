@@ -38,6 +38,23 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     cfg = parse_args_and_load_config(argv[2:])
 
+    # a `slurm:` section outside a Slurm allocation submits instead of running
+    # (reference: _cli/app.py:125-199 Slurm path)
+    import os
+
+    if cfg.get("slurm") is not None and "SLURM_JOB_ID" not in os.environ:
+        from automodel_tpu.launcher.slurm import SlurmConfig, submit
+
+        scfg = dict(cfg.get("slurm") or {})
+        scfg.pop("_target_", None)
+        cfg_path = next(
+            (argv[2:][i + 1] for i, a in enumerate(argv[2:]) if a in ("-c", "--config")),
+            None,
+        )
+        script = submit(SlurmConfig(**scfg), command, domain, cfg_path)
+        print(f"submitted {script}")
+        return 0
+
     from automodel_tpu.parallel.mesh import initialize_distributed
 
     initialize_distributed()

@@ -1,0 +1,109 @@
+"""Slurm submission for multi-host TPU jobs.
+
+Parity: reference launcher (components/launcher/slurm/ — SlurmConfig
+config.py:43, sbatch template template.py:91, submit utils.py:65). On TPU
+pods each host runs the SAME single-controller program; `srun` starts one
+task per host and JAX discovers peers through `jax.distributed.initialize`
+(coordinator = task 0), replacing the reference's torchrun rendezvous.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+from pathlib import Path
+from typing import Optional, Sequence
+
+SBATCH_TEMPLATE = """#!/bin/bash
+#SBATCH --job-name={job_name}
+#SBATCH --nodes={nodes}
+#SBATCH --ntasks-per-node=1
+#SBATCH --time={time_limit}
+{extra_directives}
+
+export JAX_COORDINATOR_ADDRESS=$(scontrol show hostnames $SLURM_JOB_NODELIST | head -n1):{coordinator_port}
+export JAX_NUM_PROCESSES=$SLURM_NTASKS
+{env_exports}
+
+srun --kill-on-bad-exit=1 bash -c '
+export JAX_PROCESS_ID=$SLURM_PROCID
+{container_prefix}python -m automodel_tpu.cli.app {command} {domain} -c {config_path} {overrides}
+'
+"""
+
+
+@dataclasses.dataclass
+class VolumeMapping:
+    source: str
+    dest: str
+
+    def __str__(self) -> str:
+        return f"{self.source}:{self.dest}"
+
+
+@dataclasses.dataclass
+class SlurmConfig:
+    job_name: str = "automodel-tpu"
+    nodes: int = 1
+    time_limit: str = "04:00:00"
+    account: Optional[str] = None
+    partition: Optional[str] = None
+    container_image: Optional[str] = None
+    container_mounts: Sequence[VolumeMapping] = ()
+    coordinator_port: int = 8476
+    env: dict = dataclasses.field(default_factory=dict)
+    extra_directives: Sequence[str] = ()
+    job_dir: str = "slurm_jobs"
+
+
+def render_sbatch(
+    cfg: SlurmConfig, command: str, domain: str, config_path: str, overrides: Sequence[str] = ()
+) -> str:
+    directives = list(cfg.extra_directives)
+    if cfg.account:
+        directives.append(f"#SBATCH --account={cfg.account}")
+    if cfg.partition:
+        directives.append(f"#SBATCH --partition={cfg.partition}")
+    container_prefix = ""
+    if cfg.container_image:
+        mounts = ",".join(str(m) for m in cfg.container_mounts)
+        mount_arg = f" --container-mounts={mounts}" if mounts else ""
+        container_prefix = (
+            f"srun --container-image={cfg.container_image}{mount_arg} "
+        )
+    env_exports = "\n".join(f"export {k}={v}" for k, v in cfg.env.items())
+    return SBATCH_TEMPLATE.format(
+        job_name=cfg.job_name,
+        nodes=cfg.nodes,
+        time_limit=cfg.time_limit,
+        extra_directives="\n".join(directives),
+        coordinator_port=cfg.coordinator_port,
+        env_exports=env_exports,
+        container_prefix=container_prefix,
+        command=command,
+        domain=domain,
+        config_path=config_path,
+        overrides=" ".join(overrides),
+    )
+
+
+def submit(
+    cfg: SlurmConfig,
+    command: str,
+    domain: str,
+    config_path: str,
+    overrides: Sequence[str] = (),
+    dry_run: bool = False,
+) -> str:
+    """Write the sbatch script and submit it; returns the script path (and
+    prints the job id on submission)."""
+    job_dir = Path(cfg.job_dir)
+    job_dir.mkdir(parents=True, exist_ok=True)
+    script = job_dir / f"{cfg.job_name}.sbatch"
+    script.write_text(render_sbatch(cfg, command, domain, config_path, overrides))
+    if not dry_run:
+        out = subprocess.run(
+            ["sbatch", str(script)], check=True, capture_output=True, text=True
+        )
+        print(out.stdout.strip())
+    return str(script)

@@ -54,6 +54,20 @@ def build_optimizer(
     if name == "adafactor":
         parts.append(optax.adafactor(learning_rate=schedule, weight_decay_rate=weight_decay or None))
         return optax.chain(*parts)
+    if name == "muon":
+        # Muon for >=2-D weights with adam fallback inside optax.contrib.muon
+        # (parity: the reference's Dion/Muon integration, optim/utils.py:151)
+        from optax import contrib as _contrib
+
+        parts.append(
+            _contrib.muon(
+                learning_rate=schedule,
+                adam_b1=betas[0],
+                adam_b2=betas[1],
+                weight_decay=weight_decay,
+            )
+        )
+        return optax.chain(*parts)
     if name not in _SCALERS:
         raise ValueError(f"Unknown optimizer {name!r}; available: {sorted(_SCALERS)}")
     parts.append(_SCALERS[name](tuple(betas), eps))

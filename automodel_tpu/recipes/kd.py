@@ -1,0 +1,103 @@
+"""Knowledge-distillation recipe.
+
+Parity: reference KD recipe (recipes/llm/kd.py:481) — a teacher model is
+built alongside the student and the loss blends forward-KL distillation with
+the CE objective: `loss = ratio·KD + (1-ratio)·CE` (kd_loss, loss/kd_loss.py:
+21). TPU-native: teacher params are a frozen closure constant of the jitted
+step (no grads, no optimizer state), mirroring the LoRA pattern.
+
+YAML additions over train_ft:
+  teacher_model: {pretrained_model_name_or_path | hf_config, backend}
+  kd: {ratio: 0.5, temperature: 1.0}
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu import auto_model
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.ops import losses as L
+from automodel_tpu.recipes.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+from automodel_tpu.training.train_step import build_eval_step, build_train_step
+
+logger = logging.getLogger(__name__)
+
+
+def make_kd_loss(student, teacher, teacher_params, constrain, ratio, temperature):
+    frozen = jax.lax.stop_gradient(teacher_params)
+
+    def loss_fn(params, mb):
+        kw = {
+            k: mb[k]
+            for k in ("position_ids", "segment_ids")
+            if k in mb and mb[k] is not None
+        }
+        s_out = student(params, mb["input_ids"], constrain=constrain, **kw)
+        s_logits, maux = s_out if isinstance(s_out, tuple) else (s_out, None)
+        t_out = teacher(frozen, mb["input_ids"], **kw)
+        t_logits = t_out[0] if isinstance(t_out, tuple) else t_out
+        ce_sum, n = L.masked_cross_entropy(s_logits, mb["labels"])
+        kd_sum, _ = L.kd_loss(
+            s_logits, jax.lax.stop_gradient(t_logits), mb["labels"], temperature
+        )
+        loss_sum = (1.0 - ratio) * ce_sum + ratio * kd_sum
+        if maux is not None:
+            loss_sum = loss_sum + maux.aux_loss * n.astype(jnp.float32)
+            return loss_sum, n, {
+                "moe_aux_loss": maux.aux_loss,
+                "expert_counts": maux.expert_counts,
+            }
+        return loss_sum, n
+
+    return loss_fn
+
+
+class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
+    def setup(self) -> None:
+        super().setup()
+        cfg = self.cfg
+        tcfg = cfg.get("teacher_model")
+        if tcfg is None:
+            raise ValueError("KD recipe requires a `teacher_model:` section")
+        tbackend = dict(tcfg.get("backend", {}) or {})
+        if tcfg.get("pretrained_model_name_or_path"):
+            self.teacher = auto_model.from_pretrained(
+                tcfg.pretrained_model_name_or_path, self.mesh_ctx, tbackend
+            )
+        else:
+            hf = tcfg.get("hf_config")
+            self.teacher = auto_model.from_config(
+                hf.to_dict() if isinstance(hf, ConfigNode) else hf,
+                self.mesh_ctx,
+                tbackend,
+                seed=cfg.get("seed", 42) + 100,
+            )
+        kd = dict(cfg.get("kd", {}) or {})
+        ratio = float(kd.get("ratio", 0.5))
+        temperature = float(kd.get("temperature", 1.0))
+        if self.peft_config is not None:
+            raise NotImplementedError("KD+LoRA composition not wired yet")
+        self.loss_fn = make_kd_loss(
+            self.model,
+            self.teacher.model,
+            self.teacher.params,
+            self.auto.constrain,
+            ratio,
+            temperature,
+        )
+        post_step = getattr(self.model, "post_step_fn", None)
+        self.train_step = build_train_step(
+            self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step
+        )
+        self.eval_step = build_eval_step(self.loss_fn)
+        logger.info("KD: ratio=%.2f temperature=%.2f", ratio, temperature)
+
+
+def main(cfg: ConfigNode) -> dict:
+    recipe = KDRecipeForNextTokenPrediction(cfg)
+    recipe.setup()
+    return recipe.run_train_validation_loop()

@@ -1,0 +1,115 @@
+"""KD recipe, Slurm launcher rendering, muon optimizer."""
+
+import numpy as np
+import pytest
+
+
+TINY = {
+    "architectures": ["LlamaForCausalLM"],
+    "model_type": "llama",
+    "vocab_size": 128,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+}
+FP32 = {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32"}
+
+
+def test_kd_recipe_learns(tmp_path):
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.kd import KDRecipeForNextTokenPrediction
+
+    teacher_cfg = dict(TINY, num_hidden_layers=3)
+    cfg = ConfigNode(
+        {
+            "seed": 0,
+            "model": {"hf_config": TINY, "backend": FP32},
+            "teacher_model": {"hf_config": teacher_cfg, "backend": FP32},
+            "kd": {"ratio": 0.5, "temperature": 2.0},
+            "distributed": {"dp_shard": 1},
+            "dataset": {
+                "_target_": "automodel_tpu.data.sft.MockSFTDataset",
+                "num_samples": 32,
+                "seq_length": 16,
+                "vocab_size": 128,
+            },
+            "dataloader": {"global_batch_size": 8},
+            "step_scheduler": {"max_steps": 4},
+            "optimizer": {"name": "adamw", "lr": 2e-3},
+            "logging": {"metrics_path": str(tmp_path / "m.jsonl")},
+        }
+    )
+    r = KDRecipeForNextTokenPrediction(cfg)
+    r.setup()
+    last = r.run_train_validation_loop()
+    assert np.isfinite(last["loss"])
+
+
+def test_kd_requires_teacher():
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.kd import KDRecipeForNextTokenPrediction
+
+    cfg = ConfigNode(
+        {
+            "model": {"hf_config": TINY, "backend": FP32},
+            "dataset": {
+                "_target_": "automodel_tpu.data.sft.MockSFTDataset",
+                "num_samples": 8,
+                "seq_length": 8,
+                "vocab_size": 128,
+            },
+            "dataloader": {"global_batch_size": 4},
+        }
+    )
+    r = KDRecipeForNextTokenPrediction(cfg)
+    with pytest.raises(ValueError, match="teacher_model"):
+        r.setup()
+
+
+def test_slurm_render(tmp_path):
+    from automodel_tpu.launcher.slurm import SlurmConfig, VolumeMapping, submit
+
+    cfg = SlurmConfig(
+        job_name="t",
+        nodes=4,
+        account="acct",
+        container_image="img:latest",
+        container_mounts=[VolumeMapping("/data", "/data")],
+        env={"FOO": "1"},
+        job_dir=str(tmp_path),
+    )
+    script = submit(cfg, "finetune", "llm", "cfg.yaml", dry_run=True)
+    text = open(script).read()
+    assert "#SBATCH --nodes=4" in text
+    assert "--account=acct" in text
+    assert "JAX_COORDINATOR_ADDRESS" in text
+    assert "--container-image=img:latest" in text
+    assert "export FOO=1" in text
+    assert "finetune llm -c cfg.yaml" in text
+
+
+def test_muon_optimizer_runs():
+    import jax
+
+    from automodel_tpu import auto_model
+    from automodel_tpu.optim.builders import build_optimizer
+    from automodel_tpu.training.train_state import TrainState
+    from automodel_tpu.training.train_step import build_train_step, make_causal_lm_loss
+
+    auto = auto_model.from_config(TINY, None, FP32, seed=0)
+    opt = build_optimizer(name="muon", lr=1e-3)
+    state = TrainState.create(auto.params, jax.jit(opt.init)(auto.params))
+    step = build_train_step(make_causal_lm_loss(auto.model), opt)
+    ids = np.random.default_rng(0).integers(0, 128, size=(1, 4, 16)).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    import jax.numpy as jnp
+
+    batch = {k: jnp.asarray(v) for k, v in batch.items()}
+    losses = []
+    for _ in range(3):
+        state, m = step(state, batch)
+        losses.append(float(jax.device_get(m["loss"])))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
