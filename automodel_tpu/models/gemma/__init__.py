@@ -1,0 +1,4 @@
+from automodel_tpu.models.gemma.model import GemmaConfig, GemmaForCausalLM
+from automodel_tpu.models.gemma.state_dict_adapter import GemmaStateDictAdapter
+
+__all__ = ["GemmaConfig", "GemmaForCausalLM", "GemmaStateDictAdapter"]

@@ -1,0 +1,63 @@
+"""HF ⇄ native adapter for the Gemma family (reuses the llama LeafPlan
+machinery; extra sandwich-norm keys, tied embeddings by default)."""
+
+from __future__ import annotations
+
+from automodel_tpu.models.gemma.model import GemmaConfig
+from automodel_tpu.models.llama.state_dict_adapter import (
+    LeafPlan,
+    LlamaStateDictAdapter,
+    _id,
+    _t,
+)
+
+
+class GemmaStateDictAdapter(LlamaStateDictAdapter):
+    def __init__(self, config: GemmaConfig):
+        super().__init__(config)
+
+    def leaf_plans(self) -> list[LeafPlan]:
+        c = self.config
+        plans: list[LeafPlan] = [
+            LeafPlan(("embed", "embedding"), "model.embed_tokens.weight", _id, _id),
+            LeafPlan(("final_norm", "scale"), "model.norm.weight", _id, _id),
+        ]
+        if not c.tie_embeddings:
+            plans.append(LeafPlan(("lm_head", "kernel"), "lm_head.weight", _t, _t))
+        hf_mod = {
+            "q_proj": "self_attn.q_proj", "k_proj": "self_attn.k_proj",
+            "v_proj": "self_attn.v_proj", "o_proj": "self_attn.o_proj",
+            "gate_proj": "mlp.gate_proj", "up_proj": "mlp.up_proj",
+            "down_proj": "mlp.down_proj",
+        }
+        for grp, name in [
+            ("attn", "q_proj"), ("attn", "k_proj"), ("attn", "v_proj"),
+            ("attn", "o_proj"), ("mlp", "gate_proj"), ("mlp", "up_proj"),
+            ("mlp", "down_proj"),
+        ]:
+            plans.append(
+                LeafPlan(
+                    ("layers", grp, name, "kernel"),
+                    f"model.layers.{{i}}.{hf_mod[name]}.weight",
+                    _t, _t, stacked=True,
+                )
+            )
+        for native, hf in [
+            ("input_norm", "input_layernorm"),
+            ("post_attn_norm", "post_attention_layernorm"),
+            ("pre_ffn_norm", "pre_feedforward_layernorm"),
+            ("post_ffn_norm", "post_feedforward_layernorm"),
+        ]:
+            plans.append(
+                LeafPlan(
+                    ("layers", native, "scale"),
+                    f"model.layers.{{i}}.{hf}.weight",
+                    _id, _id, stacked=True,
+                )
+            )
+        if c.qk_norm:
+            plans.append(LeafPlan(("layers", "attn", "q_norm", "scale"),
+                                  "model.layers.{i}.self_attn.q_norm.weight", _id, _id, stacked=True))
+            plans.append(LeafPlan(("layers", "attn", "k_norm", "scale"),
+                                  "model.layers.{i}.self_attn.k_norm.weight", _id, _id, stacked=True))
+        return plans

@@ -56,6 +56,20 @@ def _llama_builder(hf_config: Any, backend: BackendConfig):
     return LlamaForCausalLM(cfg, backend), LlamaStateDictAdapter(cfg)
 
 
+@register_architecture(
+    "Gemma2ForCausalLM", "Gemma3ForCausalLM", "Gemma3ForConditionalGeneration"
+)
+def _gemma_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.gemma import (
+        GemmaConfig,
+        GemmaForCausalLM,
+        GemmaStateDictAdapter,
+    )
+
+    cfg = GemmaConfig.from_hf(hf_config)
+    return GemmaForCausalLM(cfg, backend), GemmaStateDictAdapter(cfg)
+
+
 @register_architecture("DeepseekV3ForCausalLM")
 def _deepseek_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.deepseek_v3 import (
