@@ -87,6 +87,28 @@ def packed_collater(
     }
 
 
+def seq_cls_collater(
+    examples: Iterable[dict[str, Any]],
+    pad_token_id: int = 0,
+) -> dict[str, np.ndarray]:
+    """Collate {input_ids, label} classification examples (reference:
+    datasets/llm/seq_cls.py)."""
+    examples = list(examples)
+    seq = max(len(e["input_ids"]) for e in examples)
+    input_ids = np.stack([_pad_to(e["input_ids"], seq, pad_token_id) for e in examples])
+    mask = np.stack(
+        [
+            _pad_to([1] * len(e["input_ids"]), seq, 0)
+            for e in examples
+        ]
+    ).astype(np.int32)
+    return {
+        "input_ids": input_ids,
+        "attention_mask": mask,
+        "label": np.asarray([int(e["label"]) for e in examples], np.int32),
+    }
+
+
 def stack_microbatches(batches: Sequence[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
     """[A] list of collated batches → leaves with leading accumulation axis."""
     keys = [k for k in batches[0] if isinstance(batches[0][k], np.ndarray)]

@@ -19,7 +19,9 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _HERE = Path(__file__).parent
-_SO = _HERE / "helpers.so"
+# NOTE: not "helpers.so" — an extension-named .so next to helpers.py would
+# shadow this module in import resolution
+_SO = _HERE / "libmegatron_helpers.so"
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

@@ -162,3 +162,27 @@ class MockSFTDataset:
     def __iter__(self) -> Iterator[dict]:
         for i in range(len(self)):
             yield self[i]
+
+
+class MockSeqClsDataset:
+    """Deterministic classification dataset: label = token-sum parity
+    (reference: datasets/llm/seq_cls.py mock usage)."""
+
+    def __init__(self, vocab_size: int = 1000, seq_length: int = 64,
+                 num_samples: int = 512, num_labels: int = 2, seed: int = 0):
+        self.vocab_size = vocab_size
+        self.seq_length = seq_length
+        self.num_samples = num_samples
+        self.num_labels = num_labels
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        import numpy as np
+
+        rng = np.random.default_rng(self.seed * 100003 + idx)
+        n = int(rng.integers(self.seq_length // 2, self.seq_length + 1))
+        ids = rng.integers(1, self.vocab_size, size=n)
+        return {"input_ids": ids, "label": int(ids.sum() % self.num_labels)}

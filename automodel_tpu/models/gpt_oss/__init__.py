@@ -1,0 +1,4 @@
+from automodel_tpu.models.gpt_oss.model import GptOssConfig, GptOssForCausalLM
+from automodel_tpu.models.gpt_oss.state_dict_adapter import GptOssStateDictAdapter
+
+__all__ = ["GptOssConfig", "GptOssForCausalLM", "GptOssStateDictAdapter"]

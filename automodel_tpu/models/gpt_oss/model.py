@@ -1,0 +1,229 @@
+"""GPT-OSS family, TPU-native.
+
+Parity: reference models/gpt_oss (~600 LoC; MXFP4 ckpt handling in its
+state_dict_adapter). Architectural fingerprint (modeling_gpt_oss.py):
+
+- every layer is MoE with biased projections, gate/up interleaved on the
+  fused dim, and the clamped `(up+1)·swish(1.702·g)` activation
+  (MoEConfig: interleaved_gate_up/expert_mlp_bias/activation="swiglu_oai");
+- router = biased linear, top-k over raw logits, softmax over the picked
+  values (MoEConfig: router_linear_bias, softmax_before_topk=False);
+- attention sinks: a learned per-head virtual key absorbing probability
+  mass (ops.attention.sdpa `sinks`);
+- alternating sliding/full attention (layer_types), yarn rope, q/k/v/o
+  biases.
+
+Layers scan as one lax.scan with per-layer window bounds as scanned flags
+(same trick as the Gemma family); sinks are trainable params inside the
+scanned layer tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.models.llama.model import (
+    ACT_FNS,
+    Constrain,
+    _dense_init,
+    _noop_constrain,
+    _proj,
+)
+from automodel_tpu.models.qwen3_moe.model import MoEModelAux, _init_attn_layer
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layer import init_moe_params, moe_block
+from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import rope_table
+
+
+@dataclasses.dataclass(frozen=True)
+class GptOssConfig(TransformerConfig):
+    moe: Optional[MoEConfig] = None
+    layer_types: tuple = ()
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "GptOssConfig":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        base = TransformerConfig.from_hf(hf_cfg)
+        L = base.num_layers
+        lt = get("layer_types") or [
+            "sliding_attention" if i % 2 == 0 else "full_attention" for i in range(L)
+        ]
+        moe = MoEConfig(
+            num_experts=get("num_local_experts"),
+            num_experts_per_tok=get("num_experts_per_tok", 4),
+            moe_intermediate_size=get("intermediate_size"),
+            score_func="softmax",
+            softmax_before_topk=False,  # softmax over the picked logits
+            router_linear_bias=True,
+            interleaved_gate_up=True,
+            expert_mlp_bias=True,
+            activation="swiglu_oai",
+            aux_loss_coeff=get("router_aux_loss_coef", 0.0) or 0.0,
+        )
+        fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
+        fields.update(
+            moe=moe,
+            layer_types=tuple(lt),
+            attention_bias=bool(get("attention_bias", True)),
+            sliding_window=get("sliding_window", 128),
+        )
+        return cls(**fields)
+
+
+def init_params(cfg: GptOssConfig, backend: BackendConfig, key: jax.Array) -> dict:
+    pd = backend.param_jnp_dtype
+    D = cfg.hidden_size
+    L = cfg.num_layers
+    keys = jax.random.split(key, 4)
+    layers = _init_attn_layer(cfg, backend, keys[0], L)
+    layers["attn"]["o_proj"]["bias"] = jnp.zeros((L, D), pd)
+    layers["attn"]["sinks"] = jnp.zeros((L, cfg.num_heads), pd)
+    layers["moe"] = init_moe_params(keys[1], cfg.moe, D, pd, n_layers=L)
+    params = {
+        "embed": {
+            "embedding": jax.random.normal(keys[2], (cfg.vocab_size, D)).astype(pd)
+            * 0.02
+        },
+        "layers": layers,
+        "final_norm": {"scale": jnp.ones((D,), pd)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _dense_init(keys[3], (D, cfg.vocab_size), pd)}
+    return params
+
+
+def _layer(cfg, backend, h, lp, flags, cos, sin, segment_ids, constrain):
+    from automodel_tpu.ops.rope import apply_rope
+
+    B, S, D = h.shape
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
+    q = _proj(x, lp["attn"]["q_proj"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = _proj(x, lp["attn"]["k_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = _proj(x, lp["attn"]["v_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q, k = apply_rope(q, k, cos, sin)
+    attn_out = sdpa(
+        q,
+        k,
+        v,
+        causal=True,
+        segment_ids=segment_ids,
+        sliding_window=flags["window"],
+        sinks=lp["attn"]["sinks"],
+    )
+    h = h + _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"])
+    h = constrain(h, ("batch", "seq", None))
+    x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+    out, aux = moe_block(
+        x,
+        lp["moe"],
+        cfg.moe,
+        ACT_FNS[cfg.act],
+        experts_backend=backend.experts,
+        fake_gate=backend.fake_balanced_gate,
+        constrain=constrain,
+    )
+    h = h + out
+    return constrain(h, ("batch", "seq", None)), aux
+
+
+def forward_hidden(
+    cfg: GptOssConfig,
+    backend: BackendConfig,
+    params: dict,
+    input_ids: jnp.ndarray,
+    position_ids: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    constrain: Constrain = _noop_constrain,
+) -> tuple[jnp.ndarray, MoEModelAux]:
+    cd = backend.compute_jnp_dtype
+    B, S = input_ids.shape
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    h = params["embed"]["embedding"].astype(cd)[input_ids]
+    h = constrain(h, ("batch", "seq", None))
+    cos, sin = rope_table(position_ids, cfg.head_dim, cfg.rope)
+    sw = cfg.sliding_window or S
+    windows = jnp.asarray(
+        [sw if t == "sliding_attention" else S for t in cfg.layer_types], jnp.int32
+    )
+
+    def layer_fn(carry, xs):
+        lp, flags = xs
+        return _layer(cfg, backend, carry, lp, flags, cos, sin, segment_ids, constrain)
+
+    fn = layer_fn
+    if backend.remat == "full":
+        fn = jax.checkpoint(layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
+    elif backend.remat == "selective":
+        fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    flags = {"window": windows}
+    if backend.scan_layers:
+        h, auxs = jax.lax.scan(fn, h, (params["layers"], flags))
+        counts, aux_losses = auxs.expert_counts, auxs.aux_loss
+    else:
+        counts_l, aux_l = [], []
+        for i in range(cfg.num_layers):
+            lp = jax.tree.map(lambda x: x[i], params["layers"])
+            fl = jax.tree.map(lambda x: x[i], flags)
+            h, aux = fn(h, (lp, fl))
+            counts_l.append(aux.expert_counts)
+            aux_l.append(aux.aux_loss)
+        counts, aux_losses = jnp.stack(counts_l), jnp.stack(aux_l)
+    h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
+    return h, MoEModelAux(counts, aux_losses.sum())
+
+
+SHARDING_RULES = [
+    (r"attn/sinks$", (None, None)),
+    (r"attn/o_proj/bias$", (None, None)),
+    # llama-style attn + MoE rules (paths here are layers/attn, layers/moe)
+    (r"embed/embedding$", ("tensor", "fsdp")),
+    (r"layers/attn/[qkv]_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"layers/attn/[qkv]_proj/bias$", (None, "tensor")),
+    (r"layers/attn/o_proj/kernel$", (None, "tensor", "fsdp")),
+    (r"moe/router/weight$", (None, None, None)),
+    (r"moe/router/(bias|linear_bias)$", (None, None)),
+    (r"moe/experts/gate_up$", (None, "expert", "expert_fsdp", "tensor")),
+    (r"moe/experts/down$", (None, "expert", "tensor", "expert_fsdp")),
+    (r"moe/experts/gate_up_bias$", (None, "expert", "tensor")),
+    (r"moe/experts/down_bias$", (None, "expert", None)),
+    (r"layers/.*norm/scale$", (None, None)),
+    (r"final_norm/scale$", (None,)),
+    (r"lm_head/kernel$", ("fsdp", "tensor")),
+]
+
+
+@dataclasses.dataclass
+class GptOssForCausalLM:
+    config: GptOssConfig
+    backend: BackendConfig = BackendConfig()
+
+    def init(self, key: jax.Array) -> dict:
+        return init_params(self.config, self.backend, key)
+
+    def hidden(self, params, input_ids, **kw):
+        return forward_hidden(self.config, self.backend, params, input_ids, **kw)
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        if self.config.tie_embeddings:
+            return params["embed"]["embedding"].T
+        return params["lm_head"]["kernel"]
+
+    def __call__(self, params, input_ids, **kw):
+        h, aux = self.hidden(params, input_ids, **kw)
+        return h @ self.lm_head(params).astype(h.dtype), aux
+
+    @property
+    def sharding_rules(self):
+        return SHARDING_RULES

@@ -1,0 +1,83 @@
+"""HF ⇄ native adapter for GPT-OSS.
+
+Parity: reference models/gpt_oss/state_dict_adapter.py (incl. MXFP4
+handling — BF16-upcast checkpoints load directly; MXFP4-packed checkpoints
+should be dequantized offline first). The HF layout stores experts STACKED
+(`mlp.experts.gate_up_proj [E, D, 2I]` already [in, out]) so no per-expert
+merge is needed — only the router linear transposes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.gpt_oss.model import GptOssConfig
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+class GptOssStateDictAdapter:
+    def __init__(self, config: GptOssConfig):
+        self.config = config
+
+    def _plans(self) -> list[tuple[tuple[str, ...], str, bool]]:
+        """(native path under layers-stack, hf key template, transpose)."""
+        plans = [
+            (("attn", "q_proj", "kernel"), "model.layers.{i}.self_attn.q_proj.weight", True),
+            (("attn", "q_proj", "bias"), "model.layers.{i}.self_attn.q_proj.bias", False),
+            (("attn", "k_proj", "kernel"), "model.layers.{i}.self_attn.k_proj.weight", True),
+            (("attn", "k_proj", "bias"), "model.layers.{i}.self_attn.k_proj.bias", False),
+            (("attn", "v_proj", "kernel"), "model.layers.{i}.self_attn.v_proj.weight", True),
+            (("attn", "v_proj", "bias"), "model.layers.{i}.self_attn.v_proj.bias", False),
+            (("attn", "o_proj", "kernel"), "model.layers.{i}.self_attn.o_proj.weight", True),
+            (("attn", "o_proj", "bias"), "model.layers.{i}.self_attn.o_proj.bias", False),
+            (("attn", "sinks"), "model.layers.{i}.self_attn.sinks", False),
+            (("input_norm", "scale"), "model.layers.{i}.input_layernorm.weight", False),
+            (("post_attn_norm", "scale"), "model.layers.{i}.post_attention_layernorm.weight", False),
+            (("moe", "router", "weight"), "model.layers.{i}.mlp.router.weight", True),
+            (("moe", "router", "linear_bias"), "model.layers.{i}.mlp.router.bias", False),
+            (("moe", "experts", "gate_up"), "model.layers.{i}.mlp.experts.gate_up_proj", False),
+            (("moe", "experts", "gate_up_bias"), "model.layers.{i}.mlp.experts.gate_up_proj_bias", False),
+            (("moe", "experts", "down"), "model.layers.{i}.mlp.experts.down_proj", False),
+            (("moe", "experts", "down_bias"), "model.layers.{i}.mlp.experts.down_proj_bias", False),
+        ]
+        return plans
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        c = self.config
+        out: dict = {
+            "embed": {"embedding": get_tensor("model.embed_tokens.weight")},
+            "final_norm": {"scale": get_tensor("model.norm.weight")},
+        }
+        if not c.tie_embeddings:
+            out["lm_head"] = {"kernel": _t(get_tensor("lm_head.weight"))}
+        layers: dict = {}
+        for path, tmpl, tr in self._plans():
+            rows = []
+            for i in range(c.num_layers):
+                arr = get_tensor(tmpl.format(i=i))
+                rows.append(_t(arr) if tr else arr)
+            node = layers
+            for kk in path[:-1]:
+                node = node.setdefault(kk, {})
+            node[path[-1]] = np.stack(rows, 0)
+        out["layers"] = layers
+        return out
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        c = self.config
+        yield "model.embed_tokens.weight", np.asarray(params["embed"]["embedding"])
+        yield "model.norm.weight", np.asarray(params["final_norm"]["scale"])
+        if not c.tie_embeddings:
+            yield "lm_head.weight", _t(np.asarray(params["lm_head"]["kernel"]))
+        for path, tmpl, tr in self._plans():
+            node = params["layers"]
+            for kk in path:
+                node = node[kk]
+            for i in range(c.num_layers):
+                arr = np.asarray(node[i])
+                yield tmpl.format(i=i), (_t(arr) if tr else arr)

@@ -1,0 +1,81 @@
+"""Sequence classification head over the dense causal backbone.
+
+Parity: the reference's seq-cls path (recipes/llm/train_seq_cls.py:439 +
+qwen-cls TP plan, optimized_tp_plans.py:350) — HF
+`AutoModelForSequenceClassification` convention: the score head reads the
+LAST NON-PAD token's hidden state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.models.llama.model import (
+    LlamaForCausalLM,
+    SHARDING_RULES as BASE_RULES,
+)
+
+
+@dataclasses.dataclass
+class LlamaForSequenceClassification:
+    config: TransformerConfig
+    num_labels: int
+    backend: BackendConfig = BackendConfig()
+
+    def __post_init__(self):
+        self._lm = LlamaForCausalLM(self.config, self.backend)
+
+    def init(self, key: jax.Array) -> dict:
+        k1, k2 = jax.random.split(key)
+        params = self._lm.init(k1)
+        params.pop("lm_head", None)
+        params["score"] = {
+            "kernel": (
+                jax.random.normal(k2, (self.config.hidden_size, self.num_labels))
+                * 0.02
+            ).astype(self.backend.param_jnp_dtype)
+        }
+        return params
+
+    def __call__(
+        self,
+        params: dict,
+        input_ids: jnp.ndarray,
+        attention_mask: Optional[jnp.ndarray] = None,
+        **kw: Any,
+    ) -> jnp.ndarray:
+        """→ logits [B, num_labels] from the last non-pad position."""
+        h = self._lm.hidden(params, input_ids, **kw)  # [B, S, D]
+        if attention_mask is not None:
+            last = jnp.maximum(attention_mask.sum(axis=-1) - 1, 0)  # [B]
+        else:
+            last = jnp.full((input_ids.shape[0],), input_ids.shape[1] - 1)
+        pooled = jnp.take_along_axis(h, last[:, None, None].astype(jnp.int32), axis=1)[
+            :, 0
+        ]
+        return pooled @ params["score"]["kernel"].astype(pooled.dtype)
+
+    @property
+    def sharding_rules(self):
+        return [(r"score/kernel$", ("fsdp", None)), *BASE_RULES]
+
+
+def make_seq_cls_loss(model: LlamaForSequenceClassification, constrain=None):
+    """(params, mb) → (loss_sum, n) for {input_ids, attention_mask, label}."""
+
+    def loss_fn(params, mb):
+        logits = model(
+            params, mb["input_ids"], attention_mask=mb.get("attention_mask")
+        ).astype(jnp.float32)
+        labels = mb["label"].reshape(-1)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+        loss = (lse - picked).sum()
+        return loss, jnp.int32(labels.shape[0])
+
+    return loss_fn

@@ -243,9 +243,11 @@ def forward(
 # get explicit stacked rules (leading layer dim unsharded).
 SHARDING_RULES: list[tuple[str, tuple]] = [
     (r"moe/router/weight$", (None, None, None)),
-    (r"moe/router/bias$", (None, None)),
+    (r"moe/router/(bias|linear_bias)$", (None, None)),
     (r"moe/experts/gate_up$", (None, "expert", "expert_fsdp", "tensor")),
     (r"moe/experts/down$", (None, "expert", "tensor", "expert_fsdp")),
+    (r"moe/experts/gate_up_bias$", (None, "expert", "tensor")),
+    (r"moe/experts/down_bias$", (None, "expert", None)),
     (r"moe/shared/(gate|up)_proj/kernel$", (None, "fsdp", "tensor")),
     (r"moe/shared/down_proj/kernel$", (None, "tensor", "fsdp")),
     (r"moe/shared_gate/kernel$", (None, None, None)),

@@ -82,6 +82,18 @@ def _deepseek_builder(hf_config: Any, backend: BackendConfig):
     return DeepseekV3ForCausalLM(cfg, backend), DeepseekV3StateDictAdapter(cfg)
 
 
+@register_architecture("GptOssForCausalLM")
+def _gpt_oss_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.gpt_oss import (
+        GptOssConfig,
+        GptOssForCausalLM,
+        GptOssStateDictAdapter,
+    )
+
+    cfg = GptOssConfig.from_hf(hf_config)
+    return GptOssForCausalLM(cfg, backend), GptOssStateDictAdapter(cfg)
+
+
 @register_architecture("Qwen3MoeForCausalLM")
 def _moe_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.qwen3_moe import (

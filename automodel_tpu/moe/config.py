@@ -35,6 +35,13 @@ class MoEConfig:
     shared_expert_gate: bool = False
     # dispatch capacity factor for the gspmd (einsum) dispatcher
     capacity_factor: float = 1.25
+    # gpt-oss-style experts: gate/up interleaved on the fused dim, bias terms
+    # on both projections, clamped (up+1)*glu activation, and a learned
+    # linear bias on the router that feeds both selection and weights
+    interleaved_gate_up: bool = False
+    expert_mlp_bias: bool = False
+    activation: str = "swiglu"  # swiglu | swiglu_oai
+    router_linear_bias: bool = False
 
     def __post_init__(self):
         if self.score_func not in ("softmax", "sigmoid"):

@@ -34,21 +34,37 @@ from automodel_tpu.moe.gate import GateOutput
 Act = Callable[[jnp.ndarray], jnp.ndarray]
 
 
-def _ffn(h: jnp.ndarray, gate_up: jnp.ndarray, down: jnp.ndarray, act: Act) -> jnp.ndarray:
-    """h: [..., D] → [..., D] through fused-SwiGLU expert weights (no expert
-    dim — caller has already selected/mapped the expert axis)."""
-    gu = h @ gate_up.astype(h.dtype)
-    g, u = jnp.split(gu, 2, axis=-1)
-    return (act(g) * u) @ down.astype(h.dtype)
+def _split_gate_up(gu: jnp.ndarray, interleaved: bool) -> tuple[jnp.ndarray, jnp.ndarray]:
+    if interleaved:  # gpt-oss checkpoints interleave gate/up on the last dim
+        return gu[..., ::2], gu[..., 1::2]
+    return jnp.split(gu, 2, axis=-1)
+
+
+def _ffn(
+    h: jnp.ndarray,
+    w: dict,
+    act2: Act,
+    interleaved: bool = False,
+) -> jnp.ndarray:
+    """h: [..., D] → [..., D] through one expert's weights dict
+    {gate_up [D,2I], down [I,D], (gate_up_bias [2I], down_bias [D])}.
+    `act2(gate, up)` is the two-argument gated activation."""
+    gu = h @ w["gate_up"].astype(h.dtype)
+    if "gate_up_bias" in w:
+        gu = gu + w["gate_up_bias"].astype(h.dtype)
+    g, u = _split_gate_up(gu, interleaved)
+    out = act2(g, u) @ w["down"].astype(h.dtype)
+    if "down_bias" in w:
+        out = out + w["down_bias"].astype(h.dtype)
+    return out
 
 
 def dense_experts(
     x: jnp.ndarray,  # [T, D]
     gate_out: GateOutput,
-    gate_up: jnp.ndarray,  # [E, D, 2I]
-    down: jnp.ndarray,  # [E, I, D]
+    weights: dict,  # leaves with leading expert dim E
     cfg: MoEConfig,
-    act: Act,
+    act2: Act,
 ) -> jnp.ndarray:
     E = cfg.num_experts
     # combine weights [T, E]
@@ -56,19 +72,18 @@ def dense_experts(
     cw = cw.at[
         jnp.arange(x.shape[0])[:, None], gate_out.topk_idx
     ].add(gate_out.topk_weights)
-    ys = jax.vmap(lambda gu, dn: _ffn(x, gu, dn, act), in_axes=0, out_axes=0)(
-        gate_up, down
-    )  # [E, T, D]
+    ys = jax.vmap(
+        lambda w: _ffn(x, w, act2, cfg.interleaved_gate_up), in_axes=0, out_axes=0
+    )(weights)  # [E, T, D]
     return jnp.einsum("etd,te->td", ys, cw)
 
 
 def gspmd_experts(
     x: jnp.ndarray,  # [B, S, D] — batch groups kept for sharded dispatch
     gate_out: GateOutput,  # computed over T = B*S flattened tokens
-    gate_up: jnp.ndarray,
-    down: jnp.ndarray,
+    weights: dict,
     cfg: MoEConfig,
-    act: Act,
+    act2: Act,
     constrain: Callable = lambda a, spec: a,
 ) -> jnp.ndarray:
     """Capacity-based dispatch/combine (GSPMD MoE). Returns [B, S, D]."""
@@ -95,9 +110,9 @@ def gspmd_experts(
         x.dtype
     )
     expert_in = constrain(expert_in, ("expert", "expert_batch", None, None))
-    expert_out = jax.vmap(lambda h, gu, dn: _ffn(h, gu, dn, act))(
-        expert_in, gate_up, down
-    )  # [E, B, C, D]
+    expert_out = jax.vmap(
+        lambda h, w: _ffn(h, w, act2, cfg.interleaved_gate_up)
+    )(expert_in, weights)  # [E, B, C, D]
     expert_out = constrain(expert_out, ("expert", "expert_batch", None, None))
     out = jnp.einsum(
         "bsec,ebcd->bsd", comb, expert_out.astype(jnp.float32)
@@ -108,10 +123,9 @@ def gspmd_experts(
 def ragged_experts(
     x: jnp.ndarray,  # [T, D]
     gate_out: GateOutput,
-    gate_up: jnp.ndarray,
-    down: jnp.ndarray,
+    weights: dict,
     cfg: MoEConfig,
-    act: Act,
+    act2: Act,
 ) -> jnp.ndarray:
     """Dropless sort + ragged_dot grouped matmul (single-slice hot path)."""
     T, D = x.shape
@@ -121,10 +135,15 @@ def ragged_experts(
     token_of = order // K
     xs = x[token_of]  # [T*K, D] sorted by expert
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+    sorted_expert = flat_expert[order]
 
-    gu = jax.lax.ragged_dot(xs, gate_up.astype(xs.dtype), group_sizes)
-    g, u = jnp.split(gu, 2, axis=-1)
-    ys = jax.lax.ragged_dot((act(g) * u), down.astype(xs.dtype), group_sizes)
+    gu = jax.lax.ragged_dot(xs, weights["gate_up"].astype(xs.dtype), group_sizes)
+    if "gate_up_bias" in weights:
+        gu = gu + weights["gate_up_bias"].astype(xs.dtype)[sorted_expert]
+    g, u = _split_gate_up(gu, cfg.interleaved_gate_up)
+    ys = jax.lax.ragged_dot(act2(g, u), weights["down"].astype(xs.dtype), group_sizes)
+    if "down_bias" in weights:
+        ys = ys + weights["down_bias"].astype(xs.dtype)[sorted_expert]
 
     wflat = gate_out.topk_weights.reshape(-1)[order]  # aligned with ys
     out = jnp.zeros((T, D), jnp.float32)

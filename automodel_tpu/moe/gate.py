@@ -39,15 +39,19 @@ def gate(
     cfg: MoEConfig,
     bias: Optional[jnp.ndarray] = None,
     seq_len: Optional[int] = None,
+    linear_bias: Optional[jnp.ndarray] = None,
 ) -> GateOutput:
     """Route tokens. x: [T, D], weight: [D, E], bias: [E] aux-free correction.
 
     Returns combine weights built from the ORIGINAL scores (the bias only
-    affects selection — reference layers.py:202 semantics).
+    affects selection — reference layers.py:202 semantics). `linear_bias` is
+    a LEARNED router bias that feeds both selection and weights (gpt-oss).
     """
     T = x.shape[0]
     E, K = cfg.num_experts, cfg.num_experts_per_tok
     logits = (x.astype(jnp.float32) @ weight.astype(jnp.float32))  # [T, E]
+    if linear_bias is not None:
+        logits = logits + linear_bias.astype(jnp.float32)
 
     if cfg.softmax_before_topk or cfg.score_func == "sigmoid":
         scores = _score(logits, cfg)

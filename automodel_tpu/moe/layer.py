@@ -23,6 +23,22 @@ class MoEAux(NamedTuple):
     aux_loss: jnp.ndarray  # scalar f32
 
 
+def make_act2(cfg: MoEConfig, base_act: Callable) -> Callable:
+    """Two-argument gated activation from the config."""
+    if cfg.activation == "swiglu_oai":
+        # gpt-oss: clamp, swish(1.702*g), (up+1) shift
+        # (modeling_gpt_oss.py GptOssExperts.forward)
+        def act2(g, u):
+            g = jnp.minimum(g, 7.0)
+            u = jnp.clip(u, -7.0, 7.0)
+            import jax
+
+            return (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
+
+        return act2
+    return lambda g, u: base_act(g) * u
+
+
 def moe_block(
     x: jnp.ndarray,  # [B, S, D]
     mp: dict,
@@ -44,14 +60,15 @@ def moe_block(
             cfg,
             bias=mp["router"].get("bias"),
             seq_len=S,
+            linear_bias=mp["router"].get("linear_bias"),
         )
 
-    gu, dn = mp["experts"]["gate_up"], mp["experts"]["down"]
+    act2 = make_act2(cfg, act)
     if experts_backend == "gspmd":
-        routed = gspmd_experts(x, gout, gu, dn, cfg, act, constrain=constrain)
+        routed = gspmd_experts(x, gout, mp["experts"], cfg, act2, constrain=constrain)
     else:
         fn = EXPERT_BACKENDS[experts_backend]
-        routed = fn(xt, gout, gu, dn, cfg, act).reshape(B, S, D)
+        routed = fn(xt, gout, mp["experts"], cfg, act2).reshape(B, S, D)
 
     out = routed
     if "shared" in mp:
@@ -98,6 +115,11 @@ def init_moe_params(
     }
     if cfg.bias_update_factor > 0 or cfg.expert_bias:
         p["router"]["bias"] = jnp.zeros(shape(E), jnp.float32)
+    if cfg.router_linear_bias:
+        p["router"]["linear_bias"] = jnp.zeros(shape(E), jnp.float32)
+    if cfg.expert_mlp_bias:
+        p["experts"]["gate_up_bias"] = jnp.zeros(shape(E, 2 * I), dtype)
+        p["experts"]["down_bias"] = jnp.zeros(shape(E, D), dtype)
     if cfg.num_shared_experts > 0:
         SI = cfg.shared_expert_intermediate_size or cfg.moe_intermediate_size
         SI = SI * cfg.num_shared_experts
@@ -117,9 +139,11 @@ def init_moe_params(
 # (experts on (ep, ep_shard); moe/parallelizer.py:159-277) as pure annotation.
 MOE_SHARDING_RULES: list[tuple[str, tuple]] = [
     (r"router/weight$", (None, None)),
-    (r"router/bias$", (None,)),
+    (r"router/(bias|linear_bias)$", (None,)),
     (r"experts/gate_up$", ("expert", "expert_fsdp", "tensor")),
     (r"experts/down$", ("expert", "tensor", "expert_fsdp")),
+    (r"experts/gate_up_bias$", ("expert", "tensor")),
+    (r"experts/down_bias$", ("expert", None)),
     (r"shared/(gate|up)_proj/kernel$", ("fsdp", "tensor")),
     (r"shared/down_proj/kernel$", ("tensor", "fsdp")),
     (r"shared_gate/kernel$", (None, None)),

@@ -48,8 +48,14 @@ def sdpa(
     segment_ids: Optional[jnp.ndarray] = None,
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
+    sinks: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """XLA scaled dot-product attention. q: [B,S,N,H], k/v: [B,S,Nkv,H]."""
+    """XLA scaled dot-product attention. q: [B,S,N,H], k/v: [B,S,Nkv,H].
+
+    ``sinks``: per-head learned sink logits [N] — an extra virtual key that
+    absorbs probability mass (gpt-oss; modeling_gpt_oss.py:258: softmax over
+    [logits, sink] then drop the sink column).
+    """
     b, sq, n, h = q.shape
     n_kv = k.shape[2]
     k = repeat_kv(k, n // n_kv)
@@ -72,7 +78,14 @@ def sdpa(
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         mask = mask & seg
     logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if sinks is not None:
+        sink_col = jnp.broadcast_to(
+            sinks.astype(jnp.float32)[None, :, None, None], (b, n, sq, 1)
+        )
+        combined = jnp.concatenate([logits, sink_col], axis=-1)
+        probs = jax.nn.softmax(combined, axis=-1)[..., :-1].astype(q.dtype)
+    else:
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
 
 

@@ -46,9 +46,10 @@ def _bench_config(on_tpu: bool, device_kind: str = "") -> tuple[dict, dict, int,
             "attn": "flash",
             "param_dtype": "bfloat16",
             "compute_dtype": "bfloat16",
-            "remat": "full" if small_hbm else "selective",
+            "remat": os.environ.get("BENCH_REMAT", "full" if small_hbm else "selective"),
         }
-        return hf, backend, 4 if small_hbm else 8, 4096, 8
+        batch = int(os.environ.get("BENCH_BATCH", 4 if small_hbm else 8))
+        return hf, backend, batch, int(os.environ.get("BENCH_SEQ", 4096)), 8
     # CPU smoke path so the bench is runnable anywhere
     hf = {
         "architectures": ["LlamaForCausalLM"],

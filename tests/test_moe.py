@@ -94,11 +94,11 @@ def test_update_gate_bias_pushes_toward_balance():
 def test_expert_backends_match_dense():
     p, x = _params(), _x()
     gout = gate(x, p["router"]["weight"], CFG)
-    gu, dn = p["experts"]["gate_up"], p["experts"]["down"]
-    ref = dense_experts(x, gout, gu, dn, CFG, jax.nn.silu)
-    rag = ragged_experts(x, gout, gu, dn, CFG, jax.nn.silu)
+    act2 = lambda g, u: jax.nn.silu(g) * u
+    ref = dense_experts(x, gout, p["experts"], CFG, act2)
+    rag = ragged_experts(x, gout, p["experts"], CFG, act2)
     np.testing.assert_allclose(np.asarray(rag), np.asarray(ref), rtol=1e-4, atol=1e-5)
-    gsp = gspmd_experts(x.reshape(2, 12, 16), gout, gu, dn, CFG, jax.nn.silu)
+    gsp = gspmd_experts(x.reshape(2, 12, 16), gout, p["experts"], CFG, act2)
     np.testing.assert_allclose(
         np.asarray(gsp).reshape(24, 16), np.asarray(ref), rtol=1e-4, atol=1e-5
     )
@@ -113,8 +113,8 @@ def test_gspmd_capacity_drops_lowest_priority():
     x = _x(t=16, d=8)
     gout = gate(x, p["router"]["weight"], cfg)
     out = gspmd_experts(
-        x.reshape(1, 16, 8), gout, p["experts"]["gate_up"], p["experts"]["down"],
-        cfg, jax.nn.silu,
+        x.reshape(1, 16, 8), gout, p["experts"], cfg,
+        lambda g, u: jax.nn.silu(g) * u,
     )
     assert np.isfinite(np.asarray(out)).all()
 
