@@ -36,7 +36,7 @@ class BackendConfig:
 
     attn: str = "flash"  # any key of ops.attention.ATTENTION_BACKENDS
     rms_norm: str = "xla"
-    experts: str = "gspmd"  # gspmd | ragged | dense (moe.experts backends)
+    experts: str = "gspmd"  # gspmd | ragged | dense | a2a (moe.experts backends)
     fake_balanced_gate: bool = False  # deterministic routing for benchmarks
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"

@@ -35,6 +35,11 @@ class MoEConfig:
     shared_expert_gate: bool = False
     # dispatch capacity factor for the gspmd (einsum) dispatcher
     capacity_factor: float = 1.25
+    # a2a dispatcher per-peer buffer bound, × the balanced load T*K/ep.
+    # None = strict worst case (dropless by construction); set ~2.0 to bound
+    # memory on perf runs (over-capacity picks then contribute zero, like the
+    # reference's bounded dispatch buffers).
+    a2a_capacity_factor: Optional[float] = None
     # gpt-oss-style experts: gate/up interleaved on the fused dim, bias terms
     # on both projections, clamped (up+1)*glu activation, and a learned
     # linear bias on the router that feeds both selection and weights

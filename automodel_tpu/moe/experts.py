@@ -13,8 +13,16 @@ backends:
   Tokens over capacity are dropped (capacity_factor; the aux-free bias and
   aux loss keep loads balanced so drops stay rare).
 - ``ragged`` — dropless sort + `jax.lax.ragged_dot` grouped matmul
-  (megablocks-style). Best single-slice path; EP via shard_map a2a is the
-  planned extension.
+  (megablocks-style). Best single-slice path.
+- ``a2a``    — the DeepEP-equivalent token-exchange dispatcher (reference
+  token_dispatcher.py:339, fused_a2a.py:102,201): explicit shard_map over the
+  ``ep`` mesh axis with `lax.all_to_all` dispatch/combine around a local
+  `ragged_dot` grouped matmul. Dropless by construction at the default
+  capacity (per-peer worst case); `a2a_capacity_factor` bounds buffers for
+  perf runs (over-capacity picks contribute zero, like the reference's
+  bounded dispatch buffers). TP is handled inside the manual region: gate/up
+  are pre-split so their tp shards align, down-proj partial sums ride the
+  combine all_to_all and a single psum("tp") happens at [T, D].
 
 All backends take fused gate_up weights [E, D, 2I] and down [E, I, D];
 SwiGLU-family activation.
@@ -151,8 +159,145 @@ def ragged_experts(
     return out.astype(x.dtype)
 
 
+def a2a_experts(
+    x: jnp.ndarray,  # [B, S, D]
+    gate_out: GateOutput,
+    weights: dict,
+    cfg: MoEConfig,
+    act2: Act,
+    ctx,  # parallel.mesh.MeshContext | None
+) -> jnp.ndarray:
+    """Dropless token-exchange EP dispatch (reference DeepEP dispatcher,
+    token_dispatcher.py:339 + fused_a2a.py:102 → shard_map + lax.all_to_all).
+
+    Per device block: sort (token, k) picks by expert id, all_to_all the
+    per-peer chunks (static capacity C per peer), locally re-sort by expert
+    and run `ragged_dot` grouped matmuls, then reverse the exchange and
+    scatter-combine. `ragged_all_to_all` would avoid chunk padding but is not
+    implemented by XLA:CPU (where the multichip tests run); the padded
+    formulation is numerically identical and XLA lowers the all_to_all onto
+    ICI either way.
+    """
+    B, S, D = x.shape
+    if ctx is None or ctx.ep_size == 1:
+        # single-slice: the ragged path is already dropless
+        return ragged_experts(
+            x.reshape(-1, D), gate_out, weights, cfg, act2
+        ).reshape(B, S, D)
+
+    from automodel_tpu.parallel.mesh import MeshAxisName as A
+    from jax.sharding import PartitionSpec as P
+
+    mesh = ctx.mesh
+    ep = ctx.ep_size
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    if E % ep:
+        raise ValueError(f"num_experts={E} must be divisible by ep={ep}")
+    E_loc = E // ep
+    b_div = mesh.shape[A.DP_REPLICATE] * mesh.shape[A.DP_SHARD] * mesh.shape[A.EP]
+    s_div = mesh.shape[A.CP]
+    if B % b_div or S % s_div:
+        raise ValueError(
+            f"batch {B}x{S} not divisible by data axes {b_div}x{s_div} for a2a dispatch"
+        )
+    Tl = (B // b_div) * (S // s_div)  # tokens per device block
+    cap = Tl * min(K, E_loc)  # strict per-peer worst case → dropless
+    if cfg.a2a_capacity_factor is not None:
+        cap = min(cap, int(math.ceil(cfg.a2a_capacity_factor * Tl * K / ep)))
+    C = -(-cap // 8) * 8  # chunk rows per peer, padded for TPU layouts
+
+    gw, uw = _split_gate_up(weights["gate_up"], cfg.interleaved_gate_up)
+    wd = {"gw": gw, "uw": uw, "dw": weights["down"]}
+    if "gate_up_bias" in weights:
+        wd["gb"], wd["ub"] = _split_gate_up(
+            weights["gate_up_bias"], cfg.interleaved_gate_up
+        )
+    if "down_bias" in weights:
+        wd["db"] = weights["down_bias"]
+
+    batch_axes = (A.DP_REPLICATE, A.DP_SHARD, A.EP)
+    tok_spec = P(batch_axes, A.CP, None)
+    w_specs = {
+        "gw": P(A.EP, None, A.TP),
+        "uw": P(A.EP, None, A.TP),
+        "dw": P(A.EP, A.TP, None),
+        "gb": P(A.EP, A.TP),
+        "ub": P(A.EP, A.TP),
+        "db": P(A.EP, None),
+    }
+
+    def body(xb, idxb, cwb, wd):
+        Bl, Sl, _ = xb.shape
+        T = Bl * Sl
+        xt = xb.reshape(T, D)
+        flat = idxb.reshape(T * K)
+        order = jnp.argsort(flat, stable=True)  # sorted-pick → original-pick
+        sorted_e = flat[order]
+        xs = xt[order // K]  # [T*K, D] picks sorted by global expert id
+
+        counts = jnp.bincount(flat, length=E).astype(jnp.int32)
+        peer_counts = counts.reshape(ep, E_loc).sum(-1)
+        peer_off = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(peer_counts)[:-1]]
+        )
+        peer_of = sorted_e // E_loc
+        pos_in_peer = jnp.arange(T * K, dtype=jnp.int32) - peer_off[peer_of]
+        keep = pos_in_peer < C  # over-capacity picks drop (zero contribution)
+        dst = jnp.where(keep, peer_of * C + pos_in_peer, ep * C)
+
+        send_x = jnp.zeros((ep * C + 1, D), xs.dtype).at[dst].set(xs)[:-1]
+        send_id = (
+            jnp.full((ep * C + 1,), E_loc, jnp.int32)
+            .at[dst]
+            .set(sorted_e % E_loc)[:-1]
+        )
+        a2a = lambda a: jax.lax.all_to_all(
+            a, A.EP, split_axis=0, concat_axis=0, tiled=True
+        )
+        recv_x, recv_id = a2a(send_x), a2a(send_id)  # [ep*C, ...] by sender
+
+        order2 = jnp.argsort(recv_id, stable=True)  # sentinel E_loc sorts last
+        xs2 = recv_x[order2]
+        sid = jnp.minimum(recv_id[order2], E_loc - 1)
+        gsz = jnp.bincount(recv_id, length=E_loc).astype(jnp.int32)  # sentinel drops
+
+        g = jax.lax.ragged_dot(xs2, wd["gw"].astype(xs2.dtype), gsz)
+        u = jax.lax.ragged_dot(xs2, wd["uw"].astype(xs2.dtype), gsz)
+        if "gb" in wd:
+            g = g + wd["gb"].astype(g.dtype)[sid]
+            u = u + wd["ub"].astype(u.dtype)[sid]
+        y = jax.lax.ragged_dot(act2(g, u), wd["dw"].astype(xs2.dtype), gsz)
+        if "db" in wd:  # partial over tp: add the bias on one tp shard only
+            y = y + jnp.where(
+                jax.lax.axis_index(A.TP) == 0, wd["db"].astype(y.dtype)[sid], 0.0
+            )
+        y = jnp.zeros_like(y).at[order2].set(y)  # back to recv order
+        y = a2a(y)  # [ep*C, D] back in my send layout
+        y = jnp.concatenate([y, jnp.zeros((1, D), y.dtype)], 0)[dst]  # dropped → 0
+        y = jnp.zeros_like(y).at[order].set(y)  # original pick order
+
+        cwf = cwb.reshape(T * K, 1).astype(jnp.float32)
+        out = (
+            jnp.zeros((T, D), jnp.float32)
+            .at[jnp.arange(T * K, dtype=jnp.int32) // K]
+            .add(y.astype(jnp.float32) * cwf)
+        )
+        out = jax.lax.psum(out, A.TP)  # down-proj partials, deferred to [T, D]
+        return out.astype(xb.dtype).reshape(Bl, Sl, D)
+
+    idx = gate_out.topk_idx.reshape(B, S, K)
+    cw = gate_out.topk_weights.reshape(B, S, K)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(tok_spec, tok_spec, tok_spec, {k: w_specs[k] for k in wd}),
+        out_specs=tok_spec,
+    )(x, idx, cw, wd)
+
+
 EXPERT_BACKENDS = {
     "dense": dense_experts,
     "gspmd": gspmd_experts,
     "ragged": ragged_experts,
+    "a2a": a2a_experts,
 }

@@ -80,6 +80,9 @@ def make_constrain(ctx: MeshContext | None) -> Callable:
     def constrain(x, spec):
         return jax.lax.with_sharding_constraint(x, ctx.sharding(*spec))
 
+    # mesh-aware ops (e.g. the MoE a2a dispatcher's shard_map) fetch the
+    # context from the callback rather than widening every model signature
+    constrain.mesh_ctx = ctx
     return constrain
 
 
