@@ -33,7 +33,7 @@ from automodel_tpu.models.llama.model import (
     _noop_constrain,
     _proj,
 )
-from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.ops.attention import windowed_attention
 from automodel_tpu.ops.rope import RopeConfig, apply_rope, rope_table
 
 
@@ -152,15 +152,20 @@ def _layer(
     cos = jnp.where(use_local, ropes["local"][0], ropes["global"][0])
     sin = jnp.where(use_local, ropes["local"][1], ropes["global"][1])
     q, k = apply_rope(q, k, cos, sin)
-    attn_out = sdpa(
+    attn_out = windowed_attention(
         q,
         k,
         v,
+        backend=backend.attn,
+        is_sliding=flags["is_sliding"],
+        window=cfg.sliding_window,
+        dynamic_window=flags["window"],  # dynamic bound; S for full layers
         causal=True,
         scale=cfg.query_pre_attn_scalar**-0.5,
         segment_ids=segment_ids,
         logits_soft_cap=cfg.attn_soft_cap,
-        sliding_window=flags["window"],  # dynamic bound; S for full layers
+        block_q=backend.attn_block_q,
+        block_kv=backend.attn_block_kv,
     )
     attn_out = _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"])
     h = h + gemma_rms_norm(attn_out, lp["post_attn_norm"]["scale"], cfg.rms_eps)
@@ -222,7 +227,7 @@ def forward_hidden(
         fn = jax.checkpoint(
             layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
-    flags = {"window": windows, "use_local_rope": use_local}
+    flags = {"window": windows, "use_local_rope": use_local, "is_sliding": use_local}
     if backend.scan_layers:
         h, _ = jax.lax.scan(fn, h, (params["layers"], flags))
     else:

@@ -37,7 +37,7 @@ from automodel_tpu.models.llama.model import (
 from automodel_tpu.models.qwen3_moe.model import MoEModelAux, _init_attn_layer
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.layer import init_moe_params, moe_block
-from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.ops.attention import windowed_attention
 from automodel_tpu.ops.norms import rms_norm
 from automodel_tpu.ops.rope import rope_table
 
@@ -110,14 +110,19 @@ def _layer(cfg, backend, h, lp, flags, cos, sin, segment_ids, constrain):
     k = _proj(x, lp["attn"]["k_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     v = _proj(x, lp["attn"]["v_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     q, k = apply_rope(q, k, cos, sin)
-    attn_out = sdpa(
+    attn_out = windowed_attention(
         q,
         k,
         v,
+        backend=backend.attn,
+        is_sliding=flags["is_sliding"],
+        window=cfg.sliding_window,
+        dynamic_window=flags["window"],
         causal=True,
         segment_ids=segment_ids,
-        sliding_window=flags["window"],
         sinks=lp["attn"]["sinks"],
+        block_q=backend.attn_block_q,
+        block_kv=backend.attn_block_kv,
     )
     h = h + _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"])
     h = constrain(h, ("batch", "seq", None))
@@ -167,7 +172,12 @@ def forward_hidden(
         fn = jax.checkpoint(
             layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
-    flags = {"window": windows}
+    flags = {
+        "window": windows,
+        "is_sliding": jnp.asarray(
+            [t == "sliding_attention" for t in cfg.layer_types], bool
+        ),
+    }
     if backend.scan_layers:
         h, auxs = jax.lax.scan(fn, h, (params["layers"], flags))
         counts, aux_losses = auxs.expert_counts, auxs.aux_loss

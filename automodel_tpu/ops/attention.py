@@ -89,43 +89,105 @@ def sdpa(
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
 
 
+def _pick_block(pref: int, s: int) -> int:
+    """Largest TPU-friendly block (multiple of 128) that divides s."""
+    for b in (pref, 512, 256, 128):
+        if b <= pref and s % b == 0:
+            return b
+    return min(128, s)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "logits_soft_cap", "sliding_window", "block_q", "block_kv"),
+    static_argnames=(
+        "causal", "scale", "logits_soft_cap", "sliding_window", "block_q",
+        "block_kv", "interpret",
+    ),
 )
-def _pallas_flash(
-    q, k, v, segment_ids, *, causal, scale, logits_soft_cap, sliding_window, block_q, block_kv
+def _splash_flash(
+    q, k, v, segment_ids, sinks,
+    *, causal, scale, logits_soft_cap, sliding_window, block_q, block_kv,
+    interpret=False,
 ):
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        BlockSizes,
-        flash_attention,
-        SegmentIds,
+    """Splash attention (pallas TPU): native GQA (no repeat_kv materialize),
+    sliding-window via LocalMask, logit soft cap, segment ids, and gpt-oss
+    attention sinks — the TE-universality equivalent
+    (reference components/attention/utils.py:25-65)."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sak,
+        splash_attention_mask as sam,
     )
 
-    # pallas kernel wants BNSH layout
-    qt = q.transpose(0, 2, 1, 3)
-    n, n_kv = q.shape[2], k.shape[2]
-    kt = repeat_kv(k, n // n_kv).transpose(0, 2, 1, 3)
-    vt = repeat_kv(v, n // n_kv).transpose(0, 2, 1, 3)
-    seg = SegmentIds(q=segment_ids, kv=segment_ids) if segment_ids is not None else None
-    sq, skv = qt.shape[2], kt.shape[2]
-    bs = BlockSizes(
-        block_q=min(block_q, sq),
-        block_k_major=min(block_kv, skv),
-        block_k=min(block_kv, skv),
-        block_b=1,
-        block_q_major_dkv=min(block_q, sq),
-        block_k_major_dkv=min(block_kv, skv),
-        block_k_dkv=min(block_kv, skv),
-        block_q_dkv=min(block_q, sq),
-        block_k_major_dq=min(block_kv, skv),
-        block_k_dq=min(block_kv, skv),
-        block_q_dq=min(block_q, sq),
+    B, S, N, H = q.shape
+    # pad seq to a 128 multiple instead of losing the fused kernel; padded q
+    # rows are sliced off, padded kv is never attended (causal) / segmented out
+    Sp = -(-S // 128) * 128
+    pad = Sp - S
+    if pad:
+        zeros = lambda a: jnp.pad(a, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        q, k, v = zeros(q), zeros(k), zeros(v)
+        if segment_ids is None:
+            segment_ids = jnp.concatenate(
+                [
+                    jnp.ones((B, S), jnp.int32),
+                    jnp.zeros((B, pad), jnp.int32),
+                ],
+                axis=1,
+            )
+        else:
+            segment_ids = jnp.pad(
+                segment_ids, ((0, 0), (0, pad)), constant_values=-1
+            )
+
+    qt = (q * scale).transpose(0, 2, 1, 3)  # [B, N, S, H], pre-scaled
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    if sliding_window is not None:
+        base = sam.LocalMask((Sp, Sp), window_size=(sliding_window - 1, 0), offset=0)
+    elif causal:
+        base = sam.CausalMask((Sp, Sp))
+    else:
+        base = sam.FullMask((Sp, Sp))
+    mask = sam.MultiHeadMask([base] * N)
+    bq = _pick_block(block_q, Sp)
+    bkv = _pick_block(block_kv, Sp)
+    kernel = sak.make_splash_mha(
+        mask,
+        block_sizes=sak.BlockSizes(
+            block_q=bq, block_kv=bkv,
+            block_q_dkv=bq, block_kv_dkv=bkv,
+            block_q_dq=bq, block_kv_dq=bkv,
+        ),
+        head_shards=1,
+        q_seq_shards=1,
+        attn_logits_soft_cap=logits_soft_cap,
+        interpret=interpret,
     )
-    out = flash_attention(
-        qt, kt, vt, segment_ids=seg, causal=causal, sm_scale=scale, block_sizes=bs
+    seg = (
+        sak.SegmentIds(q=segment_ids, kv=segment_ids)
+        if segment_ids is not None
+        else None
     )
-    return out.transpose(0, 2, 1, 3)
+    out = jax.vmap(
+        kernel, in_axes=(0, 0, 0, 0 if seg is not None else None, None)
+    )(qt, kt, vt, seg, sinks)
+    out = out.transpose(0, 2, 1, 3).astype(q.dtype)
+    return out[:, :S] if pad else out
+
+
+_warned_fallback: set = set()
+
+
+def _fallback_loudly(reason: str):
+    if reason not in _warned_fallback:
+        _warned_fallback.add(reason)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "flash attention falling back to XLA sdpa (%s) — O(S^2) "
+            "materialized attention; expect a large perf cliff on TPU.", reason
+        )
 
 
 def flash(
@@ -138,43 +200,34 @@ def flash(
     segment_ids: Optional[jnp.ndarray] = None,
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
+    sinks: Optional[jnp.ndarray] = None,
     block_q: int = 512,
     block_kv: int = 512,
 ) -> jnp.ndarray:
-    """Pallas TPU flash attention; transparently falls back to sdpa when the
-    kernel does not apply (non-TPU backend, soft cap, sliding window, or
-    head_dim not MXU-tileable)."""
+    """Pallas TPU flash (splash) attention: causal/sliding-window/soft-cap/
+    segments/sinks all stay on the fused kernel; sequences are padded to 128
+    internally. Falls back to sdpa ONLY off-TPU or for non-causal dense
+    attention, and logs loudly when it does."""
     h = q.shape[-1]
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if (
-        not on_tpu
-        or logits_soft_cap is not None
-        or sliding_window is not None
-        or h % 128 != 0
-        or q.shape[1] % 128 != 0
-    ):
+    reason = None
+    if not _flash_eligible():
+        reason = "not running on TPU"
+    elif not causal and sliding_window is None:
+        reason = "non-causal dense attention"
+    if reason is not None:
+        _fallback_loudly(reason)
         return sdpa(
-            q,
-            k,
-            v,
-            causal=causal,
-            scale=scale,
-            segment_ids=segment_ids,
-            logits_soft_cap=logits_soft_cap,
-            sliding_window=sliding_window,
+            q, k, v,
+            causal=causal, scale=scale, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap, sliding_window=sliding_window,
+            sinks=sinks,
         )
     scale = scale if scale is not None else 1.0 / (h**0.5)
-    return _pallas_flash(
-        q,
-        k,
-        v,
-        segment_ids,
-        causal=causal,
-        scale=scale,
-        logits_soft_cap=logits_soft_cap,
-        sliding_window=sliding_window,
-        block_q=block_q,
-        block_kv=block_kv,
+    return _splash_flash(
+        q, k, v, segment_ids, sinks,
+        causal=causal, scale=scale, logits_soft_cap=logits_soft_cap,
+        sliding_window=sliding_window, block_q=block_q, block_kv=block_kv,
+        interpret=_interpret_requested(),
     )
 
 
@@ -207,3 +260,86 @@ def attention(
             f"Unknown attention backend {backend!r}; available: {sorted(ATTENTION_BACKENDS)}"
         )
     return fn(q, k, v, **kwargs)
+
+
+def windowed_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    backend: str,
+    is_sliding: jnp.ndarray,
+    window: Optional[int],
+    dynamic_window: jnp.ndarray,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    logits_soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jnp.ndarray:
+    """Attention for scanned layer stacks that mix full and sliding-window
+    layers (Gemma-2/3, GPT-OSS). The per-layer layer type rides the scan as
+    the traced `is_sliding` flag; the flash path needs a STATIC window for
+    its splash mask, so it branches with `lax.cond` between two static-mask
+    kernels (both compile once; one executes per layer). The sdpa path takes
+    the traced `dynamic_window` bound directly (window = S on full layers)."""
+    if backend == "flash" and window is not None and _flash_eligible():
+        kw = dict(
+            causal=causal, scale=scale, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap, sinks=sinks,
+            block_q=block_q, block_kv=block_kv,
+        )
+        return jax.lax.cond(
+            is_sliding,
+            lambda: flash(q, k, v, sliding_window=window, **kw),
+            lambda: flash(q, k, v, sliding_window=None, **kw),
+        )
+    if backend == "flash" and window is None and _flash_eligible():
+        return flash(
+            q, k, v,
+            causal=causal, scale=scale, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap, sinks=sinks,
+            block_q=block_q, block_kv=block_kv,
+        )
+    if backend == "ring":
+        if sinks is not None:
+            raise NotImplementedError(
+                "attention sinks are not supported on the ring (context-"
+                "parallel) backend yet; use attn='sdpa' or 'flash'"
+            )
+        return ATTENTION_BACKENDS["ring"](
+            q, k, v,
+            causal=causal, scale=scale, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap, sliding_window=dynamic_window,
+        )
+    if backend == "flash":
+        _fallback_loudly("not running on TPU")
+    return sdpa(
+        q, k, v,
+        causal=causal, scale=scale, segment_ids=segment_ids,
+        logits_soft_cap=logits_soft_cap, sliding_window=dynamic_window,
+        sinks=sinks,
+    )
+
+
+def _interpret_requested() -> bool:
+    """AUTOMODEL_FLASH_INTERPRET=1 runs the splash kernel through the pallas
+    interpreter — the REAL kernel code path, executable on CPU (tests)."""
+    import os
+
+    return os.environ.get("AUTOMODEL_FLASH_INTERPRET", "0") == "1"
+
+
+def _flash_eligible() -> bool:
+    if _interpret_requested():
+        return True
+    try:
+        # honor an explicitly pinned default device (tests pin CPU while a
+        # TPU is still visible in jax.devices())
+        dd = jax.config.jax_default_device
+        dev = dd if dd is not None else jax.devices()[0]
+        return getattr(dev, "platform", None) == "tpu"
+    except Exception:
+        return False
