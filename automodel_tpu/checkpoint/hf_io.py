@@ -60,9 +60,18 @@ def _read_header(path: Path) -> tuple[dict, int]:
 class HFCheckpointReader:
     """Lazy mmap reader over an HF checkpoint dir (single file or
     sharded+index). Tensors are copied out of the mmap on access, so each
-    `get_tensor` touches only that tensor's bytes."""
+    `get_tensor` touches only that tensor's bytes.
 
-    def __init__(self, path: str | os.PathLike):
+    Quantized hub checkpoints dequantize transparently (reference:
+    models/deepseek_v3/state_dict_adapter.py:375 FP8-blockwise,
+    models/gpt_oss/state_dict_adapter.py:117 MXFP4): ``get_tensor`` on an
+    fp8 weight with a companion ``_scale_inv`` returns the bf16 dequant,
+    and on an absent key whose ``_blocks``/``_scales`` pair exists returns
+    the MXFP4 unpack — so state-dict adapters only ever see logical bf16
+    tensors. Pass ``dequantize=False`` to read raw quantized payloads."""
+
+    def __init__(self, path: str | os.PathLike, dequantize: bool = True):
+        self.dequantize = dequantize
         self.path = Path(path)
         index_file = self.path / SAFETENSORS_INDEX
         self.weight_map: dict[str, str] = {}
@@ -81,8 +90,40 @@ class HFCheckpointReader:
         # per shard file: (header, data_offset, mmap)
         self._files: dict[str, tuple[dict, int, Any]] = {}
 
+    def _is_fp8_blockwise(self, key: str) -> bool:
+        """Shared predicate between keys()/info()/get_tensor(): an fp8 weight
+        with a companion ``_scale_inv`` dequantizes transparently."""
+        return (
+            key in self.weight_map
+            and f"{key}_scale_inv" in self.weight_map
+            and self._raw_info(key)[0] in ("F8_E4M3", "F8_E5M2")
+        )
+
+    def _is_mxfp4(self, key: str) -> bool:
+        return (
+            key not in self.weight_map
+            and f"{key}_blocks" in self.weight_map
+            and f"{key}_scales" in self.weight_map
+        )
+
     def keys(self) -> list[str]:
-        return list(self.weight_map)
+        """Logical tensor keys: quantization side-car keys (``_scale_inv``,
+        ``_blocks``/``_scales``) collapse into the tensor they decode to."""
+        if not self.dequantize:
+            return list(self.weight_map)
+        out = []
+        for k in self.weight_map:
+            if k.endswith("_scale_inv") and self._is_fp8_blockwise(
+                k[: -len("_scale_inv")]
+            ):
+                continue
+            if k.endswith("_blocks") and self._is_mxfp4(k[: -len("_blocks")]):
+                out.append(k[: -len("_blocks")])
+                continue
+            if k.endswith("_scales") and self._is_mxfp4(k[: -len("_scales")]):
+                continue
+            out.append(k)
+        return out
 
     def _file(self, name: str) -> tuple[dict, int, Any]:
         if name not in self._files:
@@ -94,19 +135,45 @@ class HFCheckpointReader:
             self._files[name] = (header, data_off, mm)
         return self._files[name]
 
-    def info(self, key: str) -> tuple[str, tuple[int, ...]]:
-        """(safetensors dtype string, shape) without reading data."""
+    def _raw_info(self, key: str) -> tuple[str, tuple[int, ...]]:
         header, _, _ = self._file(self.weight_map[key])
         meta = header[key]
         return meta["dtype"], tuple(meta["shape"])
 
-    def get_tensor(self, key: str) -> np.ndarray:
+    def info(self, key: str) -> tuple[str, tuple[int, ...]]:
+        """(safetensors dtype string, shape) without reading data — the
+        logical post-dequant view for quantized entries (same predicates as
+        get_tensor, so the two can never disagree)."""
+        if self.dequantize:
+            if self._is_fp8_blockwise(key):
+                return "BF16", self._raw_info(key)[1]
+            if self._is_mxfp4(key):
+                *prefix, r, g, b = self._raw_info(f"{key}_blocks")[1]
+                return "BF16", (*prefix, g * b * 2, r)
+        return self._raw_info(key)
+
+    def _raw_tensor(self, key: str) -> np.ndarray:
         header, data_off, mm = self._file(self.weight_map[key])
         meta = header[key]
         dtype = _ST_TO_NP[meta["dtype"]]
         start, end = meta["data_offsets"]
         buf = mm[data_off + start : data_off + end]
         return np.frombuffer(buf, dtype=dtype).reshape(meta["shape"])
+
+    def get_tensor(self, key: str) -> np.ndarray:
+        if self.dequantize:
+            from automodel_tpu.checkpoint import quant_io
+
+            if self._is_fp8_blockwise(key):
+                return quant_io.dequantize_fp8_blockwise(
+                    self._raw_tensor(key), self._raw_tensor(f"{key}_scale_inv")
+                )
+            if self._is_mxfp4(key):
+                return quant_io.dequantize_mxfp4(
+                    self._raw_tensor(f"{key}_blocks"),
+                    self._raw_tensor(f"{key}_scales"),
+                )
+        return self._raw_tensor(key)
 
     def close(self) -> None:
         for _, _, mm in self._files.values():

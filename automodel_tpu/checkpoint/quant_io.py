@@ -1,0 +1,142 @@
+"""Quantized-checkpoint ingest: FP8-blockwise and MXFP4 → bf16 numpy.
+
+Parity: the reference dequantizes quantized hub checkpoints while loading —
+DeepSeek-V3 FP8-blockwise (128x128 ``*_scale_inv`` tiles, reference
+models/deepseek_v3/state_dict_adapter.py:375 ``dequantize_from_fp8``) and
+GPT-OSS MXFP4 (``*_blocks``/``*_scales`` nibble packing, reference
+models/gpt_oss/state_dict_adapter.py:117 ``_convert_moe_packed_tensors``).
+
+TPU-native: dequant happens on the host, tensor-by-tensor, inside the
+checkpoint reader — so state-dict adapters only ever see logical bf16
+tensors and each dequantized leaf can be ``device_put`` to its target
+sharding immediately (no CUDA/Triton kernel needed; the hot path is a
+one-time load). Quantizer counterparts exist for round-trip tests and for
+emitting quantized checkpoints on save.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import ml_dtypes
+
+FP8_BLOCK_SIZE = 128
+
+# MXFP4 e2m1 code points, low nibble first (index == 4-bit code).
+FP4_VALUES = np.array(
+    [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0],
+    dtype=np.float32,
+)
+
+_MXFP4_GROUP = 32  # fp4 values per shared e8m0 scale
+
+
+def dequantize_fp8_blockwise(
+    weight: np.ndarray,
+    scale_inv: np.ndarray,
+    dtype=ml_dtypes.bfloat16,
+    block_size: int = FP8_BLOCK_SIZE,
+) -> np.ndarray:
+    """``weight`` fp8 [M, N] x ``scale_inv`` fp32 [ceil(M/B), ceil(N/B)]
+    per-128x128-block scales → dense [M, N] in ``dtype``."""
+    if weight.ndim != 2:
+        raise ValueError(f"fp8 blockwise weight must be 2-D, got {weight.shape}")
+    m, n = weight.shape
+    br = -(-m // block_size)
+    bc = -(-n // block_size)
+    if scale_inv.shape != (br, bc):
+        raise ValueError(
+            f"scale_inv shape {scale_inv.shape} != expected {(br, bc)} "
+            f"for weight {weight.shape} at block {block_size}"
+        )
+    # row-block loop keeps the fp32 temp at [block_size, N] instead of
+    # materializing a full [M, N] fp32 weight + scale matrix on the host
+    out = np.empty((m, n), dtype)
+    col_scale = np.repeat(scale_inv.astype(np.float32), block_size, axis=1)[:, :n]
+    for i in range(br):
+        r0, r1 = i * block_size, min((i + 1) * block_size, m)
+        out[r0:r1] = (weight[r0:r1].astype(np.float32) * col_scale[i][None, :]).astype(
+            dtype
+        )
+    return out
+
+
+def quantize_fp8_blockwise(
+    weight: np.ndarray, block_size: int = FP8_BLOCK_SIZE
+) -> tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`dequantize_fp8_blockwise` (test/export helper):
+    per-block absmax scaling into float8_e4m3fn + fp32 ``scale_inv``."""
+    m, n = weight.shape
+    br = -(-m // block_size)
+    bc = -(-n // block_size)
+    w = weight.astype(np.float32)
+    padded = np.zeros((br * block_size, bc * block_size), np.float32)
+    padded[:m, :n] = w
+    blocks = padded.reshape(br, block_size, bc, block_size)
+    absmax = np.abs(blocks).max(axis=(1, 3))
+    fp8_max = 448.0  # e4m3fn
+    scale_inv = np.where(absmax > 0, absmax / fp8_max, 1.0).astype(np.float32)
+    inv = np.repeat(np.repeat(scale_inv, block_size, 0), block_size, 1)[:m, :n]
+    q = (w / inv).astype(ml_dtypes.float8_e4m3fn)
+    return q, scale_inv
+
+
+def dequantize_mxfp4(
+    blocks: np.ndarray,
+    scales: np.ndarray,
+    dtype=ml_dtypes.bfloat16,
+    rows_per_chunk: int = 1 << 20,
+) -> np.ndarray:
+    """MXFP4 ``*_blocks`` uint8 [..., R, G, B] + ``*_scales`` uint8
+    [..., R, G] → bf16 in the HF logical layout [..., G*B*2, R].
+
+    Each byte packs two e2m1 values (low nibble first); each group of
+    ``B*2 = 32`` values shares one e8m0 scale (exponent = scales - 127).
+    The final swapaxes matches transformers' mxfp4 integration (and the
+    reference's ``out.transpose(1, 2)``): on disk the quantized tensor is
+    stored transposed relative to the bf16 checkpoint layout.
+    """
+    if blocks.shape[:-1] != scales.shape:
+        raise ValueError(f"blocks {blocks.shape} / scales {scales.shape} mismatch")
+    *prefix, g, b = blocks.shape
+    exp = scales.astype(np.int32).reshape(-1, 1) - 127
+    flat = blocks.reshape(-1, b)
+    rows_total = flat.shape[0]
+    out = np.empty((rows_total, b * 2), dtype=dtype)
+    for r0 in range(0, rows_total, rows_per_chunk):
+        r1 = min(r0 + rows_per_chunk, rows_total)
+        blk = flat[r0:r1]
+        sub = np.empty((r1 - r0, b * 2), np.float32)
+        sub[:, 0::2] = FP4_VALUES[blk & 0x0F]
+        sub[:, 1::2] = FP4_VALUES[blk >> 4]
+        np.ldexp(sub, exp[r0:r1], out=sub)
+        out[r0:r1] = sub.astype(dtype)
+    out = out.reshape(*prefix, g * b * 2)
+    return np.swapaxes(out, -1, -2)
+
+
+def pack_mxfp4(weight: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`dequantize_mxfp4` (test/export helper): HF-layout
+    [..., C, R] bf16 → (blocks uint8 [..., R, C//32, 16], scales uint8
+    [..., R, C//32]) with per-group absmax e8m0 scales."""
+    w = np.swapaxes(np.asarray(weight, np.float32), -1, -2)  # [..., R, C]
+    *prefix, r, c = w.shape
+    if c % _MXFP4_GROUP:
+        raise ValueError(f"last dim {c} not a multiple of {_MXFP4_GROUP}")
+    g = c // _MXFP4_GROUP
+    grp = w.reshape(*prefix, r, g, _MXFP4_GROUP)
+    absmax = np.abs(grp).max(axis=-1)
+    # e8m0 scale: power of two s.t. absmax/2^e <= 6 (max e2m1 magnitude)
+    e = np.where(absmax > 0, np.ceil(np.log2(np.maximum(absmax, 1e-30) / 6.0)), 0.0)
+    e = np.clip(e, -127, 128).astype(np.int32)
+    scales = (e + 127).astype(np.uint8)
+    scaled = grp / np.exp2(e)[..., None]
+    # nearest e2m1 code per value
+    dist = np.abs(scaled[..., None] - FP4_VALUES)  # [..., 32, 16]
+    codes = dist.argmin(axis=-1).astype(np.uint8)
+    lo = codes[..., 0::2]
+    hi = codes[..., 1::2]
+    blocks = (lo | (hi << 4)).reshape(*prefix, r, g, _MXFP4_GROUP // 2)
+    return blocks, scales
+
+

@@ -208,10 +208,15 @@ def forward_hidden(
         ),
     }
     sw = cfg.sliding_window or S
-    windows = jnp.asarray(
-        [sw if t == "sliding_attention" else S for t in cfg.layer_types], jnp.int32
+    # numpy (not jnp) so the unrolled path indexes out STATIC per-layer flags
+    # (one attention kernel compiled per layer); lax.scan slices them as
+    # traced leaves in the scanned path
+    import numpy as _np
+
+    windows = _np.asarray(
+        [sw if t == "sliding_attention" else S for t in cfg.layer_types], _np.int32
     )
-    use_local = jnp.asarray(
+    use_local = _np.asarray(
         [t == "sliding_attention" for t in cfg.layer_types], bool
     )
 
@@ -220,21 +225,25 @@ def forward_hidden(
         out = _layer(cfg, backend, carry, lp, flags, ropes, segment_ids, constrain)
         return out, None
 
-    fn = layer_fn
     if backend.remat == "full":
-        fn = jax.checkpoint(layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        wrap = lambda f: jax.checkpoint(f, policy=jax.checkpoint_policies.nothing_saveable)
     elif backend.remat == "selective":
-        fn = jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        wrap = lambda f: jax.checkpoint(
+            f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
+    else:
+        wrap = lambda f: f
     flags = {"window": windows, "use_local_rope": use_local, "is_sliding": use_local}
     if backend.scan_layers:
-        h, _ = jax.lax.scan(fn, h, (params["layers"], flags))
+        h, _ = jax.lax.scan(wrap(layer_fn), h, (params["layers"], flags))
     else:
         for i in range(cfg.num_layers):
             lp = jax.tree.map(lambda x: x[i], params["layers"])
-            fl = jax.tree.map(lambda x: x[i], flags)
-            h, _ = fn(h, (lp, fl))
+            # flags ride the CLOSURE as python scalars, not the traced args —
+            # jax.checkpoint would otherwise turn them into Tracers and defeat
+            # the one-static-kernel-per-layer selection in windowed_attention
+            fl = {k: v[i].item() for k, v in flags.items()}
+            h, _ = wrap(lambda carry, lp_, _fl=fl: layer_fn(carry, (lp_, _fl)))(h, lp)
     return gemma_rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
 
 

@@ -157,36 +157,42 @@ def forward_hidden(
     h = constrain(h, ("batch", "seq", None))
     cos, sin = rope_table(position_ids, cfg.head_dim, cfg.rope)
     sw = cfg.sliding_window or S
-    windows = jnp.asarray(
-        [sw if t == "sliding_attention" else S for t in cfg.layer_types], jnp.int32
+    # numpy (not jnp): static per-layer flags in the unrolled path, scanned
+    # leaves in the lax.scan path (see gemma/model.py)
+    import numpy as _np
+
+    windows = _np.asarray(
+        [sw if t == "sliding_attention" else S for t in cfg.layer_types], _np.int32
     )
 
     def layer_fn(carry, xs):
         lp, flags = xs
         return _layer(cfg, backend, carry, lp, flags, cos, sin, segment_ids, constrain)
 
-    fn = layer_fn
     if backend.remat == "full":
-        fn = jax.checkpoint(layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        wrap = lambda f: jax.checkpoint(f, policy=jax.checkpoint_policies.nothing_saveable)
     elif backend.remat == "selective":
-        fn = jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        wrap = lambda f: jax.checkpoint(
+            f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         )
+    else:
+        wrap = lambda f: f
     flags = {
         "window": windows,
-        "is_sliding": jnp.asarray(
+        "is_sliding": _np.asarray(
             [t == "sliding_attention" for t in cfg.layer_types], bool
         ),
     }
     if backend.scan_layers:
-        h, auxs = jax.lax.scan(fn, h, (params["layers"], flags))
+        h, auxs = jax.lax.scan(wrap(layer_fn), h, (params["layers"], flags))
         counts, aux_losses = auxs.expert_counts, auxs.aux_loss
     else:
         counts_l, aux_l = [], []
         for i in range(cfg.num_layers):
             lp = jax.tree.map(lambda x: x[i], params["layers"])
-            fl = jax.tree.map(lambda x: x[i], flags)
-            h, aux = fn(h, (lp, fl))
+            # static per-layer flags via closure (see gemma/model.py)
+            fl = {k: v[i].item() for k, v in flags.items()}
+            h, aux = wrap(lambda carry, lp_, _fl=fl: layer_fn(carry, (lp_, _fl)))(h, lp)
             counts_l.append(aux.expert_counts)
             aux_l.append(aux.aux_loss)
         counts, aux_losses = jnp.stack(counts_l), jnp.stack(aux_l)

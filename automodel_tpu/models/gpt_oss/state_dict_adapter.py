@@ -2,7 +2,8 @@
 
 Parity: reference models/gpt_oss/state_dict_adapter.py (incl. MXFP4
 handling — BF16-upcast checkpoints load directly; MXFP4-packed checkpoints
-should be dequantized offline first). The HF layout stores experts STACKED
+dequantize transparently inside HFCheckpointReader via
+checkpoint/quant_io.dequantize_mxfp4). The HF layout stores experts STACKED
 (`mlp.experts.gate_up_proj [E, D, 2I]` already [in, out]) so no per-expert
 merge is needed — only the router linear transposes.
 """

@@ -90,11 +90,13 @@ def sdpa(
 
 
 def _pick_block(pref: int, s: int) -> int:
-    """Largest TPU-friendly block (multiple of 128) that divides s."""
+    """Largest TPU-friendly block (multiple of 128, splash requirement) that
+    divides s. s is always a 128 multiple here, so 128 is a valid floor even
+    when pref is smaller or not 128-aligned."""
     for b in (pref, 512, 256, 128):
-        if b <= pref and s % b == 0:
+        if b <= pref and b % 128 == 0 and s % b == 0:
             return b
-    return min(128, s)
+    return 128
 
 
 @functools.partial(
@@ -212,8 +214,10 @@ def flash(
     reason = None
     if not _flash_eligible():
         reason = "not running on TPU"
-    elif not causal and sliding_window is None:
-        reason = "non-causal dense attention"
+    elif not causal:
+        # splash LocalMask silently enforces causality, so non-causal windowed
+        # attention must not route there; non-causal dense lacks a kernel win
+        reason = "non-causal attention"
     if reason is not None:
         _fallback_loudly(reason)
         return sdpa(
@@ -285,12 +289,19 @@ def windowed_attention(
     its splash mask, so it branches with `lax.cond` between two static-mask
     kernels (both compile once; one executes per layer). The sdpa path takes
     the traced `dynamic_window` bound directly (window = S on full layers)."""
+    if backend not in ATTENTION_BACKENDS:
+        raise ValueError(
+            f"Unknown attention backend {backend!r}; available: {sorted(ATTENTION_BACKENDS)}"
+        )
     if backend == "flash" and window is not None and _flash_eligible():
         kw = dict(
             causal=causal, scale=scale, segment_ids=segment_ids,
             logits_soft_cap=logits_soft_cap, sinks=sinks,
             block_q=block_q, block_kv=block_kv,
         )
+        if not isinstance(is_sliding, jax.core.Tracer):
+            # static flag (unrolled layer loop): compile exactly one kernel
+            return flash(q, k, v, sliding_window=window if bool(is_sliding) else None, **kw)
         return jax.lax.cond(
             is_sliding,
             lambda: flash(q, k, v, sliding_window=window, **kw),
@@ -337,8 +348,11 @@ def _flash_eligible() -> bool:
         return True
     try:
         # honor an explicitly pinned default device (tests pin CPU while a
-        # TPU is still visible in jax.devices())
+        # TPU is still visible in jax.devices()); jax also accepts platform
+        # strings ('tpu') as jax_default_device
         dd = jax.config.jax_default_device
+        if isinstance(dd, str):
+            return dd == "tpu"
         dev = dd if dd is not None else jax.devices()[0]
         return getattr(dev, "platform", None) == "tpu"
     except Exception:

@@ -93,17 +93,29 @@ def test_windowed_attention_cond_branches(no_fallback):
     """The scanned mixed-layer helper picks the right static mask per branch
     while staying on the kernel."""
     q, k, v = _mk()
+    # static flags (unrolled layer loop): branch picked at trace time
     sliding = windowed_attention(
-        q, k, v, backend="flash", is_sliding=jnp.asarray(True),
-        window=64, dynamic_window=jnp.asarray(64),
+        q, k, v, backend="flash", is_sliding=np.bool_(True),
+        window=64, dynamic_window=np.int32(64),
     )
     full = windowed_attention(
-        q, k, v, backend="flash", is_sliding=jnp.asarray(False),
-        window=64, dynamic_window=jnp.asarray(256),
+        q, k, v, backend="flash", is_sliding=np.bool_(False),
+        window=64, dynamic_window=np.int32(256),
     )
     _close(sliding, sdpa(q, k, v, sliding_window=64))
     _close(full, sdpa(q, k, v))
     assert np.abs(np.asarray(sliding) - np.asarray(full)).max() > 1e-3
+
+    # TRACED flag (scanned layer stack): the lax.cond path must route the
+    # same way when the predicate is a Tracer, as in gemma/gpt-oss scans
+    jitted = jax.jit(
+        lambda flag: windowed_attention(
+            q, k, v, backend="flash", is_sliding=flag,
+            window=64, dynamic_window=jnp.where(flag, 64, 256),
+        )
+    )
+    _close(jitted(jnp.asarray(True)), sdpa(q, k, v, sliding_window=64))
+    _close(jitted(jnp.asarray(False)), sdpa(q, k, v))
 
 
 def test_flash_grads_match_sdpa():
