@@ -263,6 +263,85 @@ def save_hf_checkpoint(
         (path / SAFETENSORS_INDEX).write_text(json.dumps(index, indent=2))
 
 
+class LazyStacked:
+    """A native leaf with a leading stack axis whose rows are fetched on
+    demand (one HF tensor group per row). Lets the loader build the sharded
+    device array shard-by-shard via ``jax.make_array_from_callback`` without
+    ever materializing the stacked leaf on host — the 100B-class ingest
+    story (reference: load_base_model streams per-rank shards,
+    checkpointing.py:429; SURVEY hard-part 3)."""
+
+    def __init__(self, row_fns):
+        self.row_fns = list(row_fns)
+        self._cache: tuple[int, np.ndarray] | None = None  # (idx, row)
+
+    def row(self, i: int) -> np.ndarray:
+        if self._cache is None or self._cache[0] != i:
+            self._cache = (i, np.asarray(self.row_fns[i]()))
+        return self._cache[1]
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (len(self.row_fns), *self.row(0).shape)
+
+    @property
+    def dtype(self):
+        return self.row(0).dtype
+
+    def materialize(self) -> np.ndarray:
+        return np.stack([self.row_fns[i]() for i in range(len(self.row_fns))], 0)
+
+
+def _place_lazy(leaf: "LazyStacked", sharding) -> Any:
+    """Build a sharded jax.Array from a LazyStacked leaf.
+
+    Each row is fetched from the checkpoint EXACTLY ONCE (no per-device
+    refetch when the stack axis is unsharded — the common FSDP/TP layout);
+    row slices go straight to their target device, and per-device shards
+    are stacked ON DEVICE, so host transient memory stays O(one row)."""
+    import jax
+
+    shape = leaf.shape
+    idx_map = sharding.addressable_devices_indices_map(shape)
+    row_ranges = {d: range(*idx[0].indices(shape[0])) for d, idx in idx_map.items()}
+    bufs: dict = {d: [] for d in idx_map}
+    for i in range(shape[0]):
+        row = None
+        for d, idx in idx_map.items():
+            if i in row_ranges[d]:
+                if row is None:
+                    row = leaf.row(i)
+                bufs[d].append(jax.device_put(row[tuple(idx[1:])], d))
+    shards = []
+    for d in idx_map:
+        with jax.default_device(d):
+            shards.append(jax.numpy.stack(bufs[d], 0))
+        bufs[d] = None
+    return jax.make_array_from_single_device_arrays(shape, sharding, shards)
+
+
+def _tree_get(tree: Any, path: tuple) -> Any:
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _tree_set(tree: dict, path: tuple, value: Any) -> None:
+    for k in path[:-1]:
+        tree = tree.setdefault(k, {})
+    tree[path[-1]] = value
+
+
+def assemble_tree(leaves: Iterable[tuple[tuple[str, ...], Any]]) -> dict:
+    """(path, leaf) pairs → nested dict tree (LazyStacked leaves realize)."""
+    out: dict = {}
+    for path, leaf in leaves:
+        if hasattr(leaf, "materialize"):
+            leaf = leaf.materialize()
+        _tree_set(out, path, leaf)
+    return out
+
+
 def load_params_from_hf(
     adapter: Any,
     reader: HFCheckpointReader | str | os.PathLike,
@@ -271,7 +350,12 @@ def load_params_from_hf(
 ) -> Any:
     """Assemble a native param tree from an HF checkpoint, placing each leaf
     on device with its target sharding as it is built (reference:
-    load_base_model, checkpointing.py:429 — but with no per-rank dance)."""
+    load_base_model, checkpointing.py:429 — but with no per-rank dance).
+
+    When the adapter exposes ``iter_from_hf`` (all in-tree adapters do),
+    leaves stream: each is device_put as soon as it is assembled, and
+    LazyStacked leaves never materialize on host at all — peak host memory
+    is O(largest row), not O(model)."""
     import jax
 
     if not isinstance(reader, HFCheckpointReader):
@@ -280,6 +364,26 @@ def load_params_from_hf(
     def get(key: str) -> np.ndarray:
         arr = reader.get_tensor(key)
         return arr.astype(dtype) if dtype is not None else arr
+
+    if hasattr(adapter, "iter_from_hf"):
+        out: dict = {}
+        for path, leaf in adapter.iter_from_hf(get):
+            sh = _tree_get(shardings, path) if shardings is not None else None
+            if isinstance(leaf, LazyStacked):
+                placed = (
+                    _place_lazy(leaf, sh)
+                    if sh is not None
+                    else jax.numpy.asarray(leaf.materialize())
+                )
+            else:
+                placed = (
+                    jax.device_put(leaf, sh)
+                    if sh is not None
+                    else jax.numpy.asarray(leaf)
+                )
+            _tree_set(out, path, placed)
+        reader.close()
+        return out
 
     params = adapter.from_hf(get)
     if shardings is not None:

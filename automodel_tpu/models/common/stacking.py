@@ -1,0 +1,64 @@
+"""Shared layer-stack driver: lax.scan vs unrolled loop, with remat.
+
+Parity: the reference wraps layers in activation-checkpoint modules and
+iterates nn.ModuleLists (distributed/parallelizer.py apply-AC flow). The
+TPU-native form runs the whole stack through one ``lax.scan`` over stacked
+per-layer params (fast compile, one kernel), or an unrolled python loop
+(per-layer static specialization — e.g. a distinct attention mask per
+layer compiles exactly one kernel each).
+
+The unrolled path passes per-layer flags through the CLOSURE as python
+scalars, not traced arguments — ``jax.checkpoint`` would otherwise turn
+them into Tracers and force both branches of any flag-conditional kernel
+selection to compile (see ops/attention.py windowed_attention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def remat_wrap(f: Callable, remat: str) -> Callable:
+    if remat == "full":
+        return jax.checkpoint(f, policy=jax.checkpoint_policies.nothing_saveable)
+    if remat == "selective":
+        return jax.checkpoint(
+            f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    return f
+
+
+def run_layer_stack(
+    layer_fn: Callable,
+    h: Any,
+    layer_params: Any,
+    flags: Optional[dict],
+    *,
+    scan_layers: bool,
+    remat: str,
+    num_layers: int,
+) -> tuple[Any, Any]:
+    """Run ``layer_fn(carry, (layer_slice, flag_slice)) -> (carry, y)`` over
+    a stacked layer tree. Returns (final carry, stacked ys or None).
+
+    ``flags`` values must be numpy arrays (leading layer axis): lax.scan
+    slices them as traced leaves; the unrolled loop extracts STATIC python
+    scalars per layer.
+    """
+    flags = flags or {}
+    if scan_layers:
+        return jax.lax.scan(remat_wrap(layer_fn, remat), h, (layer_params, flags))
+    ys = []
+    for i in range(num_layers):
+        lp = jax.tree.map(lambda x: x[i], layer_params)
+        fl = {k: v[i].item() for k, v in flags.items()}
+        h, y = remat_wrap(
+            lambda carry, lp_, _fl=fl: layer_fn(carry, (lp_, _fl)), remat
+        )(h, lp)
+        ys.append(y)
+    if all(y is None for y in ys):
+        return h, None
+    return h, jax.tree.map(lambda *zs: jnp.stack(zs, 0), *ys)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.models.common.stacking import run_layer_stack
 from automodel_tpu.models.llama.model import (
     ACT_FNS,
     Constrain,
@@ -225,25 +226,12 @@ def forward_hidden(
         out = _layer(cfg, backend, carry, lp, flags, ropes, segment_ids, constrain)
         return out, None
 
-    if backend.remat == "full":
-        wrap = lambda f: jax.checkpoint(f, policy=jax.checkpoint_policies.nothing_saveable)
-    elif backend.remat == "selective":
-        wrap = lambda f: jax.checkpoint(
-            f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        )
-    else:
-        wrap = lambda f: f
     flags = {"window": windows, "use_local_rope": use_local, "is_sliding": use_local}
-    if backend.scan_layers:
-        h, _ = jax.lax.scan(wrap(layer_fn), h, (params["layers"], flags))
-    else:
-        for i in range(cfg.num_layers):
-            lp = jax.tree.map(lambda x: x[i], params["layers"])
-            # flags ride the CLOSURE as python scalars, not the traced args —
-            # jax.checkpoint would otherwise turn them into Tracers and defeat
-            # the one-static-kernel-per-layer selection in windowed_attention
-            fl = {k: v[i].item() for k, v in flags.items()}
-            h, _ = wrap(lambda carry, lp_, _fl=fl: layer_fn(carry, (lp_, _fl)))(h, lp)
+    h, _ = run_layer_stack(
+        layer_fn, h, params["layers"], flags,
+        scan_layers=backend.scan_layers, remat=backend.remat,
+        num_layers=cfg.num_layers,
+    )
     return gemma_rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
 
 

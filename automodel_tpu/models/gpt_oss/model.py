@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.models.common.stacking import run_layer_stack
 from automodel_tpu.models.llama.model import (
     ACT_FNS,
     Constrain,
@@ -169,33 +170,18 @@ def forward_hidden(
         lp, flags = xs
         return _layer(cfg, backend, carry, lp, flags, cos, sin, segment_ids, constrain)
 
-    if backend.remat == "full":
-        wrap = lambda f: jax.checkpoint(f, policy=jax.checkpoint_policies.nothing_saveable)
-    elif backend.remat == "selective":
-        wrap = lambda f: jax.checkpoint(
-            f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        )
-    else:
-        wrap = lambda f: f
     flags = {
         "window": windows,
         "is_sliding": _np.asarray(
             [t == "sliding_attention" for t in cfg.layer_types], bool
         ),
     }
-    if backend.scan_layers:
-        h, auxs = jax.lax.scan(wrap(layer_fn), h, (params["layers"], flags))
-        counts, aux_losses = auxs.expert_counts, auxs.aux_loss
-    else:
-        counts_l, aux_l = [], []
-        for i in range(cfg.num_layers):
-            lp = jax.tree.map(lambda x: x[i], params["layers"])
-            # static per-layer flags via closure (see gemma/model.py)
-            fl = {k: v[i].item() for k, v in flags.items()}
-            h, aux = wrap(lambda carry, lp_, _fl=fl: layer_fn(carry, (lp_, _fl)))(h, lp)
-            counts_l.append(aux.expert_counts)
-            aux_l.append(aux.aux_loss)
-        counts, aux_losses = jnp.stack(counts_l), jnp.stack(aux_l)
+    h, auxs = run_layer_stack(
+        layer_fn, h, params["layers"], flags,
+        scan_layers=backend.scan_layers, remat=backend.remat,
+        num_layers=cfg.num_layers,
+    )
+    counts, aux_losses = auxs.expert_counts, auxs.aux_loss
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
     return h, MoEModelAux(counts, aux_losses.sum())
 

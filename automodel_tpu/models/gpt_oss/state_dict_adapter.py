@@ -48,26 +48,32 @@ class GptOssStateDictAdapter:
         ]
         return plans
 
-    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+    def iter_from_hf(self, get_tensor: Callable[[str], np.ndarray]):
+        """(native path, leaf) pairs, stacked leaves lazy — see
+        checkpoint/hf_io.py LazyStacked."""
+        from automodel_tpu.checkpoint.hf_io import LazyStacked
+
         c = self.config
-        out: dict = {
-            "embed": {"embedding": get_tensor("model.embed_tokens.weight")},
-            "final_norm": {"scale": get_tensor("model.norm.weight")},
-        }
+        yield ("embed", "embedding"), get_tensor("model.embed_tokens.weight")
+        yield ("final_norm", "scale"), get_tensor("model.norm.weight")
         if not c.tie_embeddings:
-            out["lm_head"] = {"kernel": _t(get_tensor("lm_head.weight"))}
-        layers: dict = {}
+            yield ("lm_head", "kernel"), _t(get_tensor("lm_head.weight"))
         for path, tmpl, tr in self._plans():
-            rows = []
-            for i in range(c.num_layers):
-                arr = get_tensor(tmpl.format(i=i))
-                rows.append(_t(arr) if tr else arr)
-            node = layers
-            for kk in path[:-1]:
-                node = node.setdefault(kk, {})
-            node[path[-1]] = np.stack(rows, 0)
-        out["layers"] = layers
-        return out
+            yield ("layers", *path), LazyStacked(
+                [
+                    (
+                        lambda i=i, t=tmpl, tr=tr: (
+                            _t(get_tensor(t.format(i=i))) if tr else get_tensor(t.format(i=i))
+                        )
+                    )
+                    for i in range(c.num_layers)
+                ]
+            )
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
 
     def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
         c = self.config

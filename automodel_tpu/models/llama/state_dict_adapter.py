@@ -108,27 +108,31 @@ class LlamaStateDictAdapter:
         return plans
 
     # -- load ---------------------------------------------------------------
-    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
-        """Assemble the native param tree by pulling HF tensors on demand.
+    def iter_from_hf(
+        self, get_tensor: Callable[[str], np.ndarray]
+    ) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        """Yield (native path, leaf) one finished leaf at a time so the
+        checkpoint layer can ``device_put`` each leaf as it is built — host
+        RAM stays O(largest leaf), never the whole model (reference
+        streams shards similarly in load_base_model, checkpointing.py:429)."""
+        from automodel_tpu.checkpoint.hf_io import LazyStacked
 
-        ``get_tensor(hf_key)`` may stream from safetensors shards; stacked
-        leaves are assembled layer by layer.
-        """
-        out: dict = {}
         for plan in self.leaf_plans():
             if plan.stacked:
-                rows = [
-                    plan.transform(get_tensor(plan.hf_key.format(i=i)))
-                    for i in range(self.config.num_layers)
-                ]
-                leaf = np.stack(rows, axis=0)
+                yield plan.path, LazyStacked(
+                    [
+                        (lambda i=i, p=plan: p.transform(get_tensor(p.hf_key.format(i=i))))
+                        for i in range(self.config.num_layers)
+                    ]
+                )
             else:
-                leaf = plan.transform(get_tensor(plan.hf_key))
-            node = out
-            for k in plan.path[:-1]:
-                node = node.setdefault(k, {})
-            node[plan.path[-1]] = leaf
-        return out
+                yield plan.path, plan.transform(get_tensor(plan.hf_key))
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        """Assemble the full native param tree (non-streaming convenience)."""
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
 
     # -- save ---------------------------------------------------------------
     def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:

@@ -51,57 +51,70 @@ class MoEStateDictAdapter:
         return m
 
     # ---- load --------------------------------------------------------------
-    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+    def iter_from_hf(
+        self, get_tensor: Callable[[str], np.ndarray]
+    ) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        """Yield (native path, leaf) leaf-major — each finished leaf can be
+        ``device_put`` immediately, bounding host RAM to O(largest leaf)
+        (reference: streaming shard load, checkpointing.py:429)."""
+        from automodel_tpu.checkpoint.hf_io import LazyStacked
+
         c = self.config
         moe = c.moe
         nd, L = moe.num_dense_layers, c.num_layers
-        nm = L - nd
 
-        out: dict = {
-            "embed": {"embedding": get_tensor("model.embed_tokens.weight")},
-            "final_norm": {"scale": get_tensor("model.norm.weight")},
-        }
+        yield ("embed", "embedding"), get_tensor("model.embed_tokens.weight")
+        yield ("final_norm", "scale"), get_tensor("model.norm.weight")
         if not c.tie_embeddings:
-            out["lm_head"] = {"kernel": _t(get_tensor("lm_head.weight"))}
+            yield ("lm_head", "kernel"), _t(get_tensor("lm_head.weight"))
 
-        def assemble_stack(layer_ids: list[int]) -> dict:
-            tree: dict = {}
-            for row, i in enumerate(layer_ids):
-                for path, (hf_key, tr) in self._attn_keys(i).items():
+        def attn_leaves(prefix: str, layer_ids: list[int]):
+            # leaf-major LazyStacked: rows fetch on demand, so even the
+            # stacked leaf never needs to exist on host in full
+            for path in self._attn_keys(layer_ids[0]):
+
+                def row(i, path=path):
+                    hf_key, tr = self._attn_keys(i)[path]
                     arr = get_tensor(hf_key)
-                    if tr:
-                        arr = _t(arr)
-                    node = tree
-                    for k in path[:-1]:
-                        node = node.setdefault(k, {})
-                    node.setdefault(path[-1], [None] * len(layer_ids))[row] = arr
-            return tree
+                    return _t(arr) if tr else arr
 
-        def finalize(tree: dict) -> dict:
-            return {
-                k: (finalize(v) if isinstance(v, dict) else np.stack(v, 0))
-                for k, v in tree.items()
-            }
+                yield (prefix, *path), LazyStacked(
+                    [(lambda i=i, r=row: r(i)) for i in layer_ids]
+                )
 
         if nd > 0:
-            dense = assemble_stack(list(range(nd)))
+            yield from attn_leaves("dense_layers", list(range(nd)))
             for name in ("gate_proj", "up_proj", "down_proj"):
-                rows = [
-                    _t(get_tensor(f"model.layers.{i}.mlp.{name}.weight"))
-                    for i in range(nd)
-                ]
-                dense.setdefault("mlp", {})[name] = {"kernel": rows}
-                dense["mlp"][name] = {"kernel": np.stack(rows, 0)}
-            out["dense_layers"] = finalize(
-                {k: v for k, v in dense.items() if k != "mlp"}
-            )
-            out["dense_layers"]["mlp"] = dense["mlp"]
+                yield ("dense_layers", "mlp", name, "kernel"), LazyStacked(
+                    [
+                        (lambda i=i, n=name: _t(get_tensor(f"model.layers.{i}.mlp.{n}.weight")))
+                        for i in range(nd)
+                    ]
+                )
 
         moe_ids = list(range(nd, L))
-        ml = assemble_stack(moe_ids)
-        routers, gate_ups, downs = [], [], []
-        for i in moe_ids:
-            routers.append(_t(get_tensor(f"model.layers.{i}.mlp.gate.weight")))
+        yield from attn_leaves("moe_layers", moe_ids)
+        yield ("moe_layers", "moe", "router", "weight"), LazyStacked(
+            [
+                (lambda i=i: _t(get_tensor(f"model.layers.{i}.mlp.gate.weight")))
+                for i in moe_ids
+            ]
+        )
+        if moe.expert_bias or moe.bias_update_factor > 0:
+            yield ("moe_layers", "moe", "router", "bias"), LazyStacked(
+                [
+                    (
+                        lambda i=i: get_tensor(
+                            f"model.layers.{i}.mlp.gate.e_score_correction_bias"
+                        ).astype(np.float32)
+                    )
+                    for i in moe_ids
+                ]
+            )
+
+        def gate_up_row(i):
+            # [E, D, 2I] for one layer — the unit of host residency for the
+            # model's dominant leaf
             g = [
                 _t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.gate_proj.weight"))
                 for j in range(moe.num_experts)
@@ -110,41 +123,42 @@ class MoEStateDictAdapter:
                 _t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.up_proj.weight"))
                 for j in range(moe.num_experts)
             ]
-            d = [
-                _t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.down_proj.weight"))
-                for j in range(moe.num_experts)
-            ]
-            gate_ups.append(
-                np.stack([np.concatenate([gj, uj], axis=-1) for gj, uj in zip(g, u)], 0)
+            return np.stack(
+                [np.concatenate([gj, uj], axis=-1) for gj, uj in zip(g, u)], 0
             )
-            downs.append(np.stack(d, 0))
-        ml = finalize(ml)
-        ml["moe"] = {
-            "router": {"weight": np.stack(routers, 0)},
-            "experts": {
-                "gate_up": np.stack(gate_ups, 0),
-                "down": np.stack(downs, 0),
-            },
-        }
-        if moe.expert_bias or moe.bias_update_factor > 0:
-            rows = [
-                get_tensor(f"model.layers.{i}.mlp.gate.e_score_correction_bias").astype(
-                    np.float32
-                )
-                for i in moe_ids
-            ]
-            ml["moe"]["router"]["bias"] = np.stack(rows, 0)
+
+        def down_row(i):
+            return np.stack(
+                [
+                    _t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.down_proj.weight"))
+                    for j in range(moe.num_experts)
+                ],
+                0,
+            )
+
+        yield ("moe_layers", "moe", "experts", "gate_up"), LazyStacked(
+            [(lambda i=i: gate_up_row(i)) for i in moe_ids]
+        )
+        yield ("moe_layers", "moe", "experts", "down"), LazyStacked(
+            [(lambda i=i: down_row(i)) for i in moe_ids]
+        )
         if moe.num_shared_experts > 0:
-            sh: dict = {}
             for name in ("gate_proj", "up_proj", "down_proj"):
-                rows = [
-                    _t(get_tensor(f"model.layers.{i}.mlp.shared_experts.{name}.weight"))
-                    for i in moe_ids
-                ]
-                sh[name] = {"kernel": np.stack(rows, 0)}
-            ml["moe"]["shared"] = sh
-        out["moe_layers"] = ml
-        return out
+                yield ("moe_layers", "moe", "shared", name, "kernel"), LazyStacked(
+                    [
+                        (
+                            lambda i=i, n=name: _t(
+                                get_tensor(f"model.layers.{i}.mlp.shared_experts.{n}.weight")
+                            )
+                        )
+                        for i in moe_ids
+                    ]
+                )
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
 
     # ---- save --------------------------------------------------------------
     def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:

@@ -208,8 +208,9 @@ def flash(
 ) -> jnp.ndarray:
     """Pallas TPU flash (splash) attention: causal/sliding-window/soft-cap/
     segments/sinks all stay on the fused kernel; sequences are padded to 128
-    internally. Falls back to sdpa ONLY off-TPU or for non-causal dense
-    attention, and logs loudly when it does."""
+    internally. Falls back to sdpa ONLY off-TPU or for ANY non-causal
+    attention (splash's LocalMask enforces causality, so even non-causal
+    windowed must not route there), and logs loudly when it does."""
     h = q.shape[-1]
     reason = None
     if not _flash_eligible():

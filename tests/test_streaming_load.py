@@ -1,0 +1,155 @@
+"""Streaming HF checkpoint load (VERDICT weak #6): leaves device_put as the
+adapter yields them, stacked leaves assembled shard-by-shard without ever
+materializing on host. Reference semantics: load_base_model streams hub
+safetensors shards into sharded params (checkpointing.py:429)."""
+
+import tracemalloc
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from automodel_tpu.checkpoint.hf_io import (
+    HFCheckpointReader,
+    LazyStacked,
+    load_params_from_hf,
+    save_hf_checkpoint,
+)
+from automodel_tpu.models.common.config import TransformerConfig
+from automodel_tpu.models.llama.state_dict_adapter import LlamaStateDictAdapter
+
+
+def _tiny_cfg(layers=2, hidden=16):
+    return TransformerConfig(
+        vocab_size=32,
+        hidden_size=hidden,
+        intermediate_size=hidden * 2,
+        num_layers=layers,
+        num_heads=2,
+        num_kv_heads=2,
+        head_dim=hidden // 2,
+    )
+
+
+def _hf_sd(cfg, rng):
+    adapter = LlamaStateDictAdapter(cfg)
+    return {
+        k: rng.standard_normal(_hf_shape(cfg, k)).astype(np.float32)
+        for k in adapter.hf_keys()
+    }
+
+
+def _hf_shape(cfg, key):
+    d, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    kvd = cfg.num_kv_heads * cfg.head_dim
+    if "embed_tokens" in key or key == "lm_head.weight":
+        return (v, d)
+    if "q_proj" in key or "o_proj" in key:
+        return (d, d)
+    if "k_proj" in key or "v_proj" in key:
+        return (kvd, d)
+    if "gate_proj" in key or "up_proj" in key:
+        return (i, d)
+    if "down_proj" in key:
+        return (d, i)
+    return (d,)  # norms
+
+
+def test_iter_from_hf_matches_from_hf():
+    cfg = _tiny_cfg()
+    rng = np.random.default_rng(0)
+    sd = _hf_sd(cfg, rng)
+    adapter = LlamaStateDictAdapter(cfg)
+    full = adapter.from_hf(lambda k: sd[k])
+    from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+    streamed = assemble_tree(adapter.iter_from_hf(lambda k: sd[k]))
+    jax.tree.map(np.testing.assert_array_equal, full, streamed)
+
+
+def test_lazy_stacked_rows_and_materialize():
+    calls = []
+
+    def mk(i):
+        def f():
+            calls.append(i)
+            return np.full((2, 3), i, np.float32)
+
+        return f
+
+    leaf = LazyStacked([mk(i) for i in range(4)])
+    assert leaf.shape == (4, 2, 3)
+    assert leaf.dtype == np.float32
+    # row cache: repeated access to the same row fetches once
+    calls.clear()
+    leaf.row(2)
+    leaf.row(2)
+    assert calls == [2]
+    np.testing.assert_array_equal(leaf.materialize()[3], np.full((2, 3), 3))
+
+
+def test_streaming_load_places_sharded(tmp_path, devices8):
+    from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    cfg = _tiny_cfg(layers=4)
+    rng = np.random.default_rng(1)
+    sd = _hf_sd(cfg, rng)
+    save_hf_checkpoint(tmp_path, list(sd.items()))
+
+    ctx = build_mesh(MeshConfig(dp_shard=4, tp=2), devices=devices8)
+    adapter = LlamaStateDictAdapter(cfg)
+    # build a shardings tree matching the adapter layout
+    full = adapter.from_hf(lambda k: sd[k])
+    sh3 = ctx.sharding(None, "fsdp", "tensor")
+    shardings = jax.tree.map(
+        lambda leaf: sh3 if np.ndim(leaf) == 3 else ctx.sharding(),
+        full,
+    )
+    params = load_params_from_hf(adapter, tmp_path, shardings=shardings)
+    # every leaf is a committed jax.Array with the requested sharding
+    q = params["layers"]["attn"]["q_proj"]["kernel"]
+    assert isinstance(q, jax.Array)
+    assert q.sharding == sh3
+    assert len(q.addressable_shards) == 8
+    # values identical to the non-streaming assembly
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b), params, full
+    )
+
+
+def test_streaming_load_bounds_host_memory(tmp_path, devices8):
+    """The load's TRANSIENT host allocation (peak minus what remains resident
+    — on the CPU backend shard buffers stay host-tracked, on TPU they move to
+    HBM) stays within ~2 largest leaves. The old whole-tree assembly would
+    put the full ~21 MB model in the transient."""
+    from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    cfg = _tiny_cfg(layers=8, hidden=256)
+    rng = np.random.default_rng(2)
+    sd = _hf_sd(cfg, rng)
+    total_bytes = sum(a.nbytes for a in sd.values())
+    largest_leaf = 8 * cfg.intermediate_size * cfg.hidden_size * 4  # stacked mlp
+    save_hf_checkpoint(tmp_path, list(sd.items()))
+    del sd
+
+    ctx = build_mesh(MeshConfig(dp_shard=8), devices=devices8)
+    adapter = LlamaStateDictAdapter(cfg)
+    # shard the layer-stack axis so each device shard pulls only its rows
+    sh3 = ctx.sharding("fsdp", None, None)
+    reader = HFCheckpointReader(tmp_path)
+    abstract = adapter.from_hf(lambda k: np.empty(reader.info(k)[1], np.float32))
+    reader.close()
+    shardings = jax.tree.map(
+        lambda leaf: sh3 if np.ndim(leaf) == 3 else ctx.sharding(),
+        abstract,
+    )
+    del abstract
+
+    tracemalloc.start()
+    params = load_params_from_hf(adapter, tmp_path, shardings=shardings)
+    cur, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    transient = peak - cur
+    assert transient < 2 * largest_leaf, (transient, largest_leaf, total_bytes)
+    assert params["layers"]["mlp"]["down_proj"]["kernel"].shape[0] == 8
