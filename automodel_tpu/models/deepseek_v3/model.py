@@ -244,6 +244,15 @@ class DeepseekV3ForCausalLM:
             return params["embed"]["embedding"].T
         return params["lm_head"]["kernel"]
 
+    # hooks for parallel/pp.py (MLA block + decoupled-rope dim)
+    @property
+    def pp_attn_block(self):
+        return mla_block
+
+    @property
+    def pp_rope_dim(self):
+        return self.config.qk_rope_head_dim
+
     @property
     def sharding_rules(self) -> list[tuple[str, tuple]]:
         return SHARDING_RULES

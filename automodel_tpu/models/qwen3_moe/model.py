@@ -276,6 +276,14 @@ class MoEForCausalLM:
             return params["embed"]["embedding"].T
         return params["lm_head"]["kernel"]
 
+    # hooks for parallel/pp.py: the per-layer attention block and rope dim
+    # the pipelined forward must reuse
+    @property
+    def pp_attn_block(self):
+        return attention_block
+
+    pp_rope_dim = None
+
     @property
     def sharding_rules(self) -> list[tuple[str, tuple]]:
         return SHARDING_RULES

@@ -12,9 +12,18 @@ the whole pipeline (transpose of ppermute reverses the ring), so the backward
 wavefront needs no hand-written schedule, and XLA overlaps the ppermute with
 stage compute.
 
-Bubble: (pp-1)/(M+pp-1) with M microbatches — choose M >= 4·pp. The
-interleaved/zero-bubble schedules of the reference map to circular stage
-assignment here (planned: num_repeats > 1 slicing the layer axis round-robin).
+Outputs leave the pipeline SHARDED on pp (out_specs lead with "pp"); the
+caller slices the last stage's entry, which lowers to a broadcast from one
+rank instead of the full-activation psum an earlier revision paid per step
+(reference keeps loss on the last stage the same way, train_ft.py:1365).
+
+MoE stacks pipeline too: the stage function may return (y, stage_aux) and
+per-stage aux (expert counts, aux losses) accumulates across microbatch
+ticks under a validity mask, coming back [pp, L/pp, ...] for reassembly —
+the composition the reference reaches via PP+EP parallelize_fn per stage
+(moe/parallelizer.py:300).
+
+Bubble: (pp-1)/(M+pp-1) with M microbatches — choose M >= 4·pp.
 """
 
 from __future__ import annotations
@@ -31,18 +40,32 @@ from automodel_tpu.parallel.mesh import MeshContext
 
 
 def spmd_pipeline(
-    stage_fn: Callable,  # (stage_params, x [mb,...], aux pytree) -> y [mb,...]
+    stage_fn: Callable,  # (stage_params, x [mb,...], aux) -> y | (y, stage_aux)
     stage_params: Any,  # pytree, leaves [L, ...] with L divisible by pp
     inputs: jnp.ndarray,  # [M, mb, ...] microbatched activations
     aux: Any,  # pytree of [M, ...] per-microbatch side inputs (cos/sin/seg)
     mesh_ctx: MeshContext,
-) -> jnp.ndarray:
-    """Run the stacked-layer decoder as a pp-stage pipeline; returns [M, mb, ...]."""
+    has_stage_aux: bool = False,
+) -> Any:
+    """Run the stacked-layer decoder as a pp-stage pipeline.
+
+    Returns [M, mb, ...] outputs, or (outputs, global_aux) when
+    ``has_stage_aux`` — global_aux leaves lead with the pp axis
+    ([pp, L/pp, ...]) for the caller to reassemble into [L, ...].
+    """
     mesh = mesh_ctx.mesh
     pp = mesh.shape["pp"]
     if pp == 1:
-        ys = jax.lax.map(lambda args: stage_fn(stage_params, args[0], args[1]), (inputs, aux))
-        return ys
+        if has_stage_aux:
+            ys, auxs = jax.lax.map(
+                lambda args: stage_fn(stage_params, args[0], args[1]), (inputs, aux)
+            )
+            # sum microbatch contributions; prepend the pp=1 stage axis
+            auxs = jax.tree.map(lambda a: a.sum(0)[None].astype(jnp.float32), auxs)
+            return ys, auxs
+        return jax.lax.map(
+            lambda args: stage_fn(stage_params, args[0], args[1]), (inputs, aux)
+        )
     M = inputs.shape[0]
     compute_dtype = inputs.dtype
 
@@ -55,43 +78,111 @@ def spmd_pipeline(
 
     def pp_fn(sp, inp, auxb):
         # local views: sp leaves [L/pp, ...]; inp/auxb full [M, ...]
-        sp = jax.tree.map(lambda x: x, sp)
         p = jax.lax.axis_index("pp")
         n_ticks = M + pp - 1
         state0 = jnp.zeros(inp.shape[1:], compute_dtype)
 
-        def tick(state, t):
+        a0 = jax.tree.map(lambda b: b[0], auxb)
+        if has_stage_aux:
+            _, aux_shape = jax.eval_shape(stage_fn, sp, state0, a0)
+            acc0 = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), aux_shape)
+        else:
+            acc0 = None
+
+        def tick(carry, t):
+            state, acc = carry
             in_idx = jnp.clip(t, 0, M - 1)
             mb_idx = jnp.clip(t - p, 0, M - 1)
             x_in = jnp.where(p == 0, inp[in_idx].astype(compute_dtype), state)
             a = jax.tree.map(lambda b: b[mb_idx], auxb)
-            y = stage_fn(sp, x_in, a)
-            y_out = jnp.where(
-                jnp.logical_and(p == pp - 1, t >= pp - 1), y, jnp.zeros_like(y)
-            )
+            if has_stage_aux:
+                y, saux = stage_fn(sp, x_in, a)
+                # rank p holds a real microbatch only for ticks [p, p+M)
+                valid = jnp.logical_and(t >= p, t < p + M)
+                acc = jax.tree.map(
+                    lambda A, s: A + jnp.where(valid, s.astype(jnp.float32), 0.0),
+                    acc,
+                    saux,
+                )
+            else:
+                y = stage_fn(sp, x_in, a)
             state_next = jax.lax.ppermute(
                 y, "pp", [(i, (i + 1) % pp) for i in range(pp)]
             )
-            return state_next, y_out
+            return (state_next, acc), y
 
-        _, ys = jax.lax.scan(tick, state0, jnp.arange(n_ticks))
-        # only the last stage produced real outputs; make them global.
-        # (psum over pp = one activation all-reduce per step; the planned
-        # refinement keeps loss computation on the last stage instead.)
-        # f32 ring: XLA CPU's AllReducePromotion crashes on bf16 psum, and on
-        # TPU f32 reduction of bf16 zeros+values is exact anyway.
-        ys = jax.lax.psum(ys.astype(jnp.float32), "pp").astype(ys.dtype)
-        return ys[pp - 1 :]
+        (_, acc), ys = jax.lax.scan(tick, (state0, acc0), jnp.arange(n_ticks))
+        # each rank returns its own tick outputs, sharded on a leading pp
+        # axis; only rank pp-1's row holds final-stage activations and the
+        # caller's slice of that row lowers to a broadcast from one rank —
+        # no full-activation psum.
+        ys = ys[pp - 1 :][None]
+        if has_stage_aux:
+            return ys, jax.tree.map(lambda A: A[None], acc)
+        return ys
 
+    out_specs = (P("pp"), P("pp")) if has_stage_aux else P("pp")
     mapped = shard_map(
         pp_fn,
         mesh=mesh,
         in_specs=(param_specs, P(), P()),
-        out_specs=P(),
+        out_specs=out_specs,
         axis_names={"pp"},
         check_vma=False,
     )
-    return mapped(stage_params, inputs, aux)
+    if has_stage_aux:
+        ys, acc = mapped(stage_params, inputs, aux)
+        return ys[pp - 1], acc
+    return mapped(stage_params, inputs, aux)[pp - 1]
+
+
+_logged_a2a_pp = False
+
+
+def _log_a2a_pp_fallback():
+    global _logged_a2a_pp
+    if not _logged_a2a_pp:
+        _logged_a2a_pp = True
+        import logging
+
+        logging.getLogger(__name__).info(
+            "experts='a2a' inside pipeline stages runs as the dropless ragged "
+            "path with GSPMD-chosen ep collectives (nested shard_map over ep "
+            "is not possible inside the pp-manual region); no tokens drop."
+        )
+
+
+def _maybe_remat(fn, backend):
+    if backend.remat in ("full", "selective"):
+        pol = (
+            jax.checkpoint_policies.nothing_saveable
+            if backend.remat == "full"
+            else jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+        return jax.checkpoint(fn, policy=pol)
+    return fn
+
+
+def _microbatch_plumbing(model, params, input_ids, position_ids, M):
+    """Shared embed/rope/split prep for the pipelined forwards."""
+    from automodel_tpu.ops.rope import rope_table
+
+    cfg, backend = model.config, model.backend
+    cd = backend.compute_jnp_dtype
+    B, S = input_ids.shape
+    assert B % M == 0, f"batch {B} not divisible by n_microbatches {M}"
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+        )
+    h = params["embed"]["embedding"].astype(cd)[input_ids]
+    rope_dim = getattr(model, "pp_rope_dim", None) or cfg.head_dim
+    cos, sin = rope_table(position_ids, rope_dim, cfg.rope)
+
+    def split(x):
+        return None if x is None else x.reshape(M, B // M, *x.shape[1:])
+
+    return h, cos, sin, split
 
 
 @dataclasses.dataclass
@@ -141,26 +232,15 @@ class PipelinedCausalLM:
                constrain=None):
         from automodel_tpu.models.llama.model import decoder_layer
         from automodel_tpu.ops.norms import rms_norm
-        from automodel_tpu.ops.rope import rope_table
 
         cfg, backend = self.model.config, self.model.backend
         constrain = constrain or (lambda x, s: x)
-        cd = backend.compute_jnp_dtype
-        B, S = input_ids.shape
         M = self.n_microbatches
-        assert B % M == 0, f"batch {B} not divisible by n_microbatches {M}"
-        if position_ids is None:
-            position_ids = jnp.broadcast_to(
-                jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
-            )
-
-        h = params["embed"]["embedding"].astype(cd)[input_ids]
+        B, S = input_ids.shape
+        h, cos, sin, split = _microbatch_plumbing(
+            self.model, params, input_ids, position_ids, M
+        )
         h = constrain(h, ("batch", "seq", None))
-        cos, sin = rope_table(position_ids, cfg.head_dim, cfg.rope)
-
-        def split(x):
-            return None if x is None else x.reshape(M, B // M, *x.shape[1:])
-
         aux = {"cos": split(cos), "sin": split(sin)}
         if segment_ids is not None:
             aux["seg"] = split(segment_ids)
@@ -173,15 +253,7 @@ class PipelinedCausalLM:
                 )                     # inside the manual region; GSPMD infers
                 return out, None
 
-            fn = layer
-            if backend.remat in ("full", "selective"):
-                pol = (
-                    jax.checkpoint_policies.nothing_saveable
-                    if backend.remat == "full"
-                    else jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                )
-                fn = jax.checkpoint(layer, policy=pol)
-            out, _ = jax.lax.scan(fn, x, sp)
+            out, _ = jax.lax.scan(_maybe_remat(layer, backend), x, sp)
             return out
 
         hm = spmd_pipeline(
@@ -200,15 +272,186 @@ class PipelinedCausalLM:
         return logits
 
 
+@dataclasses.dataclass
+class PipelinedMoECausalLM:
+    """PP for the MoE families (Qwen3-MoE shaped, incl. DeepSeek-V3 MLA).
+
+    The routed-MoE stack pipelines over pp (EP/TP/FSDP stay GSPMD-managed
+    inside each stage); the short dense prefix (DeepSeek
+    first_k_dense_replace) runs GSPMD outside the pipeline on every rank,
+    like embed/lm_head. Per-layer gate aux (expert counts, aux loss) rides
+    the tick scan under a validity mask and reassembles to the same
+    MoEModelAux the unpipelined forward returns — so aux-free bias updates
+    and load-balance metrics work unchanged under PP (reference:
+    PP+EP composition via per-stage parallelize_fn, moe/parallelizer.py:300).
+    """
+
+    model: Any  # MoEForCausalLM | DeepseekV3ForCausalLM
+    mesh_ctx: MeshContext
+    n_microbatches: int = 4
+
+    @property
+    def config(self):
+        return self.model.config
+
+    @property
+    def backend(self):
+        return self.model.backend
+
+    def init(self, key: jax.Array) -> dict:
+        return self.model.init(key)
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        return self.model.lm_head(params)
+
+    def post_step_fn(self, params: dict, extras: dict) -> dict:
+        return self.model.post_step_fn(params, extras)
+
+    _NONSTACK = ("embed/", "lm_head/", "final_norm/")
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        """dense_layers stay replicated over pp (they run outside the
+        pipeline); moe_layers leaves get their stack dim sharded on
+        `stage`. Family patterns are normalized so both prefixed variants
+        match ('layers/attn/...' → 'attn/...')."""
+        rules: list[tuple[str, tuple]] = []
+        for pat, spec in self.model.sharding_rules:
+            if any(s in pat for s in self._NONSTACK):
+                rules.append((pat, spec))
+                continue
+            core = pat[len("layers/"):] if pat.startswith("layers/") else pat
+            rules.append((f"^dense_layers/.*{core}", spec))
+            rules.append((f"^moe_layers/.*{core}", ("stage", *tuple(spec)[1:])))
+        return rules
+
+    def hidden(self, params, input_ids, **kw):
+        # same contract as the wrapped MoE models: (hidden, MoEModelAux) —
+        # the fused_linear_ce loss path consumes the aux from hidden()
+        return self._forward(params, input_ids, **kw)
+
+    def _forward(self, params, input_ids, position_ids=None, segment_ids=None,
+                 constrain=None):
+        from automodel_tpu.models.llama.model import ACT_FNS
+        from automodel_tpu.moe.layer import moe_block
+        from automodel_tpu.ops.norms import rms_norm
+
+        cfg, backend = self.model.config, self.model.backend
+        moe = cfg.moe
+        constrain = constrain or (lambda x, s: x)
+        attn_block = self.model.pp_attn_block
+        M = self.n_microbatches
+        B, S = input_ids.shape
+        h, cos, sin, split = _microbatch_plumbing(
+            self.model, params, input_ids, position_ids, M
+        )
+        h = constrain(h, ("batch", "seq", None))
+
+        # dense prefix outside the pipeline (GSPMD on every rank)
+        if "dense_layers" in params:
+            def dense_fn(carry, lp):
+                hh = attn_block(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
+                x = rms_norm(hh, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+                act = ACT_FNS[cfg.act]
+                mlp = (
+                    act(x @ lp["mlp"]["gate_proj"]["kernel"].astype(x.dtype))
+                    * (x @ lp["mlp"]["up_proj"]["kernel"].astype(x.dtype))
+                ) @ lp["mlp"]["down_proj"]["kernel"].astype(x.dtype)
+                return constrain(hh + mlp, ("batch", "seq", None)), None
+
+            h, _ = jax.lax.scan(_maybe_remat(dense_fn, backend), h, params["dense_layers"])
+
+        aux_in = {"cos": split(cos), "sin": split(sin)}
+        if segment_ids is not None:
+            aux_in["seg"] = split(segment_ids)
+
+        # the a2a token-exchange dispatcher is itself a shard_map over ep/tp,
+        # and jax only allows nested shard_map over axes ALREADY manual — so
+        # inside the pp-manual region it cannot run. Use the dropless ragged
+        # path instead: XLA partitions its grouped GEMMs over the auto ep
+        # axis (no token drops; explicit a2a-in-PP needs nested manual axes)
+        experts_backend = backend.experts
+        if experts_backend == "a2a":
+            _log_a2a_pp_fallback()
+            experts_backend = "ragged"
+
+        def stage_fn(sp, x, a):
+            def layer(carry, lp):
+                hh = attn_block(
+                    cfg, backend, carry, lp, a["cos"], a["sin"], a.get("seg"),
+                    lambda t, s: t,
+                )
+                xx = rms_norm(hh, lp["post_attn_norm"]["scale"], cfg.rms_eps)
+                out, aux = moe_block(
+                    xx,
+                    lp["moe"],
+                    moe,
+                    ACT_FNS[cfg.act],
+                    experts_backend=experts_backend,
+                    fake_gate=backend.fake_balanced_gate,
+                    constrain=lambda t, s: t,
+                )
+                return hh + out, aux
+
+            out, auxs = jax.lax.scan(_maybe_remat(layer, backend), x, sp)
+            return out, auxs  # auxs leaves [L/pp, ...]
+
+        hm, acc = spmd_pipeline(
+            stage_fn, params["moe_layers"], split(h), aux_in, self.mesh_ctx,
+            has_stage_aux=True,
+        )
+        h = hm.reshape(B, S, -1)
+        h = constrain(h, ("batch", "seq", None))
+        h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
+
+        # acc leaves [pp, L/pp, ...] summed over microbatches → [L_moe, ...];
+        # aux losses were per-microbatch means, so average over M
+        from automodel_tpu.models.qwen3_moe.model import MoEModelAux
+
+        counts = acc.expert_counts.reshape(-1, *acc.expert_counts.shape[2:])
+        aux_loss = acc.aux_loss.reshape(-1).sum() / self.n_microbatches
+        return h, MoEModelAux(counts, aux_loss)
+
+    def __call__(self, params, input_ids, **kw):
+        h, aux = self._forward(params, input_ids, **kw)
+        logits = h @ self.model.lm_head(params).astype(h.dtype)
+        cfg = self.model.config
+        if cfg.logits_soft_cap is not None:
+            logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
+        return logits, aux
+
+
 def maybe_pipeline(model: Any, mesh_ctx: Optional[MeshContext], n_microbatches: int = 4):
-    """Wrap `model` for PP when the mesh has pp > 1 (dense families only for
-    now; MoE+PP composition is tracked work)."""
+    """Wrap `model` for PP when the mesh has pp > 1. Dense llama-family and
+    MoE (qwen3-moe / deepseek-v3) stacks are supported; mixed-window stacks
+    (gemma/gpt-oss) still raise."""
     if mesh_ctx is None or mesh_ctx.pp_size == 1:
         return model
-    if not hasattr(model, "config") or getattr(model.config, "moe", None) is not None:
-        raise NotImplementedError("PP currently supports dense stacked-layer models")
-    if model.config.num_layers % mesh_ctx.pp_size != 0:
+    if not hasattr(model, "config"):
+        raise NotImplementedError("PP needs a stacked-layer causal LM")
+    cfg = model.config
+    if getattr(cfg, "moe", None) is not None:
+        if not hasattr(model, "pp_attn_block"):
+            raise NotImplementedError(
+                f"PP for {type(model).__name__} not supported yet (per-layer "
+                "static attention windows don't slice across pp ranks)"
+            )
+        n_moe = cfg.num_layers - cfg.moe.num_dense_layers
+        if n_moe % mesh_ctx.pp_size != 0:
+            raise ValueError(
+                f"moe layer count {n_moe} must divide pp={mesh_ctx.pp_size}"
+            )
+        return PipelinedMoECausalLM(model, mesh_ctx, n_microbatches)
+    from automodel_tpu.models.llama.model import LlamaForCausalLM
+
+    if not isinstance(model, LlamaForCausalLM):
+        # e.g. gemma: homogeneous llama layers is what the dense stage runs
+        raise NotImplementedError(
+            f"PP for {type(model).__name__} not supported yet (the dense "
+            "pipeline stage runs llama-family decoder layers)"
+        )
+    if cfg.num_layers % mesh_ctx.pp_size != 0:
         raise ValueError(
-            f"num_layers {model.config.num_layers} must divide pp={mesh_ctx.pp_size}"
+            f"num_layers {cfg.num_layers} must divide pp={mesh_ctx.pp_size}"
         )
     return PipelinedCausalLM(model, mesh_ctx, n_microbatches)

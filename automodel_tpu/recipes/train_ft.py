@@ -64,7 +64,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             k: dist.get(k, -1 if k == "dp_shard" else 1)
             for k in ("dp_replicate", "dp_shard", "tp", "cp", "pp", "ep")
         }
-        self.mesh_ctx = build_mesh(MeshConfig(**mesh_degrees))
+        # distributed.platform pins the device platform — e.g. `cpu` to run
+        # SPMD recipes on virtual host devices (the reference's gloo-backend
+        # CPU test path, init_utils.py:136-140)
+        platform = dist.get("platform", None)
+        devices = jax.devices(platform) if platform else None
+        self.mesh_ctx = build_mesh(MeshConfig(**mesh_degrees), devices=devices)
         logger.info("mesh: %s", dict(self.mesh_ctx.mesh.shape))
 
         # model

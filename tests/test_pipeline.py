@@ -92,3 +92,112 @@ def test_pp_requires_divisible_layers(devices8):
     bad = dict(HF, num_hidden_layers=3)
     with pytest.raises(ValueError, match="divide"):
         auto_model.from_config(bad, ctx, FP32, seed=0)
+
+# ---- MoE + PP composition (VERDICT #105: was explicitly unsupported) --------
+
+MOE_HF = {
+    "architectures": ["Qwen3MoeForCausalLM"],
+    "model_type": "qwen3_moe",
+    "vocab_size": 128,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "moe_intermediate_size": 32,
+    "num_hidden_layers": 4,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+    "num_experts": 4,
+    "num_experts_per_tok": 2,
+    "norm_topk_prob": True,
+    # nonzero so the aux-loss parity assertion actually exercises the
+    # validity-masked accumulation + /M averaging in spmd_pipeline
+    "router_aux_loss_coef": 0.01,
+}
+
+
+@pytest.fixture(scope="module")
+def moe_pp_setup(devices8):
+    # pp=2 x ep=2 x tp=2: the 3-way composition the reference reaches via
+    # per-stage parallelize_fn (moe/parallelizer.py:300)
+    ctx = build_mesh(MeshConfig(pp=2, dp_shard=2, ep=2, tp=2), devices=devices8)
+    auto_pp = auto_model.from_config(MOE_HF, ctx, {**FP32, "pp_microbatches": 4}, seed=0)
+    auto_ref = auto_model.from_config(MOE_HF, None, FP32, seed=0)
+    return ctx, auto_pp, auto_ref
+
+
+def test_moe_pp_forward_and_aux_match(moe_pp_setup):
+    ctx, auto_pp, auto_ref = moe_pp_setup
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(0, 128, size=(8, 16)), jnp.int32
+    )
+    out_pp, aux_pp = jax.jit(auto_pp.model.__call__)(auto_pp.params, ids)
+    out_ref, aux_ref = auto_ref.model(auto_ref.params, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_pp), np.asarray(out_ref), atol=2e-4, rtol=2e-3
+    )
+    # per-layer expert counts and summed aux loss survive the pipeline
+    np.testing.assert_allclose(
+        np.asarray(aux_pp.expert_counts),
+        np.asarray(aux_ref.expert_counts),
+        atol=1e-3,
+    )
+    np.testing.assert_allclose(
+        float(aux_pp.aux_loss), float(aux_ref.aux_loss), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_moe_pp_grads_match(moe_pp_setup):
+    ctx, auto_pp, auto_ref = moe_pp_setup
+    ids = jnp.asarray(
+        np.random.default_rng(3).integers(0, 128, size=(8, 16)), jnp.int32
+    )
+
+    def loss(model):
+        def f(p):
+            logits, aux = model(p, ids)
+            return logits.astype(jnp.float32).sum() + aux.aux_loss.astype(jnp.float32)
+
+        return f
+
+    g_pp = jax.jit(jax.grad(loss(auto_pp.model)))(auto_pp.params)
+    g_ref = jax.grad(loss(auto_ref.model))(auto_ref.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3
+        ),
+        jax.device_get(g_pp),
+        jax.device_get(g_ref),
+    )
+
+
+def test_pp4_forward_matches(devices8):
+    ctx = build_mesh(MeshConfig(pp=4, dp_shard=2), devices=devices8)
+    auto_pp = auto_model.from_config(HF, ctx, {**FP32, "pp_microbatches": 8}, seed=0)
+    auto_ref = auto_model.from_config(HF, None, FP32, seed=0)
+    ids = jnp.asarray(
+        np.random.default_rng(4).integers(0, 128, size=(8, 16)), jnp.int32
+    )
+    out_pp = np.asarray(jax.jit(auto_pp.model.__call__)(auto_pp.params, ids))
+    out_ref = np.asarray(auto_ref.model(auto_ref.params, ids))
+    np.testing.assert_allclose(out_pp, out_ref, atol=2e-4, rtol=2e-3)
+
+
+def test_pp_no_full_activation_psum(pp_setup):
+    """The pipeline output leaves the shard_map sharded on pp and is sliced —
+    the compiled HLO must not contain an all-reduce over full [B,S,D]
+    activations (VERDICT weak #4)."""
+    ctx, auto_pp, _ = pp_setup
+    ids = jnp.asarray(np.zeros((8, 16)), jnp.int32)
+    compiled = jax.jit(auto_pp.model.__call__).lower(auto_pp.params, ids).compile()
+    hlo = compiled.as_text()
+    import re
+
+    # the old psum was rank-4 [ticks, mb, S, D]; TP's legitimate per-layer
+    # partial-sum all-reduces are rank-3 [mb, S, D] and stay
+    bad = []
+    for m in re.finditer(r"all-reduce[^=\n]*=\s*\(?(\S+?)[\s,)]", hlo):
+        shape = m.group(1)
+        dims = [int(d) for d in re.findall(r"(?<=[\[,])\d+(?=[\],])", shape)]
+        if len(dims) >= 4 and np.prod(dims) >= 4 * 2 * 16 * 64:
+            bad.append(m.group(0))
+    assert not bad, bad
