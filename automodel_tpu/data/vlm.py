@@ -1,0 +1,200 @@
+"""VLM datasets + collation.
+
+Parity: reference datasets/vlm/ (collate_fns.py — per-family collators;
+datasets.py — dataset zoo; recipes/vlm/finetune.py processor-based path).
+TPU-native shape conventions: the collator emits `pixel_values` as ONE
+stacked [N_images_total, C, H, W] array per batch (images across the batch
+concatenate in row-major sample order, matching the model's scatter of
+projected image features over image-token runs) alongside the usual padded
+input_ids/labels/position_ids.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from automodel_tpu.data.collators import IGNORE_INDEX, default_collater
+
+
+_warned_answer_span = False
+
+
+def _warn_answer_span_once():
+    global _warned_answer_span
+    if not _warned_answer_span:
+        _warned_answer_span = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ProcessorVLMDataset: could not locate the tokenized answer span "
+            "inside the templated sequence; training on the FULL sequence for "
+            "such samples (prompt tokens unmasked)."
+        )
+
+
+def vlm_collater(
+    examples: Iterable[dict[str, Any]],
+    pad_token_id: int = 0,
+    pad_seq_len_divisible: int | None = None,
+    max_seq_len: int | None = None,
+) -> dict[str, np.ndarray]:
+    """default_collater + stacked pixel_values (reference:
+    datasets/vlm/collate_fns.py default path)."""
+    examples = list(examples)
+    batch = default_collater(
+        examples,
+        pad_token_id=pad_token_id,
+        pad_seq_len_divisible=pad_seq_len_divisible,
+        max_seq_len=max_seq_len,
+    )
+    pvs = []
+    for e in examples:
+        pv = np.asarray(e["pixel_values"], np.float32)
+        pvs.append(pv[None] if pv.ndim == 3 else pv)  # [N_i, C, H, W]
+    batch["pixel_values"] = np.concatenate(pvs, axis=0)
+    return batch
+
+
+class MockVLMDataset:
+    """Deterministic random VLM samples (reference: mock datasets pattern,
+    datasets/llm/mock*.py): each sample is text with one
+    BOI + mm_tokens_per_image image tokens + EOI run and a random image.
+    Image-token positions carry IGNORE_INDEX labels."""
+
+    def __init__(
+        self,
+        vocab_size: int = 128,
+        seq_length: int = 64,
+        image_size: int = 28,
+        mm_tokens_per_image: int = 4,
+        image_token_id: int = 120,
+        boi_token_id: int = 121,
+        eoi_token_id: int = 122,
+        num_samples: int = 256,
+        seed: int = 0,
+    ):
+        self.vocab_size = vocab_size
+        self.seq_length = seq_length
+        self.image_size = image_size
+        self.mm_tokens = mm_tokens_per_image
+        self.image_token_id = image_token_id
+        self.boi = boi_token_id
+        self.eoi = eoi_token_id
+        self.num_samples = num_samples
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        rng = np.random.default_rng(self.seed * 9176 + idx)
+        ids = rng.integers(0, min(100, self.vocab_size), size=self.seq_length)
+        start = int(rng.integers(1, max(2, self.seq_length - self.mm_tokens - 3)))
+        ids[start] = self.boi
+        ids[start + 1 : start + 1 + self.mm_tokens] = self.image_token_id
+        ids[start + 1 + self.mm_tokens] = self.eoi
+        labels = np.where(ids == self.image_token_id, IGNORE_INDEX, ids)
+        pixels = rng.standard_normal((3, self.image_size, self.image_size))
+        return {
+            "input_ids": ids.tolist(),
+            "labels": labels.tolist(),
+            "pixel_values": pixels.astype(np.float32),
+        }
+
+    def __iter__(self) -> Iterator[dict]:
+        for i in range(len(self)):
+            yield self[i]
+
+
+class ProcessorVLMDataset:
+    """Processor-based image+text SFT dataset (reference:
+    recipes/vlm/finetune.py:469 + datasets/vlm/datasets.py — HF AutoProcessor
+    applies the chat template, expands image placeholders into soft-token
+    runs, and emits pixel_values).
+
+    ``dataset`` rows must expose ``image_column`` (PIL image / array) and
+    ``text_column`` (user text); optional ``answer_column`` is the target —
+    prompt tokens get IGNORE_INDEX labels so loss covers the answer only.
+    """
+
+    def __init__(
+        self,
+        dataset: Any,
+        processor: Any,  # transformers AutoProcessor
+        image_column: str = "image",
+        text_column: str = "text",
+        answer_column: Optional[str] = None,
+        system_prompt: Optional[str] = None,
+    ):
+        self.dataset = dataset
+        self.processor = processor
+        self.image_column = image_column
+        self.text_column = text_column
+        self.answer_column = answer_column
+        self.system_prompt = system_prompt
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def __getitem__(self, idx: int) -> dict:
+        row = self.dataset[idx]
+        content = [{"type": "image", "image": row[self.image_column]}]
+        content.append({"type": "text", "text": str(row[self.text_column])})
+        messages = []
+        if self.system_prompt:
+            messages.append(
+                {"role": "system", "content": [{"type": "text", "text": self.system_prompt}]}
+            )
+        messages.append({"role": "user", "content": content})
+        answer = str(row[self.answer_column]) if self.answer_column else None
+        if answer is not None:
+            messages.append(
+                {"role": "assistant", "content": [{"type": "text", "text": answer}]}
+            )
+        out = self.processor.apply_chat_template(
+            messages,
+            add_generation_prompt=False,
+            tokenize=True,
+            return_dict=True,
+            return_tensors="np",
+        )
+        input_ids = np.asarray(out["input_ids"]).reshape(-1)
+        labels = input_ids.copy()
+        if answer is not None:
+            # loss on the assistant answer only: mask everything before the
+            # final-answer token span. Subword boundaries can merge the
+            # answer's first token with template text, so retry without it;
+            # if no span matches, train on the full sequence (safe) and warn
+            # rather than mislabel a guessed offset.
+            ans_ids = np.asarray(
+                self.processor.tokenizer(answer, add_special_tokens=False)["input_ids"]
+            )
+            cut = None
+            for cand in (ans_ids, ans_ids[1:]):
+                if cut is not None or len(cand) == 0:
+                    break
+                for off in range(len(input_ids) - len(cand), -1, -1):
+                    if np.array_equal(input_ids[off : off + len(cand)], cand):
+                        cut = off
+                        break
+            if cut is None:
+                _warn_answer_span_once()
+                cut = 0
+            labels[:cut] = IGNORE_INDEX
+        image_token_id = getattr(
+            self.processor, "image_token_id",
+            getattr(getattr(self.processor, "tokenizer", None), "image_token_id", None),
+        )
+        if image_token_id is not None:
+            labels = np.where(input_ids == image_token_id, IGNORE_INDEX, labels)
+        return {
+            "input_ids": input_ids.tolist(),
+            "labels": labels.tolist(),
+            "pixel_values": np.asarray(out["pixel_values"], np.float32),
+        }
+
+    def __iter__(self) -> Iterator[dict]:
+        for i in range(len(self)):
+            yield self[i]

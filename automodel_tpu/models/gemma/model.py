@@ -140,6 +140,7 @@ def _layer(
     ropes: dict,  # {"local": (cos,sin), "global": (cos,sin)}
     segment_ids: Optional[jnp.ndarray],
     constrain: Constrain,
+    bidir_groups: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     B, S, D = h.shape
     x = gemma_rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
@@ -165,6 +166,7 @@ def _layer(
         scale=cfg.query_pre_attn_scalar**-0.5,
         segment_ids=segment_ids,
         logits_soft_cap=cfg.attn_soft_cap,
+        bidir_groups=bidir_groups,
         block_q=backend.attn_block_q,
         block_kv=backend.attn_block_kv,
     )
@@ -189,6 +191,8 @@ def forward_hidden(
     position_ids: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     constrain: Constrain = _noop_constrain,
+    inputs_embeds: Optional[jnp.ndarray] = None,
+    bidir_groups: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     cd = backend.compute_jnp_dtype
     B, S = input_ids.shape
@@ -196,8 +200,13 @@ def forward_hidden(
         position_ids = jnp.broadcast_to(
             jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
         )
-    h = params["embed"]["embedding"].astype(cd)[input_ids]
-    h = h * jnp.asarray(cfg.embed_scale, cd)
+    if inputs_embeds is not None:
+        # VLM path: caller already embedded + scaled text tokens and
+        # scattered projected image features in (gemma3_vl/model.py)
+        h = inputs_embeds.astype(cd)
+    else:
+        h = params["embed"]["embedding"].astype(cd)[input_ids]
+        h = h * jnp.asarray(cfg.embed_scale, cd)
     h = constrain(h, ("batch", "seq", None))
 
     ropes = {
@@ -223,7 +232,10 @@ def forward_hidden(
 
     def layer_fn(carry, xs):
         lp, flags = xs
-        out = _layer(cfg, backend, carry, lp, flags, ropes, segment_ids, constrain)
+        out = _layer(
+            cfg, backend, carry, lp, flags, ropes, segment_ids, constrain,
+            bidir_groups=bidir_groups,
+        )
         return out, None
 
     flags = {"window": windows, "use_local_rope": use_local, "is_sliding": use_local}

@@ -1,0 +1,11 @@
+from automodel_tpu.models.gemma3_vl.model import (
+    Gemma3VLConfig,
+    Gemma3VLForConditionalGeneration,
+)
+from automodel_tpu.models.gemma3_vl.state_dict_adapter import Gemma3VLStateDictAdapter
+
+__all__ = [
+    "Gemma3VLConfig",
+    "Gemma3VLForConditionalGeneration",
+    "Gemma3VLStateDictAdapter",
+]

@@ -1,0 +1,183 @@
+"""Gemma-3 vision-language model (Gemma3ForConditionalGeneration), TPU-native.
+
+Parity: HF modeling_gemma3.py — SigLIP tower → multimodal projector
+(avg-pool to mm_tokens_per_image, zero-centered RMSNorm, linear into text
+space) → image features scattered over the ``<image_soft_token>`` positions
+of the SCALED text embeddings → gemma-3 text stack where image-token blocks
+attend bidirectionally (token_type_ids_mask_function). The reference's VLM
+families live in components/models/{qwen3_vl_moe,kimivl,...}; gemma-3 is
+the slice chosen here because the text stack already exists
+(automodel_tpu/models/gemma).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.gemma.model import (
+    GemmaConfig,
+    SHARDING_RULES as TEXT_RULES,
+    forward_hidden,
+    gemma_rms_norm,
+    init_params as init_text_params,
+)
+from automodel_tpu.models.gemma3_vl.vision import (
+    SiglipVisionConfig,
+    init_vision_params,
+    vision_tower,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Gemma3VLConfig:
+    text: GemmaConfig
+    vision: SiglipVisionConfig
+    mm_tokens_per_image: int = 256
+    image_token_id: int = 262144
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "Gemma3VLConfig":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        return cls(
+            text=GemmaConfig.from_hf(hf_cfg),  # unwraps text_config itself
+            vision=SiglipVisionConfig.from_hf(get("vision_config")),
+            mm_tokens_per_image=get("mm_tokens_per_image", 256),
+            image_token_id=get("image_token_index", None) or get("image_token_id", 262144),
+        )
+
+    # loss/metrics code addresses the LM config uniformly across families
+    @property
+    def logits_soft_cap(self):
+        return self.text.logits_soft_cap
+
+    @property
+    def vocab_size(self) -> int:
+        return self.text.vocab_size
+
+    @property
+    def hidden_size(self) -> int:
+        return self.text.hidden_size
+
+
+def image_group_ids(input_ids: jnp.ndarray, image_token_id: int) -> jnp.ndarray:
+    """[B, S] → per-token image-group id (consecutive image-token runs share
+    a group; text gets -1) — HF's image_group_ids for the bidirectional
+    block mask."""
+    is_img = input_ids == image_token_id
+    starts = is_img & ~jnp.pad(is_img, ((0, 0), (1, 0)))[:, :-1]
+    groups = jnp.cumsum(starts.astype(jnp.int32), axis=1) - 1
+    return jnp.where(is_img, groups, -1)
+
+
+def project_image_features(cfg: Gemma3VLConfig, params: dict, feats: jnp.ndarray):
+    """[N, P, Hv] tower output → [N, mm_tokens_per_image, D_text]
+    (HF Gemma3MultiModalProjector: spatial avg-pool → RMSNorm → matmul)."""
+    n, _, hv = feats.shape
+    g = cfg.vision.patches_per_side
+    t = int(cfg.mm_tokens_per_image**0.5)
+    k = g // t
+    x = feats.reshape(n, g, g, hv)
+    x = x.reshape(n, t, k, t, k, hv).mean(axis=(2, 4))  # avg-pool k x k
+    x = x.reshape(n, t * t, hv)
+    x = gemma_rms_norm(x, params["norm"]["scale"], cfg.vision.layer_norm_eps)
+    return x @ params["kernel"].astype(x.dtype)
+
+
+def init_vl_params(cfg: Gemma3VLConfig, backend: BackendConfig, key) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    pd = backend.param_jnp_dtype
+    return {
+        "text": init_text_params(cfg.text, backend, k1),
+        "vision": init_vision_params(cfg.vision, backend, k2),
+        "projector": {
+            "kernel": jax.random.normal(
+                k3, (cfg.vision.hidden_size, cfg.text.hidden_size)
+            ).astype(pd)
+            * 0.02,
+            "norm": {"scale": jnp.zeros((cfg.vision.hidden_size,), pd)},
+        },
+    }
+
+
+SHARDING_RULES: list[tuple[str, tuple]] = [
+    # vision tower + projector: small and usually frozen — replicate.
+    # Ordered first: match_rule is first-match-wins and the text patterns
+    # are unanchored (they find "layers/..." under "text/layers/...").
+    (r"^vision/", ()),
+    (r"^projector/", ()),
+    *TEXT_RULES,
+]
+
+
+@dataclasses.dataclass
+class Gemma3VLForConditionalGeneration:
+    config: Gemma3VLConfig
+    backend: BackendConfig = BackendConfig()
+
+    def init(self, key: jax.Array) -> dict:
+        return init_vl_params(self.config, self.backend, key)
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        tp = params["text"]
+        if self.config.text.tie_embeddings:
+            return tp["embed"]["embedding"].T
+        return tp["lm_head"]["kernel"]
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return SHARDING_RULES
+
+    def hidden(
+        self,
+        params: dict,
+        input_ids: jnp.ndarray,
+        pixel_values: Optional[jnp.ndarray] = None,
+        constrain=lambda x, s: x,
+        **kw: Any,
+    ) -> jnp.ndarray:
+        cfg = self.config
+        cd = self.backend.compute_jnp_dtype
+        tp = params["text"]
+        B, S = input_ids.shape
+        h = tp["embed"]["embedding"].astype(cd)[input_ids]
+        h = h * jnp.asarray(cfg.text.embed_scale, cd)
+        groups = None
+        if pixel_values is not None:
+            feats = vision_tower(cfg.vision, self.backend, params["vision"], pixel_values)
+            img = project_image_features(cfg, params["projector"], feats)  # [N,T,D]
+            img_flat = img.reshape(-1, img.shape[-1]).astype(cd)
+            # scatter image features over image-token positions in row-major
+            # order (HF masked_scatter semantics). HF raises on a count
+            # mismatch; under jit the count is traced, so excess image
+            # tokens are POISONED with NaN instead — a silent feature-row
+            # misalignment (e.g. a truncated image run) must not train
+            mask = (input_ids == cfg.image_token_id).reshape(-1)
+            idx = jnp.cumsum(mask) - 1
+            feats_at = img_flat[jnp.clip(idx, 0, img_flat.shape[0] - 1)]
+            feats_at = jnp.where(
+                (idx < img_flat.shape[0])[:, None], feats_at, jnp.nan
+            )
+            h = jnp.where(
+                mask[:, None], feats_at, h.reshape(B * S, -1)
+            ).reshape(B, S, -1)
+            groups = image_group_ids(input_ids, cfg.image_token_id)
+        return forward_hidden(
+            cfg.text, self.backend, tp, input_ids,
+            constrain=constrain, inputs_embeds=h, bidir_groups=groups, **kw,
+        )
+
+    def __call__(self, params, input_ids, **kw):
+        h = self.hidden(params, input_ids, **kw)
+        logits = h @ self.lm_head(params).astype(h.dtype)
+        if self.config.text.logits_soft_cap is not None:
+            logits = self.config.text.logits_soft_cap * jnp.tanh(
+                logits / self.config.text.logits_soft_cap
+            )
+        return logits

@@ -1,0 +1,144 @@
+"""HF ⇄ native adapter for Gemma-3 VLM (Gemma3ForConditionalGeneration).
+
+Text keys delegate to the gemma text adapter with the
+``model.`` → ``model.language_model.`` prefix rewrite; vision tower and
+projector leaves map directly. The SigLIP pooling ``head.*`` keys HF ships
+are unused by gemma-3 (it reads last_hidden_state) and are skipped both
+ways. Parity target: reference VLM adapters
+(models/qwen3_vl_moe/state_dict_adapter.py shape of the problem).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.gemma.state_dict_adapter import GemmaStateDictAdapter
+from automodel_tpu.models.gemma3_vl.model import Gemma3VLConfig
+
+_V = "model.vision_tower.vision_model"
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+class Gemma3VLStateDictAdapter:
+    def __init__(self, config: Gemma3VLConfig):
+        self.config = config
+        self.text_adapter = GemmaStateDictAdapter(config.text)
+
+    # text keys: "model.X" → "model.language_model.X"; lm_head unchanged
+    @staticmethod
+    def _to_vlm_key(k: str) -> str:
+        if k.startswith("model."):
+            return "model.language_model." + k[len("model."):]
+        return k
+
+    def _vision_plans(self) -> list[tuple[tuple[str, ...], str, bool]]:
+        """(native path under vision/layers, hf key template, transpose)."""
+        return [
+            (("ln1", "scale"), _V + ".encoder.layers.{i}.layer_norm1.weight", False),
+            (("ln1", "bias"), _V + ".encoder.layers.{i}.layer_norm1.bias", False),
+            (("ln2", "scale"), _V + ".encoder.layers.{i}.layer_norm2.weight", False),
+            (("ln2", "bias"), _V + ".encoder.layers.{i}.layer_norm2.bias", False),
+            (("attn", "q_proj", "kernel"), _V + ".encoder.layers.{i}.self_attn.q_proj.weight", True),
+            (("attn", "q_proj", "bias"), _V + ".encoder.layers.{i}.self_attn.q_proj.bias", False),
+            (("attn", "k_proj", "kernel"), _V + ".encoder.layers.{i}.self_attn.k_proj.weight", True),
+            (("attn", "k_proj", "bias"), _V + ".encoder.layers.{i}.self_attn.k_proj.bias", False),
+            (("attn", "v_proj", "kernel"), _V + ".encoder.layers.{i}.self_attn.v_proj.weight", True),
+            (("attn", "v_proj", "bias"), _V + ".encoder.layers.{i}.self_attn.v_proj.bias", False),
+            (("attn", "out_proj", "kernel"), _V + ".encoder.layers.{i}.self_attn.out_proj.weight", True),
+            (("attn", "out_proj", "bias"), _V + ".encoder.layers.{i}.self_attn.out_proj.bias", False),
+            (("mlp", "fc1", "kernel"), _V + ".encoder.layers.{i}.mlp.fc1.weight", True),
+            (("mlp", "fc1", "bias"), _V + ".encoder.layers.{i}.mlp.fc1.bias", False),
+            (("mlp", "fc2", "kernel"), _V + ".encoder.layers.{i}.mlp.fc2.weight", True),
+            (("mlp", "fc2", "bias"), _V + ".encoder.layers.{i}.mlp.fc2.bias", False),
+        ]
+
+    def iter_from_hf(self, get_tensor: Callable[[str], np.ndarray]):
+        vc = self.config.vision
+
+        # text stack under "text/" with rewritten keys
+        text_get = lambda k: get_tensor(self._to_vlm_key(k))
+        for path, leaf in self.text_adapter.iter_from_hf(text_get):
+            yield ("text", *path), leaf
+
+        # patch conv [D, C, p, p] → patch-vector matmul kernel [(c,ph,pw), D]
+        w = np.asarray(get_tensor(_V + ".embeddings.patch_embedding.weight"))
+        yield ("vision", "patch_embed", "kernel"), _t(w.reshape(w.shape[0], -1))
+        yield ("vision", "patch_embed", "bias"), get_tensor(
+            _V + ".embeddings.patch_embedding.bias"
+        )
+        yield ("vision", "pos_embed", "embedding"), get_tensor(
+            _V + ".embeddings.position_embedding.weight"
+        )
+        for path, tmpl, tr in self._vision_plans():
+            rows = []
+            for i in range(vc.num_layers):
+                arr = get_tensor(tmpl.format(i=i))
+                rows.append(_t(arr) if tr else arr)
+            yield ("vision", "layers", *path), np.stack(rows, 0)
+        yield ("vision", "post_ln", "scale"), get_tensor(_V + ".post_layernorm.weight")
+        yield ("vision", "post_ln", "bias"), get_tensor(_V + ".post_layernorm.bias")
+
+        # projector: mm_input_projection_weight is already [H_vision, D_text]
+        # (HF matmuls it un-transposed)
+        yield ("projector", "kernel"), get_tensor(
+            "model.multi_modal_projector.mm_input_projection_weight"
+        )
+        yield ("projector", "norm", "scale"), get_tensor(
+            "model.multi_modal_projector.mm_soft_emb_norm.weight"
+        )
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        vc = self.config.vision
+        for k, arr in self.text_adapter.to_hf(params["text"]):
+            yield self._to_vlm_key(k), arr
+
+        v = params["vision"]
+        pk = np.asarray(v["patch_embed"]["kernel"])  # [(c,ph,pw), D]
+        p = vc.patch_size
+        yield _V + ".embeddings.patch_embedding.weight", _t(pk).reshape(
+            vc.hidden_size, vc.num_channels, p, p
+        )
+        yield _V + ".embeddings.patch_embedding.bias", np.asarray(v["patch_embed"]["bias"])
+        yield _V + ".embeddings.position_embedding.weight", np.asarray(
+            v["pos_embed"]["embedding"]
+        )
+        for path, tmpl, tr in self._vision_plans():
+            node = v["layers"]
+            for k in path:
+                node = node[k]
+            leaf = np.asarray(node)
+            for i in range(vc.num_layers):
+                yield tmpl.format(i=i), (_t(leaf[i]) if tr else leaf[i])
+        yield _V + ".post_layernorm.weight", np.asarray(v["post_ln"]["scale"])
+        yield _V + ".post_layernorm.bias", np.asarray(v["post_ln"]["bias"])
+        yield "model.multi_modal_projector.mm_input_projection_weight", np.asarray(
+            params["projector"]["kernel"]
+        )
+        yield "model.multi_modal_projector.mm_soft_emb_norm.weight", np.asarray(
+            params["projector"]["norm"]["scale"]
+        )
+
+    def hf_keys(self) -> list[str]:
+        keys = [self._to_vlm_key(k) for k in self.text_adapter.hf_keys()]
+        keys += [
+            _V + ".embeddings.patch_embedding.weight",
+            _V + ".embeddings.patch_embedding.bias",
+            _V + ".embeddings.position_embedding.weight",
+            _V + ".post_layernorm.weight",
+            _V + ".post_layernorm.bias",
+            "model.multi_modal_projector.mm_input_projection_weight",
+            "model.multi_modal_projector.mm_soft_emb_norm.weight",
+        ]
+        for _, tmpl, _tr in self._vision_plans():
+            keys += [tmpl.format(i=i) for i in range(self.config.vision.num_layers)]
+        return keys

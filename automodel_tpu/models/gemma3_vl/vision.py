@@ -1,0 +1,163 @@
+"""SigLIP vision tower, TPU-native (gemma-3's image encoder).
+
+Parity: HF ``SiglipVisionModel`` as consumed by Gemma3ForConditionalGeneration
+(reference uses the HF tower inside models/qwen3_vl_moe-style families; here
+the tower is rebuilt functionally). The pooling ``head`` HF ships in the
+checkpoint is NOT used by gemma-3 (it reads last_hidden_state) and is skipped.
+
+TPU notes: the stride=kernel patch conv is expressed as patch-extract +
+matmul (one big MXU GEMM, no conv lowering); encoder layers run as one
+``lax.scan`` over stacked params; attention is full-bidirectional sdpa
+(vision sequences are short — 256-4096 patches — so O(S²) is fine and XLA
+fuses it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.llama.model import ACT_FNS, _dense_init
+from automodel_tpu.ops.attention import sdpa
+
+
+@dataclasses.dataclass(frozen=True)
+class SiglipVisionConfig:
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    image_size: int
+    patch_size: int
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-6
+    act: str = "gelu_pytorch_tanh"
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "SiglipVisionConfig":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        return cls(
+            hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            image_size=get("image_size"),
+            patch_size=get("patch_size"),
+            num_channels=get("num_channels", 3),
+            layer_norm_eps=get("layer_norm_eps", 1e-6),
+            act=get("hidden_act", "gelu_pytorch_tanh"),
+        )
+
+    @property
+    def patches_per_side(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_patches(self) -> int:
+        return self.patches_per_side**2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def _ln(x: jnp.ndarray, p: dict, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def init_vision_params(cfg: SiglipVisionConfig, backend: BackendConfig, key) -> dict:
+    pd = backend.param_jnp_dtype
+    D, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    pv = cfg.num_channels * cfg.patch_size**2
+    keys = jax.random.split(key, 9)
+
+    def stack(k, shape, in_axis=0):
+        return _dense_init(k, (L, *shape), pd, in_axis=in_axis + 1)
+
+    def zeros(shape):
+        return jnp.zeros(shape, pd)
+
+    return {
+        "patch_embed": {"kernel": _dense_init(keys[0], (pv, D), pd), "bias": zeros((D,))},
+        "pos_embed": {
+            "embedding": jax.random.normal(keys[1], (cfg.num_patches, D)).astype(pd)
+            * 0.02
+        },
+        "layers": {
+            "ln1": {"scale": jnp.ones((L, D), pd), "bias": zeros((L, D))},
+            "ln2": {"scale": jnp.ones((L, D), pd), "bias": zeros((L, D))},
+            "attn": {
+                "q_proj": {"kernel": stack(keys[2], (D, D)), "bias": zeros((L, D))},
+                "k_proj": {"kernel": stack(keys[3], (D, D)), "bias": zeros((L, D))},
+                "v_proj": {"kernel": stack(keys[4], (D, D)), "bias": zeros((L, D))},
+                "out_proj": {"kernel": stack(keys[5], (D, D)), "bias": zeros((L, D))},
+            },
+            "mlp": {
+                "fc1": {"kernel": stack(keys[6], (D, I)), "bias": zeros((L, I))},
+                "fc2": {"kernel": stack(keys[7], (I, D)), "bias": zeros((L, D))},
+            },
+        },
+        "post_ln": {"scale": jnp.ones((D,), pd), "bias": zeros((D,))},
+    }
+
+
+def vision_tower(
+    cfg: SiglipVisionConfig,
+    backend: BackendConfig,
+    params: dict,
+    pixel_values: jnp.ndarray,  # [N, C, H, W] (HF processor layout)
+) -> jnp.ndarray:
+    """→ [N, num_patches, hidden] (HF last_hidden_state after post_layernorm)."""
+    cd = backend.compute_jnp_dtype
+    N = pixel_values.shape[0]
+    p, g = cfg.patch_size, cfg.patches_per_side
+    # stride=kernel conv == row-major patch extraction + one GEMM; the patch
+    # vector layout (c, ph, pw) matches the torch conv kernel [D, C, p, p]
+    x = pixel_values.astype(cd).reshape(N, cfg.num_channels, g, p, g, p)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(N, g * g, cfg.num_channels * p * p)
+    h = x @ params["patch_embed"]["kernel"].astype(cd) + params["patch_embed"][
+        "bias"
+    ].astype(cd)
+    h = h + params["pos_embed"]["embedding"].astype(cd)[None]
+
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    def layer(carry, lp):
+        x = _ln(carry, lp["ln1"], cfg.layer_norm_eps)
+        S = x.shape[1]
+
+        def proj(pp):
+            return x @ pp["kernel"].astype(x.dtype) + pp["bias"].astype(x.dtype)
+
+        q = proj(lp["attn"]["q_proj"]).reshape(N, S, nh, hd)
+        k = proj(lp["attn"]["k_proj"]).reshape(N, S, nh, hd)
+        v = proj(lp["attn"]["v_proj"]).reshape(N, S, nh, hd)
+        attn = sdpa(q, k, v, causal=False).reshape(N, S, cfg.hidden_size)
+        attn = attn @ lp["attn"]["out_proj"]["kernel"].astype(x.dtype) + lp["attn"][
+            "out_proj"
+        ]["bias"].astype(x.dtype)
+        h = carry + attn
+        x = _ln(h, lp["ln2"], cfg.layer_norm_eps)
+        y = x @ lp["mlp"]["fc1"]["kernel"].astype(x.dtype) + lp["mlp"]["fc1"][
+            "bias"
+        ].astype(x.dtype)
+        y = ACT_FNS[cfg.act](y)
+        y = y @ lp["mlp"]["fc2"]["kernel"].astype(x.dtype) + lp["mlp"]["fc2"][
+            "bias"
+        ].astype(x.dtype)
+        return h + y, None
+
+    h, _ = jax.lax.scan(layer, h, params["layers"])
+    return _ln(h, params["post_ln"], cfg.layer_norm_eps)

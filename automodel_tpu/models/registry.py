@@ -56,9 +56,7 @@ def _llama_builder(hf_config: Any, backend: BackendConfig):
     return LlamaForCausalLM(cfg, backend), LlamaStateDictAdapter(cfg)
 
 
-@register_architecture(
-    "Gemma2ForCausalLM", "Gemma3ForCausalLM", "Gemma3ForConditionalGeneration"
-)
+@register_architecture("Gemma2ForCausalLM", "Gemma3ForCausalLM")
 def _gemma_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.gemma import (
         GemmaConfig,
@@ -68,6 +66,18 @@ def _gemma_builder(hf_config: Any, backend: BackendConfig):
 
     cfg = GemmaConfig.from_hf(hf_config)
     return GemmaForCausalLM(cfg, backend), GemmaStateDictAdapter(cfg)
+
+
+@register_architecture("Gemma3ForConditionalGeneration")
+def _gemma3_vl_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.gemma3_vl import (
+        Gemma3VLConfig,
+        Gemma3VLForConditionalGeneration,
+        Gemma3VLStateDictAdapter,
+    )
+
+    cfg = Gemma3VLConfig.from_hf(hf_config)
+    return Gemma3VLForConditionalGeneration(cfg, backend), Gemma3VLStateDictAdapter(cfg)
 
 
 @register_architecture("DeepseekV3ForCausalLM")

@@ -49,12 +49,18 @@ def sdpa(
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
     sinks: Optional[jnp.ndarray] = None,
+    bidir_groups: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """XLA scaled dot-product attention. q: [B,S,N,H], k/v: [B,S,Nkv,H].
 
     ``sinks``: per-head learned sink logits [N] — an extra virtual key that
     absorbs probability mass (gpt-oss; modeling_gpt_oss.py:258: softmax over
     [logits, sink] then drop the sink column).
+
+    ``bidir_groups``: [B, S] int group ids, -1 for ordinary causal tokens —
+    tokens sharing a nonnegative group attend to each other BIDIRECTIONALLY
+    (ORed onto the causal/window mask), the gemma-3 image-block rule
+    (modeling_gemma3.py token_type_ids_mask_function).
     """
     b, sq, n, h = q.shape
     n_kv = k.shape[2]
@@ -74,6 +80,10 @@ def sdpa(
         pos_k = jnp.arange(sk)[None, :]
         mask = mask & (pos_q - pos_k < sliding_window)
     mask = mask[None, None]
+    if bidir_groups is not None:
+        gq = bidir_groups[:, None, :, None]
+        gk = bidir_groups[:, None, None, :]
+        mask = mask | ((gq >= 0) & (gq == gk))
     if segment_ids is not None:
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         mask = mask & seg
@@ -281,6 +291,7 @@ def windowed_attention(
     segment_ids: Optional[jnp.ndarray] = None,
     logits_soft_cap: Optional[float] = None,
     sinks: Optional[jnp.ndarray] = None,
+    bidir_groups: Optional[jnp.ndarray] = None,
     block_q: int = 512,
     block_kv: int = 512,
 ) -> jnp.ndarray:
@@ -293,6 +304,17 @@ def windowed_attention(
     if backend not in ATTENTION_BACKENDS:
         raise ValueError(
             f"Unknown attention backend {backend!r}; available: {sorted(ATTENTION_BACKENDS)}"
+        )
+    if bidir_groups is not None:
+        # data-dependent OR-mask (gemma-3 image blocks): splash masks are
+        # static, so this runs on sdpa until a custom dynamic-mask kernel
+        if backend == "flash":
+            _fallback_loudly("bidirectional image-block mask")
+        return sdpa(
+            q, k, v,
+            causal=causal, scale=scale, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap, sliding_window=dynamic_window,
+            sinks=sinks, bidir_groups=bidir_groups,
         )
     if backend == "flash" and window is not None and _flash_eligible():
         kw = dict(

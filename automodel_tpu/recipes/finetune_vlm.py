@@ -1,0 +1,64 @@
+"""VLM finetune recipe.
+
+Parity: FinetuneRecipeForVLM (reference recipes/vlm/finetune.py:469) — the
+LLM finetune skeleton plus: processor-based image+text datasets
+(data/vlm.py), the VLM collator stacking pixel_values, and a freeze config
+for towers (reference freezes vision tower / language model / projector by
+flags; here `freeze.patterns` are path globs over the param tree, default
+freezing the vision tower).
+
+YAML additions over train_ft:
+  freeze: {patterns: ["vision/*"]}        # [] to train everything
+  dataset: a data/vlm.py dataset (MockVLMDataset / ProcessorVLMDataset)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.data.loader import DataLoader
+from automodel_tpu.data.vlm import vlm_collater
+from automodel_tpu.recipes.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_FREEZE = ["vision/*"]
+
+
+class FinetuneRecipeForVLM(TrainFinetuneRecipeForNextTokenPrediction):
+    def _wrap_optimizer(self, optimizer: Any, trainable: Any) -> Any:
+        fcfg = self.cfg.get("freeze", None)
+        patterns = (
+            list(fcfg.get("patterns", DEFAULT_FREEZE))
+            if fcfg is not None
+            else DEFAULT_FREEZE
+        )
+        if not patterns:
+            return optimizer
+        from automodel_tpu.training.freeze import (
+            apply_freeze,
+            freeze_mask,
+            trainable_count,
+        )
+
+        mask = freeze_mask(trainable, patterns)
+        n_train, n_total = trainable_count(mask, trainable)
+        logger.info(
+            "freeze %s: %d / %d params trainable", patterns, n_train, n_total
+        )
+        # train_step zeroes frozen grads (backward DCE + honest grad_norm)
+        self.grad_mask = mask
+        return apply_freeze(optimizer, mask)
+
+    def _build_dataloader(self, dataset_cfg: Any, dl_cfg: Any) -> DataLoader:
+        dl = dict(dl_cfg or {})
+        dl.setdefault("collate_fn", vlm_collater)
+        return super()._build_dataloader(dataset_cfg, dl)
+
+
+def main(cfg: ConfigNode) -> dict:
+    recipe = FinetuneRecipeForVLM(cfg)
+    recipe.setup()
+    return recipe.run_train_validation_loop()

@@ -123,7 +123,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         ocfg.pop("_target_", None)
         sched_cfg = dict(ocfg.get("lr_schedule") or {})
         self.lr_schedule = build_lr_schedule(lr=ocfg.get("lr", 1e-4), **sched_cfg)
-        self.optimizer = build_optimizer(**ocfg)
+        self.optimizer = self._wrap_optimizer(build_optimizer(**ocfg), trainable)
         opt_state = jax.jit(self.optimizer.init)(trainable)
         self.state = TrainState.create(trainable, opt_state)
 
@@ -142,7 +142,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             )
         post_step = getattr(self.model, "post_step_fn", None) if self.peft_config is None else None
         self.train_step = build_train_step(
-            self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step
+            self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step,
+            grad_mask=getattr(self, "grad_mask", None),
         )
         self.eval_step = build_eval_step(self.loss_fn)
 
@@ -170,6 +171,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # metrics
         log_cfg = cfg.get("logging", ConfigNode())
         self.metric_logger = MetricLogger(log_cfg.get("metrics_path", "train_metrics.jsonl"))
+
+    def _wrap_optimizer(self, optimizer: Any, trainable: Any) -> Any:
+        """Subclass hook (VLM recipe: freeze-pattern masking)."""
+        return optimizer
 
     def _build_dataloader(self, dataset_cfg: Any, dl_cfg: Any) -> DataLoader:
         if dataset_cfg is None:

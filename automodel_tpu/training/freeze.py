@@ -1,0 +1,53 @@
+"""Parameter freezing by path pattern.
+
+Parity: the reference freezes modules by config before sharding
+(infrastructure.py:441 parameter freezing; recipes/vlm/finetune.py freeze
+config for vision towers / language model). TPU-native: freezing is two
+complementary pieces. (1) `optax.multi_transform` routes frozen leaves to
+`set_to_zero`, so no optimizer state is allocated for them and weight decay
+cannot touch them. (2) the train step zeroes frozen leaves' gradients right
+after value_and_grad (build_train_step(grad_mask=...)) — that makes the
+backward ops producing them dead code XLA eliminates, and keeps grad_norm
+a metric over trainable params only.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Sequence
+
+import jax
+import optax
+
+from automodel_tpu.parallel.plans import path_str
+
+
+def freeze_mask(params: Any, freeze_patterns: Sequence[str]) -> Any:
+    """Pytree of bools matching `params`: True = trainable, False = frozen.
+    Patterns are fnmatch-style over "a/b/c" paths (e.g. "vision/*")."""
+
+    def label(path, _leaf):
+        p = path_str(path)
+        return not any(fnmatch.fnmatch(p, pat) for pat in freeze_patterns)
+
+    return jax.tree_util.tree_map_with_path(label, params)
+
+
+def apply_freeze(
+    optimizer: optax.GradientTransformation, mask: Any
+) -> optax.GradientTransformation:
+    """Wrap `optimizer` so frozen leaves receive zero updates and hold no
+    optimizer state."""
+    labels = jax.tree.map(lambda t: "train" if t else "freeze", mask)
+    return optax.multi_transform(
+        {"train": optimizer, "freeze": optax.set_to_zero()}, labels
+    )
+
+
+def trainable_count(mask: Any, params: Any) -> tuple[int, int]:
+    """(trainable param count, total param count) for logging."""
+    counts = jax.tree.map(
+        lambda t, p: (int(p.size) if t else 0, int(p.size)), mask, params
+    )
+    leaves = jax.tree.leaves(counts, is_leaf=lambda x: isinstance(x, tuple))
+    return sum(a for a, _ in leaves), sum(b for _, b in leaves)

@@ -33,6 +33,7 @@ def build_train_step(
     lr_schedule: Optional[Callable] = None,
     donate: bool = True,
     post_step_fn: Optional[Callable[[Any, dict], Any]] = None,
+    grad_mask: Any = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted (state, batch) → (state, metrics) step.
 
@@ -46,6 +47,11 @@ def build_train_step(
     ``post_step_fn(new_params, extras_sum) -> new_params`` runs AFTER the
     optimizer update, outside the gradient — the reference's
     update_moe_gate_bias slot (train_ft.py:1341, aux-free load balancing).
+
+    ``grad_mask`` (bool pytree, True = trainable): frozen leaves' gradients
+    are replaced by zeros immediately after value_and_grad — XLA dead-code-
+    eliminates the backward compute that only produced them, and grad_norm
+    reflects trainable params only (see training/freeze.py).
     """
 
     def call_loss(params, mb):
@@ -59,7 +65,12 @@ def build_train_step(
         def wrapped(p):
             loss_sum, n, extras = call_loss(p, mb)
             return loss_sum.astype(jnp.float32), (n, extras)
-        return jax.value_and_grad(wrapped, has_aux=True)(params)
+        val, grads = jax.value_and_grad(wrapped, has_aux=True)(params)
+        if grad_mask is not None:
+            grads = jax.tree.map(
+                lambda g, m: g if m else jnp.zeros_like(g), grads, grad_mask
+            )
+        return val, grads
 
     def step_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         grads0 = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params)
@@ -148,7 +159,7 @@ def make_causal_lm_loss(
     def loss_fn(params, mb):
         kw = {
             k: mb[k]
-            for k in ("position_ids", "segment_ids")
+            for k in ("position_ids", "segment_ids", "pixel_values")
             if k in mb and mb[k] is not None
         }
         if loss == "fused_linear_ce":
