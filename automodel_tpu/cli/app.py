@@ -14,12 +14,12 @@ import sys
 from automodel_tpu.config.arg_parser import parse_args_and_load_config
 
 COMMANDS = ("finetune", "pretrain", "kd", "benchmark")
-DOMAINS = ("llm", "vlm")
+DOMAINS = ("llm", "vlm", "biencoder")
 
 
 def _usage() -> str:
     return (
-        "usage: automodel_tpu <finetune|pretrain|kd|benchmark> <llm|vlm> "
+        "usage: automodel_tpu <finetune|pretrain|kd|benchmark> <llm|vlm|biencoder> "
         "-c config.yaml [--dotted.key=value ...]"
     )
 
@@ -65,6 +65,7 @@ def main(argv: list[str] | None = None) -> int:
         ("benchmark", "llm"): "automodel_tpu.recipes.benchmark",
         ("kd", "llm"): "automodel_tpu.recipes.kd",
         ("finetune", "vlm"): "automodel_tpu.recipes.finetune_vlm",
+        ("finetune", "biencoder"): "automodel_tpu.recipes.train_biencoder",
     }
     module_name = recipe_modules.get((command, domain))
     if module_name is not None:

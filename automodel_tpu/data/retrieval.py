@@ -1,0 +1,130 @@
+"""Retrieval / biencoder datasets + collation.
+
+Parity: reference datasets/llm/retrieval_*.py (1,052 LoC: query/pos/neg
+datasets + collator). Each example: a query, one positive document, and
+n_negatives hard negatives. The collator tokenizes (or passes through
+pre-tokenized ids), pads, and emits:
+
+  query_input_ids/query_attention_mask        [B, Sq]
+  doc_input_ids/doc_attention_mask            [B*(1+n_neg), Sd]
+                                              (positives first, row-major)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RetrievalDataset:
+    """Column-mapped (query, positive, negatives) view over any
+    indexable rows (HF dataset, list of dicts...). ``tokenizer`` maps
+    str → list[int]; rows may instead carry pre-tokenized id lists."""
+
+    def __init__(
+        self,
+        dataset: Any,
+        tokenizer: Optional[Any] = None,
+        query_column: str = "query",
+        positive_column: str = "positive",
+        negatives_column: Optional[str] = "negatives",
+        n_negatives: int = 1,
+        max_len: int = 512,
+        query_prefix: str = "",
+        passage_prefix: str = "",
+    ):
+        self.dataset = dataset
+        self.tokenizer = tokenizer
+        self.query_column = query_column
+        self.positive_column = positive_column
+        self.negatives_column = negatives_column
+        self.n_negatives = n_negatives
+        self.max_len = max_len
+        self.query_prefix = query_prefix
+        self.passage_prefix = passage_prefix
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def _encode(self, text: Any, prefix: str) -> list[int]:
+        if isinstance(text, (list, np.ndarray)):
+            return list(text)[: self.max_len]
+        ids = self.tokenizer(prefix + str(text), add_special_tokens=True)
+        if isinstance(ids, dict):
+            ids = ids["input_ids"]
+        return list(ids)[: self.max_len]
+
+    def __getitem__(self, idx: int) -> dict:
+        row = self.dataset[idx]
+        negs = list(row.get(self.negatives_column, []) or []) if self.negatives_column else []
+        negs = (negs * self.n_negatives)[: self.n_negatives] if negs else []
+        return {
+            "query_ids": self._encode(row[self.query_column], self.query_prefix),
+            "positive_ids": self._encode(row[self.positive_column], self.passage_prefix),
+            "negative_ids": [self._encode(n, self.passage_prefix) for n in negs],
+        }
+
+    def __iter__(self) -> Iterator[dict]:
+        for i in range(len(self)):
+            yield self[i]
+
+
+class MockRetrievalDataset:
+    """Deterministic random (query, positive, negatives) token samples."""
+
+    def __init__(self, vocab_size=128, seq_length=16, n_negatives=1,
+                 num_samples=256, seed=0):
+        self.vocab_size, self.seq_length = vocab_size, seq_length
+        self.n_negatives, self.num_samples, self.seed = n_negatives, num_samples, seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed * 7919 + idx)
+        mk = lambda: rng.integers(1, self.vocab_size, size=self.seq_length).tolist()
+        return {
+            "query_ids": mk(),
+            "positive_ids": mk(),
+            "negative_ids": [mk() for _ in range(self.n_negatives)],
+        }
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+def _pad_batch(rows: Sequence[Sequence[int]], pad_id: int, divisible: int | None):
+    seq = max(len(r) for r in rows)
+    if divisible:
+        seq = -(-seq // divisible) * divisible
+    ids = np.full((len(rows), seq), pad_id, np.int32)
+    mask = np.zeros((len(rows), seq), np.int32)
+    for i, r in enumerate(rows):
+        ids[i, : len(r)] = r
+        mask[i, : len(r)] = 1
+    return ids, mask
+
+
+def retrieval_collater(
+    examples: Any,
+    pad_token_id: int = 0,
+    pad_seq_len_divisible: int | None = None,
+) -> dict[str, np.ndarray]:
+    examples = list(examples)
+    n_neg = len(examples[0]["negative_ids"])
+    queries = [e["query_ids"] for e in examples]
+    docs = [e["positive_ids"] for e in examples]  # positives first
+    for e in examples:
+        assert len(e["negative_ids"]) == n_neg, "ragged negative counts"
+        docs.extend(e["negative_ids"])
+    q_ids, q_mask = _pad_batch(queries, pad_token_id, pad_seq_len_divisible)
+    d_ids, d_mask = _pad_batch(docs, pad_token_id, pad_seq_len_divisible)
+    return {
+        "query_input_ids": q_ids,
+        "query_attention_mask": q_mask,
+        "doc_input_ids": d_ids,
+        "doc_attention_mask": d_mask,
+        "num_label_tokens": len(examples),
+    }

@@ -75,6 +75,11 @@ class MockVLMDataset:
         num_samples: int = 256,
         seed: int = 0,
     ):
+        if seq_length < mm_tokens_per_image + 4:
+            raise ValueError(
+                f"seq_length {seq_length} too short for an image run of "
+                f"{mm_tokens_per_image} tokens plus BOI/EOI markers"
+            )
         self.vocab_size = vocab_size
         self.seq_length = seq_length
         self.image_size = image_size

@@ -1,0 +1,7 @@
+from automodel_tpu.models.biencoder.model import (
+    LlamaBidirectionalModel,
+    contrastive_loss,
+    pool_hidden,
+)
+
+__all__ = ["LlamaBidirectionalModel", "contrastive_loss", "pool_hidden"]

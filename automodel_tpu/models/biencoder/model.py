@@ -1,0 +1,113 @@
+"""Biencoder embedding model: bidirectional llama + pooling.
+
+Parity: reference models/biencoder/llama_bidirectional_model.py:685 — a
+llama stack run with BIDIRECTIONAL attention (causal=False), pooled into a
+single embedding per sequence (avg / cls / last-token pooling over
+non-padding positions), optionally L2-normalized; trained contrastively
+(recipes/biencoder/train_biencoder.py, see recipes/train_biencoder.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.models.llama.model import (
+    SHARDING_RULES as LLAMA_RULES,
+    forward_hidden,
+    init_params,
+)
+
+POOLINGS = ("avg", "cls", "last")
+
+
+def pool_hidden(
+    h: jnp.ndarray,  # [B, S, D]
+    attention_mask: jnp.ndarray,  # [B, S] 1 = real token
+    pooling: str = "avg",
+) -> jnp.ndarray:
+    """→ [B, D] (reference pool types: average over valid tokens / first
+    token / last valid token)."""
+    m = attention_mask.astype(h.dtype)
+    if pooling == "avg":
+        return (h * m[..., None]).sum(1) / jnp.maximum(m.sum(1), 1.0)[..., None]
+    if pooling == "cls":
+        return h[:, 0]
+    if pooling == "last":
+        last = jnp.maximum(attention_mask.sum(1) - 1, 0)
+        return jnp.take_along_axis(h, last[:, None, None].astype(jnp.int32), 1)[:, 0]
+    raise ValueError(f"pooling {pooling!r}; available: {POOLINGS}")
+
+
+@dataclasses.dataclass
+class LlamaBidirectionalModel:
+    """Same param tree as LlamaForCausalLM minus lm_head (embedding use)."""
+
+    config: TransformerConfig
+    backend: BackendConfig = BackendConfig()
+    pooling: str = "avg"
+    normalize: bool = True
+
+    def __post_init__(self):
+        if self.config.causal:
+            self.config = dataclasses.replace(self.config, causal=False)
+        if self.pooling not in POOLINGS:
+            raise ValueError(f"pooling {self.pooling!r}; available: {POOLINGS}")
+
+    def init(self, key: jax.Array) -> dict:
+        params = init_params(
+            dataclasses.replace(self.config, tie_embeddings=True), self.backend, key
+        )
+        params.pop("lm_head", None)
+        return params
+
+    def hidden(self, params, input_ids, **kw):
+        return forward_hidden(self.config, self.backend, params, input_ids, **kw)
+
+    def __call__(
+        self,
+        params,
+        input_ids,
+        attention_mask: Optional[jnp.ndarray] = None,
+        constrain=lambda x, s: x,
+        **kw: Any,
+    ) -> jnp.ndarray:
+        """→ [B, D] pooled (optionally unit-norm) embeddings."""
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        # padding must not attend: express it as segment ids (pad = segment 0,
+        # real = segment 1) — bidirectional attention stays within segment
+        seg = kw.pop("segment_ids", None)
+        if seg is None:
+            seg = attention_mask.astype(jnp.int32)
+        h = self.hidden(params, input_ids, segment_ids=seg, constrain=constrain, **kw)
+        emb = pool_hidden(h, attention_mask, self.pooling)
+        if self.normalize:
+            emb = emb * jax.lax.rsqrt(
+                jnp.maximum((emb * emb).sum(-1, keepdims=True), 1e-12)
+            )
+        return emb
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return LLAMA_RULES
+
+
+def contrastive_loss(
+    q_emb: jnp.ndarray,  # [B, D] query embeddings
+    d_emb: jnp.ndarray,  # [B * (1 + n_neg), D] docs: positives first
+    temperature: float = 0.02,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """In-batch-negatives InfoNCE (reference train_biencoder contrastive
+    objective): query i's positive is document i; every other document
+    (other positives + all hard negatives) is a negative.
+    Returns (loss_sum, n) like the LM losses so build_train_step can
+    normalize globally."""
+    logits = (q_emb @ d_emb.T).astype(jnp.float32) / temperature  # [B, B*(1+n)]
+    labels = jnp.arange(q_emb.shape[0])
+    loss = -jax.nn.log_softmax(logits, axis=-1)[labels, labels]
+    return loss.sum(), jnp.int32(q_emb.shape[0])

@@ -98,6 +98,12 @@ class TransformerConfig:
     # attention; layers >= max_window_layers use the sliding window.
     max_window_layers: int = 0
     attn_scale: Optional[float] = None  # override 1/sqrt(head_dim)
+    # GLM-4 / phi-style partial rotary: only the first
+    # head_dim * partial_rotary_factor channels rotate
+    partial_rotary_factor: float = 1.0
+    # biencoder embedding models run the same stack bidirectionally
+    # (reference: models/biencoder/llama_bidirectional_model.py)
+    causal: bool = True
 
     @classmethod
     def from_hf(cls, hf_cfg: Any) -> "TransformerConfig":
@@ -134,7 +140,15 @@ class TransformerConfig:
                 else None
             ),
             max_window_layers=get("max_window_layers", 0) or 0,
+            partial_rotary_factor=get("partial_rotary_factor", 1.0) or 1.0,
         )
+
+    @property
+    def rope_dim(self) -> Optional[int]:
+        """Rotary channel count when partial (None = full head_dim)."""
+        if self.partial_rotary_factor and self.partial_rotary_factor < 1.0:
+            return int(self.head_dim * self.partial_rotary_factor)
+        return None
 
     @property
     def q_dim(self) -> int:

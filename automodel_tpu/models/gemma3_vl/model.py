@@ -161,8 +161,12 @@ class Gemma3VLForConditionalGeneration:
             mask = (input_ids == cfg.image_token_id).reshape(-1)
             idx = jnp.cumsum(mask) - 1
             feats_at = img_flat[jnp.clip(idx, 0, img_flat.shape[0] - 1)]
+            # any count mismatch (excess OR missing image tokens — e.g. a
+            # truncated image run) misaligns the row-major scatter, so poison
+            # ALL image features, not just the out-of-range tail
+            count_ok = mask.sum() == img_flat.shape[0]
             feats_at = jnp.where(
-                (idx < img_flat.shape[0])[:, None], feats_at, jnp.nan
+                count_ok & (idx < img_flat.shape[0])[:, None], feats_at, jnp.nan
             )
             h = jnp.where(
                 mask[:, None], feats_at, h.reshape(B * S, -1)

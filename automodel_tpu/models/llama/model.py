@@ -132,7 +132,7 @@ def attention_block(
         k,
         v,
         backend=backend.attn,
-        causal=True,
+        causal=cfg.causal,
         scale=cfg.attn_scale,
         segment_ids=segment_ids,
         logits_soft_cap=cfg.attn_soft_cap,
@@ -186,7 +186,7 @@ def forward_hidden(
     if cfg.embed_scale != 1.0:
         h = h * jnp.asarray(cfg.embed_scale, cd)
     h = constrain(h, ("batch", "seq", None))
-    cos, sin = rope_table(position_ids, cfg.head_dim, cfg.rope)
+    cos, sin = rope_table(position_ids, cfg.rope_dim or cfg.head_dim, cfg.rope)
 
     def make_layer_fn(sliding_window):
         def layer_fn(carry, lp):

@@ -49,6 +49,13 @@ class MoETransformerConfig(TransformerConfig):
         get = lambda k, d=None: (
             hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
         )
+        model_type = get("model_type", "")
+        # GLM4-MoE routes like DeepSeek-V3 (sigmoid scores + always-present
+        # e_score_correction_bias, grouped top-k) but has no scoring_func /
+        # topk_method keys in its HF config (modeling_glm4_moe.py
+        # Glm4MoeTopkRouter)
+        is_glm4 = model_type == "glm4_moe"
+        aux_free = get("topk_method", None) == "noaux_tc" or is_glm4
         moe = MoEConfig(
             num_experts=get("num_experts", None) or get("n_routed_experts"),
             num_experts_per_tok=get("num_experts_per_tok", 8),
@@ -56,21 +63,23 @@ class MoETransformerConfig(TransformerConfig):
             num_shared_experts=get("n_shared_experts", 0) or 0,
             shared_expert_intermediate_size=get("shared_expert_intermediate_size", 0)
             or get("moe_intermediate_size"),
-            score_func=get("scoring_func", "softmax"),
+            score_func=get("scoring_func", None) or ("sigmoid" if is_glm4 else "softmax"),
             route_scale=get("routed_scaling_factor", 1.0) or 1.0,
             norm_topk_prob=bool(get("norm_topk_prob", True)),
             n_group=get("n_group", 1) or 1,
             topk_group=get("topk_group", 1) or 1,
             aux_loss_coeff=get("router_aux_loss_coef", 0.0) or 0.0,
             num_dense_layers=get("first_k_dense_replace", 0) or 0,
-            expert_bias=get("topk_method", None) == "noaux_tc",
-            bias_update_factor=0.001 if get("topk_method", None) == "noaux_tc" else 0.0,
+            expert_bias=aux_free,
+            bias_update_factor=0.001 if aux_free else 0.0,
         )
         fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
         fields["moe"] = moe
-        # qwen3_moe uses qk per-head norms like qwen3
-        if get("model_type") in ("qwen3_moe", "qwen3moe"):
+        # qwen3_moe uses qk per-head norms like qwen3; glm4_moe gates them
+        if model_type in ("qwen3_moe", "qwen3moe"):
             fields["qk_norm"] = True
+        elif is_glm4:
+            fields["qk_norm"] = bool(get("use_qk_norm", False))
         return cls(**fields)
 
 
@@ -158,7 +167,9 @@ def forward_hidden(
         position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
     h = params["embed"]["embedding"].astype(cd)[input_ids]
     h = constrain(h, ("batch", "seq", None))
-    cos, sin = rope_table(position_ids, rope_dim or cfg.head_dim, cfg.rope)
+    cos, sin = rope_table(
+        position_ids, rope_dim or cfg.rope_dim or cfg.head_dim, cfg.rope
+    )
 
     def maybe_remat(fn):
         if backend.remat == "full":

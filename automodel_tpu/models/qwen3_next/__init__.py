@@ -1,0 +1,7 @@
+from automodel_tpu.models.qwen3_next.model import (
+    Qwen3NextConfig,
+    Qwen3NextForCausalLM,
+)
+from automodel_tpu.models.qwen3_next.state_dict_adapter import Qwen3NextStateDictAdapter
+
+__all__ = ["Qwen3NextConfig", "Qwen3NextForCausalLM", "Qwen3NextStateDictAdapter"]

@@ -1,0 +1,184 @@
+"""HF ⇄ native adapter for Qwen3-Next (hybrid DeltaNet + full attention).
+
+Parity: reference models/qwen3_next/state_dict_adapter shape of the problem.
+Native layout splits heterogeneous layers into two stacked subtrees
+(full_attn / linear_attn) plus an all-layers stack for norms+MoE (see
+model.py); HF keys are per-layer ``model.layers.{i}.(self_attn|linear_attn)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.qwen3_next.model import Qwen3NextConfig
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+class Qwen3NextStateDictAdapter:
+    def __init__(self, config: Qwen3NextConfig):
+        self.config = config
+        self.full_ids = [
+            i for i, t in enumerate(config.layer_types) if t == "full_attention"
+        ]
+        self.linear_ids = [
+            i for i, t in enumerate(config.layer_types) if t == "linear_attention"
+        ]
+
+    # (native path under full_attn, hf suffix, transpose)
+    _FULL = [
+        (("q_proj", "kernel"), "self_attn.q_proj.weight", True),
+        (("k_proj", "kernel"), "self_attn.k_proj.weight", True),
+        (("v_proj", "kernel"), "self_attn.v_proj.weight", True),
+        (("o_proj", "kernel"), "self_attn.o_proj.weight", True),
+        (("q_norm", "scale"), "self_attn.q_norm.weight", False),
+        (("k_norm", "scale"), "self_attn.k_norm.weight", False),
+    ]
+    _LINEAR = [
+        (("in_qkvz", "kernel"), "linear_attn.in_proj_qkvz.weight", True),
+        (("in_ba", "kernel"), "linear_attn.in_proj_ba.weight", True),
+        (("dt_bias",), "linear_attn.dt_bias", False),
+        (("A_log",), "linear_attn.A_log", False),
+        (("norm", "scale"), "linear_attn.norm.weight", False),
+        (("out_proj", "kernel"), "linear_attn.out_proj.weight", True),
+    ]
+
+    def iter_from_hf(self, get_tensor: Callable[[str], np.ndarray]):
+        c = self.config
+        moe = c.moe
+        L = c.num_layers
+
+        yield ("embed", "embedding"), get_tensor("model.embed_tokens.weight")
+        yield ("final_norm", "scale"), get_tensor("model.norm.weight")
+        if not c.tie_embeddings:
+            yield ("lm_head", "kernel"), _t(get_tensor("lm_head.weight"))
+
+        for name, hf in [("input_norm", "input_layernorm"), ("post_attn_norm", "post_attention_layernorm")]:
+            yield ("layers", name, "scale"), np.stack(
+                [get_tensor(f"model.layers.{i}.{hf}.weight") for i in range(L)], 0
+            )
+
+        # MoE on every layer
+        yield ("layers", "moe", "router", "weight"), np.stack(
+            [_t(get_tensor(f"model.layers.{i}.mlp.gate.weight")) for i in range(L)], 0
+        )
+        gus, dns = [], []
+        for i in range(L):
+            g = [_t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.gate_proj.weight")) for j in range(moe.num_experts)]
+            u = [_t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.up_proj.weight")) for j in range(moe.num_experts)]
+            d = [_t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.down_proj.weight")) for j in range(moe.num_experts)]
+            gus.append(np.stack([np.concatenate([gj, uj], -1) for gj, uj in zip(g, u)], 0))
+            dns.append(np.stack(d, 0))
+        yield ("layers", "moe", "experts", "gate_up"), np.stack(gus, 0)
+        yield ("layers", "moe", "experts", "down"), np.stack(dns, 0)
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            yield ("layers", "moe", "shared", name, "kernel"), np.stack(
+                [_t(get_tensor(f"model.layers.{i}.mlp.shared_expert.{name}.weight")) for i in range(L)], 0
+            )
+        yield ("layers", "moe", "shared_gate", "kernel"), np.stack(
+            [_t(get_tensor(f"model.layers.{i}.mlp.shared_expert_gate.weight")) for i in range(L)], 0
+        )
+
+        for path, suffix, tr in self._FULL:
+            rows = [get_tensor(f"model.layers.{i}.{suffix}") for i in self.full_ids]
+            yield ("full_attn", *path), np.stack([_t(r) if tr else r for r in rows], 0)
+        for path, suffix, tr in self._LINEAR:
+            rows = [get_tensor(f"model.layers.{i}.{suffix}") for i in self.linear_ids]
+            yield ("linear_attn", *path), np.stack([_t(r) if tr else r for r in rows], 0)
+        # conv1d [C, 1, K] → depthwise [C, K]
+        yield ("linear_attn", "conv", "weight"), np.stack(
+            [
+                get_tensor(f"model.layers.{i}.linear_attn.conv1d.weight")[:, 0, :]
+                for i in self.linear_ids
+            ],
+            0,
+        )
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        c = self.config
+        moe = c.moe
+        L = c.num_layers
+        yield "model.embed_tokens.weight", np.asarray(params["embed"]["embedding"])
+        yield "model.norm.weight", np.asarray(params["final_norm"]["scale"])
+        if not c.tie_embeddings:
+            yield "lm_head.weight", _t(np.asarray(params["lm_head"]["kernel"]))
+        for name, hf in [("input_norm", "input_layernorm"), ("post_attn_norm", "post_attention_layernorm")]:
+            leaf = np.asarray(params["layers"][name]["scale"])
+            for i in range(L):
+                yield f"model.layers.{i}.{hf}.weight", leaf[i]
+        router = np.asarray(params["layers"]["moe"]["router"]["weight"])
+        gu = np.asarray(params["layers"]["moe"]["experts"]["gate_up"])
+        dn = np.asarray(params["layers"]["moe"]["experts"]["down"])
+        I = dn.shape[2]
+        for i in range(L):
+            yield f"model.layers.{i}.mlp.gate.weight", _t(router[i])
+            for j in range(moe.num_experts):
+                yield f"model.layers.{i}.mlp.experts.{j}.gate_proj.weight", _t(gu[i, j, :, :I])
+                yield f"model.layers.{i}.mlp.experts.{j}.up_proj.weight", _t(gu[i, j, :, I:])
+                yield f"model.layers.{i}.mlp.experts.{j}.down_proj.weight", _t(dn[i, j])
+            for name in ("gate_proj", "up_proj", "down_proj"):
+                yield (
+                    f"model.layers.{i}.mlp.shared_expert.{name}.weight",
+                    _t(np.asarray(params["layers"]["moe"]["shared"][name]["kernel"][i])),
+                )
+            yield (
+                f"model.layers.{i}.mlp.shared_expert_gate.weight",
+                _t(np.asarray(params["layers"]["moe"]["shared_gate"]["kernel"][i])),
+            )
+        for path, suffix, tr in self._FULL:
+            node = params["full_attn"]
+            for kk in path:
+                node = node[kk]
+            leaf = np.asarray(node)
+            for row, i in enumerate(self.full_ids):
+                yield f"model.layers.{i}.{suffix}", (_t(leaf[row]) if tr else leaf[row])
+        for path, suffix, tr in self._LINEAR:
+            node = params["linear_attn"]
+            for kk in path:
+                node = node[kk]
+            leaf = np.asarray(node)
+            for row, i in enumerate(self.linear_ids):
+                yield f"model.layers.{i}.{suffix}", (_t(leaf[row]) if tr else leaf[row])
+        conv = np.asarray(params["linear_attn"]["conv"]["weight"])
+        for row, i in enumerate(self.linear_ids):
+            yield f"model.layers.{i}.linear_attn.conv1d.weight", conv[row][:, None, :]
+
+    def hf_keys(self) -> list[str]:
+        seen = []
+        for k, _ in self.to_hf_shapes():
+            seen.append(k)
+        return seen
+
+    def to_hf_shapes(self):
+        """(key, None) pairs without needing params — mirrors to_hf keys."""
+        c = self.config
+        L = c.num_layers
+        yield "model.embed_tokens.weight", None
+        yield "model.norm.weight", None
+        if not c.tie_embeddings:
+            yield "lm_head.weight", None
+        for i in range(L):
+            yield f"model.layers.{i}.input_layernorm.weight", None
+            yield f"model.layers.{i}.post_attention_layernorm.weight", None
+            yield f"model.layers.{i}.mlp.gate.weight", None
+            for j in range(c.moe.num_experts):
+                for n in ("gate_proj", "up_proj", "down_proj"):
+                    yield f"model.layers.{i}.mlp.experts.{j}.{n}.weight", None
+            for n in ("gate_proj", "up_proj", "down_proj"):
+                yield f"model.layers.{i}.mlp.shared_expert.{n}.weight", None
+            yield f"model.layers.{i}.mlp.shared_expert_gate.weight", None
+        for _, suffix, _tr in self._FULL:
+            for i in self.full_ids:
+                yield f"model.layers.{i}.{suffix}", None
+        for _, suffix, _tr in self._LINEAR + [((), "linear_attn.conv1d.weight", False)]:
+            for i in self.linear_ids:
+                yield f"model.layers.{i}.{suffix}", None

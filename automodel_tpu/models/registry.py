@@ -104,7 +104,19 @@ def _gpt_oss_builder(hf_config: Any, backend: BackendConfig):
     return GptOssForCausalLM(cfg, backend), GptOssStateDictAdapter(cfg)
 
 
-@register_architecture("Qwen3MoeForCausalLM")
+@register_architecture("Qwen3NextForCausalLM")
+def _qwen3_next_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.qwen3_next import (
+        Qwen3NextConfig,
+        Qwen3NextForCausalLM,
+        Qwen3NextStateDictAdapter,
+    )
+
+    cfg = Qwen3NextConfig.from_hf(hf_config)
+    return Qwen3NextForCausalLM(cfg, backend), Qwen3NextStateDictAdapter(cfg)
+
+
+@register_architecture("Qwen3MoeForCausalLM", "Glm4MoeForCausalLM")
 def _moe_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.qwen3_moe import (
         MoEForCausalLM,

@@ -150,6 +150,11 @@ def apply_rope(
     ``interleave``: checkpoint stores pair-interleaved rope dims (DeepSeek
     MLA, HF `rope_interleave` / apply_rotary_pos_emb_interleave) — deinterleave
     [x0,y0,x1,y1,...] → [x0,x1,...,y0,y1,...] before the rotation.
+
+    Partial rotary (GLM-4 / phi-style ``partial_rotary_factor``): when the
+    table's last dim is smaller than the head dim, only the first
+    ``rotary_dim`` channels rotate and the rest pass through (HF
+    apply_rotary_pos_emb slices the same way).
     """
 
     def deint(x: jnp.ndarray) -> jnp.ndarray:
@@ -161,8 +166,17 @@ def apply_rope(
         x1, x2 = x[..., :half], x[..., half:]
         return jnp.concatenate([-x2, x1], axis=-1)
 
+    rotary_dim = cos.shape[-1]
+    q_pass = k_pass = None
+    if rotary_dim < q.shape[-1]:
+        q, q_pass = q[..., :rotary_dim], q[..., rotary_dim:]
+        k, k_pass = k[..., :rotary_dim], k[..., rotary_dim:]
     if interleave:
         q, k = deint(q), deint(k)
     c = cos[..., None, :].astype(q.dtype)
     s = sin[..., None, :].astype(q.dtype)
-    return q * c + rot(q) * s, k * c + rot(k) * s
+    q, k = q * c + rot(q) * s, k * c + rot(k) * s
+    if q_pass is not None:
+        q = jnp.concatenate([q, q_pass], axis=-1)
+        k = jnp.concatenate([k, k_pass], axis=-1)
+    return q, k

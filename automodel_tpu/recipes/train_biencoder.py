@@ -1,0 +1,83 @@
+"""Biencoder contrastive training recipe.
+
+Parity: reference recipes/biencoder/train_biencoder.py (790 LoC contrastive
+trainer; hard-negative mining is an offline pipeline there, out of scope).
+Reuses the finetune skeleton — mesh, optimizer, step scheduler,
+checkpointing, JSONL metrics — swapping in the bidirectional embedding
+model (models/biencoder), the in-batch-negatives InfoNCE loss, and the
+retrieval collator (data/retrieval.py).
+
+YAML additions over train_ft:
+  model.pooling: avg|cls|last     model.normalize: true
+  loss_fn: {temperature: 0.02}
+  dataset: a data/retrieval.py dataset
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.data.loader import DataLoader
+from automodel_tpu.data.retrieval import retrieval_collater
+from automodel_tpu.models.biencoder import LlamaBidirectionalModel, contrastive_loss
+from automodel_tpu.recipes.train_ft import TrainFinetuneRecipeForNextTokenPrediction
+
+logger = logging.getLogger(__name__)
+
+
+class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    def _build_auto(self, mcfg: Any, backend: dict):
+        auto = super()._build_auto(mcfg, backend)
+        base = auto.model
+        bi = LlamaBidirectionalModel(
+            base.config,
+            base.backend,
+            pooling=mcfg.get("pooling", "avg"),
+            normalize=bool(mcfg.get("normalize", True)),
+        )
+        # the embedding model never uses lm_head: dropping it avoids Adam
+        # moments + fp32 grad buffers for it and keeps weight decay from
+        # silently corrupting a checkpointed head that gets no gradients
+        params = dict(auto.params)
+        params.pop("lm_head", None)
+        return dataclasses.replace(auto, model=bi, params=params)
+
+    def setup(self) -> None:
+        super().setup()
+        # replace the causal-LM loss with the contrastive objective
+        lcfg = dict(self.cfg.get("loss_fn", {}) or {})
+        lcfg.pop("_target_", None)
+        lcfg.pop("name", None)
+        temperature = float(lcfg.get("temperature", 0.02))
+        model, constrain = self.model, self.auto.constrain
+
+        def loss_fn(params, mb):
+            q = model(
+                params, mb["query_input_ids"],
+                attention_mask=mb["query_attention_mask"], constrain=constrain,
+            )
+            d = model(
+                params, mb["doc_input_ids"],
+                attention_mask=mb["doc_attention_mask"], constrain=constrain,
+            )
+            return contrastive_loss(q, d, temperature=temperature)
+
+        from automodel_tpu.training.train_step import build_eval_step, build_train_step
+
+        self.loss_fn = loss_fn
+        self.train_step = build_train_step(loss_fn, self.optimizer, self.lr_schedule)
+        self.eval_step = build_eval_step(loss_fn)
+
+    def _build_dataloader(self, dataset_cfg: Any, dl_cfg: Any) -> DataLoader:
+        dl = dict(dl_cfg or {})
+        dl.setdefault("collate_fn", retrieval_collater)
+        return super()._build_dataloader(dataset_cfg, dl)
+
+
+def main(cfg: ConfigNode) -> dict:
+    recipe = TrainBiencoderRecipe(cfg)
+    recipe.setup()
+    return recipe.run_train_validation_loop()

@@ -75,18 +75,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # model
         mcfg = cfg.model
         backend = dict(mcfg.get("backend", {}) or {})
-        if mcfg.get("pretrained_model_name_or_path"):
-            self.auto = auto_model.from_pretrained(
-                mcfg.pretrained_model_name_or_path, self.mesh_ctx, backend
-            )
-        else:
-            hf_config = mcfg.get("hf_config")
-            self.auto = auto_model.from_config(
-                hf_config.to_dict() if isinstance(hf_config, ConfigNode) else hf_config,
-                self.mesh_ctx,
-                backend,
-                seed=cfg.get("seed", 42),
-            )
+        self.auto = self._build_auto(mcfg, backend)
         self.model = self.auto.model
 
         # peft (LoRA): trainable tree = adapters only; base closed over frozen
@@ -172,6 +161,20 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         log_cfg = cfg.get("logging", ConfigNode())
         self.metric_logger = MetricLogger(log_cfg.get("metrics_path", "train_metrics.jsonl"))
 
+    def _build_auto(self, mcfg: Any, backend: dict):
+        """Subclass hook (biencoder recipe wraps the model)."""
+        if mcfg.get("pretrained_model_name_or_path"):
+            return auto_model.from_pretrained(
+                mcfg.pretrained_model_name_or_path, self.mesh_ctx, backend
+            )
+        hf_config = mcfg.get("hf_config")
+        return auto_model.from_config(
+            hf_config.to_dict() if isinstance(hf_config, ConfigNode) else hf_config,
+            self.mesh_ctx,
+            backend,
+            seed=self.cfg.get("seed", 42),
+        )
+
     def _wrap_optimizer(self, optimizer: Any, trainable: Any) -> Any:
         """Subclass hook (VLM recipe: freeze-pattern masking)."""
         return optimizer
@@ -246,7 +249,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         t0 = time.perf_counter()
         for group in self.step_scheduler:
             stacked = stack_microbatches(group)
-            n_tokens_batch = int(np.prod(stacked["input_ids"].shape))
+            # tps numerator: all *input_ids leaves (biencoder batches carry
+            # query_/doc_input_ids instead of a single input_ids)
+            n_tokens_batch = int(
+                sum(
+                    np.prod(v.shape)
+                    for k, v in stacked.items()
+                    if k.endswith("input_ids")
+                )
+            )
             batch = place_batch(self.mesh_ctx, stacked)
             self.state, metrics = self.train_step(self.state, batch)
             if self.step_scheduler.is_log_step:
