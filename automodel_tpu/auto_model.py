@@ -37,6 +37,10 @@ class AutoModel:
     params: Any
     adapter: Any
     mesh_ctx: Optional[MeshContext]
+    # provenance for consolidated-HF export (config.json / tokenizer copies —
+    # reference ConsolidatedHFAddon, checkpoint/addons.py)
+    hf_config: Optional[dict] = None
+    source_dir: Optional[str] = None
 
     @property
     def config(self):
@@ -90,7 +94,10 @@ def from_config(
             mesh_ctx, jax.eval_shape(model.init, key), model.sharding_rules
         )
         params = jax.jit(model.init, out_shardings=shardings)(key)
-    return AutoModel(model=model, params=params, adapter=adapter, mesh_ctx=mesh_ctx)
+    return AutoModel(
+        model=model, params=params, adapter=adapter, mesh_ctx=mesh_ctx,
+        hf_config=hf_config if isinstance(hf_config, dict) else None,
+    )
 
 
 def from_pretrained(
@@ -112,13 +119,23 @@ def from_pretrained(
     if mesh_ctx is not None:
         abstract = jax.eval_shape(model.init, jax.random.key(0))
         shardings = make_param_shardings(mesh_ctx, abstract, model.sharding_rules)
+    # variant-layout checkpoints (fused qkv/gate_up) present a canonical
+    # view through the conversion mapping (reference conversion_mapping.py)
+    from automodel_tpu.checkpoint.conversion_mapping import detect_remaps
+    from automodel_tpu.checkpoint.hf_io import HFCheckpointReader
+
+    reader = HFCheckpointReader(ckpt_dir)
+    reader = detect_remaps(reader, hf_config) or reader
     params = load_params_from_hf(
         adapter,
-        ckpt_dir,
+        reader,
         shardings=shardings,
         dtype=_np_dtype(backend.param_dtype),
     )
-    return AutoModel(model=model, params=params, adapter=adapter, mesh_ctx=mesh_ctx)
+    return AutoModel(
+        model=model, params=params, adapter=adapter, mesh_ctx=mesh_ctx,
+        hf_config=hf_config, source_dir=str(ckpt_dir),
+    )
 
 
 def _as_backend(

@@ -34,12 +34,31 @@ class CheckpointingConfig:
     save_consolidated: bool = False
     keep_last_k: int = 0  # 0 = keep all
     restore_from: Optional[str] = None
+    # async staged save: the orbax save returns immediately and uploads in
+    # the background; the next save (or close()) waits for it — reference
+    # async staging, checkpointing.py:84-97,519-540
+    is_async: bool = False
 
 
 class Checkpointer:
     def __init__(self, config: CheckpointingConfig):
         self.config = config
         self.root = Path(config.checkpoint_dir)
+        self._async: Optional[ocp.AsyncCheckpointer] = None
+        if config.is_async:
+            self._async = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+
+    def wait(self) -> None:
+        """Block until any in-flight async save finishes (the reference gates
+        the next optimizer step on staging, train_ft.py:1336)."""
+        if self._async is not None:
+            self._async.wait_until_finished()
+
+    def close(self) -> None:
+        if self._async is not None:
+            self._async.wait_until_finished()
+            self._async.close()
+            self._async = None
 
     # -- paths --------------------------------------------------------------
     def step_dir(self, epoch: int, step: int) -> Path:
@@ -50,7 +69,14 @@ class Checkpointer:
             return Path(self.config.restore_from)
         if not self.root.exists():
             return None
-        cands = [p for p in self.root.iterdir() if p.is_dir() and p.name.startswith("epoch_")]
+        # only COMMITTED checkpoints count: orbax writes to a tmp-suffixed
+        # dir and renames to `state` on completion, so a crash mid-async-save
+        # leaves no `state/` and auto-resume falls back to the previous step
+        cands = [
+            p
+            for p in self.root.iterdir()
+            if p.is_dir() and p.name.startswith("epoch_") and (p / "state").exists()
+        ]
         if not cands:
             return None
         return max(cands, key=lambda p: int(p.name.rsplit("_", 1)[1]))
@@ -64,15 +90,22 @@ class Checkpointer:
         extra_state: dict[str, dict] | None = None,
         hf_export: Any = None,  # (adapter, params) for consolidated HF save
         config_snapshot: dict | None = None,
+        hf_meta: dict | None = None,  # {"hf_config": dict, "source_dir": str}
     ) -> Path:
         out = self.step_dir(epoch, step)
         out.mkdir(parents=True, exist_ok=True)
         # saving the same step twice (cadence save + end-of-loop save) is
         # idempotent: replace the previous state dir
+        self.wait()  # at most one async save in flight
         if (out / "state").exists():
             shutil.rmtree(out / "state")
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save((out / "state").absolute(), state)
+        if self._async is not None:
+            self._async.save(
+                (out / "state").absolute(), args=ocp.args.StandardSave(state)
+            )
+        else:
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save((out / "state").absolute(), state)
         if extra_state:
             (out / "extra_state.json").write_text(json.dumps(extra_state, default=_json_default))
         if config_snapshot:
@@ -80,6 +113,7 @@ class Checkpointer:
         if hf_export is not None and (
             self.config.save_consolidated or self.config.model_save_format == "safetensors"
         ):
+            from automodel_tpu.checkpoint.addons import write_hf_addons
             from automodel_tpu.checkpoint.hf_io import save_hf_checkpoint
 
             adapter, params = hf_export
@@ -87,6 +121,7 @@ class Checkpointer:
             # time — device→host transfer streams per leaf, and
             # save_hf_checkpoint flushes shard files as they fill.
             save_hf_checkpoint(out / "hf", adapter.to_hf(params))
+            write_hf_addons(out / "hf", **(hf_meta or {}))
         self._prune()
         return out
 

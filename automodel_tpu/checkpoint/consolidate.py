@@ -1,0 +1,92 @@
+"""Offline orbax→consolidated-HF conversion tool.
+
+Parity: reference tools/offline_hf_consolidation.py — turn an existing
+training run's sharded checkpoint into a transformers-loadable HF dir
+without re-running the recipe.
+
+Usage:
+    python -m automodel_tpu.checkpoint.consolidate <step_dir> <out_dir>
+
+``step_dir`` is an epoch_X_step_Y directory containing ``state/`` (orbax)
+and ``config.json`` (the recipe config snapshot, written at save time).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def consolidate(step_dir: str | Path, out_dir: str | Path) -> Path:
+    import orbax.checkpoint as ocp
+
+    from automodel_tpu.checkpoint.addons import write_hf_addons
+    from automodel_tpu.checkpoint.hf_io import save_hf_checkpoint
+    from automodel_tpu.models.common.config import BackendConfig
+    from automodel_tpu.models.registry import resolve_architecture
+
+    step_dir = Path(step_dir)
+    snap = json.loads((step_dir / "config.json").read_text())
+    mcfg = snap.get("model", {})
+    hf_config = mcfg.get("hf_config")
+    source_dir = None
+    if hf_config is None:
+        # from_pretrained runs: read the source checkpoint's config
+        source_dir = mcfg.get("pretrained_model_name_or_path")
+        cfg_file = Path(source_dir or "") / "config.json"
+        if not cfg_file.exists():
+            raise FileNotFoundError(
+                "config snapshot has no model.hf_config and the source dir "
+                f"config is unavailable ({cfg_file})"
+            )
+        hf_config = json.loads(cfg_file.read_text())
+
+    backend = BackendConfig(**{
+        k: v for k, v in dict(mcfg.get("backend", {}) or {}).items() if k != "_target_"
+    })
+    model, adapter = resolve_architecture(hf_config)(hf_config, backend)
+
+    # restore on host: rebuild the full TrainState abstract tree (orbax
+    # restores by pytree structure) from the recipe's config snapshot
+    import jax
+
+    from automodel_tpu.optim.builders import build_optimizer
+    from automodel_tpu.training.train_state import TrainState
+
+    ocfg = dict(snap.get("optimizer", {}) or {"name": "adamw"})
+    ocfg.pop("_target_", None)
+    optimizer = build_optimizer(**ocfg)
+    abstract_params = jax.eval_shape(model.init, jax.random.key(0))
+    abstract = jax.eval_shape(
+        lambda p: TrainState.create(p, optimizer.init(p)), abstract_params
+    )
+    # restore everything onto one local device (host consolidation)
+    dev = jax.local_devices()[0]
+    one = jax.sharding.SingleDeviceSharding(dev)
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=one), abstract
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore((step_dir / "state").absolute(), abstract)
+    params = jax.tree.map(np.asarray, state.params)
+
+    out = Path(out_dir)
+    save_hf_checkpoint(out, adapter.to_hf(params))
+    write_hf_addons(out, hf_config=hf_config, source_dir=source_dir)
+    return out
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__)
+        return 2
+    out = consolidate(argv[0], argv[1])
+    print(f"consolidated HF checkpoint written to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,130 @@
+"""HF checkpoint key-conversion mappings.
+
+Parity: reference checkpoint/conversion_mapping.py (228 LoC) — some hub
+checkpoints store keys under older/newer HF conventions than the adapters
+expect (renames, and FUSED tensors like ``qkv_proj``/``gate_up_proj`` that
+must split into the canonical per-projection keys). A ``RemappedReader``
+wraps HFCheckpointReader and presents the canonical view, so state-dict
+adapters never see the variant layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Rename:
+    """Key regex rename: canonical key ``sub`` of ``pattern``."""
+
+    pattern: str
+    sub: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """A fused on-disk tensor serving several canonical keys.
+
+    ``pattern``: regex over the fused on-disk key, with groups usable in the
+    target templates. ``targets``: canonical key template → slicer taking
+    (fused array, sizes dict) → split array. ``sizes`` names are resolved
+    from the model's HF config by the caller.
+    """
+
+    pattern: str
+    targets: dict[str, Callable[[np.ndarray, dict], np.ndarray]]
+
+
+# phi3 / fused-qkv style checkpoints: qkv_proj.weight = [q; k; v] rows,
+# gate_up_proj.weight = [gate; up] rows (torch Linear [out, in])
+FUSED_QKV = Split(
+    pattern=r"^(?P<p>.*\.self_attn\.)qkv_proj\.weight$",
+    targets={
+        r"\g<p>q_proj.weight": lambda a, s: a[: s["q"]],
+        r"\g<p>k_proj.weight": lambda a, s: a[s["q"] : s["q"] + s["kv"]],
+        r"\g<p>v_proj.weight": lambda a, s: a[s["q"] + s["kv"] :],
+    },
+)
+FUSED_GATE_UP = Split(
+    pattern=r"^(?P<p>.*\.mlp\.)gate_up_proj\.weight$",
+    targets={
+        r"\g<p>gate_proj.weight": lambda a, s: a[: a.shape[0] // 2],
+        r"\g<p>up_proj.weight": lambda a, s: a[a.shape[0] // 2 :],
+    },
+)
+
+
+class RemappedReader:
+    """Reader wrapper presenting canonical keys over a variant checkpoint."""
+
+    def __init__(
+        self,
+        reader: Any,
+        renames: Sequence[Rename] = (),
+        splits: Sequence[Split] = (),
+        sizes: Optional[dict] = None,
+    ):
+        self.reader = reader
+        self.sizes = sizes or {}
+        self._rename_to_raw: dict[str, str] = {}
+        self._split_sources: dict[str, tuple[str, Callable]] = {}
+        raw_keys = list(reader.keys())
+        consumed: set[str] = set()
+        for raw in raw_keys:
+            for r in renames:
+                if re.match(r.pattern, raw):
+                    self._rename_to_raw[re.sub(r.pattern, r.sub, raw)] = raw
+                    consumed.add(raw)
+                    break
+            for sp in splits:
+                m = re.match(sp.pattern, raw)
+                if m:
+                    for tmpl, slicer in sp.targets.items():
+                        self._split_sources[m.expand(tmpl)] = (raw, slicer)
+                    consumed.add(raw)
+        self._passthrough = [k for k in raw_keys if k not in consumed]
+
+    def keys(self) -> list[str]:
+        return (
+            self._passthrough
+            + list(self._rename_to_raw)
+            + list(self._split_sources)
+        )
+
+    def get_tensor(self, key: str) -> np.ndarray:
+        if key in self._split_sources:
+            raw, slicer = self._split_sources[key]
+            return np.ascontiguousarray(slicer(self.reader.get_tensor(raw), self.sizes))
+        raw = self._rename_to_raw.get(key, key)
+        return self.reader.get_tensor(raw)
+
+    def info(self, key: str):
+        if key in self._split_sources:
+            return "BF16", tuple(self.get_tensor(key).shape)
+        return self.reader.info(self._rename_to_raw.get(key, key))
+
+    def close(self) -> None:
+        self.reader.close()
+
+
+def detect_remaps(reader: Any, hf_config: Optional[dict] = None) -> Optional[RemappedReader]:
+    """Wrap `reader` when a known variant layout is detected (fused qkv /
+    gate_up); None when the checkpoint is already canonical."""
+    keys = reader.keys()
+    has_fused = any(k.endswith(".self_attn.qkv_proj.weight") for k in keys) or any(
+        k.endswith(".mlp.gate_up_proj.weight") for k in keys
+    )
+    if not has_fused:
+        return None
+    get = lambda k, d=None: (hf_config or {}).get(k, d)
+    heads = get("num_attention_heads") or 1
+    head_dim = get("head_dim") or (get("hidden_size", 0) // heads)
+    sizes = {
+        "q": heads * head_dim,
+        "kv": (get("num_key_value_heads") or heads) * head_dim,
+    }
+    return RemappedReader(reader, splits=(FUSED_QKV, FUSED_GATE_UP), sizes=sizes)

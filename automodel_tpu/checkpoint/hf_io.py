@@ -358,8 +358,8 @@ def load_params_from_hf(
     is O(largest row), not O(model)."""
     import jax
 
-    if not isinstance(reader, HFCheckpointReader):
-        reader = HFCheckpointReader(reader)
+    if not hasattr(reader, "get_tensor"):  # path-like → open; readers
+        reader = HFCheckpointReader(reader)  # (incl. RemappedReader) pass through
 
     def get(key: str) -> np.ndarray:
         arr = reader.get_tensor(key)

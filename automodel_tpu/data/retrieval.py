@@ -59,6 +59,15 @@ class RetrievalDataset:
         row = self.dataset[idx]
         negs = list(row.get(self.negatives_column, []) or []) if self.negatives_column else []
         negs = (negs * self.n_negatives)[: self.n_negatives] if negs else []
+        if self.n_negatives and len(negs) < self.n_negatives:
+            # rows without hard negatives fall back to random corpus
+            # passages, keeping per-example negative counts rectangular for
+            # the collator (random negatives are the standard degenerate case)
+            rng = np.random.default_rng(hash((self.query_column, idx)) & 0x7FFFFFFF)
+            while len(negs) < self.n_negatives:
+                j = int(rng.integers(0, len(self.dataset)))
+                if j != idx:
+                    negs.append(self.dataset[j][self.positive_column])
         return {
             "query_ids": self._encode(row[self.query_column], self.query_prefix),
             "positive_ids": self._encode(row[self.positive_column], self.passage_prefix),

@@ -21,13 +21,16 @@ def _to_scalar(v: Any) -> Any:
 
 
 class MetricLogger:
-    """Append-only JSONL metrics file; one record per call."""
+    """Append-only JSONL metrics file; one record per call. ``sinks`` fan
+    the same record out to wandb / MLflow style loggers (anything with
+    ``.log(dict, step)``)."""
 
-    def __init__(self, path: str, wandb_run: Any = None):
+    def __init__(self, path: str, wandb_run: Any = None, sinks: Any = None):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._f = open(self.path, "a")
         self.wandb_run = wandb_run
+        self.sinks = list(sinks or [])
 
     def log(self, metrics: dict[str, Any], step: int | None = None) -> None:
         rec = {k: _to_scalar(v) for k, v in metrics.items()}
@@ -38,6 +41,12 @@ class MetricLogger:
         self._f.flush()
         if self.wandb_run is not None:
             self.wandb_run.log(rec, step=step)
+        for s in self.sinks:
+            s.log(rec, step=step)
 
     def close(self) -> None:
         self._f.close()
+        for s in self.sinks:
+            close = getattr(s, "close", None)
+            if close:
+                close()

@@ -1,0 +1,46 @@
+"""MLflow metric sink (parity: reference loggers/mlflow_utils.py:24).
+
+Import-guarded like wandb; exposes the same ``.log(dict, step)`` interface
+the JSONL logger fans out to."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class MLflowLogger:
+    def __init__(
+        self,
+        tracking_uri: Optional[str] = None,
+        experiment: Optional[str] = None,
+        run_name: Optional[str] = None,
+    ):
+        try:
+            import mlflow
+        except ImportError:
+            logger.warning("mlflow requested but not installed; disabled")
+            self.mlflow = None
+            return
+        self.mlflow = mlflow
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        if experiment:
+            mlflow.set_experiment(experiment)
+        self._run = mlflow.start_run(run_name=run_name)
+
+    def log(self, metrics: dict[str, Any], step: int | None = None) -> None:
+        if self.mlflow is None:
+            return
+        scalars = {
+            k: float(v)
+            for k, v in metrics.items()
+            if isinstance(v, (int, float)) and k != "ts"
+        }
+        self.mlflow.log_metrics(scalars, step=step)
+
+    def close(self) -> None:
+        if self.mlflow is not None:
+            self.mlflow.end_run()

@@ -1,0 +1,27 @@
+"""Weights & Biases setup (parity: reference loggers/wandb_utils.py).
+
+Import-guarded: wandb is an optional dependency; when missing, setup
+returns None and the recipe logs JSONL only."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def setup_wandb(
+    project: Optional[str] = None,
+    name: Optional[str] = None,
+    config: Optional[dict] = None,
+    mode: str = "online",
+    **kwargs: Any,
+):
+    """→ a wandb run (usable as MetricLogger's wandb_run) or None."""
+    try:
+        import wandb
+    except ImportError:
+        logger.warning("wandb requested but not installed; JSONL metrics only")
+        return None
+    return wandb.init(project=project, name=name, config=config, mode=mode, **kwargs)

@@ -231,6 +231,15 @@ def forward_hidden(
 ) -> tuple[jnp.ndarray, MoEModelAux]:
     cd = backend.compute_jnp_dtype
     B, S = input_ids.shape
+    if segment_ids is not None:
+        # the conv + delta-rule recurrence would leak context across packed
+        # document boundaries; fail loudly until segment resets exist in the
+        # chunked kernel
+        raise NotImplementedError(
+            "qwen3-next linear-attention layers do not support packed "
+            "sequences (segment_ids) yet — the recurrent state has no "
+            "segment reset; use unpacked batches"
+        )
     if position_ids is None:
         position_ids = jnp.broadcast_to(
             jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)

@@ -126,6 +126,72 @@ def fused_linear_cross_entropy(
     return loss, n
 
 
+def vocab_parallel_cross_entropy(
+    hidden: jnp.ndarray,
+    lm_head_kernel: jnp.ndarray,
+    labels: jnp.ndarray,
+    mesh_ctx,
+    logits_soft_cap: float | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """TP loss-parallel CE: the lm_head projection AND the softmax run with
+    the vocab dim sharded over the ``tensor`` axis — full [T, V] logits never
+    exist on any device (reference: TEParallelCrossEntropy,
+    loss/te_parallel_ce.py:113 over Triton online-softmax kernels; here a
+    shard_map online softmax with psum/pmax collectives over ICI).
+
+    hidden [..., D] (replicated over tensor), lm_head_kernel [D, V] sharded
+    on V, labels [...]. Returns (loss_sum fp32, n_valid) replicated.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh_ctx.mesh
+    tp = mesh.shape["tp"]
+    d = hidden.shape[-1]
+    flat_h = hidden.reshape(-1, d)
+    flat_labels = labels.reshape(-1)
+    if tp == 1:
+        return fused_linear_cross_entropy(
+            hidden, lm_head_kernel, labels, logits_soft_cap=logits_soft_cap
+        )
+
+    def body(h, kern, lb):
+        # local shard: kern [D, V/tp]
+        vl = kern.shape[-1]
+        logits = (h @ kern).astype(jnp.float32)
+        if logits_soft_cap is not None:
+            logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+        # max shift is gradient-free (lse is invariant to it) and pmax has
+        # no differentiation rule — stop the gradient BEFORE pmax so the
+        # collective only ever sees constants
+        m = jax.lax.pmax(jax.lax.stop_gradient(logits.max(-1)), "tp")  # [T]
+        z = jax.lax.psum(jnp.exp(logits - m[:, None]).sum(-1), "tp")
+        lse = jnp.log(z) + m
+        off = jax.lax.axis_index("tp") * vl
+        local = (lb >= off) & (lb < off + vl)
+        idx = jnp.clip(lb - off, 0, vl - 1)
+        picked = jnp.take_along_axis(logits, idx[:, None], 1)[:, 0]
+        correct = jax.lax.psum(jnp.where(local, picked, 0.0), "tp")
+        valid = lb != IGNORE_INDEX
+        loss = jnp.where(valid, lse - correct, 0.0)
+        # post-psum the value is identical on every tp shard; out_specs must
+        # name the manual axis, so return [1]-per-shard and slice one copy
+        return loss.sum()[None], valid.sum(dtype=jnp.int32)[None]
+
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(None, "tp"), P()),
+        out_specs=(P("tp"), P("tp")),
+        axis_names={"tp"},
+        check_vma=False,
+    )
+    # partial-manual shard_map only traces under jit; harmless inside an
+    # outer jit (the train step), makes eager calls work too
+    loss, n = jax.jit(mapped)(flat_h, lm_head_kernel, flat_labels)
+    return loss[0], n[0]
+
+
 def kd_loss(
     student_logits: jnp.ndarray,
     teacher_logits: jnp.ndarray,

@@ -40,10 +40,18 @@ class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         )
         # the embedding model never uses lm_head: dropping it avoids Adam
         # moments + fp32 grad buffers for it and keeps weight decay from
-        # silently corrupting a checkpointed head that gets no gradients
+        # silently corrupting a checkpointed head that gets no gradients.
+        # The adapter must match the headless tree, or consolidated-HF saves
+        # would KeyError on the missing lm_head leaf — a tied-embeddings
+        # adapter emits no lm_head key
         params = dict(auto.params)
         params.pop("lm_head", None)
-        return dataclasses.replace(auto, model=bi, params=params)
+        adapter = auto.adapter
+        if hasattr(adapter, "config") and not adapter.config.tie_embeddings:
+            adapter = type(adapter)(
+                dataclasses.replace(adapter.config, tie_embeddings=True)
+            )
+        return dataclasses.replace(auto, model=bi, params=params, adapter=adapter)
 
     def setup(self) -> None:
         super().setup()

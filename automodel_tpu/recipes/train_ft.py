@@ -157,9 +157,25 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if self.checkpointer and self.checkpointer.has_checkpoint():
             self._restore()
 
-        # metrics
+        # metrics (JSONL + optional wandb/MLflow fan-out,
+        # reference train_ft.py:844-853)
         log_cfg = cfg.get("logging", ConfigNode())
-        self.metric_logger = MetricLogger(log_cfg.get("metrics_path", "train_metrics.jsonl"))
+        wandb_run, sinks = None, []
+        if log_cfg.get("wandb") is not None:
+            from automodel_tpu.loggers.wandb_utils import setup_wandb
+
+            wandb_run = setup_wandb(
+                config=cfg.to_dict(), **dict(log_cfg.get("wandb") or {})
+            )
+        if log_cfg.get("mlflow") is not None:
+            from automodel_tpu.loggers.mlflow_utils import MLflowLogger
+
+            sinks.append(MLflowLogger(**dict(log_cfg.get("mlflow") or {})))
+        self.metric_logger = MetricLogger(
+            log_cfg.get("metrics_path", "train_metrics.jsonl"),
+            wandb_run=wandb_run,
+            sinks=sinks,
+        )
 
     def _build_auto(self, mcfg: Any, backend: dict):
         """Subclass hook (biencoder recipe wraps the model)."""
@@ -206,6 +222,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             extra_state=extra,
             hf_export=hf_export,
             config_snapshot=self.cfg.to_dict(),
+            hf_meta={
+                "hf_config": self.auto.hf_config,
+                "source_dir": self.auto.source_dir,
+            },
         )
         if self.peft_config is not None:
             from automodel_tpu.peft import export_hf_peft
@@ -246,6 +266,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
     # -- train loop ---------------------------------------------------------
     def run_train_validation_loop(self) -> dict:
         last: dict = {}
+        first_step = True
         t0 = time.perf_counter()
         for group in self.step_scheduler:
             stacked = stack_microbatches(group)
@@ -263,11 +284,18 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             if self.step_scheduler.is_log_step:
                 metrics = {k: v for k, v in jax.device_get(metrics).items()}
                 dt = time.perf_counter() - t0
-                metrics["tps"] = n_tokens_batch / max(dt, 1e-9)
-                metrics["tps_per_device"] = metrics["tps"] / self.mesh_ctx.world_size
-                metrics["step_time_s"] = dt
+                if first_step:
+                    # the first step's wall time is dominated by XLA compile;
+                    # report it separately instead of polluting tps
+                    # (reference excludes warmup in the benchmark recipe)
+                    metrics["compile_time_s"] = dt
+                else:
+                    metrics["tps"] = n_tokens_batch / max(dt, 1e-9)
+                    metrics["tps_per_device"] = metrics["tps"] / self.mesh_ctx.world_size
+                    metrics["step_time_s"] = dt
                 self.metric_logger.log(metrics, step=int(metrics["step"]))
                 last = metrics
+            first_step = False
             if self.step_scheduler.is_val_step and self.val_dataloader is not None:
                 val = self.run_validation()
                 self.metric_logger.log(val, step=self.step_scheduler.step)
@@ -276,6 +304,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             t0 = time.perf_counter()
         if self.checkpointer:
             self.save_checkpoint()
+            self.checkpointer.close()  # drain any in-flight async save
         return last
 
     def run_validation(self) -> dict:

@@ -107,9 +107,11 @@ def build_train_step(
             metrics["moe_aux_loss"] = extras_sum["moe_aux_loss"] / batch_size(batch)
         if "expert_counts" in extras_sum:
             c = extras_sum["expert_counts"].astype(jnp.float32)  # [L, E]
-            metrics["expert_load_imbalance"] = (
-                c.max(axis=-1) / jnp.maximum(c.mean(axis=-1), 1.0)
-            ).mean()
+            per_layer = c.max(axis=-1) / jnp.maximum(c.mean(axis=-1), 1.0)
+            metrics["expert_load_imbalance"] = per_layer.mean()
+            # per-layer detail for the JSONL (reference:
+            # moe/load_balance_metrics.py detailed metrics)
+            metrics["expert_load_imbalance_per_layer"] = per_layer
         if lr_schedule is not None:
             metrics["lr"] = lr_schedule(state.step)
         new_state = TrainState(
@@ -162,14 +164,21 @@ def make_causal_lm_loss(
             for k in ("position_ids", "segment_ids", "pixel_values")
             if k in mb and mb[k] is not None
         }
-        if loss == "fused_linear_ce":
+        if loss in ("fused_linear_ce", "vocab_parallel_ce"):
             out = model.hidden(params, mb["input_ids"], constrain=constrain, **kw)
             hidden, maux = out if isinstance(out, tuple) else (out, None)
             kernel = model.lm_head(params).astype(hidden.dtype)
-            loss_sum, n = L.fused_linear_cross_entropy(
-                hidden, kernel, mb["labels"],
-                logits_soft_cap=model.config.logits_soft_cap, **loss_kwargs,
-            )
+            mesh_ctx = getattr(constrain, "mesh_ctx", None)
+            if loss == "vocab_parallel_ce" and mesh_ctx is not None:
+                loss_sum, n = L.vocab_parallel_cross_entropy(
+                    hidden, kernel, mb["labels"], mesh_ctx,
+                    logits_soft_cap=model.config.logits_soft_cap, **loss_kwargs,
+                )
+            else:
+                loss_sum, n = L.fused_linear_cross_entropy(
+                    hidden, kernel, mb["labels"],
+                    logits_soft_cap=model.config.logits_soft_cap, **loss_kwargs,
+                )
         else:
             out = model(params, mb["input_ids"], constrain=constrain, **kw)
             logits, maux = out if isinstance(out, tuple) else (out, None)
