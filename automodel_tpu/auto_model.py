@@ -150,7 +150,7 @@ def _as_backend(
             raise ValueError("attn='ring' (context parallel) requires a mesh")
         from automodel_tpu.parallel.cp import install_ring_backend
 
-        install_ring_backend(mesh_ctx)
+        install_ring_backend(mesh_ctx, zigzag=backend.cp_zigzag)
     return backend
 
 

@@ -62,12 +62,20 @@ class RetrievalDataset:
         if self.n_negatives and len(negs) < self.n_negatives:
             # rows without hard negatives fall back to random corpus
             # passages, keeping per-example negative counts rectangular for
-            # the collator (random negatives are the standard degenerate case)
-            rng = np.random.default_rng(hash((self.query_column, idx)) & 0x7FFFFFFF)
+            # the collator (random negatives are the standard degenerate
+            # case). Seed deterministically (python hash() is per-process
+            # randomized) and sample j != idx directly so single-row
+            # datasets fail fast instead of looping.
+            if len(self.dataset) <= 1:
+                raise ValueError(
+                    "cannot draw random negatives from a single-row dataset; "
+                    "provide a negatives column or set n_negatives=0"
+                )
+            rng = np.random.default_rng((9173, idx))
             while len(negs) < self.n_negatives:
-                j = int(rng.integers(0, len(self.dataset)))
-                if j != idx:
-                    negs.append(self.dataset[j][self.positive_column])
+                j = int(rng.integers(0, len(self.dataset) - 1))
+                j += j >= idx
+                negs.append(self.dataset[j][self.positive_column])
         return {
             "query_ids": self._encode(row[self.query_column], self.query_prefix),
             "positive_ids": self._encode(row[self.positive_column], self.passage_prefix),

@@ -1,0 +1,43 @@
+"""Tokenizer layer.
+
+Parity: reference `NeMoAutoTokenizer` (_transformers/auto_tokenizer.py:151)
+— a thin AutoTokenizer builder that guarantees the invariants the data
+pipeline relies on (a pad token exists; padding side is right for
+training), so datasets never need tokenizer-specific special-casing.
+The mistral-common adapter (tokenization_mistral_common.py, 2k LoC) is
+out of scope until a mistral-common dependency exists in-image.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def build_tokenizer(
+    pretrained_model_name_or_path: str,
+    use_fast: bool = True,
+    trust_remote_code: bool = False,
+    padding_side: str = "right",
+    **kwargs: Any,
+):
+    """AutoTokenizer with training-safe defaults (pad token guaranteed)."""
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(
+        pretrained_model_name_or_path,
+        use_fast=use_fast,
+        trust_remote_code=trust_remote_code,
+        **kwargs,
+    )
+    tok.padding_side = padding_side
+    if tok.pad_token is None:
+        if tok.eos_token is not None:
+            tok.pad_token = tok.eos_token
+            logger.info("tokenizer had no pad token; using eos (%r)", tok.eos_token)
+        else:
+            tok.add_special_tokens({"pad_token": "<|pad|>"})
+            logger.info("tokenizer had no pad/eos token; added <|pad|>")
+    return tok

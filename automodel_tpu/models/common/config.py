@@ -42,6 +42,13 @@ class BackendConfig:
     compute_dtype: str = "bfloat16"
     remat: str = "none"  # none | full | selective
     scan_layers: bool = True
+    # fp8 matmul recipe for dense projections (e4m3 fwd / e5m2 grads,
+    # per-tensor dynamic scaling — see ops/fp8.py; reference:
+    # quantization/fp8.py + BackendConfig.te_fp8)
+    fp8: bool = False
+    # ring attention with causally load-balanced zigzag seq layout —
+    # requires the DATA permuted via parallel.cp.apply_zigzag
+    cp_zigzag: bool = False
     pp_microbatches: int = 4  # pipeline microbatches when mesh pp > 1
     attn_block_q: int = 512
     attn_block_kv: int = 512

@@ -194,6 +194,9 @@ def forward_hidden(
     inputs_embeds: Optional[jnp.ndarray] = None,
     bidir_groups: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
+    from automodel_tpu.ops import fp8 as _fp8
+
+    _fp8.set_enabled(backend.fp8)
     cd = backend.compute_jnp_dtype
     B, S = input_ids.shape
     if position_ids is None:

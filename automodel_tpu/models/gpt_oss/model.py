@@ -150,6 +150,9 @@ def forward_hidden(
     segment_ids: Optional[jnp.ndarray] = None,
     constrain: Constrain = _noop_constrain,
 ) -> tuple[jnp.ndarray, MoEModelAux]:
+    from automodel_tpu.ops import fp8 as _fp8
+
+    _fp8.set_enabled(backend.fp8)
     cd = backend.compute_jnp_dtype
     B, S = input_ids.shape
     if position_ids is None:

@@ -91,7 +91,9 @@ def init_params(cfg: TransformerConfig, backend: BackendConfig, key: jax.Array) 
 
 
 def _proj(x: jnp.ndarray, p: dict) -> jnp.ndarray:
-    y = x @ p["kernel"].astype(x.dtype)
+    from automodel_tpu.ops import fp8 as _fp8
+
+    y = _fp8.maybe_fp8_dot(x, p["kernel"], _fp8.is_enabled())
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     return y
@@ -178,6 +180,9 @@ def forward_hidden(
     constrain: Constrain = _noop_constrain,
 ) -> jnp.ndarray:
     """Embed + decoder stack → final-norm hidden states [B, S, D]."""
+    from automodel_tpu.ops import fp8 as _fp8
+
+    _fp8.set_enabled(backend.fp8)  # trace-time switch for _proj
     cd = backend.compute_jnp_dtype
     if position_ids is None:
         position_ids = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)

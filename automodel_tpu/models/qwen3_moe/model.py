@@ -160,6 +160,9 @@ def forward_hidden(
     attn_block: Any = attention_block,
     rope_dim: Optional[int] = None,
 ) -> tuple[jnp.ndarray, MoEModelAux]:
+    from automodel_tpu.ops import fp8 as _fp8
+
+    _fp8.set_enabled(backend.fp8)
     cd = backend.compute_jnp_dtype
     moe = cfg.moe
     if position_ids is None:

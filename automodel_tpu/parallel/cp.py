@@ -16,12 +16,18 @@ Two layers:
   from the MeshContext and registers it as the ``"ring"`` backend in
   `ops.attention.ATTENTION_BACKENDS` via :func:`install_ring_backend`.
 
-Sharding is CONTIGUOUS on the seq dim (rank r holds positions
-[r·S/cp, (r+1)·S/cp)). With causal masking this is load-imbalanced (later
-ranks do more real work; every rank computes every block and masks) — the
-reference balances via THD round-robin partitioning (cp_utils.py:296-337).
-A zigzag layout is a planned perf upgrade; correctness and O(S/cp) memory
-hold either way.
+Two seq layouts:
+
+- CONTIGUOUS (default): rank r holds positions [r·S/cp, (r+1)·S/cp). Causal
+  masking makes this load-imbalanced (later ranks do more real work).
+- ZIGZAG (``zigzag=True``): the sequence splits into 2·cp chunks and rank r
+  holds chunks (r, 2cp-1-r) — every rank sees the same causal work, the
+  standard ring-attention balancing (the reference balances via THD
+  round-robin partitioning, cp_utils.py:296-337). The DATA must be permuted
+  into zigzag order first (:func:`zigzag_indices` / :func:`apply_zigzag` on
+  input_ids/labels/position_ids/segment_ids); rope stays correct because
+  position_ids carry true positions, and the loss is layout-invariant
+  because labels were shifted before the permutation.
 """
 
 from __future__ import annotations
@@ -39,6 +45,52 @@ from automodel_tpu.ops.attention import repeat_kv
 _NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def zigzag_indices(seq_len: int, cp: int):
+    """Permutation putting global positions into zigzag-layout order: chunk
+    list (0, 2cp-1), (1, 2cp-2), ... concatenated rank-major."""
+    import numpy as np
+
+    if seq_len % (2 * cp):
+        raise ValueError(f"seq_len {seq_len} must divide 2*cp={2 * cp}")
+    half = seq_len // (2 * cp)
+    chunks = np.arange(seq_len).reshape(2 * cp, half)
+    order = []
+    for r in range(cp):
+        order.append(chunks[r])
+        order.append(chunks[2 * cp - 1 - r])
+    return np.concatenate(order)
+
+
+def apply_zigzag(x, cp: int, axis: int = 1):
+    """Reorder the seq axis into zigzag layout (host or device arrays)."""
+    import numpy as np
+
+    idx = zigzag_indices(x.shape[axis], cp)
+    return jnp.take(x, idx, axis=axis) if isinstance(x, jnp.ndarray) else np.take(
+        x, idx, axis=axis
+    )
+
+
+def undo_zigzag(x, cp: int, axis: int = 1):
+    import numpy as np
+
+    idx = zigzag_indices(x.shape[axis], cp)
+    inv = np.empty_like(idx)
+    inv[idx] = np.arange(len(idx))
+    return jnp.take(x, inv, axis=axis) if isinstance(x, jnp.ndarray) else np.take(
+        x, inv, axis=axis
+    )
+
+
+def _zigzag_positions(rank, s_loc: int, cp: int):
+    """Global positions of a rank's local tokens in zigzag layout."""
+    half = s_loc // 2
+    a = jnp.arange(half)
+    return jnp.concatenate(
+        [rank * half + a, (2 * cp - 1 - rank) * half + a]
+    )
+
+
 def ring_attention_shard(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -50,6 +102,7 @@ def ring_attention_shard(
     segment_ids: Optional[jnp.ndarray] = None,
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
+    zigzag: bool = False,
 ) -> jnp.ndarray:
     """Ring attention on per-device shards. q/k/v: [B, S_loc, N(,kv), H],
     segment_ids: [B, S_loc]. Requires `axis_name` bound (shard_map)."""
@@ -60,7 +113,15 @@ def ring_attention_shard(
     my_rank = jax.lax.axis_index(axis_name)
 
     q32 = q.astype(jnp.float32)
-    q_pos = my_rank * s_loc + jnp.arange(s_loc)  # global q positions
+
+    def pos_of(rank):  # global positions of rank's local tokens
+        if zigzag:
+            # cp is a traced axis size only under vmap-style tracing; in
+            # shard_map it is a python int via psum(1) — static here
+            return _zigzag_positions(rank, s_loc, int(cp))
+        return rank * s_loc + jnp.arange(s_loc)
+
+    q_pos = pos_of(my_rank)
 
     # online-softmax accumulators
     o = jnp.zeros((b, s_loc, n, h), jnp.float32)
@@ -77,7 +138,7 @@ def ring_attention_shard(
     def body(step, carry):
         o, m, l, k_blk, v_blk, seg_blk = carry
         src_rank = (my_rank - step) % cp
-        kv_pos = src_rank * s_loc + jnp.arange(s_loc)
+        kv_pos = pos_of(src_rank)
 
         k_exp = repeat_kv(k_blk, n // n_kv).astype(jnp.float32)
         v_exp = repeat_kv(v_blk, n // n_kv).astype(jnp.float32)
@@ -114,7 +175,7 @@ def ring_attention_shard(
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh_ctx):
+def make_ring_attention(mesh_ctx, zigzag: bool = False):
     """Drop-in attention over GLOBAL arrays: shard_map'd ring over cp, with
     batch sharded on the data axes and heads on tp (the GSPMD layout the rest
     of the model uses)."""
@@ -147,6 +208,7 @@ def make_ring_attention(mesh_ctx):
             scale=scale,
             logits_soft_cap=logits_soft_cap,
             sliding_window=sliding_window,
+            zigzag=zigzag and mesh.shape["cp"] > 1,
         )
 
         def fn(*args):
@@ -165,10 +227,10 @@ def make_ring_attention(mesh_ctx):
     return ring
 
 
-def install_ring_backend(mesh_ctx) -> None:
+def install_ring_backend(mesh_ctx, zigzag: bool = False) -> None:
     """Register ``"ring"`` in the attention-backend registry, bound to this
     mesh. One mesh at a time (module-global registry) — matches the
     one-mesh-per-process training model."""
     from automodel_tpu.ops.attention import ATTENTION_BACKENDS
 
-    ATTENTION_BACKENDS["ring"] = make_ring_attention(mesh_ctx)
+    ATTENTION_BACKENDS["ring"] = make_ring_attention(mesh_ctx, zigzag=zigzag)

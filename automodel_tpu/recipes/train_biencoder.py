@@ -47,11 +47,18 @@ class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         params = dict(auto.params)
         params.pop("lm_head", None)
         adapter = auto.adapter
+        hf_config = auto.hf_config
         if hasattr(adapter, "config") and not adapter.config.tie_embeddings:
             adapter = type(adapter)(
                 dataclasses.replace(adapter.config, tie_embeddings=True)
             )
-        return dataclasses.replace(auto, model=bi, params=params, adapter=adapter)
+            # keep the exported config.json consistent with the headless
+            # weights, or transformers would random-init a missing lm_head
+            if hf_config is not None:
+                hf_config = dict(hf_config, tie_word_embeddings=True)
+        return dataclasses.replace(
+            auto, model=bi, params=params, adapter=adapter, hf_config=hf_config
+        )
 
     def setup(self) -> None:
         super().setup()

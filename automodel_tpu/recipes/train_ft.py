@@ -77,6 +77,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         backend = dict(mcfg.get("backend", {}) or {})
         self.auto = self._build_auto(mcfg, backend)
         self.model = self.auto.model
+        # zigzag CP: the ring masks tokens as if the DATA is in zigzag
+        # order, so the loop must permute every seq-axis leaf to match
+        self._zigzag_cp = (
+            self.mesh_ctx.size("cp")
+            if backend.get("cp_zigzag") and self.mesh_ctx.size("cp") > 1
+            else 0
+        )
 
         # peft (LoRA): trainable tree = adapters only; base closed over frozen
         pcfg = cfg.get("peft")
@@ -270,6 +277,17 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         t0 = time.perf_counter()
         for group in self.step_scheduler:
             stacked = stack_microbatches(group)
+            if self._zigzag_cp:
+                from automodel_tpu.parallel.cp import apply_zigzag
+
+                stacked = {
+                    k: (
+                        apply_zigzag(v, self._zigzag_cp, axis=2)
+                        if k in ("input_ids", "labels", "position_ids", "segment_ids")
+                        else v
+                    )
+                    for k, v in stacked.items()
+                }
             # tps numerator: all *input_ids leaves (biencoder batches carry
             # query_/doc_input_ids instead of a single input_ids)
             n_tokens_batch = int(

@@ -54,16 +54,21 @@ def build_train_step(
     reflects trainable params only (see training/freeze.py).
     """
 
-    def call_loss(params, mb):
-        out = loss_fn(params, mb)
+    # a loss_fn may carry frozen params (LoRA base) to pass as a REAL jit
+    # argument — closures over device trees become captured constants baked
+    # into every lowering (GBs for large bases)
+    bound_params = getattr(loss_fn, "bound_params", None)
+
+    def call_loss(params, mb, bound):
+        out = loss_fn(params, mb, bound) if bound is not None else loss_fn(params, mb)
         if len(out) == 3:
             return out
         loss_sum, n = out
         return loss_sum, n, {}
 
-    def mb_value_and_grad(params, mb):
+    def mb_value_and_grad(params, mb, bound):
         def wrapped(p):
-            loss_sum, n, extras = call_loss(p, mb)
+            loss_sum, n, extras = call_loss(p, mb, bound)
             return loss_sum.astype(jnp.float32), (n, extras)
         val, grads = jax.value_and_grad(wrapped, has_aux=True)(params)
         if grad_mask is not None:
@@ -72,13 +77,13 @@ def build_train_step(
             )
         return val, grads
 
-    def step_fn(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+    def step_fn(state: TrainState, batch: dict, bound=None) -> tuple[TrainState, dict]:
         grads0 = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params)
         carry0 = (grads0, jnp.float32(0.0), jnp.int32(0))
 
         def body(carry, mb):
             g_acc, l_acc, n_acc = carry
-            (loss_sum, (n, extras)), grads = mb_value_and_grad(state.params, mb)
+            (loss_sum, (n, extras)), grads = mb_value_and_grad(state.params, mb, bound)
             g_acc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), g_acc, grads
             )
@@ -123,24 +128,32 @@ def build_train_step(
         leaf = jax.tree.leaves(batch)[0]
         return jnp.float32(leaf.shape[0])
 
-    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+    jitted = jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+    if bound_params is None:
+        return jitted
+    return lambda state, batch: jitted(state, batch, bound_params)
 
 
 def build_eval_step(
     loss_fn: Callable[[Any, dict], tuple[jnp.ndarray, jnp.ndarray]],
 ) -> Callable[[TrainState, dict], dict]:
     """Validation step: microbatch-scanned loss sum + token count."""
+    bound_params = getattr(loss_fn, "bound_params", None)
 
-    def step_fn(state: TrainState, batch: dict) -> dict:
+    def step_fn(state: TrainState, batch: dict, bound=None) -> dict:
         def body(carry, mb):
             l_acc, n_acc = carry
-            loss_sum, n = loss_fn(state.params, mb)[:2]
+            out = loss_fn(state.params, mb, bound) if bound is not None else loss_fn(state.params, mb)
+            loss_sum, n = out[:2]
             return (l_acc + loss_sum.astype(jnp.float32), n_acc + n), None
 
         (loss_sum, n), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), batch)
         return {"loss_sum": loss_sum, "num_label_tokens": n}
 
-    return jax.jit(step_fn)
+    jitted = jax.jit(step_fn)
+    if bound_params is None:
+        return jitted
+    return lambda state, batch: jitted(state, batch, bound_params)
 
 
 def make_causal_lm_loss(

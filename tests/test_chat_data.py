@@ -1,0 +1,135 @@
+"""Chat-template / xLAM / seq-cls datasets + tokenizer layer (reference:
+datasets/llm/chat_dataset.py, xlam.py, seq_cls.py, auto_tokenizer.py).
+Tests run against a deterministic fake tokenizer — no hub access."""
+
+import numpy as np
+
+from automodel_tpu.data.chat import (
+    ChatDataset,
+    SeqClsDataset,
+    XLamDataset,
+    tokenize_conversation,
+)
+from automodel_tpu.data.collators import IGNORE_INDEX
+
+
+class FakeTokenizer:
+    """Whitespace 'tokenizer' with a llama-ish chat template:
+    role-header token, content tokens, end token per message."""
+
+    ROLE = {"system": 1, "user": 2, "assistant": 3}
+    END = 4
+    pad_token = "<pad>"
+    eos_token = "<eos>"
+
+    def _word(self, w):
+        return 10 + (hash(w) % 1000)
+
+    def __call__(self, text, add_special_tokens=True):
+        return {"input_ids": [self._word(w) for w in str(text).split()]}
+
+    def apply_chat_template(self, messages, tokenize=True, **kw):
+        ids = []
+        for m in messages:
+            ids.append(self.ROLE[m["role"]])
+            ids.extend(self._word(w) for w in str(m["content"]).split())
+            ids.append(self.END)
+        return ids
+
+
+def test_tokenize_conversation_masks_non_assistant():
+    tok = FakeTokenizer()
+    messages = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "hi there"},
+        {"role": "assistant", "content": "hello world foo"},
+        {"role": "user", "content": "more"},
+        {"role": "assistant", "content": "bye"},
+    ]
+    out = tokenize_conversation(tok, messages)
+    ids = np.asarray(out["input_ids"])
+    labels = np.asarray(out["labels"])
+    assert len(ids) == len(labels)
+    # assistant spans (incl. role header + end token) train; all else masked
+    full = tok.apply_chat_template(messages)
+    pre2 = len(tok.apply_chat_template(messages[:2]))
+    end2 = len(tok.apply_chat_template(messages[:3]))
+    pre4 = len(tok.apply_chat_template(messages[:4]))
+    expected = np.full(len(full), IGNORE_INDEX)
+    expected[pre2:end2] = ids[pre2:end2]
+    expected[pre4:] = ids[pre4:]
+    np.testing.assert_array_equal(labels, expected)
+    n_train = (labels != IGNORE_INDEX).sum()
+    assert n_train == (end2 - pre2) + (len(full) - pre4)
+
+
+def test_chat_dataset_sharegpt_normalization():
+    tok = FakeTokenizer()
+    rows = [
+        {"messages": [
+            {"from": "human", "value": "q"},
+            {"from": "gpt", "value": "a b"},
+        ]}
+    ]
+    ds = ChatDataset(rows, tok, system_prompt="sys")
+    ex = ds[0]
+    labels = np.asarray(ex["labels"])
+    assert (labels != IGNORE_INDEX).sum() == 4  # role + 'a' + 'b' + end
+
+
+def test_xlam_dataset():
+    tok = FakeTokenizer()
+    rows = [
+        {
+            "query": "what time is it",
+            "tools": '[{"name": "clock", "parameters": {}}]',
+            "answers": '[{"name": "clock", "arguments": {}}]',
+        }
+    ]
+    ds = XLamDataset(rows, tok)
+    ex = ds[0]
+    labels = np.asarray(ex["labels"])
+    # only the final assistant (tool-call JSON) span trains
+    assert 0 < (labels != IGNORE_INDEX).sum() < len(labels)
+
+
+def test_seq_cls_dataset():
+    tok = FakeTokenizer()
+    rows = [{"text": "good movie", "label": 1}, {"text": "bad", "label": 0}]
+    ds = SeqClsDataset(rows, tok)
+    assert ds[0]["label"] == 1 and len(ds[0]["input_ids"]) == 2
+    assert ds[1]["label"] == 0
+
+
+def test_build_tokenizer_pad_fallback(monkeypatch):
+    from automodel_tpu.data import tokenizer as T
+
+    class Tok:
+        pad_token = None
+        eos_token = "</s>"
+        padding_side = "left"
+
+    class FakeAuto:
+        @staticmethod
+        def from_pretrained(name, **kw):
+            return Tok()
+
+    import transformers
+
+    monkeypatch.setattr(transformers, "AutoTokenizer", FakeAuto)
+    tok = T.build_tokenizer("any")
+    assert tok.pad_token == "</s>"
+    assert tok.padding_side == "right"
+
+
+def make_mock_chat_rows(n: int = 32):
+    """Rows for recipe-level tests (used by verify drives too)."""
+    return [
+        {
+            "messages": [
+                {"role": "user", "content": f"question {i} about thing {i % 7}"},
+                {"role": "assistant", "content": f"answer {i} is {i * 3}"},
+            ]
+        }
+        for i in range(n)
+    ]
