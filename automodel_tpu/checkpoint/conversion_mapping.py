@@ -111,20 +111,51 @@ class RemappedReader:
         self.reader.close()
 
 
+# mixtral stores the MoE block as block_sparse_moe with w1/w3/w2 experts —
+# rename to the canonical qwen3-moe-style keys the MoE adapter reads
+MIXTRAL_RENAMES = (
+    Rename(r"^(.*\.)block_sparse_moe\.gate\.weight$", r"\1mlp.gate.weight"),
+    Rename(
+        r"^(.*\.)block_sparse_moe\.experts\.(\d+)\.w1\.weight$",
+        r"\1mlp.experts.\2.gate_proj.weight",
+    ),
+    Rename(
+        r"^(.*\.)block_sparse_moe\.experts\.(\d+)\.w3\.weight$",
+        r"\1mlp.experts.\2.up_proj.weight",
+    ),
+    Rename(
+        r"^(.*\.)block_sparse_moe\.experts\.(\d+)\.w2\.weight$",
+        r"\1mlp.experts.\2.down_proj.weight",
+    ),
+)
+
+# qwen2-moe: singular shared_expert → the adapter's shared_experts keys
+QWEN2_MOE_RENAMES = (
+    Rename(r"^(.*\.mlp\.)shared_expert\.(.*)$", r"\1shared_experts.\2"),
+)
+
+
 def detect_remaps(reader: Any, hf_config: Optional[dict] = None) -> Optional[RemappedReader]:
     """Wrap `reader` when a known variant layout is detected (fused qkv /
-    gate_up); None when the checkpoint is already canonical."""
+    gate_up, mixtral block_sparse_moe, qwen2-moe shared_expert); None when
+    the checkpoint is already canonical."""
     keys = reader.keys()
+    get = lambda k, d=None: (hf_config or {}).get(k, d)
+    renames: tuple = ()
+    if any(".block_sparse_moe." in k for k in keys):
+        renames += MIXTRAL_RENAMES
+    if any(".mlp.shared_expert." in k for k in keys):
+        renames += QWEN2_MOE_RENAMES
     has_fused = any(k.endswith(".self_attn.qkv_proj.weight") for k in keys) or any(
         k.endswith(".mlp.gate_up_proj.weight") for k in keys
     )
-    if not has_fused:
+    if not has_fused and not renames:
         return None
-    get = lambda k, d=None: (hf_config or {}).get(k, d)
     heads = get("num_attention_heads") or 1
     head_dim = get("head_dim") or (get("hidden_size", 0) // heads)
     sizes = {
         "q": heads * head_dim,
         "kv": (get("num_key_value_heads") or heads) * head_dim,
     }
-    return RemappedReader(reader, splits=(FUSED_QKV, FUSED_GATE_UP), sizes=sizes)
+    splits = (FUSED_QKV, FUSED_GATE_UP) if has_fused else ()
+    return RemappedReader(reader, renames=renames, splits=splits, sizes=sizes)

@@ -38,22 +38,38 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     cfg = parse_args_and_load_config(argv[2:])
 
-    # a `slurm:` section outside a Slurm allocation submits instead of running
-    # (reference: _cli/app.py:125-199 Slurm path)
+    # a `slurm:`/`k8s:` section outside the corresponding cluster submits
+    # instead of running (reference: _cli/app.py:125-199 Slurm; its k8s path
+    # is a stub at :333 — see launcher/k8s.py)
     import os
 
-    if cfg.get("slurm") is not None and "SLURM_JOB_ID" not in os.environ:
-        from automodel_tpu.launcher.slurm import SlurmConfig, submit
-
-        scfg = dict(cfg.get("slurm") or {})
-        scfg.pop("_target_", None)
+    def _launch_section(key: str, in_cluster_env: str, submit_fn):
+        if cfg.get(key) is None or in_cluster_env in os.environ:
+            return None
+        section = dict(cfg.get(key) or {})
+        section.pop("_target_", None)
         cfg_path = next(
             (argv[2:][i + 1] for i, a in enumerate(argv[2:]) if a in ("-c", "--config")),
             None,
         )
-        script = submit(SlurmConfig(**scfg), command, domain, cfg_path)
-        print(f"submitted {script}")
-        return 0
+        return submit_fn(section, cfg_path)
+
+    def _slurm(section, cfg_path):
+        from automodel_tpu.launcher.slurm import SlurmConfig, submit
+
+        return submit(SlurmConfig(**section), command, domain, cfg_path)
+
+    def _k8s(section, cfg_path):
+        from automodel_tpu.launcher.k8s import K8sConfig, submit
+
+        apply = section.pop("apply", True)
+        return submit(K8sConfig(**section), command, domain, cfg_path, apply=apply)
+
+    for key, env, fn in (("slurm", "SLURM_JOB_ID", _slurm), ("k8s", "KUBERNETES_SERVICE_HOST", _k8s)):
+        submitted = _launch_section(key, env, fn)
+        if submitted is not None:
+            print(f"submitted {submitted}")
+            return 0
 
     from automodel_tpu.parallel.mesh import initialize_distributed
 

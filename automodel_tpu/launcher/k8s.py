@@ -1,0 +1,105 @@
+"""Kubernetes (GKE/JobSet) launcher.
+
+Parity: the reference's CLI k8s path is a stub (_cli/app.py:333); here the
+launcher renders a complete multi-host TPU JobSet-style manifest and
+optionally submits via kubectl — multi-host JAX picks up coordination from
+the TPU pod environment (jax.distributed.initialize with no args).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+MANIFEST_TEMPLATE = """\
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: {name}
+spec:
+  backoffLimit: 0
+  completions: {num_hosts}
+  parallelism: {num_hosts}
+  completionMode: Indexed
+  template:
+    spec:
+      restartPolicy: Never
+      subdomain: {name}
+      nodeSelector:
+        cloud.google.com/gke-tpu-accelerator: {accelerator}
+        cloud.google.com/gke-tpu-topology: {topology}
+      containers:
+        - name: train
+          image: {image}
+          command: ["python", "-m", "automodel_tpu.cli.app", "{command}", "{domain}", "-c", "{config_path}"{overrides}]
+          resources:
+            requests:
+              google.com/tpu: "{chips_per_host}"
+            limits:
+              google.com/tpu: "{chips_per_host}"
+          env:
+            - name: JAX_PLATFORMS
+              value: "tpu"
+{extra_env}
+"""
+
+
+@dataclasses.dataclass
+class K8sConfig:
+    name: str = "automodel-train"
+    image: str = "python:3.12"
+    accelerator: str = "tpu-v5p-slice"
+    topology: str = "2x2x1"
+    num_hosts: int = 1
+    chips_per_host: int = 4
+    env: Optional[dict] = None
+    manifest_dir: str = "k8s"
+
+
+def render_manifest(
+    cfg: K8sConfig,
+    command: str,
+    domain: str,
+    config_path: str,
+    overrides: Optional[list] = None,
+) -> str:
+    """NOTE: ``config_path`` must exist INSIDE the container image (or be
+    provided via a ConfigMap/volume patch on the rendered manifest) — the
+    manifest does not ship local files."""
+    extra_env = ""
+    for k, v in (cfg.env or {}).items():
+        extra_env += f'            - name: {k}\n              value: "{v}"\n'
+    ov = "".join(f', "{o}"' for o in (overrides or []))
+    return MANIFEST_TEMPLATE.format(
+        overrides=ov,
+        name=cfg.name,
+        image=cfg.image,
+        accelerator=cfg.accelerator,
+        topology=cfg.topology,
+        num_hosts=cfg.num_hosts,
+        chips_per_host=cfg.chips_per_host,
+        command=command,
+        domain=domain,
+        config_path=config_path,
+        extra_env=extra_env.rstrip("\n"),
+    )
+
+
+def submit(
+    cfg: K8sConfig,
+    command: str,
+    domain: str,
+    config_path: str,
+    apply: bool = True,
+    overrides: Optional[list] = None,
+) -> Path:
+    """Write the manifest; `kubectl apply` it when requested and available."""
+    out = Path(cfg.manifest_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    path = out / f"{cfg.name}.yaml"
+    path.write_text(render_manifest(cfg, command, domain, config_path, overrides))
+    if apply:
+        subprocess.run(["kubectl", "apply", "-f", str(path)], check=True)
+    return path

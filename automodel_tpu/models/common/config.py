@@ -134,7 +134,7 @@ class TransformerConfig:
             max_position_embeddings=get("max_position_embeddings", 8192),
             tie_embeddings=bool(get("tie_word_embeddings", False)),
             attention_bias=bool(
-                get("attention_bias", model_type in ("qwen2",))
+                get("attention_bias", model_type in ("qwen2", "qwen2_moe"))
             ),
             mlp_bias=bool(get("mlp_bias", False)),
             qk_norm=model_type in ("qwen3", "qwen3_moe"),
@@ -143,7 +143,8 @@ class TransformerConfig:
             # configs apply sliding_window unconditionally when present.
             sliding_window=(
                 get("sliding_window", None)
-                if get("use_sliding_window", model_type == "mistral")
+                # these families apply sliding_window unconditionally in HF
+                if get("use_sliding_window", model_type in ("mistral", "mixtral", "phi3"))
                 else None
             ),
             max_window_layers=get("max_window_layers", 0) or 0,

@@ -55,15 +55,30 @@ class MoETransformerConfig(TransformerConfig):
         # topk_method keys in its HF config (modeling_glm4_moe.py
         # Glm4MoeTopkRouter)
         is_glm4 = model_type == "glm4_moe"
+        # mixtral's expert MLP width is `intermediate_size` and its count
+        # `num_local_experts` (handled by the get-chains below); qwen2-moe
+        # always has one sigmoid-gated shared expert
+        is_qwen2_moe = model_type == "qwen2_moe"
         aux_free = get("topk_method", None) == "noaux_tc" or is_glm4
         moe = MoEConfig(
-            num_experts=get("num_experts", None) or get("n_routed_experts"),
+            num_experts=get("num_experts", None)
+            or get("n_routed_experts", None)
+            or get("num_local_experts"),
             num_experts_per_tok=get("num_experts_per_tok", 8),
-            moe_intermediate_size=get("moe_intermediate_size"),
-            num_shared_experts=get("n_shared_experts", 0) or 0,
+            moe_intermediate_size=get("moe_intermediate_size", None)
+            or get("intermediate_size"),
+            num_shared_experts=(
+                1 if is_qwen2_moe else get("n_shared_experts", 0) or 0
+            ),
             shared_expert_intermediate_size=get("shared_expert_intermediate_size", 0)
-            or get("moe_intermediate_size"),
+            or get("moe_intermediate_size", 0)
+            or 0,
+            shared_expert_gate=is_qwen2_moe,
             score_func=get("scoring_func", None) or ("sigmoid" if is_glm4 else "softmax"),
+            # every softmax-scoring family ingested here (qwen3-moe, mixtral,
+            # qwen2-moe) softmaxes the FULL router logits before top-k;
+            # gpt-oss (softmax over the picked logits) sets its own config
+            softmax_before_topk=True,
             route_scale=get("routed_scaling_factor", 1.0) or 1.0,
             norm_topk_prob=bool(get("norm_topk_prob", True)),
             n_group=get("n_group", 1) or 1,

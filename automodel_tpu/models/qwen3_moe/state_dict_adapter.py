@@ -23,9 +23,37 @@ def _t(x: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(x.T)
 
 
+# per-variant SAVE-side key rewrites (the load side goes through
+# checkpoint/conversion_mapping renames): canonical suffix → variant suffix
+_VARIANT_KEY_STYLES: dict[str, list[tuple[str, str]]] = {
+    "mixtral": [
+        (r"\.mlp\.gate\.weight$", ".block_sparse_moe.gate.weight"),
+        (r"\.mlp\.experts\.(\d+)\.gate_proj\.weight$", r".block_sparse_moe.experts.\1.w1.weight"),
+        (r"\.mlp\.experts\.(\d+)\.up_proj\.weight$", r".block_sparse_moe.experts.\1.w3.weight"),
+        (r"\.mlp\.experts\.(\d+)\.down_proj\.weight$", r".block_sparse_moe.experts.\1.w2.weight"),
+    ],
+    "qwen2_moe": [
+        (r"\.mlp\.shared_experts\.", ".mlp.shared_expert."),
+    ],
+}
+
+
 class MoEStateDictAdapter:
-    def __init__(self, config: MoETransformerConfig):
+    def __init__(self, config: MoETransformerConfig, hf_key_style: str | None = None):
         self.config = config
+        # save-side key dialect so exported checkpoints reload in the
+        # ORIGINAL HF architecture (Mixtral w1/w3/w2, qwen2-moe singular
+        # shared_expert)
+        self.hf_key_style = hf_key_style
+
+    def _style_key(self, key: str) -> str:
+        import re
+
+        for pat, sub in _VARIANT_KEY_STYLES.get(self.hf_key_style or "", []):
+            new = re.sub(pat, sub, key)
+            if new != key:
+                return new
+        return key
 
     # ---- key helpers -------------------------------------------------------
     def _attn_keys(self, i: int) -> dict[tuple[str, ...], tuple[str, bool]]:
@@ -154,6 +182,17 @@ class MoEStateDictAdapter:
                         for i in moe_ids
                     ]
                 )
+            if moe.shared_expert_gate:
+                yield ("moe_layers", "moe", "shared_gate", "kernel"), LazyStacked(
+                    [
+                        (
+                            lambda i=i: _t(
+                                get_tensor(f"model.layers.{i}.mlp.shared_expert_gate.weight")
+                            )
+                        )
+                        for i in moe_ids
+                    ]
+                )
 
     def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
         from automodel_tpu.checkpoint.hf_io import assemble_tree
@@ -162,6 +201,10 @@ class MoEStateDictAdapter:
 
     # ---- save --------------------------------------------------------------
     def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        for k, v in self._to_hf_canonical(params):
+            yield self._style_key(k), v
+
+    def _to_hf_canonical(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
         c = self.config
         moe = c.moe
         nd, L = moe.num_dense_layers, c.num_layers
@@ -216,4 +259,9 @@ class MoEStateDictAdapter:
                         f"model.layers.{i}.mlp.shared_experts.{name}.weight",
                         _t(np.asarray(ml["moe"]["shared"][name]["kernel"][row])),
                     )
+            if "shared_gate" in ml["moe"]:
+                yield (
+                    f"model.layers.{i}.mlp.shared_expert_gate.weight",
+                    _t(np.asarray(ml["moe"]["shared_gate"]["kernel"][row])),
+                )
 

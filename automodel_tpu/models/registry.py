@@ -48,6 +48,9 @@ def available_architectures() -> list[str]:
     "Qwen2ForCausalLM",
     "Qwen3ForCausalLM",
     "MistralForCausalLM",
+    # fused qkv/gate_up checkpoints load through the conversion mapping
+    # (checkpoint/conversion_mapping.py FUSED_QKV / FUSED_GATE_UP)
+    "Phi3ForCausalLM",
 )
 def _llama_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.llama import LlamaForCausalLM, LlamaStateDictAdapter
@@ -116,7 +119,14 @@ def _qwen3_next_builder(hf_config: Any, backend: BackendConfig):
     return Qwen3NextForCausalLM(cfg, backend), Qwen3NextStateDictAdapter(cfg)
 
 
-@register_architecture("Qwen3MoeForCausalLM", "Glm4MoeForCausalLM")
+@register_architecture(
+    "Qwen3MoeForCausalLM",
+    "Glm4MoeForCausalLM",
+    # mixtral / qwen2-moe checkpoints present canonical keys through the
+    # conversion mapping (block_sparse_moe w1/w3/w2, shared_expert renames)
+    "MixtralForCausalLM",
+    "Qwen2MoeForCausalLM",
+)
 def _moe_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.qwen3_moe import (
         MoEForCausalLM,
@@ -125,4 +135,9 @@ def _moe_builder(hf_config: Any, backend: BackendConfig):
     )
 
     cfg = MoETransformerConfig.from_hf(hf_config)
-    return MoEForCausalLM(cfg, backend), MoEStateDictAdapter(cfg)
+    get = lambda k, d=None: (
+        hf_config.get(k, d) if isinstance(hf_config, dict) else getattr(hf_config, k, d)
+    )
+    model_type = get("model_type", "")
+    style = model_type if model_type in ("mixtral", "qwen2_moe") else None
+    return MoEForCausalLM(cfg, backend), MoEStateDictAdapter(cfg, hf_key_style=style)

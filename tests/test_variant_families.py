@@ -1,0 +1,98 @@
+"""Mixtral / Qwen2-MoE / Phi3: HF parity through the conversion-mapping
+path — the checkpoint is written with the VARIANT key layout
+(block_sparse_moe w1/w3/w2, shared_expert singular, fused qkv/gate_up) and
+loaded through auto_model.from_pretrained, so the remaps are exercised end
+to end."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu import auto_model
+from automodel_tpu.checkpoint.hf_io import save_hf_checkpoint
+
+FP32 = {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32",
+        "experts": "dense"}
+
+
+def _save(tmp_path, hf_model, arch):
+    """Write the checkpoint the way the hub does: full serialized config
+    (all defaults materialized — avoids dict-vs-object default drift) +
+    safetensors weights."""
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    save_hf_checkpoint(tmp_path, list(sd.items()))
+    cfg_dict = hf_model.config.to_dict()
+    cfg_dict["architectures"] = [arch]
+    (tmp_path / "config.json").write_text(json.dumps(cfg_dict, default=str))
+    return tmp_path
+
+
+def _parity(tmp_path, hf_model, arch, atol=3e-4, roundtrip=True):
+    import torch
+
+    d = _save(tmp_path, hf_model, arch)
+    auto = auto_model.from_pretrained(str(d), None, FP32)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, hf_model.config.vocab_size, size=(2, 10)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf_model(input_ids=torch.from_numpy(ids)).logits.numpy()
+    out = auto.model(auto.params, jnp.asarray(ids))
+    logits = out[0] if isinstance(out, tuple) else out
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=atol, rtol=2e-3)
+    if roundtrip:
+        # save-side key dialect: exported checkpoints reload in the ORIGINAL arch
+        sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+        out_keys = {k for k, _ in auto.adapter.to_hf(jax.device_get(auto.params))}
+        assert out_keys == set(sd), (set(sd) ^ out_keys)
+
+
+def test_mixtral_parity(tmp_path):
+    import torch
+
+    torch.manual_seed(0)
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    kw = dict(
+        vocab_size=96, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        head_dim=16, num_local_experts=4, num_experts_per_tok=2,
+        sliding_window=None, rope_theta=1e6, attn_implementation="eager",
+    )
+    m = MixtralForCausalLM(MixtralConfig(**kw)).eval()
+    _parity(tmp_path, m, "MixtralForCausalLM")
+
+
+def test_qwen2_moe_parity(tmp_path):
+    import torch
+
+    torch.manual_seed(0)
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    kw = dict(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=16, shared_expert_intermediate_size=24,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[], attn_implementation="eager",
+    )
+    m = Qwen2MoeForCausalLM(Qwen2MoeConfig(**kw)).eval()
+    _parity(tmp_path, m, "Qwen2MoeForCausalLM")
+
+
+def test_phi3_parity(tmp_path):
+    import torch
+
+    torch.manual_seed(0)
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    kw = dict(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        pad_token_id=0, attn_implementation="eager",
+    )
+    m = Phi3ForCausalLM(Phi3Config(**kw)).eval()
+    # exports use canonical split keys; a fused-qkv save dialect is pending
+    _parity(tmp_path, m, "Phi3ForCausalLM", roundtrip=False)
