@@ -145,6 +145,10 @@ def _as_backend(
         backend = BackendConfig()
     elif not isinstance(backend, BackendConfig):
         backend = BackendConfig(**dict(backend))
+    if backend.platform is None and mesh_ctx is not None:
+        import dataclasses
+
+        backend = dataclasses.replace(backend, platform=mesh_ctx.platform)
     if backend.attn == "ring":
         if mesh_ctx is None:
             raise ValueError("attn='ring' (context parallel) requires a mesh")

@@ -21,6 +21,7 @@ from typing import Any, Iterator, Mapping
 import yaml
 
 _ENV_RE = re.compile(r"\$\{(?:env:)?([A-Za-z_][A-Za-z0-9_]*)(?::([^}]*))?\}")
+_SCI_NOTATION_RE = re.compile(r"^[+-]?\d+(\.\d*)?[eE][+-]?\d+$")
 
 # Dotted-path prefixes that `_target_` may import. Mirrors the reference's
 # safety allowlist concept (config/loader.py:73) with TPU-world entries.
@@ -57,9 +58,15 @@ def _interp_env(value: str) -> str:
 def translate_value(v: str) -> Any:
     """Parse a CLI override string into a Python value (YAML semantics)."""
     try:
-        return yaml.safe_load(v)
+        out = yaml.safe_load(v)
     except yaml.YAMLError:
         return v
+    if isinstance(out, str) and _SCI_NOTATION_RE.match(out):
+        # YAML 1.1 parses dotless scientific notation ('1e-2') as a string;
+        # coerce so `--optimizer.lr=1e-2` behaves like `lr: 1.0e-2`. Regex-
+        # gated: bare float() would also swallow 'nan'/'inf'/'1_5'.
+        return float(out)
+    return out
 
 
 def resolve_target(path: str) -> Any:

@@ -52,6 +52,10 @@ class LlamaBidirectionalModel:
     pooling: str = "avg"
     normalize: bool = True
 
+    # runs llama's forward_hidden → _proj, which applies grafted LoRA
+    # activation-side (see peft.lora.graft_lora)
+    lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel", "*/mlp/*_proj/kernel")
+
     def __post_init__(self):
         if self.config.causal:
             self.config = dataclasses.replace(self.config, causal=False)

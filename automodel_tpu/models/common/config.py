@@ -36,6 +36,12 @@ class BackendConfig:
 
     attn: str = "flash"  # any key of ops.attention.ATTENTION_BACKENDS
     rms_norm: str = "xla"
+    # compute platform of the mesh the model runs on ('tpu'/'cpu'); resolved
+    # by auto_model._as_backend from the MeshContext. Pallas kernel
+    # eligibility keys off this — NOT the process default device, which may
+    # point at a different backend than the mesh (e.g. CPU mesh + visible
+    # TPU). None → fall back to the default-device heuristic.
+    platform: Optional[str] = None
     experts: str = "gspmd"  # gspmd | ragged | dense | a2a (moe.experts backends)
     fake_balanced_gate: bool = False  # deterministic routing for benchmarks
     param_dtype: str = "float32"

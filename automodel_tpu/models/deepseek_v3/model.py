@@ -171,6 +171,7 @@ def mla_block(
         kh,
         v,
         backend=backend.attn,
+        platform=backend.platform,
         causal=True,
         scale=cfg.mla_attn_scale,
         segment_ids=segment_ids,

@@ -159,6 +159,7 @@ def _layer(
         k,
         v,
         backend=backend.attn,
+        platform=backend.platform,
         is_sliding=flags["is_sliding"],
         window=cfg.sliding_window,
         dynamic_window=flags["window"],  # dynamic bound; S for full layers
@@ -267,6 +268,9 @@ SHARDING_RULES = [
 class GemmaForCausalLM:
     config: GemmaConfig
     backend: BackendConfig = BackendConfig()
+
+    # see llama.model._proj: these paths apply grafted LoRA activation-side
+    lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel", "*/mlp/*_proj/kernel")
 
     def init(self, key: jax.Array) -> dict:
         return init_params(self.config, self.backend, key)

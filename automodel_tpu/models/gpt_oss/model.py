@@ -116,6 +116,7 @@ def _layer(cfg, backend, h, lp, flags, cos, sin, segment_ids, constrain):
         k,
         v,
         backend=backend.attn,
+        platform=backend.platform,
         is_sliding=flags["is_sliding"],
         window=cfg.sliding_window,
         dynamic_window=flags["window"],
@@ -136,6 +137,7 @@ def _layer(cfg, backend, h, lp, flags, cos, sin, segment_ids, constrain):
         experts_backend=backend.experts,
         fake_gate=backend.fake_balanced_gate,
         constrain=constrain,
+        platform=backend.platform,
     )
     h = h + out
     return constrain(h, ("batch", "seq", None)), aux
@@ -213,6 +215,10 @@ SHARDING_RULES = [
 class GptOssForCausalLM:
     config: GptOssConfig
     backend: BackendConfig = BackendConfig()
+
+    # see llama.model._proj: attn projections apply grafted LoRA activation-
+    # side; expert weights (moe paths) stay on the merged fallback
+    lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel",)
 
     def init(self, key: jax.Array) -> dict:
         return init_params(self.config, self.backend, key)

@@ -96,6 +96,12 @@ def _proj(x: jnp.ndarray, p: dict) -> jnp.ndarray:
     y = _fp8.maybe_fp8_dot(x, p["kernel"], _fp8.is_enabled())
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
+    if "lora_A" in p:
+        # activation-side LoRA (grafted by peft.graft_lora; scale folded into
+        # A). The merged form W+s·A@B forces the layer-scan backward to carry
+        # a full-rank [L,in,out] dW accumulator — at 3B+ that alone OOMs a
+        # 16GB chip; the two rank-r matmuls here never materialize it.
+        y = y + (x @ p["lora_A"].astype(x.dtype)) @ p["lora_B"].astype(x.dtype)
     return y
 
 
@@ -134,6 +140,7 @@ def attention_block(
         k,
         v,
         backend=backend.attn,
+        platform=backend.platform,
         causal=cfg.causal,
         scale=cfg.attn_scale,
         segment_ids=segment_ids,
@@ -273,6 +280,10 @@ class LlamaForCausalLM:
 
     config: TransformerConfig
     backend: BackendConfig = BackendConfig()
+
+    # adapter paths `_proj` consumes activation-side when grafted into the
+    # param tree (peft.make_lora_loss_fn grafts these; others stay merged)
+    lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel", "*/mlp/*_proj/kernel")
 
     def init(self, key: jax.Array) -> dict:
         return init_params(self.config, self.backend, key)

@@ -222,6 +222,7 @@ def forward_hidden(
             experts_backend=backend.experts,
             fake_gate=backend.fake_balanced_gate,
             constrain=constrain,
+            platform=backend.platform,
         )
         hh = hh + out
         return constrain(hh, ("batch", "seq", None)), aux
@@ -290,6 +291,11 @@ class MoEForCausalLM:
 
     config: MoETransformerConfig
     backend: BackendConfig = BackendConfig()
+
+    # attention rides llama's attention_block/_proj, which applies grafted
+    # LoRA activation-side; mlp/expert weights do raw kernel matmuls and
+    # stay on the merged fallback (see peft.lora.graft_lora)
+    lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel",)
 
     def init(self, key: jax.Array) -> dict:
         return init_params(self.config, self.backend, key)

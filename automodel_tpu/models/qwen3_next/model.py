@@ -163,7 +163,8 @@ def _full_attn_layer(cfg, backend, x, ap, cos, sin, segment_ids):
     q, k = apply_rope(q, k, cos, sin)
     out = attention(
         q, k, v,
-        backend=backend.attn, causal=True, segment_ids=segment_ids,
+        backend=backend.attn, platform=backend.platform,
+        causal=True, segment_ids=segment_ids,
         **(
             {"block_q": backend.attn_block_q, "block_kv": backend.attn_block_kv}
             if backend.attn == "flash"
@@ -286,6 +287,7 @@ def forward_hidden(
                 experts_backend=backend.experts,
                 fake_gate=backend.fake_balanced_gate,
                 constrain=constrain,
+                platform=backend.platform,
             )
             return constrain(h + out, ("batch", "seq", None)), aux
 

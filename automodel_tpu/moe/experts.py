@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.gate import GateOutput
+from automodel_tpu.ops.grouped_matmul import ragged_dot
 
 Act = Callable[[jnp.ndarray], jnp.ndarray]
 
@@ -134,6 +135,7 @@ def ragged_experts(
     weights: dict,
     cfg: MoEConfig,
     act2: Act,
+    platform: str | None = None,
 ) -> jnp.ndarray:
     """Dropless sort + ragged_dot grouped matmul (single-slice hot path)."""
     T, D = x.shape
@@ -145,11 +147,13 @@ def ragged_experts(
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
     sorted_expert = flat_expert[order]
 
-    gu = jax.lax.ragged_dot(xs, weights["gate_up"].astype(xs.dtype), group_sizes)
+    gu = ragged_dot(xs, weights["gate_up"].astype(xs.dtype), group_sizes,
+                    platform=platform)
     if "gate_up_bias" in weights:
         gu = gu + weights["gate_up_bias"].astype(xs.dtype)[sorted_expert]
     g, u = _split_gate_up(gu, cfg.interleaved_gate_up)
-    ys = jax.lax.ragged_dot(act2(g, u), weights["down"].astype(xs.dtype), group_sizes)
+    ys = ragged_dot(act2(g, u), weights["down"].astype(xs.dtype), group_sizes,
+                    platform=platform)
     if "down_bias" in weights:
         ys = ys + weights["down_bias"].astype(xs.dtype)[sorted_expert]
 
@@ -166,6 +170,7 @@ def a2a_experts(
     cfg: MoEConfig,
     act2: Act,
     ctx,  # parallel.mesh.MeshContext | None
+    platform: str | None = None,
 ) -> jnp.ndarray:
     """Dropless token-exchange EP dispatch (reference DeepEP dispatcher,
     token_dispatcher.py:339 + fused_a2a.py:102 → shard_map + lax.all_to_all).
@@ -179,10 +184,12 @@ def a2a_experts(
     ICI either way.
     """
     B, S, D = x.shape
+    if ctx is not None:
+        platform = ctx.platform
     if ctx is None or ctx.ep_size == 1:
         # single-slice: the ragged path is already dropless
         return ragged_experts(
-            x.reshape(-1, D), gate_out, weights, cfg, act2
+            x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform
         ).reshape(B, S, D)
 
     from automodel_tpu.parallel.mesh import MeshAxisName as A
@@ -261,12 +268,12 @@ def a2a_experts(
         sid = jnp.minimum(recv_id[order2], E_loc - 1)
         gsz = jnp.bincount(recv_id, length=E_loc).astype(jnp.int32)  # sentinel drops
 
-        g = jax.lax.ragged_dot(xs2, wd["gw"].astype(xs2.dtype), gsz)
-        u = jax.lax.ragged_dot(xs2, wd["uw"].astype(xs2.dtype), gsz)
+        g = ragged_dot(xs2, wd["gw"].astype(xs2.dtype), gsz, platform=platform)
+        u = ragged_dot(xs2, wd["uw"].astype(xs2.dtype), gsz, platform=platform)
         if "gb" in wd:
             g = g + wd["gb"].astype(g.dtype)[sid]
             u = u + wd["ub"].astype(u.dtype)[sid]
-        y = jax.lax.ragged_dot(act2(g, u), wd["dw"].astype(xs2.dtype), gsz)
+        y = ragged_dot(act2(g, u), wd["dw"].astype(xs2.dtype), gsz, platform=platform)
         if "db" in wd:  # partial over tp: add the bias on one tp shard only
             y = y + jnp.where(
                 jax.lax.axis_index(A.TP) == 0, wd["db"].astype(y.dtype)[sid], 0.0
@@ -295,9 +302,41 @@ def a2a_experts(
     )(x, idx, cw, wd)
 
 
+# Registry with a UNIFORM call shape — x is [B, S, D]; every entry accepts
+# (and ignores where irrelevant) ctx/constrain/platform, so the dispatch in
+# moe.layer stays one registry call as kwargs accrete. The per-backend
+# functions above keep their natural signatures for direct/test use.
+def _noop_constrain(a, spec):
+    return a
+
+
+def _run_dense(x, gate_out, weights, cfg, act2, *, ctx=None,
+               constrain=_noop_constrain, platform=None):
+    B, S, D = x.shape
+    return dense_experts(x.reshape(-1, D), gate_out, weights, cfg, act2).reshape(B, S, D)
+
+
+def _run_gspmd(x, gate_out, weights, cfg, act2, *, ctx=None,
+               constrain=_noop_constrain, platform=None):
+    return gspmd_experts(x, gate_out, weights, cfg, act2, constrain=constrain)
+
+
+def _run_ragged(x, gate_out, weights, cfg, act2, *, ctx=None,
+                constrain=_noop_constrain, platform=None):
+    B, S, D = x.shape
+    return ragged_experts(
+        x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform
+    ).reshape(B, S, D)
+
+
+def _run_a2a(x, gate_out, weights, cfg, act2, *, ctx=None,
+             constrain=_noop_constrain, platform=None):
+    return a2a_experts(x, gate_out, weights, cfg, act2, ctx, platform=platform)
+
+
 EXPERT_BACKENDS = {
-    "dense": dense_experts,
-    "gspmd": gspmd_experts,
-    "ragged": ragged_experts,
-    "a2a": a2a_experts,
+    "dense": _run_dense,
+    "gspmd": _run_gspmd,
+    "ragged": _run_ragged,
+    "a2a": _run_a2a,
 }

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 logger = logging.getLogger(__name__)
 
 from automodel_tpu.moe.config import MoEConfig
-from automodel_tpu.moe.experts import EXPERT_BACKENDS, a2a_experts, gspmd_experts
+from automodel_tpu.moe.experts import EXPERT_BACKENDS
 from automodel_tpu.moe.gate import GateOutput, fake_balanced_gate, gate
 
 
@@ -50,6 +50,7 @@ def moe_block(
     experts_backend: str = "gspmd",
     fake_gate: bool = False,
     constrain: Callable = lambda a, s: a,
+    platform: Optional[str] = None,
 ) -> tuple[jnp.ndarray, MoEAux]:
     B, S, D = x.shape
     xt = x.reshape(-1, D)
@@ -67,23 +68,20 @@ def moe_block(
         )
 
     act2 = make_act2(cfg, act)
-    if experts_backend == "gspmd":
-        routed = gspmd_experts(x, gout, mp["experts"], cfg, act2, constrain=constrain)
-    elif experts_backend == "a2a":
-        # mesh-aware: the token-exchange dispatcher needs the real Mesh for
-        # its shard_map region (make_constrain attaches it)
-        ctx = getattr(constrain, "mesh_ctx", None)
-        if ctx is None:
-            logger.warning(
-                "experts='a2a' but the constrain callback carries no mesh_ctx "
-                "(use parallel.plans.make_constrain, or a custom wrapper must "
-                "preserve the attribute); falling back to the single-slice "
-                "ragged path — NO expert-parallel token exchange will happen."
-            )
-        routed = a2a_experts(x, gout, mp["experts"], cfg, act2, ctx)
-    else:
-        fn = EXPERT_BACKENDS[experts_backend]
-        routed = fn(xt, gout, mp["experts"], cfg, act2).reshape(B, S, D)
+    # mesh-aware backends (a2a) need the real Mesh for their shard_map
+    # region; make_constrain attaches it to the constrain callback
+    ctx = getattr(constrain, "mesh_ctx", None)
+    if experts_backend == "a2a" and ctx is None:
+        logger.warning(
+            "experts='a2a' but the constrain callback carries no mesh_ctx "
+            "(use parallel.plans.make_constrain, or a custom wrapper must "
+            "preserve the attribute); falling back to the single-slice "
+            "ragged path — NO expert-parallel token exchange will happen."
+        )
+    routed = EXPERT_BACKENDS[experts_backend](
+        x, gout, mp["experts"], cfg, act2,
+        ctx=ctx, constrain=constrain, platform=platform,
+    )
 
     out = routed
     if "shared" in mp:

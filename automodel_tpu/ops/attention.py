@@ -215,6 +215,7 @@ def flash(
     sinks: Optional[jnp.ndarray] = None,
     block_q: int = 512,
     block_kv: int = 512,
+    platform: Optional[str] = None,
 ) -> jnp.ndarray:
     """Pallas TPU flash (splash) attention: causal/sliding-window/soft-cap/
     segments/sinks all stay on the fused kernel; sequences are padded to 128
@@ -223,7 +224,7 @@ def flash(
     windowed must not route there), and logs loudly when it does."""
     h = q.shape[-1]
     reason = None
-    if not _flash_eligible():
+    if not _flash_eligible(platform):
         reason = "not running on TPU"
     elif not causal:
         # splash LocalMask silently enforces causality, so non-causal windowed
@@ -266,6 +267,7 @@ def attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     backend: str = "sdpa",
+    platform: Optional[str] = None,
     **kwargs,
 ) -> jnp.ndarray:
     try:
@@ -274,6 +276,8 @@ def attention(
         raise ValueError(
             f"Unknown attention backend {backend!r}; available: {sorted(ATTENTION_BACKENDS)}"
         )
+    if backend == "flash":
+        kwargs["platform"] = platform
     return fn(q, k, v, **kwargs)
 
 
@@ -294,6 +298,7 @@ def windowed_attention(
     bidir_groups: Optional[jnp.ndarray] = None,
     block_q: int = 512,
     block_kv: int = 512,
+    platform: Optional[str] = None,
 ) -> jnp.ndarray:
     """Attention for scanned layer stacks that mix full and sliding-window
     layers (Gemma-2/3, GPT-OSS). The per-layer layer type rides the scan as
@@ -316,11 +321,11 @@ def windowed_attention(
             logits_soft_cap=logits_soft_cap, sliding_window=dynamic_window,
             sinks=sinks, bidir_groups=bidir_groups,
         )
-    if backend == "flash" and window is not None and _flash_eligible():
+    if backend == "flash" and window is not None and _flash_eligible(platform):
         kw = dict(
             causal=causal, scale=scale, segment_ids=segment_ids,
             logits_soft_cap=logits_soft_cap, sinks=sinks,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, platform=platform,
         )
         if not isinstance(is_sliding, jax.core.Tracer):
             # static flag (unrolled layer loop): compile exactly one kernel
@@ -330,12 +335,12 @@ def windowed_attention(
             lambda: flash(q, k, v, sliding_window=window, **kw),
             lambda: flash(q, k, v, sliding_window=None, **kw),
         )
-    if backend == "flash" and window is None and _flash_eligible():
+    if backend == "flash" and window is None and _flash_eligible(platform):
         return flash(
             q, k, v,
             causal=causal, scale=scale, segment_ids=segment_ids,
             logits_soft_cap=logits_soft_cap, sinks=sinks,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, platform=platform,
         )
     if backend == "ring":
         if sinks is not None:
@@ -366,17 +371,7 @@ def _interpret_requested() -> bool:
     return os.environ.get("AUTOMODEL_FLASH_INTERPRET", "0") == "1"
 
 
-def _flash_eligible() -> bool:
-    if _interpret_requested():
-        return True
-    try:
-        # honor an explicitly pinned default device (tests pin CPU while a
-        # TPU is still visible in jax.devices()); jax also accepts platform
-        # strings ('tpu') as jax_default_device
-        dd = jax.config.jax_default_device
-        if isinstance(dd, str):
-            return dd == "tpu"
-        dev = dd if dd is not None else jax.devices()[0]
-        return getattr(dev, "platform", None) == "tpu"
-    except Exception:
-        return False
+def _flash_eligible(platform: Optional[str] = None) -> bool:
+    from automodel_tpu.ops.platform_check import is_tpu_platform
+
+    return _interpret_requested() or is_tpu_platform(platform)

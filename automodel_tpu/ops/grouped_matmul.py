@@ -1,0 +1,239 @@
+"""Pallas grouped matmul (megablocks-style) — the MoE expert hot path.
+
+Parity: reference `GroupedExperts` grouped GEMM (components/moe/experts.py:158
+via torch `_grouped_mm`). On TPU the idiomatic lowering is `lax.ragged_dot`,
+but this image's AOT compile helper crashes lowering ragged_dot at bench-scale
+token counts, and XLA's lowering isn't tuned for the sorted-by-expert MoE
+layout anyway — so this is a hand-scheduled Pallas kernel:
+
+  out[m, n] = sum_k lhs[m, k] @ rhs[g(m), k, n]
+
+with `lhs` rows sorted by group and `group_sizes[g]` rows per group.
+
+Scheduling: the grid iterates over *work units* — (m-tile, group) pairs that
+actually overlap — computed at trace time from `group_sizes` with jnp ops and
+handed to the kernel via scalar prefetch (group/tile id + row window per
+unit). A tile spanning a group boundary is visited once per group, with a row
+mask selecting each group's rows; consecutive units on the same output tile
+keep it resident in VMEM (TPU grids are sequential), so the read-modify-write
+blend needs no atomics. Worst case `M/tm + G` units, i.e. O(1) overhead per
+group boundary — dropless, no capacity factor, no padding per expert.
+
+The backward needs two more kernels: dlhs is just gmm against `rhs`
+transposed, and drhs is a transposed grouped matmul (`_tgmm`) accumulating
+`lhs_g^T @ dout_g` per group over that group's row tiles (same work-unit
+plan, output tile = the group's [K, N] slab, fp32 accumulation in place).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret_requested() -> bool:
+    return os.environ.get("AUTOMODEL_GMM_INTERPRET", "0") == "1"
+
+
+def _pallas_eligible(platform: str | None = None) -> bool:
+    from automodel_tpu.ops.platform_check import is_tpu_platform
+
+    return _interpret_requested() or is_tpu_platform(platform)
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _plan(group_sizes: jnp.ndarray, m_padded: int, tm: int, num_groups: int):
+    """Work-unit schedule: for each of W = m_padded/tm + G grid steps, the
+    (group, m-tile, row-window) it computes. All jnp — `group_sizes` is a
+    traced value; the plan rides to the kernel as scalar prefetch."""
+    gs = group_sizes.astype(jnp.int32)
+    ends = jnp.cumsum(gs)
+    starts = ends - gs
+    first = starts // tm
+    last = jnp.maximum(ends - 1, starts) // tm
+    ntiles = jnp.where(gs > 0, last - first + 1, 0)
+    wstart = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(ntiles)[:-1]])
+    total = wstart[-1] + ntiles[-1]
+
+    W = m_padded // tm + num_groups
+    i = jnp.arange(W, dtype=jnp.int32)
+    valid = i < total
+    j = jnp.clip(i, 0, jnp.maximum(total - 1, 0))
+    # last group whose first work unit is ≤ j; runs of equal wstart (empty
+    # groups) resolve to the run's last member, which is the non-empty one
+    g = (jnp.searchsorted(wstart, j, side="right") - 1).astype(jnp.int32)
+    tile = first[g] + (j - wstart[g])
+    # row window; invalid (clamped) units get an empty window → masked no-op
+    row_s = jnp.where(valid, starts[g], 0)
+    row_e = jnp.where(valid, ends[g], 0)
+    return g, tile.astype(jnp.int32), row_s, row_e
+
+
+def _pick_tiles(k: int, n: int, itemsize: int) -> tuple[int, int]:
+    """(tm, tn) fitting lhs/rhs/out double-buffered blocks in ~12MB VMEM."""
+    budget = 12 * 1024 * 1024
+    for tm in (512, 256, 128):
+        for tn in (512, 256, 128):
+            need = 2 * itemsize * (tm * k + k * tn + tm * tn)
+            if need <= budget:
+                return tm, tn
+    return 128, 128
+
+
+def _gmm_kernel(wg, wt, ws, we, lhs_ref, rhs_ref, out_ref, *, tm, tn):
+    w = pl.program_id(1)
+    t = wt[w]
+    acc = jax.lax.dot_general(
+        lhs_ref[...],
+        rhs_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    rows = t * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, tn), 0)
+    mask = (rows >= ws[w]) & (rows < we[w])
+    # same-tile successor: keep the previous visitor's rows; first visitor
+    # zero-fills (uninitialized VMEM is only ever read through the select)
+    same = jnp.logical_and(w > 0, wt[jnp.maximum(w - 1, 0)] == t)
+    cur = out_ref[...]
+    prev = jnp.where(same, cur, jnp.zeros_like(cur))
+    out_ref[...] = jnp.where(mask, acc.astype(cur.dtype), prev)
+
+
+def _gmm(lhs: jnp.ndarray, rhs: jnp.ndarray, group_sizes: jnp.ndarray,
+         interpret: bool = False) -> jnp.ndarray:
+    """lhs [M, K] (rows sorted by group) @ rhs [G, K, N] → [M, N]."""
+    M, K = lhs.shape
+    G, _, N = rhs.shape
+    out_dtype = lhs.dtype
+    tm, tn = _pick_tiles(_round_up(K, 128), _round_up(N, 128), lhs.dtype.itemsize)
+    Mp, Kp, Np = _round_up(M, tm), _round_up(K, 128), _round_up(N, tn)
+    if (Mp, Kp) != (M, K):
+        lhs = jnp.pad(lhs, ((0, Mp - M), (0, Kp - K)))
+    if (Kp, Np) != (K, N):
+        rhs = jnp.pad(rhs, ((0, 0), (0, Kp - K), (0, Np - N)))
+
+    wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)
+    W = Mp // tm + G
+    grid = (Np // tn, W)
+
+    out = pl.pallas_call(
+        functools.partial(_gmm_kernel, tm=tm, tn=tn),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, Kp), lambda n, w, wg, wt, ws, we: (wt[w], 0)),
+                pl.BlockSpec((1, Kp, tn), lambda n, w, wg, wt, ws, we: (wg[w], 0, n)),
+            ],
+            out_specs=pl.BlockSpec((tm, tn), lambda n, w, wg, wt, ws, we: (wt[w], n)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((Mp, Np), out_dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(wg, wt, ws, we, lhs, rhs)
+    return out[:M, :N]
+
+
+def _tgmm_kernel(wg, wt, ws, we, lhs_ref, dout_ref, out_ref, *, tm):
+    w = pl.program_id(2)
+    rows = wt[w] * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0)
+    mask = (rows >= ws[w]) & (rows < we[w])
+    lhs_tile = lhs_ref[...]
+    lhs = jnp.where(mask, lhs_tile, jnp.zeros_like(lhs_tile))
+    acc = jax.lax.dot_general(
+        lhs,
+        dout_ref[...],
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    first = jnp.logical_or(w == 0, wg[jnp.maximum(w - 1, 0)] != wg[w])
+    cur = out_ref[0]
+    out_ref[0] = acc + jnp.where(first, jnp.zeros_like(cur), cur)
+
+
+def _tgmm(lhs: jnp.ndarray, dout: jnp.ndarray, group_sizes: jnp.ndarray,
+          interpret: bool = False) -> jnp.ndarray:
+    """Per-group lhs_g^T @ dout_g: [M, K] × [M, N] → [G, K, N] fp32."""
+    M, K = lhs.shape
+    _, N = dout.shape
+    G = group_sizes.shape[0]
+    tm, tn = _pick_tiles(_round_up(K, 128), _round_up(N, 128), lhs.dtype.itemsize)
+    tk = min(_round_up(K, 128), 512)
+    Mp, Kp, Np = _round_up(M, tm), _round_up(K, tk), _round_up(N, tn)
+    if (Mp, Kp) != (M, K):
+        lhs = jnp.pad(lhs, ((0, Mp - M), (0, Kp - K)))
+    if (Mp, Np) != (M, N):
+        dout = jnp.pad(dout, ((0, Mp - M), (0, Np - N)))
+
+    wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)
+    W = Mp // tm + G
+    grid = (Kp // tk, Np // tn, W)
+
+    out = pl.pallas_call(
+        functools.partial(_tgmm_kernel, tm=tm),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, tk), lambda k, n, w, wg, wt, ws, we: (wt[w], k)),
+                pl.BlockSpec((tm, tn), lambda k, n, w, wg, wt, ws, we: (wt[w], n)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, tk, tn), lambda k, n, w, wg, wt, ws, we: (wg[w], k, n)
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((G, Kp, Np), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(wg, wt, ws, we, lhs, dout)
+    # empty groups are never visited → force their slabs to zero
+    out = jnp.where((group_sizes > 0)[:, None, None], out[:, :K, :N], 0.0)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _grouped_matmul(lhs, rhs, group_sizes, interpret=False):
+    return _gmm(lhs, rhs, group_sizes, interpret=interpret)
+
+
+def _grouped_matmul_fwd(lhs, rhs, group_sizes, interpret):
+    return _gmm(lhs, rhs, group_sizes, interpret=interpret), (lhs, rhs, group_sizes)
+
+
+def _grouped_matmul_bwd(interpret, res, dout):
+    lhs, rhs, group_sizes = res
+    dlhs = _gmm(dout, rhs.swapaxes(1, 2), group_sizes, interpret=interpret)
+    drhs = _tgmm(lhs, dout, group_sizes, interpret=interpret)
+    return dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype), None
+
+
+_grouped_matmul.defvjp(_grouped_matmul_fwd, _grouped_matmul_bwd)
+
+
+def ragged_dot(
+    lhs: jnp.ndarray,
+    rhs: jnp.ndarray,
+    group_sizes: jnp.ndarray,
+    *,
+    interpret: bool | None = None,
+    platform: str | None = None,
+) -> jnp.ndarray:
+    """Drop-in for `jax.lax.ragged_dot`: Pallas gmm on TPU (or under
+    AUTOMODEL_GMM_INTERPRET=1 anywhere), XLA's ragged_dot elsewhere."""
+    if interpret is None:
+        interpret = _interpret_requested()
+    if not (interpret or _pallas_eligible(platform)):
+        return jax.lax.ragged_dot(lhs, rhs, group_sizes)
+    return _grouped_matmul(lhs, rhs, group_sizes, interpret)

@@ -1,0 +1,31 @@
+"""Shared "can this Pallas kernel run here?" check.
+
+Used by ops.attention (splash flash) and ops.grouped_matmul (MoE gmm) so the
+two kernels can't drift in how they decide the mesh is a TPU. Per-kernel
+interpret-mode env switches stay with each kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def is_tpu_platform(platform: str | None = None) -> bool:
+    """`platform` (from BackendConfig.platform, resolved off the MeshContext)
+    is authoritative when known — the process default device may belong to a
+    DIFFERENT backend than the mesh the computation runs on (e.g. a CPU mesh
+    on an image whose sitecustomize registers a TPU client). The
+    default-device heuristic below is only the no-mesh fallback."""
+    if platform is not None:
+        return platform == "tpu"
+    try:
+        # honor an explicitly pinned default device (tests pin CPU while a
+        # TPU is still visible in jax.devices()); jax also accepts platform
+        # strings ('tpu') as jax_default_device
+        dd = jax.config.jax_default_device
+        if isinstance(dd, str):
+            return dd == "tpu"
+        dev = dd if dd is not None else jax.devices()[0]
+        return getattr(dev, "platform", None) == "tpu"
+    except Exception:
+        return False

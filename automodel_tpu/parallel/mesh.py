@@ -161,6 +161,13 @@ class MeshContext:
     def ep_size(self) -> int:
         return self.size(MeshAxisName.EP)
 
+    @property
+    def platform(self) -> str:
+        """Platform of the devices computation actually runs on ('tpu',
+        'cpu', ...). Kernel eligibility must key off THIS, not the process
+        default device — a CPU mesh can coexist with a visible TPU backend."""
+        return self.mesh.devices.flat[0].platform
+
     # -- sharding -----------------------------------------------------------
     def resolve(self, logical: Sequence[Any] | None) -> P:
         """Map a logical spec (tuple of logical axis names / None / tuples of

@@ -106,17 +106,71 @@ def merge_lora(base_params: Any, lora_params: dict, cfg: PeftConfig) -> Any:
     return jax.tree_util.tree_map_with_path(visit, base_params)
 
 
-def make_lora_loss_fn(base_loss_fn, base_params: Any, cfg: PeftConfig):
+def graft_lora(base_params: Any, lora_params: dict, cfg: PeftConfig) -> Any:
+    """Insert adapter factors NEXT TO their kernels (activation-side LoRA).
+
+    For each adapter at ``.../kernel`` the holding dict gains ``lora_A``
+    (scale pre-folded) and ``lora_B``; a consuming projection computes
+    ``x@W + (x@A')@B``. Unlike :func:`merge_lora` this never materializes
+    ``W + s·A@B`` — under a layer scan the merged form makes the backward
+    accumulate a full-rank ``[L, in, out]`` dW (to be contracted onto A/B),
+    which alone OOMs a 16GB chip at 3B params. Only paths the model's
+    projections actually consume may be grafted (``lora_graft_patterns``);
+    grafting an ignored path would silently train dead adapters."""
+    scale = jnp.asarray(cfg.scale)
+
+    def _insert(tree: Any, parts: list, upd: dict) -> Any:
+        new = dict(tree)
+        if parts:
+            new[parts[0]] = _insert(tree[parts[0]], parts[1:], upd)
+        else:
+            new.update(upd)
+        return new
+
+    out = base_params
+    for p, ab in lora_params.items():
+        parts = p.split("/")
+        if parts[-1] != "kernel":
+            raise ValueError(f"graft_lora only supports kernel leaves, got {p!r}")
+        a = ab["lora_A"]
+        upd = {
+            "lora_A": (a.astype(jnp.float32) * scale).astype(a.dtype),
+            "lora_B": ab["lora_B"],
+        }
+        out = _insert(out, parts[:-1], upd)
+    return out
+
+
+def make_lora_loss_fn(
+    base_loss_fn,
+    base_params: Any,
+    cfg: PeftConfig,
+    graft_patterns: Sequence[str] = (),
+):
     """Wrap a (params, mb) loss into an (adapters, mb) loss.
 
     The base tree is exposed as ``loss_fn.bound_params`` and the train step
     passes it as a REAL jit argument — closing over it would bake ~2 bytes/
     param of captured constants into the lowered computation (a 14.5 GB
-    constant blob for an 8B base), paid at every compile."""
+    constant blob for an 8B base), paid at every compile.
+
+    ``graft_patterns`` (the model's ``lora_graft_patterns``) selects adapter
+    paths applied activation-side via :func:`graft_lora`; the rest go through
+    the merged formulation."""
+
+    def _graftable(p: str) -> bool:
+        return p.endswith("/kernel") and any(
+            fnmatch.fnmatch(p, pat) for pat in graft_patterns
+        )
 
     def loss_fn(lora_params, mb, base):
         frozen = jax.lax.stop_gradient(base)
-        return base_loss_fn(merge_lora(frozen, lora_params, cfg), mb)
+        graft = {p: ab for p, ab in lora_params.items() if _graftable(p)}
+        merged = {p: ab for p, ab in lora_params.items() if not _graftable(p)}
+        params = graft_lora(frozen, graft, cfg) if graft else frozen
+        if merged:
+            params = merge_lora(params, merged, cfg)
+        return base_loss_fn(params, mb)
 
     loss_fn.bound_params = base_params
     return loss_fn

@@ -134,7 +134,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             from automodel_tpu.peft import make_lora_loss_fn
 
             self.loss_fn = make_lora_loss_fn(
-                self.loss_fn, self.auto.params, self.peft_config
+                self.loss_fn, self.auto.params, self.peft_config,
+                graft_patterns=getattr(self.model, "lora_graft_patterns", ()),
             )
         post_step = getattr(self.model, "post_step_fn", None) if self.peft_config is None else None
         self.train_step = build_train_step(

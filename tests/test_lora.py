@@ -160,3 +160,51 @@ def test_recipe_with_peft(tmp_path):
     assert np.isfinite(last["loss"])
     adapters = list((tmp_path / "ckpt").glob("*/hf_adapter/adapter_config.json"))
     assert adapters, "HF PEFT adapter export missing"
+
+
+def test_graft_matches_merged_formulation():
+    """Activation-side (grafted) LoRA must match the merged formulation to
+    fp32 numerics — same math, different association order."""
+    from automodel_tpu.peft.lora import graft_lora
+
+    auto = auto_model.from_config(HF, None, FP32, seed=0)
+    cfg = PeftConfig(target_modules=("*attn/[qkvo]_proj*", "*mlp*"), dim=4, alpha=8)
+    lora = init_lora_params(jax.random.key(0), auto.params, cfg)
+    # make B nonzero so the adapters actually contribute
+    lora = jax.tree.map(
+        lambda x: x + 0.01 * jnp.ones_like(x) if x.ndim >= 2 else x, lora
+    )
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, size=(1, 16)), jnp.int32)
+    out_merged = auto.model(merge_lora(auto.params, lora, cfg), ids)
+    out_graft = auto.model(graft_lora(auto.params, lora, cfg), ids)
+    np.testing.assert_allclose(
+        np.asarray(out_graft), np.asarray(out_merged), atol=2e-5
+    )
+
+
+def test_lora_loss_fn_grafts_for_supporting_model():
+    """With graft_patterns the loss routes matched adapters activation-side;
+    gradients flow to them and match the merged-path gradients."""
+    auto = auto_model.from_config(HF, None, FP32, seed=0)
+    cfg = PeftConfig(target_modules=("*attn/[qkvo]_proj*", "*mlp*"), dim=4, alpha=8)
+    lora = init_lora_params(jax.random.key(1), auto.params, cfg)
+    from automodel_tpu.training.train_step import make_causal_lm_loss
+
+    base_loss = make_causal_lm_loss(auto.model)
+    ids = np.random.default_rng(1).integers(0, 128, size=(1, 16)).astype(np.int32)
+    mb = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(ids)}
+
+    merged_fn = make_lora_loss_fn(base_loss, auto.params, cfg)
+    graft_fn = make_lora_loss_fn(
+        base_loss, auto.params, cfg,
+        graft_patterns=auto.model.lora_graft_patterns,
+    )
+    lm, gm = jax.value_and_grad(lambda l: merged_fn(l, mb, auto.params)[0])(lora)
+    lg, gg = jax.value_and_grad(lambda l: graft_fn(l, mb, auto.params)[0])(lora)
+    np.testing.assert_allclose(float(lg), float(lm), atol=1e-5)
+    for p in lora:
+        for w in ("lora_A", "lora_B"):
+            np.testing.assert_allclose(
+                np.asarray(gg[p][w]), np.asarray(gm[p][w]), atol=1e-4,
+                err_msg=f"{p}/{w}",
+            )
