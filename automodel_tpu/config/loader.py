@@ -61,10 +61,11 @@ def translate_value(v: str) -> Any:
         out = yaml.safe_load(v)
     except yaml.YAMLError:
         return v
-    if isinstance(out, str) and _SCI_NOTATION_RE.match(out):
+    if isinstance(out, str) and out == v and _SCI_NOTATION_RE.match(out):
         # YAML 1.1 parses dotless scientific notation ('1e-2') as a string;
         # coerce so `--optimizer.lr=1e-2` behaves like `lr: 1.0e-2`. Regex-
-        # gated: bare float() would also swallow 'nan'/'inf'/'1_5'.
+        # gated (bare float() would also swallow 'nan'/'inf'/'1_5') and only
+        # when the text was unquoted (out == v): --tag='"1e5"' stays a string.
         return float(out)
     return out
 

@@ -91,6 +91,12 @@ def _zigzag_positions(rank, s_loc: int, cp: int):
     )
 
 
+def _ring_interpret_requested() -> bool:
+    import os
+
+    return os.environ.get("AUTOMODEL_RING_INTERPRET", "0") == "1"
+
+
 def ring_attention_shard(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -103,9 +109,146 @@ def ring_attention_shard(
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
     zigzag: bool = False,
+    platform: Optional[str] = None,
 ) -> jnp.ndarray:
     """Ring attention on per-device shards. q/k/v: [B, S_loc, N(,kv), H],
-    segment_ids: [B, S_loc]. Requires `axis_name` bound (shard_map)."""
+    segment_ids: [B, S_loc]. Requires `axis_name` bound (shard_map).
+
+    On TPU (or under AUTOMODEL_RING_INTERPRET=1) each ring step runs the
+    Pallas blockwise kernels from ops.ring_flash — O(S_loc·block) memory;
+    otherwise (and for logits_soft_cap, which the kernel path doesn't carry)
+    the XLA formulation below materializes per-step S_loc² logits."""
+    from automodel_tpu.ops.platform_check import is_tpu_platform
+
+    interpret = _ring_interpret_requested()
+    if logits_soft_cap is None and (interpret or is_tpu_platform(platform)):
+        return _ring_flash_shard(
+            q, k, v,
+            axis_name=axis_name, causal=causal, scale=scale,
+            segment_ids=segment_ids, sliding_window=sliding_window,
+            zigzag=zigzag, interpret=interpret,
+        )
+    return _ring_attention_shard_xla(
+        q, k, v,
+        axis_name=axis_name, causal=causal, scale=scale,
+        segment_ids=segment_ids, logits_soft_cap=logits_soft_cap,
+        sliding_window=sliding_window, zigzag=zigzag,
+    )
+
+
+def _ring_flash_shard(
+    q, k, v, *, axis_name, causal, scale, segment_ids, sliding_window,
+    zigzag, interpret,
+):
+    from automodel_tpu.ops.ring_flash import (
+        NEG_INF,
+        flash_block_bwd,
+        flash_block_fwd,
+        merge_partials,
+    )
+
+    b, s_loc, n, h = q.shape
+    scale = scale if scale is not None else 1.0 / (h**0.5)
+    cp = jax.lax.psum(1, axis_name)  # python int inside shard_map
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def pos_of(rank):
+        if zigzag:
+            return _zigzag_positions(rank, s_loc, int(cp))
+        return rank * s_loc + jnp.arange(s_loc)
+
+    if segment_ids is None:
+        seg0 = jnp.zeros((b, s_loc), jnp.int32)
+    else:
+        seg0 = segment_ids.astype(jnp.int32)
+
+    def rotate(*xs):
+        return tuple(jax.lax.ppermute(x, axis_name, perm) for x in xs)
+
+    # NOTE: the custom_vjp fwd/bwd must not close over tracers (axis_index);
+    # rank/positions are recomputed inside each impl.
+    def _fwd_impl(q, k, v, seg):
+        my_rank = jax.lax.axis_index(axis_name)
+        q_pos = pos_of(my_rank)
+        out = jnp.zeros((b, s_loc, n, h), jnp.float32)
+        lse = jnp.full((b, n, s_loc), NEG_INF, jnp.float32)
+
+        def body(step, carry):
+            out, lse, k_blk, v_blk, seg_blk = carry
+            kv_pos = pos_of((my_rank - step) % cp)
+            o_t, lse_t = flash_block_fwd(
+                q, k_blk, v_blk, q_pos, kv_pos, seg, seg_blk,
+                causal=causal, window=sliding_window, scale=scale,
+                interpret=interpret,
+            )
+            out, lse = merge_partials(out, lse, o_t.astype(jnp.float32), lse_t)
+            k_blk, v_blk, seg_blk = rotate(k_blk, v_blk, seg_blk)
+            return out, lse, k_blk, v_blk, seg_blk
+
+        out, lse, *_ = jax.lax.fori_loop(0, cp, body, (out, lse, k, v, seg))
+        return out.astype(q.dtype), lse
+
+    @jax.custom_vjp
+    def ring(q, k, v, seg):
+        return _fwd_impl(q, k, v, seg)[0]
+
+    def ring_fwd(q, k, v, seg):
+        out, lse = _fwd_impl(q, k, v, seg)
+        return out, (q, k, v, seg, out, lse)
+
+    def ring_bwd(res, dout):
+        q, k, v, seg, out, lse = res
+        my_rank = jax.lax.axis_index(axis_name)
+        q_pos = pos_of(my_rank)
+        do32 = dout.astype(jnp.float32)
+        # delta = rowsum(dO ∘ O) per (b, n, s) — the flash backward constant
+        delta = (do32 * out.astype(jnp.float32)).sum(-1).transpose(0, 2, 1)
+
+        def body(step, carry):
+            dq, k_blk, v_blk, seg_blk, dk_blk, dv_blk = carry
+            kv_pos = pos_of((my_rank - step) % cp)
+            dq_t, dk_t, dv_t = flash_block_bwd(
+                q, k_blk, v_blk, dout, lse, delta, q_pos, kv_pos, seg, seg_blk,
+                causal=causal, window=sliding_window, scale=scale,
+                interpret=interpret,
+            )
+            dq = dq + dq_t
+            # dk/dv ride the ring WITH their kv block; after cp rotations
+            # they are back on the owning device with every contribution
+            dk_blk, dv_blk = dk_blk + dk_t, dv_blk + dv_t
+            k_blk, v_blk, seg_blk, dk_blk, dv_blk = rotate(
+                k_blk, v_blk, seg_blk, dk_blk, dv_blk
+            )
+            return dq, k_blk, v_blk, seg_blk, dk_blk, dv_blk
+
+        dq = jnp.zeros(q.shape, jnp.float32)
+        dkv0 = jnp.zeros(k.shape, jnp.float32)
+        dq, _, _, _, dk, dv = jax.lax.fori_loop(
+            0, cp, body, (dq, k, v, seg, dkv0, jnp.zeros(v.shape, jnp.float32))
+        )
+        import numpy as np
+
+        ct_seg = np.zeros(seg.shape, jax.dtypes.float0)
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), ct_seg
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring(q, k, v, seg0)
+
+
+def _ring_attention_shard_xla(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "cp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    zigzag: bool = False,
+) -> jnp.ndarray:
+    """Reference XLA ring (materializes per-step S_loc² logits)."""
     b, s_loc, n, h = q.shape
     n_kv = k.shape[2]
     scale = scale if scale is not None else 1.0 / (h**0.5)
@@ -209,6 +352,7 @@ def make_ring_attention(mesh_ctx, zigzag: bool = False):
             logits_soft_cap=logits_soft_cap,
             sliding_window=sliding_window,
             zigzag=zigzag and mesh.shape["cp"] > 1,
+            platform=mesh_ctx.platform,
         )
 
         def fn(*args):
