@@ -30,6 +30,7 @@ SwiGLU-family activation.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Callable
 
@@ -233,65 +234,11 @@ def a2a_experts(
         "db": P(A.EP, None),
     }
 
-    def body(xb, idxb, cwb, wd):
-        Bl, Sl, _ = xb.shape
-        T = Bl * Sl
-        xt = xb.reshape(T, D)
-        flat = idxb.reshape(T * K)
-        order = jnp.argsort(flat, stable=True)  # sorted-pick → original-pick
-        sorted_e = flat[order]
-        xs = xt[order // K]  # [T*K, D] picks sorted by global expert id
-
-        counts = jnp.bincount(flat, length=E).astype(jnp.int32)
-        peer_counts = counts.reshape(ep, E_loc).sum(-1)
-        peer_off = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), jnp.cumsum(peer_counts)[:-1]]
-        )
-        peer_of = sorted_e // E_loc
-        pos_in_peer = jnp.arange(T * K, dtype=jnp.int32) - peer_off[peer_of]
-        keep = pos_in_peer < C  # over-capacity picks drop (zero contribution)
-        dst = jnp.where(keep, peer_of * C + pos_in_peer, ep * C)
-
-        send_x = jnp.zeros((ep * C + 1, D), xs.dtype).at[dst].set(xs)[:-1]
-        send_id = (
-            jnp.full((ep * C + 1,), E_loc, jnp.int32)
-            .at[dst]
-            .set(sorted_e % E_loc)[:-1]
-        )
-        a2a = lambda a: jax.lax.all_to_all(
-            a, A.EP, split_axis=0, concat_axis=0, tiled=True
-        )
-        recv_x, recv_id = a2a(send_x), a2a(send_id)  # [ep*C, ...] by sender
-
-        order2 = jnp.argsort(recv_id, stable=True)  # sentinel E_loc sorts last
-        xs2 = recv_x[order2]
-        sid = jnp.minimum(recv_id[order2], E_loc - 1)
-        gsz = jnp.bincount(recv_id, length=E_loc).astype(jnp.int32)  # sentinel drops
-
-        g = ragged_dot(xs2, wd["gw"].astype(xs2.dtype), gsz, platform=platform)
-        u = ragged_dot(xs2, wd["uw"].astype(xs2.dtype), gsz, platform=platform)
-        if "gb" in wd:
-            g = g + wd["gb"].astype(g.dtype)[sid]
-            u = u + wd["ub"].astype(u.dtype)[sid]
-        y = ragged_dot(act2(g, u), wd["dw"].astype(xs2.dtype), gsz, platform=platform)
-        if "db" in wd:  # partial over tp: add the bias on one tp shard only
-            y = y + jnp.where(
-                jax.lax.axis_index(A.TP) == 0, wd["db"].astype(y.dtype)[sid], 0.0
-            )
-        y = jnp.zeros_like(y).at[order2].set(y)  # back to recv order
-        y = a2a(y)  # [ep*C, D] back in my send layout
-        y = jnp.concatenate([y, jnp.zeros((1, D), y.dtype)], 0)[dst]  # dropped → 0
-        y = jnp.zeros_like(y).at[order].set(y)  # original pick order
-
-        cwf = cwb.reshape(T * K, 1).astype(jnp.float32)
-        out = (
-            jnp.zeros((T, D), jnp.float32)
-            .at[jnp.arange(T * K, dtype=jnp.int32) // K]
-            .add(y.astype(jnp.float32) * cwf)
-        )
-        out = jax.lax.psum(out, A.TP)  # down-proj partials, deferred to [T, D]
-        return out.astype(xb.dtype).reshape(Bl, Sl, D)
-
+    body = functools.partial(
+        _a2a_body,
+        ep=ep, ep_axis=A.EP, E=E, E_loc=E_loc, C=C, D=D, K=K,
+        act2=act2, tp_axis=A.TP, platform=platform,
+    )
     idx = gate_out.topk_idx.reshape(B, S, K)
     cw = gate_out.topk_weights.reshape(B, S, K)
     return jax.shard_map(
@@ -300,6 +247,118 @@ def a2a_experts(
         in_specs=(tok_spec, tok_spec, tok_spec, {k: w_specs[k] for k in wd}),
         out_specs=tok_spec,
     )(x, idx, cw, wd)
+
+
+def _a2a_body(xb, idxb, cwb, wd, *, ep, ep_axis, E, E_loc, C, D, K, act2,
+              tp_axis=None, platform=None):
+    """The per-device token-exchange block. Requires `ep_axis` (and, when
+    ``tp_axis`` is set, that axis too) to be MANUAL in the calling context —
+    either a2a_experts' own shard_map, or a pipeline region already manual
+    over {pp, ep} (parallel.pp ep_manual mode, tp_axis=None)."""
+    Bl, Sl, _ = xb.shape
+    T = Bl * Sl
+    xt = xb.reshape(T, D)
+    flat = idxb.reshape(T * K)
+    order = jnp.argsort(flat, stable=True)  # sorted-pick → original-pick
+    sorted_e = flat[order]
+    xs = xt[order // K]  # [T*K, D] picks sorted by global expert id
+
+    counts = jnp.bincount(flat, length=E).astype(jnp.int32)
+    peer_counts = counts.reshape(ep, E_loc).sum(-1)
+    peer_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(peer_counts)[:-1]]
+    )
+    peer_of = sorted_e // E_loc
+    pos_in_peer = jnp.arange(T * K, dtype=jnp.int32) - peer_off[peer_of]
+    keep = pos_in_peer < C  # over-capacity picks drop (zero contribution)
+    dst = jnp.where(keep, peer_of * C + pos_in_peer, ep * C)
+
+    send_x = jnp.zeros((ep * C + 1, D), xs.dtype).at[dst].set(xs)[:-1]
+    send_id = (
+        jnp.full((ep * C + 1,), E_loc, jnp.int32)
+        .at[dst]
+        .set(sorted_e % E_loc)[:-1]
+    )
+    a2a = lambda a: jax.lax.all_to_all(
+        a, ep_axis, split_axis=0, concat_axis=0, tiled=True
+    )
+    recv_x, recv_id = a2a(send_x), a2a(send_id)  # [ep*C, ...] by sender
+
+    order2 = jnp.argsort(recv_id, stable=True)  # sentinel E_loc sorts last
+    xs2 = recv_x[order2]
+    sid = jnp.minimum(recv_id[order2], E_loc - 1)
+    gsz = jnp.bincount(recv_id, length=E_loc).astype(jnp.int32)  # sentinel drops
+
+    g = ragged_dot(xs2, wd["gw"].astype(xs2.dtype), gsz, platform=platform)
+    u = ragged_dot(xs2, wd["uw"].astype(xs2.dtype), gsz, platform=platform)
+    if "gb" in wd:
+        g = g + wd["gb"].astype(g.dtype)[sid]
+        u = u + wd["ub"].astype(u.dtype)[sid]
+    y = ragged_dot(act2(g, u), wd["dw"].astype(xs2.dtype), gsz, platform=platform)
+    if "db" in wd:
+        if tp_axis is not None:  # partial over tp: bias on one tp shard only
+            y = y + jnp.where(
+                jax.lax.axis_index(tp_axis) == 0, wd["db"].astype(y.dtype)[sid], 0.0
+            )
+        else:
+            y = y + wd["db"].astype(y.dtype)[sid]
+    y = jnp.zeros_like(y).at[order2].set(y)  # back to recv order
+    y = a2a(y)  # [ep*C, D] back in my send layout
+    y = jnp.concatenate([y, jnp.zeros((1, D), y.dtype)], 0)[dst]  # dropped → 0
+    y = jnp.zeros_like(y).at[order].set(y)  # original pick order
+
+    cwf = cwb.reshape(T * K, 1).astype(jnp.float32)
+    out = (
+        jnp.zeros((T, D), jnp.float32)
+        .at[jnp.arange(T * K, dtype=jnp.int32) // K]
+        .add(y.astype(jnp.float32) * cwf)
+    )
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)  # down-proj partials, deferred to [T, D]
+    return out.astype(xb.dtype).reshape(Bl, Sl, D)
+
+
+def a2a_experts_manual(
+    x: jnp.ndarray,  # [B_loc, S_loc, D] — the LOCAL ep shard
+    gate_out: GateOutput,  # over the local tokens
+    weights: dict,
+    cfg: MoEConfig,
+    act2: Act,
+    *,
+    ep: int,
+    ep_axis: str = "ep",
+    platform: str | None = None,
+) -> jnp.ndarray:
+    """a2a dispatch for contexts where `ep` is ALREADY a manual axis (the
+    pp×ep pipeline region). tp must not shard the expert weights here
+    (parallel.pp restricts ep_manual mode to tp=1)."""
+    Bl, Sl, D = x.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    if E % ep:
+        raise ValueError(f"num_experts={E} must be divisible by ep={ep}")
+    E_loc = E // ep
+    Tl = Bl * Sl
+    cap = Tl * min(K, E_loc)  # strict per-peer worst case → dropless
+    if cfg.a2a_capacity_factor is not None:
+        cap = min(cap, int(math.ceil(cfg.a2a_capacity_factor * Tl * K / ep)))
+    C = -(-cap // 8) * 8
+
+    gw, uw = _split_gate_up(weights["gate_up"], cfg.interleaved_gate_up)
+    wd = {"gw": gw, "uw": uw, "dw": weights["down"]}
+    if "gate_up_bias" in weights:
+        wd["gb"], wd["ub"] = _split_gate_up(
+            weights["gate_up_bias"], cfg.interleaved_gate_up
+        )
+    if "down_bias" in weights:
+        wd["db"] = weights["down_bias"]
+
+    idx = gate_out.topk_idx.reshape(Bl, Sl, K)
+    cw = gate_out.topk_weights.reshape(Bl, Sl, K)
+    return _a2a_body(
+        x, idx, cw, wd,
+        ep=ep, ep_axis=ep_axis, E=E, E_loc=E_loc, C=C, D=D, K=K,
+        act2=act2, tp_axis=None, platform=platform,
+    )
 
 
 # Registry with a UNIFORM call shape — x is [B, S, D]; every entry accepts

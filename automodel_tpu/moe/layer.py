@@ -78,7 +78,13 @@ def moe_block(
             "preserve the attribute); falling back to the single-slice "
             "ragged path — NO expert-parallel token exchange will happen."
         )
-    routed = EXPERT_BACKENDS[experts_backend](
+    # a callable backend (e.g. the pipeline's ep-manual a2a binding) uses the
+    # registry's uniform signature directly
+    backend_fn = (
+        experts_backend if callable(experts_backend)
+        else EXPERT_BACKENDS[experts_backend]
+    )
+    routed = backend_fn(
         x, gout, mp["experts"], cfg, act2,
         ctx=ctx, constrain=constrain, platform=platform,
     )

@@ -173,8 +173,11 @@ def _ring_flash_shard(
         out = jnp.zeros((b, s_loc, n, h), jnp.float32)
         lse = jnp.full((b, n, s_loc), NEG_INF, jnp.float32)
 
-        def body(step, carry):
-            out, lse, k_blk, v_blk, seg_blk = carry
+        # python loop: cp is a static int here, and unrolling lets the last
+        # step skip its (result-discarding) kv rotation — ring attention is
+        # ICI-bound, so a dead full-KV ppermute per layer is real wall-clock
+        k_blk, v_blk, seg_blk = k, v, seg
+        for step in range(cp):
             kv_pos = pos_of((my_rank - step) % cp)
             o_t, lse_t = flash_block_fwd(
                 q, k_blk, v_blk, q_pos, kv_pos, seg, seg_blk,
@@ -182,10 +185,8 @@ def _ring_flash_shard(
                 interpret=interpret,
             )
             out, lse = merge_partials(out, lse, o_t.astype(jnp.float32), lse_t)
-            k_blk, v_blk, seg_blk = rotate(k_blk, v_blk, seg_blk)
-            return out, lse, k_blk, v_blk, seg_blk
-
-        out, lse, *_ = jax.lax.fori_loop(0, cp, body, (out, lse, k, v, seg))
+            if step < cp - 1:
+                k_blk, v_blk, seg_blk = rotate(k_blk, v_blk, seg_blk)
         return out.astype(q.dtype), lse
 
     @jax.custom_vjp
@@ -204,8 +205,11 @@ def _ring_flash_shard(
         # delta = rowsum(dO ∘ O) per (b, n, s) — the flash backward constant
         delta = (do32 * out.astype(jnp.float32)).sum(-1).transpose(0, 2, 1)
 
-        def body(step, carry):
-            dq, k_blk, v_blk, seg_blk, dk_blk, dv_blk = carry
+        dq = jnp.zeros(q.shape, jnp.float32)
+        dk = jnp.zeros(k.shape, jnp.float32)
+        dv = jnp.zeros(v.shape, jnp.float32)
+        k_blk, v_blk, seg_blk = k, v, seg
+        for step in range(cp):
             kv_pos = pos_of((my_rank - step) % cp)
             dq_t, dk_t, dv_t = flash_block_bwd(
                 q, k_blk, v_blk, dout, lse, delta, q_pos, kv_pos, seg, seg_blk,
@@ -213,19 +217,16 @@ def _ring_flash_shard(
                 interpret=interpret,
             )
             dq = dq + dq_t
-            # dk/dv ride the ring WITH their kv block; after cp rotations
-            # they are back on the owning device with every contribution
-            dk_blk, dv_blk = dk_blk + dk_t, dv_blk + dv_t
-            k_blk, v_blk, seg_blk, dk_blk, dv_blk = rotate(
-                k_blk, v_blk, seg_blk, dk_blk, dv_blk
-            )
-            return dq, k_blk, v_blk, seg_blk, dk_blk, dv_blk
-
-        dq = jnp.zeros(q.shape, jnp.float32)
-        dkv0 = jnp.zeros(k.shape, jnp.float32)
-        dq, _, _, _, dk, dv = jax.lax.fori_loop(
-            0, cp, body, (dq, k, v, seg, dkv0, jnp.zeros(v.shape, jnp.float32))
-        )
+            # dk/dv ride the ring WITH their kv block; after cp total
+            # rotations they are back on the owning device with every
+            # contribution (the k/v/seg blocks themselves stop one step
+            # early — the last compute doesn't need the next block)
+            dk, dv = dk + dk_t, dv + dv_t
+            if step < cp - 1:
+                k_blk, v_blk, seg_blk = rotate(k_blk, v_blk, seg_blk)
+                dk, dv = rotate(dk, dv)
+            else:
+                dk, dv = rotate(dk, dv)
         import numpy as np
 
         ct_seg = np.zeros(seg.shape, jax.dtypes.float0)

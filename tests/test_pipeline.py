@@ -201,3 +201,51 @@ def test_pp_no_full_activation_psum(pp_setup):
         if len(dims) >= 4 and np.prod(dims) >= 4 * 2 * 16 * 64:
             bad.append(m.group(0))
     assert not bad, bad
+
+
+def test_moe_pp_a2a_manual_matches(devices8):
+    """PP x EP with experts='a2a' runs the token-exchange body with ep
+    MANUAL inside the pipeline region (VERDICT r2 #5) — no silent ragged
+    downgrade — and matches the unpipelined forward."""
+    import automodel_tpu.parallel.pp as ppm
+
+    ctx = build_mesh(MeshConfig(pp=2, ep=2, dp_shard=4), devices=devices8)
+    backend = {**FP32, "experts": "a2a", "pp_microbatches": 2}
+    auto_pp = auto_model.from_config(MOE_HF, ctx, backend, seed=0)
+    # reference must be DROPLESS too (a2a with no mesh → single-slice
+    # ragged); the default gspmd backend drops late over-capacity picks
+    auto_ref = auto_model.from_config(MOE_HF, None, {**FP32, "experts": "a2a"}, seed=0)
+    ids = jnp.asarray(
+        np.random.default_rng(7).integers(0, 128, size=(4, 32)), jnp.int32
+    )
+    ppm._logged_a2a_pp = False
+    out_pp, aux_pp = jax.jit(lambda p, i: auto_pp.model(p, i))(auto_pp.params, ids)
+    out_ref, aux_ref = auto_ref.model(auto_ref.params, ids)
+    assert not ppm._logged_a2a_pp, "a2a silently downgraded to ragged under PP"
+    np.testing.assert_allclose(
+        np.asarray(out_pp), np.asarray(out_ref), atol=2e-4, rtol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(aux_pp.expert_counts), np.asarray(aux_ref.expert_counts)
+    )
+
+    # gradients flow through the manual exchange
+    def loss_pp(p):
+        out, aux = auto_pp.model(p, ids)
+        return (out.astype(jnp.float32) ** 2).mean() + aux.aux_loss
+
+    def loss_ref(p):
+        out, aux = auto_ref.model(p, ids)
+        return (out.astype(jnp.float32) ** 2).mean() + aux.aux_loss
+
+    g_pp = jax.jit(jax.grad(loss_pp))(auto_pp.params)
+    g_ref = jax.grad(loss_ref)(auto_ref.params)
+    for path, a, b in zip(
+        [p for p, _ in jax.tree_util.tree_flatten_with_path(g_ref)[0]],
+        jax.tree.leaves(g_pp),
+        jax.tree.leaves(g_ref),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-3,
+            err_msg=str(path),
+        )
