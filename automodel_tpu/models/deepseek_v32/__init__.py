@@ -1,0 +1,13 @@
+from automodel_tpu.models.deepseek_v32.model import (
+    DeepseekV32Config,
+    DeepseekV32ForCausalLM,
+)
+from automodel_tpu.models.deepseek_v32.state_dict_adapter import (
+    DeepseekV32StateDictAdapter,
+)
+
+__all__ = [
+    "DeepseekV32Config",
+    "DeepseekV32ForCausalLM",
+    "DeepseekV32StateDictAdapter",
+]

@@ -1,0 +1,260 @@
+"""DeepSeek-V3.2: MLA + lightning-indexer top-k sparse attention, TPU-native.
+
+Parity: reference models/deepseek_v32 (layers.py:95 DeepseekV32Indexer,
+layers.py:272 DeepseekV32MLA, :358 _build_sparse_mask) and the official
+DeepSeek-V3.2-Exp training code it follows. The V3 MLA projections are
+reused unchanged (models/deepseek_v3 here); V3.2 adds:
+
+- an **indexer**: q from the q-lora residual (wq_b), a SINGLE shared key
+  head (wk + LayerNorm), partial decoupled RoPE on the pe dims, a Hadamard
+  rotation on both, ReLU'd q·kᵀ scores weighted per-head (weights_proj) and
+  summed over heads → per-query top-k key positions;
+- a **sparse mask** (0 at the top-k positions, -inf elsewhere, on top of
+  causal) applied to the MLA attention as an additive bias.
+
+The Hadamard rotation is an exact matmul against the Sylvester matrix
+(head_dim is a power of two) — MXU-friendly, no custom kernel needed.
+Attention runs as masked sdpa; the top-k gather-style kernel is a perf
+follow-up, not a numerics requirement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.deepseek_v3.model import (
+    DeepseekV3Config,
+    DeepseekV3ForCausalLM,
+    SHARDING_RULES as V3_RULES,
+    init_params as v3_init_params,
+)
+from automodel_tpu.models.llama.model import Constrain, _dense_init
+from automodel_tpu.models.qwen3_moe.model import forward_hidden as moe_forward_hidden
+from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope
+
+NEG_INF = float(np.finfo(np.float32).min) / 2
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepseekV32Config(DeepseekV3Config):
+    index_n_heads: int = 64
+    index_head_dim: int = 128
+    index_topk: int = 2048
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "DeepseekV32Config":
+        base = DeepseekV3Config.from_hf(hf_cfg)
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
+        fields.update(
+            index_n_heads=get("index_n_heads", 64),
+            index_head_dim=get("index_head_dim", 128),
+            index_topk=get("index_topk", 2048),
+        )
+        return cls(**fields)
+
+
+def _hadamard_matrix(n: int) -> np.ndarray:
+    """Sylvester construction H_n (n a power of two)."""
+    if n & (n - 1):
+        raise ValueError(f"Hadamard rotation needs power-of-two dim, got {n}")
+    h = np.array([[1.0]], np.float32)
+    while h.shape[0] < n:
+        h = np.block([[h, h], [h, -h]])
+    return h
+
+
+def _rotate_activation(x: jnp.ndarray) -> jnp.ndarray:
+    """x @ H · d^{-1/2} (reference layers.py:77 rotate_activation)."""
+    d = x.shape[-1]
+    H = jnp.asarray(_hadamard_matrix(d) * d**-0.5, x.dtype)
+    return x @ H
+
+
+def init_indexer_layer(cfg: DeepseekV32Config, backend: BackendConfig, key, L: int) -> dict:
+    pd = backend.param_jnp_dtype
+    D, Hn, hd = cfg.hidden_size, cfg.index_n_heads, cfg.index_head_dim
+    ks = jax.random.split(key, 3)
+
+    def stack(k, shape):
+        return _dense_init(k, (L, *shape), pd, in_axis=1)
+
+    return {
+        "wq_b": {"kernel": stack(ks[0], (cfg.q_lora_rank, Hn * hd))},
+        "wk": {"kernel": stack(ks[1], (D, hd))},
+        "k_norm": {"scale": jnp.ones((L, hd), pd), "bias": jnp.zeros((L, hd), pd)},
+        "weights_proj": {"kernel": stack(ks[2], (D, Hn))},
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):  # torch nn.LayerNorm default eps
+    from automodel_tpu.ops.norms import layer_norm
+
+    return layer_norm(x, scale, bias, eps)
+
+
+def indexer_topk_mask(
+    cfg: DeepseekV32Config,
+    ip: dict,  # indexer params for one layer
+    x: jnp.ndarray,  # [B, S, D] normed hidden
+    q_resid: jnp.ndarray,  # [B, S, q_lora_rank]
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    segment_ids: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """→ additive sparse mask [B, 1, S, S] (0 at top-k ∧ causal, else -inf)."""
+    B, S, _ = x.shape
+    Hn, hd, rope = cfg.index_n_heads, cfg.index_head_dim, cfg.qk_rope_head_dim
+    nope = hd - rope
+
+    q = (q_resid @ ip["wq_b"]["kernel"].astype(x.dtype)).reshape(B, S, Hn, hd)
+    k = _layer_norm(
+        x @ ip["wk"]["kernel"].astype(x.dtype),
+        ip["k_norm"]["scale"], ip["k_norm"]["bias"],
+    )  # [B, S, hd] single shared head
+
+    q_nope, q_pe = q[..., :nope], q[..., nope:]
+    k_nope, k_pe = k[..., :nope], k[..., nope:]
+    q_pe, k_pe = apply_rope(
+        q_pe, k_pe[:, :, None, :], cos, sin, interleave=cfg.rope_interleave
+    )
+    q = _rotate_activation(jnp.concatenate([q_nope, q_pe], axis=-1))
+    k = _rotate_activation(
+        jnp.concatenate([k_nope, k_pe[:, :, 0, :]], axis=-1)
+    )
+
+    # relu(q·kᵀ) per head, weighted (weights_proj · Hn^-1/2 · hd^-1/2), summed
+    w = (x @ ip["weights_proj"]["kernel"].astype(x.dtype)).astype(jnp.float32)
+    w = w * (Hn**-0.5) * (hd**-0.5)  # [B, S, Hn]
+    scores = jnp.einsum(
+        "bqhd,bkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    )
+    scores = jax.nn.relu(scores)
+    scores = (scores * w.transpose(0, 2, 1)[..., None]).sum(axis=1)  # [B, S, S]
+
+    valid = jnp.tril(jnp.ones((S, S), bool))[None]
+    if segment_ids is not None:
+        # packed sequences: keep the top-k budget inside the query's own
+        # segment, or cross-segment picks (later masked by sdpa anyway)
+        # would crowd out real keys
+        valid = valid & (
+            segment_ids[:, :, None] == segment_ids[:, None, :]
+        )
+    scores = jnp.where(valid, scores, NEG_INF)
+
+    topk = min(cfg.index_topk, S)
+    _, idx = jax.lax.top_k(scores, topk)  # [B, S, topk]
+    mask = jnp.full((B, S, S), NEG_INF, jnp.float32).at[
+        jnp.arange(B)[:, None, None], jnp.arange(S)[None, :, None], idx
+    ].set(0.0)
+    return mask[:, None]  # [B, 1, S, S]
+
+
+def mla_sparse_block(
+    cfg: DeepseekV32Config,
+    backend: BackendConfig,
+    h: jnp.ndarray,
+    lp: dict,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    segment_ids: Optional[jnp.ndarray],
+    constrain: Constrain,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """V3 MLA with the indexer's sparse mask (reference DeepseekV32MLA)."""
+    B, S, D = h.shape
+    N = cfg.num_heads
+    nope, rope, vdim = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    ap = lp["attn"]
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
+
+    qa = x @ ap["q_a_proj"]["kernel"].astype(x.dtype)
+    qa = rms_norm(qa, ap["q_a_norm"]["scale"], cfg.rms_eps)
+    q = (qa @ ap["q_b_proj"]["kernel"].astype(x.dtype)).reshape(B, S, N, nope + rope)
+    q_pass, q_rot = q[..., :nope], q[..., nope:]
+
+    ckv = x @ ap["kv_a_proj"]["kernel"].astype(x.dtype)
+    k_pass_c, k_rot = ckv[..., : cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank :]
+    k_pass_c = rms_norm(k_pass_c, ap["kv_a_norm"]["scale"], cfg.rms_eps)
+    kv = (k_pass_c @ ap["kv_b_proj"]["kernel"].astype(x.dtype)).reshape(
+        B, S, N, nope + vdim
+    )
+    k_pass, v = kv[..., :nope], kv[..., nope:]
+
+    k_rot = k_rot[:, :, None, :]
+    q_rot, k_rot = apply_rope(q_rot, k_rot, cos, sin, interleave=cfg.rope_interleave)
+    k_rot = jnp.broadcast_to(k_rot, (B, S, N, rope))
+
+    sparse = indexer_topk_mask(
+        cfg, lp["indexer"], x, qa, cos, sin, segment_ids=segment_ids
+    )
+    out = sdpa(
+        jnp.concatenate([q_pass, q_rot], axis=-1),
+        jnp.concatenate([k_pass, k_rot], axis=-1),
+        v,
+        causal=True,
+        scale=cfg.mla_attn_scale,
+        segment_ids=segment_ids,
+        attn_bias=sparse,
+    )
+    h = h + out.reshape(B, S, N * vdim) @ ap["o_proj"]["kernel"].astype(x.dtype)
+    return constrain(h, ("batch", "seq", None))
+
+
+def init_params(cfg: DeepseekV32Config, backend: BackendConfig, key: jax.Array) -> dict:
+    params = v3_init_params(cfg, backend, key)
+    k = jax.random.fold_in(key, 11)
+    nd = cfg.moe.num_dense_layers
+    nm = cfg.num_layers - nd
+    if nd > 0:
+        params["dense_layers"]["indexer"] = init_indexer_layer(
+            cfg, backend, jax.random.fold_in(k, 0), nd
+        )
+    params["moe_layers"]["indexer"] = init_indexer_layer(
+        cfg, backend, jax.random.fold_in(k, 1), nm
+    )
+    return params
+
+
+SHARDING_RULES: list[tuple[str, tuple]] = [
+    (r"indexer/wq_b/kernel$", (None, "fsdp", "tensor")),
+    (r"indexer/wk/kernel$", (None, "fsdp", None)),
+    (r"indexer/k_norm/(scale|bias)$", (None, None)),
+    (r"indexer/weights_proj/kernel$", (None, "fsdp", None)),
+    *V3_RULES,
+]
+
+
+@dataclasses.dataclass
+class DeepseekV32ForCausalLM(DeepseekV3ForCausalLM):
+    def init(self, key: jax.Array) -> dict:
+        return init_params(self.config, self.backend, key)
+
+    def _fwd_hidden(self, params, input_ids, **kw):
+        return moe_forward_hidden(
+            self.config,
+            self.backend,
+            params,
+            input_ids,
+            attn_block=mla_sparse_block,
+            rope_dim=self.config.qk_rope_head_dim,
+            **kw,
+        )
+
+    @property
+    def pp_attn_block(self):
+        return mla_sparse_block
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return SHARDING_RULES

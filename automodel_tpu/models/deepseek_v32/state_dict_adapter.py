@@ -1,0 +1,25 @@
+"""HF ⇄ native adapter for DeepSeek-V3.2: the V3 adapter plus the indexer
+keys (reference models/deepseek_v32/state_dict_adapter.py; official key
+layout model.layers.{i}.self_attn.indexer.{wq_b,wk,k_norm,weights_proj})."""
+
+from __future__ import annotations
+
+from automodel_tpu.models.deepseek_v3.state_dict_adapter import (
+    DeepseekV3StateDictAdapter,
+)
+from automodel_tpu.models.deepseek_v32.model import DeepseekV32Config
+
+
+class DeepseekV32StateDictAdapter(DeepseekV3StateDictAdapter):
+    def __init__(self, config: DeepseekV32Config):
+        super().__init__(config)
+
+    def _attn_keys(self, i: int):
+        m = super()._attn_keys(i)
+        p = f"model.layers.{i}.self_attn.indexer"
+        m[("indexer", "wq_b", "kernel")] = (p + ".wq_b.weight", True)
+        m[("indexer", "wk", "kernel")] = (p + ".wk.weight", True)
+        m[("indexer", "k_norm", "scale")] = (p + ".k_norm.weight", False)
+        m[("indexer", "k_norm", "bias")] = (p + ".k_norm.bias", False)
+        m[("indexer", "weights_proj", "kernel")] = (p + ".weights_proj.weight", True)
+        return m

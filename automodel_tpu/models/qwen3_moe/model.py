@@ -91,7 +91,7 @@ class MoETransformerConfig(TransformerConfig):
         fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
         fields["moe"] = moe
         # qwen3_moe uses qk per-head norms like qwen3; glm4_moe gates them
-        if model_type in ("qwen3_moe", "qwen3moe"):
+        if model_type in ("qwen3_moe", "qwen3moe", "qwen3_vl_moe_text"):
             fields["qk_norm"] = True
         elif is_glm4:
             fields["qk_norm"] = bool(get("use_qk_norm", False))
@@ -174,7 +174,15 @@ def forward_hidden(
     constrain: Constrain = _noop_constrain,
     attn_block: Any = attention_block,
     rope_dim: Optional[int] = None,
+    inputs_embeds: Optional[jnp.ndarray] = None,
+    rope_cos_sin: Optional[tuple] = None,
+    deepstack: Optional[tuple] = None,
 ) -> tuple[jnp.ndarray, MoEModelAux]:
+    """``inputs_embeds``/``rope_cos_sin``/``deepstack`` are the VLM hooks
+    (qwen3_vl_moe): precomputed embeddings with image features scattered in,
+    an mrope cos/sin table, and ``(visual_mask [B,S,1], ds [n_deep,B,S,D])``
+    visual embeds added to the hidden states after each of the first n_deep
+    layers (HF Qwen3VLMoeTextModel._deepstack_process)."""
     from automodel_tpu.ops import fp8 as _fp8
 
     _fp8.set_enabled(backend.fp8)
@@ -183,9 +191,12 @@ def forward_hidden(
     if position_ids is None:
         position_ids = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
         position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
-    h = params["embed"]["embedding"].astype(cd)[input_ids]
+    if inputs_embeds is None:
+        h = params["embed"]["embedding"].astype(cd)[input_ids]
+    else:
+        h = inputs_embeds.astype(cd)
     h = constrain(h, ("batch", "seq", None))
-    cos, sin = rope_table(
+    cos, sin = rope_cos_sin if rope_cos_sin is not None else rope_table(
         position_ids, rope_dim or cfg.rope_dim or cfg.head_dim, cfg.rope
     )
 
@@ -227,12 +238,28 @@ def forward_hidden(
         hh = hh + out
         return constrain(hh, ("batch", "seq", None)), aux
 
-    if backend.scan_layers:
+    nm = cfg.num_layers - moe.num_dense_layers
+    if deepstack is not None:
+        # run the first n_deep layers unstacked, adding the deepstack visual
+        # embeds at image positions after each, then scan the homogeneous rest
+        vis_mask, ds = deepstack  # [B,S,1], [n_deep,B,S,D]
+        nd = ds.shape[0]
+        counts_l, aux_l = [], []
+        for i in range(nd):
+            lp = jax.tree.map(lambda x: x[i], params["moe_layers"])
+            h, aux = maybe_remat(moe_fn)(h, lp)
+            h = h + jnp.where(vis_mask, ds[i].astype(h.dtype), 0)
+            counts_l.append(aux.expert_counts)
+            aux_l.append(aux.aux_loss)
+        rest = jax.tree.map(lambda x: x[nd:], params["moe_layers"])
+        h, auxs = jax.lax.scan(maybe_remat(moe_fn), h, rest)
+        counts = jnp.concatenate([jnp.stack(counts_l), auxs.expert_counts])
+        aux_losses = jnp.concatenate([jnp.stack(aux_l), auxs.aux_loss])
+    elif backend.scan_layers:
         h, auxs = jax.lax.scan(maybe_remat(moe_fn), h, params["moe_layers"])
         counts, aux_losses = auxs.expert_counts, auxs.aux_loss
     else:
         counts_l, aux_l = [], []
-        nm = cfg.num_layers - moe.num_dense_layers
         for i in range(nm):
             lp = jax.tree.map(lambda x: x[i], params["moe_layers"])
             h, aux = moe_fn(h, lp)

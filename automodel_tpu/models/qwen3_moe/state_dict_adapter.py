@@ -39,12 +39,17 @@ _VARIANT_KEY_STYLES: dict[str, list[tuple[str, str]]] = {
 
 
 class MoEStateDictAdapter:
-    def __init__(self, config: MoETransformerConfig, hf_key_style: str | None = None):
+    def __init__(self, config: MoETransformerConfig, hf_key_style: str | None = None,
+                 expert_layout: str = "per_expert"):
         self.config = config
         # save-side key dialect so exported checkpoints reload in the
         # ORIGINAL HF architecture (Mixtral w1/w3/w2, qwen2-moe singular
         # shared_expert)
         self.hf_key_style = hf_key_style
+        # "per_expert": mlp.experts.{j}.gate_proj.weight Linears (qwen3-moe);
+        # "batched": one mlp.experts.gate_up_proj [E, D, 2I] parameter per
+        # layer, already in x@W orientation (qwen3-vl-moe TextExperts)
+        self.expert_layout = expert_layout
 
     def _style_key(self, key: str) -> str:
         import re
@@ -143,6 +148,8 @@ class MoEStateDictAdapter:
         def gate_up_row(i):
             # [E, D, 2I] for one layer — the unit of host residency for the
             # model's dominant leaf
+            if self.expert_layout == "batched":
+                return get_tensor(f"model.layers.{i}.mlp.experts.gate_up_proj")
             g = [
                 _t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.gate_proj.weight"))
                 for j in range(moe.num_experts)
@@ -156,6 +163,8 @@ class MoEStateDictAdapter:
             )
 
         def down_row(i):
+            if self.expert_layout == "batched":
+                return get_tensor(f"model.layers.{i}.mlp.experts.down_proj")
             return np.stack(
                 [
                     _t(get_tensor(f"model.layers.{i}.mlp.experts.{j}.down_proj.weight"))
@@ -248,11 +257,15 @@ class MoEStateDictAdapter:
                 )
             gu = np.asarray(ml["moe"]["experts"]["gate_up"][row])  # [E, D, 2I]
             dn = np.asarray(ml["moe"]["experts"]["down"][row])  # [E, I, D]
-            I = dn.shape[1]
-            for j in range(moe.num_experts):
-                yield f"model.layers.{i}.mlp.experts.{j}.gate_proj.weight", _t(gu[j, :, :I])
-                yield f"model.layers.{i}.mlp.experts.{j}.up_proj.weight", _t(gu[j, :, I:])
-                yield f"model.layers.{i}.mlp.experts.{j}.down_proj.weight", _t(dn[j])
+            if self.expert_layout == "batched":
+                yield f"model.layers.{i}.mlp.experts.gate_up_proj", gu
+                yield f"model.layers.{i}.mlp.experts.down_proj", dn
+            else:
+                I = dn.shape[1]
+                for j in range(moe.num_experts):
+                    yield f"model.layers.{i}.mlp.experts.{j}.gate_proj.weight", _t(gu[j, :, :I])
+                    yield f"model.layers.{i}.mlp.experts.{j}.up_proj.weight", _t(gu[j, :, I:])
+                    yield f"model.layers.{i}.mlp.experts.{j}.down_proj.weight", _t(dn[j])
             if "shared" in ml["moe"]:
                 for name in ("gate_proj", "up_proj", "down_proj"):
                     yield (

@@ -1,0 +1,15 @@
+from automodel_tpu.models.qwen3_vl_moe.model import (
+    Qwen3VLMoeConfig,
+    Qwen3VLMoeForConditionalGeneration,
+    get_rope_index,
+)
+from automodel_tpu.models.qwen3_vl_moe.state_dict_adapter import (
+    Qwen3VLMoeStateDictAdapter,
+)
+
+__all__ = [
+    "Qwen3VLMoeConfig",
+    "Qwen3VLMoeForConditionalGeneration",
+    "Qwen3VLMoeStateDictAdapter",
+    "get_rope_index",
+]

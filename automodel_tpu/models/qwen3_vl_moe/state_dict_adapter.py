@@ -1,0 +1,132 @@
+"""HF ⇄ native adapter for Qwen3-VL-MoE (Qwen3VLMoeForConditionalGeneration).
+
+Text keys delegate to the qwen3_moe MoE adapter with the ``model.`` →
+``model.language_model.`` prefix rewrite; vision tower leaves map directly
+(the Conv3d patch embed flattens to one [patch_dim, D] kernel). Parity
+target: reference models/qwen3_vl_moe/state_dict_adapter.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import MoEStateDictAdapter
+from automodel_tpu.models.qwen3_vl_moe.model import Qwen3VLMoeConfig
+
+_V = "model.visual"
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+class Qwen3VLMoeStateDictAdapter:
+    def __init__(self, config: Qwen3VLMoeConfig):
+        self.config = config
+        self.text_adapter = MoEStateDictAdapter(config.text, expert_layout="batched")
+
+    @staticmethod
+    def _to_vlm_key(k: str) -> str:
+        if k.startswith("model."):
+            return "model.language_model." + k[len("model."):]
+        return k
+
+    def _block_plans(self) -> list[tuple[tuple[str, ...], str, bool]]:
+        """(native path under vision/blocks, hf key template, transpose)."""
+        return [
+            (("ln1", "scale"), _V + ".blocks.{i}.norm1.weight", False),
+            (("ln1", "bias"), _V + ".blocks.{i}.norm1.bias", False),
+            (("ln2", "scale"), _V + ".blocks.{i}.norm2.weight", False),
+            (("ln2", "bias"), _V + ".blocks.{i}.norm2.bias", False),
+            (("attn", "qkv", "kernel"), _V + ".blocks.{i}.attn.qkv.weight", True),
+            (("attn", "qkv", "bias"), _V + ".blocks.{i}.attn.qkv.bias", False),
+            (("attn", "proj", "kernel"), _V + ".blocks.{i}.attn.proj.weight", True),
+            (("attn", "proj", "bias"), _V + ".blocks.{i}.attn.proj.bias", False),
+            (("mlp", "fc1", "kernel"), _V + ".blocks.{i}.mlp.linear_fc1.weight", True),
+            (("mlp", "fc1", "bias"), _V + ".blocks.{i}.mlp.linear_fc1.bias", False),
+            (("mlp", "fc2", "kernel"), _V + ".blocks.{i}.mlp.linear_fc2.weight", True),
+            (("mlp", "fc2", "bias"), _V + ".blocks.{i}.mlp.linear_fc2.bias", False),
+        ]
+
+    @staticmethod
+    def _merger_plans(prefix: tuple, hf_prefix: str):
+        return [
+            ((*prefix, "norm", "scale"), hf_prefix + ".norm.weight", False),
+            ((*prefix, "norm", "bias"), hf_prefix + ".norm.bias", False),
+            ((*prefix, "fc1", "kernel"), hf_prefix + ".linear_fc1.weight", True),
+            ((*prefix, "fc1", "bias"), hf_prefix + ".linear_fc1.bias", False),
+            ((*prefix, "fc2", "kernel"), hf_prefix + ".linear_fc2.weight", True),
+            ((*prefix, "fc2", "bias"), hf_prefix + ".linear_fc2.bias", False),
+        ]
+
+    def iter_from_hf(
+        self, get_tensor: Callable[[str], np.ndarray]
+    ) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        # text: reuse the MoE adapter, rewriting the keys it asks for
+        for path, val in self.text_adapter.iter_from_hf(
+            lambda k: get_tensor(self._to_vlm_key(k))
+        ):
+            yield path, val
+
+        cfg = self.config.vision
+        pe = get_tensor(_V + ".patch_embed.proj.weight")  # [D, C, T, P, P]
+        yield (("vision", "patch_embed", "kernel"),
+               _t(pe.reshape(pe.shape[0], -1)))
+        yield (("vision", "patch_embed", "bias"),
+               get_tensor(_V + ".patch_embed.proj.bias"))
+        yield (("vision", "pos_embed", "embedding"),
+               get_tensor(_V + ".pos_embed.weight"))
+
+        for sub, tmpl, tr in self._block_plans():
+            vals = [get_tensor(tmpl.format(i=i)) for i in range(cfg.depth)]
+            stacked = np.stack([_t(v) if tr else v for v in vals])
+            yield (("vision", "blocks", *sub), stacked)
+
+        for sub, key, tr in self._merger_plans((), _V + ".merger"):
+            v = get_tensor(key)
+            yield (("vision", "merger", *sub), _t(v) if tr else v)
+
+        nd = len(cfg.deepstack_visual_indexes)
+        if nd:
+            for sub, tmpl, tr in self._merger_plans((), _V + ".deepstack_merger_list.{i}"):
+                vals = [get_tensor(tmpl.format(i=i)) for i in range(nd)]
+                yield (("vision", "deepstack_mergers", *sub),
+                       np.stack([_t(v) if tr else v for v in vals]))
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        text = {k: v for k, v in params.items() if k != "vision"}
+        for key, val in self.text_adapter.to_hf(text):
+            yield self._to_vlm_key(key), val
+
+        vis = params["vision"]
+        pe = np.asarray(vis["patch_embed"]["kernel"])
+        cfg = self.config.vision
+        yield (_V + ".patch_embed.proj.weight",
+               _t(pe).reshape(cfg.hidden_size, cfg.in_channels,
+                              cfg.temporal_patch_size, cfg.patch_size, cfg.patch_size))
+        yield (_V + ".patch_embed.proj.bias", np.asarray(vis["patch_embed"]["bias"]))
+        yield (_V + ".pos_embed.weight", np.asarray(vis["pos_embed"]["embedding"]))
+
+        def leaf(tree, sub):
+            x = tree
+            for s in sub:
+                x = x[s]
+            return np.asarray(x)
+
+        for sub, tmpl, tr in self._block_plans():
+            stacked = leaf(vis["blocks"], sub)
+            for i in range(cfg.depth):
+                v = stacked[i]
+                yield tmpl.format(i=i), _t(v) if tr else v
+        for sub, key, tr in self._merger_plans((), _V + ".merger"):
+            v = leaf(vis["merger"], sub)
+            yield key, _t(v) if tr else v
+        nd = len(cfg.deepstack_visual_indexes)
+        if nd:
+            for sub, tmpl, tr in self._merger_plans((), _V + ".deepstack_merger_list.{i}"):
+                stacked = leaf(vis["deepstack_mergers"], sub)
+                for i in range(nd):
+                    v = stacked[i]
+                    yield tmpl.format(i=i), _t(v) if tr else v

@@ -1,0 +1,279 @@
+"""Qwen3-VL vision tower, TPU-native.
+
+Parity: HF Qwen3VLMoeVisionModel (modeling_qwen3_vl_moe.py:617) — Conv3d
+patch embed (≡ one linear over the flattened patch), bilinearly interpolated
+learned position embeddings laid out in spatial-merge order, 2-axis rotary
+(row/col halves), pre-LN blocks with full bidirectional attention per image
+(cu_seqlens → segment ids), a spatial-merge MLP "merger" to the text width,
+and per-level deepstack mergers (post-shuffle LayerNorm) tapped at
+``deepstack_visual_indexes``.
+
+``grid_thw`` is STATIC (a python tuple of (t, h, w) per image): position
+tables, segment ids, and merge reshapes are all shape-defining, so the data
+pipeline fixes the image grid per batch — the reference reaches the same
+point via its processor's fixed `image_grid_thw` buckets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.llama.model import ACT_FNS, _dense_init
+from automodel_tpu.ops.attention import sdpa
+
+
+@dataclasses.dataclass(frozen=True)
+class Qwen3VLVisionConfig:
+    depth: int = 2
+    hidden_size: int = 32
+    intermediate_size: int = 64
+    num_heads: int = 2
+    in_channels: int = 3
+    patch_size: int = 16
+    spatial_merge_size: int = 2
+    temporal_patch_size: int = 2
+    out_hidden_size: int = 64
+    num_position_embeddings: int = 2304
+    deepstack_visual_indexes: tuple = (8, 16, 24)
+    hidden_act: str = "gelu_pytorch_tanh"
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "Qwen3VLVisionConfig":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        act = get("hidden_act", "gelu_pytorch_tanh")  # key in llama ACT_FNS
+        return cls(
+            depth=get("depth"),
+            hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_heads=get("num_heads"),
+            in_channels=get("in_channels", 3),
+            patch_size=get("patch_size"),
+            spatial_merge_size=get("spatial_merge_size", 2),
+            temporal_patch_size=get("temporal_patch_size", 2),
+            out_hidden_size=get("out_hidden_size"),
+            num_position_embeddings=get("num_position_embeddings"),
+            deepstack_visual_indexes=tuple(get("deepstack_visual_indexes", ())),
+            hidden_act=act,
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.in_channels * self.temporal_patch_size * self.patch_size**2
+
+    @property
+    def merge_dim(self) -> int:
+        return self.hidden_size * self.spatial_merge_size**2
+
+
+def _ln(x: jnp.ndarray, p: dict, eps: float = 1e-6) -> jnp.ndarray:
+    from automodel_tpu.ops.norms import layer_norm
+
+    return layer_norm(x, p["scale"], p["bias"], eps)
+
+
+def init_vision_params(cfg: Qwen3VLVisionConfig, backend: BackendConfig, key) -> dict:
+    pd = backend.param_jnp_dtype
+    ks = jax.random.split(key, 12)
+    D, I, MD = cfg.hidden_size, cfg.intermediate_size, cfg.merge_dim
+    L = cfg.depth
+
+    def stack(k, shape, in_axis=0):
+        return _dense_init(k, (L, *shape), pd, in_axis=1 + in_axis)
+
+    def zeros(*shape):
+        return jnp.zeros(shape, pd)
+
+    def merger(k1, k2, norm_dim):
+        # HF: use_postshuffle_norm=False (main) norms over hidden_size BEFORE
+        # the merge reshape; deepstack mergers norm over merge_dim after it
+        return {
+            "norm": {"scale": jnp.ones((norm_dim,), pd), "bias": zeros(norm_dim)},
+            "fc1": {"kernel": _dense_init(k1, (MD, MD), pd), "bias": zeros(MD)},
+            "fc2": {
+                "kernel": _dense_init(k2, (MD, cfg.out_hidden_size), pd),
+                "bias": zeros(cfg.out_hidden_size),
+            },
+        }
+
+    p = {
+        "patch_embed": {
+            "kernel": _dense_init(ks[0], (cfg.patch_dim, D), pd),
+            "bias": zeros(D),
+        },
+        "pos_embed": {
+            "embedding": (
+                jax.random.normal(ks[1], (cfg.num_position_embeddings, D)) * 0.02
+            ).astype(pd)
+        },
+        "blocks": {
+            "ln1": {"scale": jnp.ones((L, D), pd), "bias": zeros(L, D)},
+            "ln2": {"scale": jnp.ones((L, D), pd), "bias": zeros(L, D)},
+            "attn": {
+                "qkv": {"kernel": stack(ks[2], (D, 3 * D)), "bias": zeros(L, 3 * D)},
+                "proj": {"kernel": stack(ks[3], (D, D)), "bias": zeros(L, D)},
+            },
+            "mlp": {
+                "fc1": {"kernel": stack(ks[4], (D, I)), "bias": zeros(L, I)},
+                "fc2": {"kernel": stack(ks[5], (I, D)), "bias": zeros(L, D)},
+            },
+        },
+        "merger": merger(ks[6], ks[7], D),
+    }
+    nd = len(cfg.deepstack_visual_indexes)
+    if nd:
+        dms = [merger(jax.random.fold_in(ks[8], 2 * i),
+                      jax.random.fold_in(ks[8], 2 * i + 1), MD)
+               for i in range(nd)]
+        # norm here is post-shuffle (over merge_dim), same shapes as merger
+        p["deepstack_mergers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *dms)
+    return p
+
+
+def _merger_apply(x: jnp.ndarray, p: dict, act, post_shuffle: bool) -> jnp.ndarray:
+    """[Lm, merge_dim-or-hidden...] — reshape merge groups, LN, fc1-act-fc2."""
+    md = p["fc1"]["kernel"].shape[0]
+    if post_shuffle:  # deepstack: reshape FIRST, then LN over merge_dim
+        x = x.reshape(-1, md)
+        x = _ln(x, p["norm"])
+    else:  # main merger: LN over hidden, then merge reshape
+        x = _ln(x, p["norm"])
+        x = x.reshape(-1, md)
+    x = act(x @ p["fc1"]["kernel"].astype(x.dtype) + p["fc1"]["bias"].astype(x.dtype))
+    return x @ p["fc2"]["kernel"].astype(x.dtype) + p["fc2"]["bias"].astype(x.dtype)
+
+
+def _pos_embed_interpolated(cfg: Qwen3VLVisionConfig, table: jnp.ndarray,
+                            grid_thw) -> jnp.ndarray:
+    """Bilinear interpolation of the learned grid to each image's (h, w),
+    repeated over t and permuted into spatial-merge order (HF
+    fast_pos_embed_interpolate). Static grids → numpy indices."""
+    side = int(round(cfg.num_position_embeddings ** 0.5))
+    m = cfg.spatial_merge_size
+    outs = []
+    for t, h, w in grid_thw:
+        hi = np.linspace(0, side - 1, h)
+        wi = np.linspace(0, side - 1, w)
+        hf_, wf_ = np.floor(hi).astype(np.int64), np.floor(wi).astype(np.int64)
+        hc = np.clip(hf_ + 1, None, side - 1)
+        wc = np.clip(wf_ + 1, None, side - 1)
+        dh, dw = hi - hf_, wi - wf_
+        idx = np.stack([
+            (hf_[:, None] * side + wf_[None, :]).ravel(),
+            (hf_[:, None] * side + wc[None, :]).ravel(),
+            (hc[:, None] * side + wf_[None, :]).ravel(),
+            (hc[:, None] * side + wc[None, :]).ravel(),
+        ])
+        wgt = np.stack([
+            ((1 - dh)[:, None] * (1 - dw)[None, :]).ravel(),
+            ((1 - dh)[:, None] * dw[None, :]).ravel(),
+            (dh[:, None] * (1 - dw)[None, :]).ravel(),
+            (dh[:, None] * dw[None, :]).ravel(),
+        ])
+        pe = (table[idx] * jnp.asarray(wgt, table.dtype)[:, :, None]).sum(0)  # [h*w, D]
+        pe = jnp.tile(pe, (t, 1))
+        pe = pe.reshape(t, h // m, m, w // m, m, -1).transpose(0, 1, 3, 2, 4, 5)
+        outs.append(pe.reshape(-1, pe.shape[-1]))
+    return jnp.concatenate(outs, axis=0)
+
+
+def _rot_pos_ids(cfg: Qwen3VLVisionConfig, grid_thw) -> np.ndarray:
+    """[(t,h,w)] → [P_total, 2] (row, col) positions in merge order (HF
+    rot_pos_emb)."""
+    m = cfg.spatial_merge_size
+    out = []
+    for t, h, w in grid_thw:
+        rows = (
+            np.arange(h // m)[:, None, None, None] * m
+            + np.arange(m)[None, None, :, None]
+        )
+        cols = (
+            np.arange(w // m)[None, :, None, None] * m
+            + np.arange(m)[None, None, None, :]
+        )
+        rows = np.broadcast_to(rows, (h // m, w // m, m, m)).reshape(-1)
+        cols = np.broadcast_to(cols, (h // m, w // m, m, m)).reshape(-1)
+        coords = np.stack([rows, cols], -1)
+        out.append(np.tile(coords, (t, 1)))
+    return np.concatenate(out, axis=0)
+
+
+def vision_tower(
+    cfg: Qwen3VLVisionConfig,
+    backend: BackendConfig,
+    params: dict,
+    pixel_values: jnp.ndarray,  # [P_total, patch_dim]
+    grid_thw,  # static tuple of (t, h, w)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (features [P_total/m², out_hidden],
+         deepstack [n_deep, P_total/m², out_hidden])."""
+    cd = backend.compute_jnp_dtype
+    act = ACT_FNS[cfg.hidden_act]
+    x = pixel_values.astype(cd) @ params["patch_embed"]["kernel"].astype(cd)
+    x = x + params["patch_embed"]["bias"].astype(cd)
+    x = x + _pos_embed_interpolated(
+        cfg, params["pos_embed"]["embedding"].astype(cd), grid_thw
+    )
+
+    # 2-axis rotary: head_dim/4 freqs each for row and col
+    pos = _rot_pos_ids(cfg, grid_thw)  # [P, 2] numpy
+    dim = cfg.head_dim // 2
+    inv = 1.0 / (10000.0 ** (np.arange(0, dim, 2) / dim))
+    freqs = jnp.asarray(
+        np.concatenate([pos[:, :1] * inv[None], pos[:, 1:] * inv[None]], axis=1),
+        jnp.float32,
+    )  # [P, head_dim/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [P, head_dim]
+    cos, sin = jnp.cos(emb)[None], jnp.sin(emb)[None]  # [1, P, hd]
+
+    # per-image full attention: segment ids from the static grid sizes
+    seg = np.repeat(
+        np.arange(len(grid_thw)), [t * h * w for t, h, w in grid_thw]
+    ).astype(np.int32)
+    seg = jnp.asarray(seg)[None]  # [1, P]
+
+    P = x.shape[0]
+    N, H = cfg.num_heads, cfg.head_dim
+    ds_taps = {int(i): k for k, i in enumerate(cfg.deepstack_visual_indexes)}
+    deep_feats = []
+    h = x[None]  # [1, P, D]
+    for li in range(cfg.depth):
+        lp = jax.tree.map(lambda a: a[li], params["blocks"])
+        y = _ln(h, lp["ln1"])
+        qkv = y @ lp["attn"]["qkv"]["kernel"].astype(cd) + lp["attn"]["qkv"]["bias"].astype(cd)
+        q, k, v = jnp.split(qkv.reshape(1, P, 3 * N, H), 3, axis=2)
+        # vision rope: plain rotate-half on fp32 (HF apply_rotary_pos_emb_vision)
+        from automodel_tpu.ops.rope import apply_rope
+
+        q, k = apply_rope(q, k, cos, sin)
+        attn = sdpa(q, k, v, causal=False, segment_ids=seg)
+        attn = attn.reshape(1, P, N * H)
+        h = h + (attn @ lp["attn"]["proj"]["kernel"].astype(cd)
+                 + lp["attn"]["proj"]["bias"].astype(cd))
+        y = _ln(h, lp["ln2"])
+        y = act(y @ lp["mlp"]["fc1"]["kernel"].astype(cd) + lp["mlp"]["fc1"]["bias"].astype(cd))
+        h = h + (y @ lp["mlp"]["fc2"]["kernel"].astype(cd) + lp["mlp"]["fc2"]["bias"].astype(cd))
+        if li in ds_taps:
+            dp = jax.tree.map(
+                lambda a, k=ds_taps[li]: a[k], params["deepstack_mergers"]
+            )
+            deep_feats.append(_merger_apply(h[0], dp, act, post_shuffle=True))
+
+    feats = _merger_apply(h[0], params["merger"], act, post_shuffle=False)
+    deep = (
+        jnp.stack(deep_feats)
+        if deep_feats
+        else jnp.zeros((0, feats.shape[0], feats.shape[1]), feats.dtype)
+    )
+    return feats, deep

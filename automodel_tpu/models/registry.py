@@ -141,3 +141,30 @@ def _moe_builder(hf_config: Any, backend: BackendConfig):
     model_type = get("model_type", "")
     style = model_type if model_type in ("mixtral", "qwen2_moe") else None
     return MoEForCausalLM(cfg, backend), MoEStateDictAdapter(cfg, hf_key_style=style)
+
+
+@register_architecture("Qwen3VLMoeForConditionalGeneration")
+def _qwen3_vl_moe_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.qwen3_vl_moe import (
+        Qwen3VLMoeConfig,
+        Qwen3VLMoeForConditionalGeneration,
+        Qwen3VLMoeStateDictAdapter,
+    )
+
+    cfg = Qwen3VLMoeConfig.from_hf(hf_config)
+    return (
+        Qwen3VLMoeForConditionalGeneration(cfg, backend),
+        Qwen3VLMoeStateDictAdapter(cfg),
+    )
+
+
+@register_architecture("DeepseekV32ForCausalLM")
+def _deepseek_v32_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.deepseek_v32 import (
+        DeepseekV32Config,
+        DeepseekV32ForCausalLM,
+        DeepseekV32StateDictAdapter,
+    )
+
+    cfg = DeepseekV32Config.from_hf(hf_config)
+    return DeepseekV32ForCausalLM(cfg, backend), DeepseekV32StateDictAdapter(cfg)

@@ -50,8 +50,12 @@ def sdpa(
     sliding_window: Optional[int] = None,
     sinks: Optional[jnp.ndarray] = None,
     bidir_groups: Optional[jnp.ndarray] = None,
+    attn_bias: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """XLA scaled dot-product attention. q: [B,S,N,H], k/v: [B,S,Nkv,H].
+
+    ``attn_bias``: additive fp32 bias [B, 1|N, Sq, Sk] applied after scaling
+    (DeepSeek-V3.2 sparse top-k mask; TE core_attention_bias equivalent).
 
     ``sinks``: per-head learned sink logits [N] — an extra virtual key that
     absorbs probability mass (gpt-oss; modeling_gpt_oss.py:258: softmax over
@@ -69,6 +73,8 @@ def sdpa(
     scale = scale if scale is not None else 1.0 / (h**0.5)
     logits = jnp.einsum("bqnh,bknh->bnqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
+    if attn_bias is not None:
+        logits = logits + attn_bias.astype(logits.dtype)
     if logits_soft_cap is not None:
         logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
     sk = k.shape[1]

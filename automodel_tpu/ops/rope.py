@@ -138,6 +138,28 @@ def rope_table(
     return jnp.cos(emb) * f, jnp.sin(emb) * f
 
 
+def mrope_table(
+    position_ids: jnp.ndarray,  # [3, B, S] — t/h/w grid positions
+    head_dim: int,
+    cfg: RopeConfig,
+    mrope_section: tuple[int, int, int],
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Interleaved multi-axis RoPE (Qwen3-VL: HF apply_interleaved_mrope,
+    modeling_qwen3_vl_moe.py:830) — frequency slot i takes the H axis when
+    i≡1 (mod 3) and i < 3·section_h, the W axis when i≡2 (mod 3) and
+    i < 3·section_w, else the T axis. Returns cos/sin [B, S, head_dim]."""
+    inv = _inv_freq(head_dim, cfg)
+    freqs = position_ids[..., None].astype(jnp.float32) * inv  # [3, B, S, hd/2]
+    i = jnp.arange(head_dim // 2)
+    take_h = (i % 3 == 1) & (i < 3 * mrope_section[1])
+    take_w = (i % 3 == 2) & (i < 3 * mrope_section[2])
+    half = jnp.where(take_h, freqs[1], freqs[0])
+    half = jnp.where(take_w, freqs[2], half)
+    emb = jnp.concatenate([half, half], axis=-1)
+    f = _attention_factor(cfg)
+    return jnp.cos(emb) * f, jnp.sin(emb) * f
+
+
 def apply_rope(
     q: jnp.ndarray,
     k: jnp.ndarray,

@@ -43,6 +43,12 @@ def build_optimizer(
     lr_schedule: Any | None = None,
     **sched_kwargs: Any,
 ) -> optax.GradientTransformation:
+    # YAML 1.1 parses dotless scientific notation (`lr: 1e-2`) as a string;
+    # coerce here so config-file values behave like `1.0e-2`
+    lr, weight_decay, eps = float(lr), float(weight_decay), float(eps)
+    betas = tuple(float(b) for b in betas)
+    if grad_clip_norm is not None:
+        grad_clip_norm = float(grad_clip_norm)
     if lr_schedule is not None:
         sched_kwargs = dict(lr_schedule)
     schedule = (

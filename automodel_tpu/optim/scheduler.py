@@ -28,6 +28,8 @@ def build_lr_schedule(
     warmup. WSD (warmup-stable-decay) holds lr constant then decays linearly
     over the final `wsd_decay_steps`.
     """
+    # YAML 1.1 parses dotless scientific notation (`lr: 1e-2`) as a string
+    lr, min_lr = float(lr), float(min_lr)
     if style == "constant":
         return optax.join_schedules(
             [optax.linear_schedule(0.0, lr, max(warmup_steps, 1)), optax.constant_schedule(lr)],

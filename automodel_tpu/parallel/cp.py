@@ -163,7 +163,8 @@ def _ring_flash_shard(
         seg0 = segment_ids.astype(jnp.int32)
 
     def rotate(*xs):
-        return tuple(jax.lax.ppermute(x, axis_name, perm) for x in xs)
+        # one ppermute over the tuple → one fused collective on ICI
+        return jax.lax.ppermute(xs, axis_name, perm)
 
     # NOTE: the custom_vjp fwd/bwd must not close over tracers (axis_index);
     # rank/positions are recomputed inside each impl.
@@ -223,9 +224,10 @@ def _ring_flash_shard(
             # early — the last compute doesn't need the next block)
             dk, dv = dk + dk_t, dv + dv_t
             if step < cp - 1:
-                k_blk, v_blk, seg_blk = rotate(k_blk, v_blk, seg_blk)
-                dk, dv = rotate(dk, dv)
-            else:
+                k_blk, v_blk, seg_blk, dk, dv = rotate(
+                    k_blk, v_blk, seg_blk, dk, dv
+                )
+            else:  # k/v/seg are done; dk/dv still need the final hop home
                 dk, dv = rotate(dk, dv)
         import numpy as np
 
