@@ -104,14 +104,20 @@ def from_pretrained(
     pretrained_model_name_or_path: str,
     mesh_ctx: Optional[MeshContext] = None,
     backend: BackendConfig | dict | None = None,
+    hf_config_overrides: Optional[dict] = None,
 ) -> AutoModel:
     """Load an HF checkpoint directory into a sharded native model
-    (reference: from_pretrained, auto_model.py:339 + load_base_model)."""
+    (reference: from_pretrained, auto_model.py:339 + load_base_model).
+
+    ``hf_config_overrides`` merges extra keys over the checkpoint's
+    config.json — e.g. training_image_grid_thw for the VLM data path."""
     from automodel_tpu.checkpoint.hf_io import load_params_from_hf
 
     backend = _as_backend(backend, mesh_ctx)
     ckpt_dir = _resolve_checkpoint_dir(pretrained_model_name_or_path)
     hf_config = _read_hf_config(ckpt_dir)
+    if hf_config_overrides:
+        hf_config = {**hf_config, **dict(hf_config_overrides)}
     builder = resolve_architecture(hf_config)
     model, adapter = builder(hf_config, backend)
     model = _maybe_pp(model, mesh_ctx, backend)

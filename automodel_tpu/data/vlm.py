@@ -52,8 +52,19 @@ def vlm_collater(
     pvs = []
     for e in examples:
         pv = np.asarray(e["pixel_values"], np.float32)
-        pvs.append(pv[None] if pv.ndim == 3 else pv)  # [N_i, C, H, W]
+        pvs.append(pv[None] if pv.ndim == 3 else pv)  # [N_i, C, H, W] | [P, pd]
     batch["pixel_values"] = np.concatenate(pvs, axis=0)
+    if "mrope_position_ids" in examples[0]:
+        # qwen3-vl 3-axis positions [3, S_i]; pad by edge replication to the
+        # batch's padded seq len (padded tokens carry IGNORE labels anyway)
+        S = batch["input_ids"].shape[1]
+        rows = []
+        for e in examples:
+            m = np.asarray(e["mrope_position_ids"], np.int32)
+            if m.shape[1] < S:
+                m = np.pad(m, ((0, 0), (0, S - m.shape[1])), mode="edge")
+            rows.append(m[:, :S])
+        batch["mrope_position_ids"] = np.stack(rows)  # [B, 3, S]
     return batch
 
 
@@ -201,5 +212,82 @@ class ProcessorVLMDataset:
         }
 
     def __iter__(self) -> Iterator[dict]:
+        for i in range(len(self)):
+            yield self[i]
+
+
+class MockQwen3VLDataset:
+    """Deterministic qwen3-vl-shaped samples: input_ids with one
+    vision_start + merged-image-token run, pixel_values as FLATTENED PATCHES
+    [t·h·w, in_channels·temporal_patch·patch²] (the qwen3_vl_moe vision
+    tower's input layout), and 3-axis mrope positions from
+    models.qwen3_vl_moe.get_rope_index. One fixed ``grid_thw`` bucket per
+    dataset — grids are shape-defining, so the model reads the same grid
+    from ``hf_config.training_image_grid_thw``."""
+
+    def __init__(
+        self,
+        vocab_size: int = 151936,
+        seq_length: int = 64,
+        grid_thw: tuple = (1, 4, 4),
+        spatial_merge_size: int = 2,
+        patch_size: int = 4,
+        temporal_patch_size: int = 2,
+        in_channels: int = 3,
+        image_token_id: int = 151655,
+        vision_start_token_id: int = 151652,
+        num_samples: int = 256,
+        seed: int = 0,
+    ):
+        t, h, w = (int(v) for v in grid_thw)
+        self.grid = (t, h, w)
+        self.merged = t * (h // spatial_merge_size) * (w // spatial_merge_size)
+        if seq_length < self.merged + 4:
+            raise ValueError(
+                f"seq_length {seq_length} too short for {self.merged} merged "
+                "image tokens plus markers"
+            )
+        self.vocab_size = vocab_size
+        self.seq_length = seq_length
+        self.patch_dim = in_channels * temporal_patch_size * patch_size**2
+        self.n_patches = t * h * w
+        self.image_token_id = image_token_id
+        self.vision_start = vision_start_token_id
+        self.merge = spatial_merge_size
+        self.num_samples = num_samples
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, i: int) -> dict[str, Any]:
+        from types import SimpleNamespace
+
+        from automodel_tpu.models.qwen3_vl_moe.model import get_rope_index
+
+        cfg = SimpleNamespace(
+            vision=SimpleNamespace(spatial_merge_size=self.merge),
+            image_token_id=self.image_token_id,
+            video_token_id=-1,
+        )
+        rng = np.random.default_rng(self.seed * 9176 + i)
+        text_max = min(self.vocab_size, self.image_token_id)
+        ids = rng.integers(1, text_max, size=self.seq_length)
+        start = 1 + (i % 3)
+        ids[start] = self.vision_start
+        ids[start + 1 : start + 1 + self.merged] = self.image_token_id
+        labels = np.concatenate([ids[1:], [IGNORE_INDEX]]).astype(np.int64)
+        labels[np.asarray(ids)[: self.seq_length] == self.image_token_id] = IGNORE_INDEX
+        pos = get_rope_index(cfg, np.asarray(ids)[None], [self.grid])[:, 0]
+        return {
+            "input_ids": ids.astype(np.int64),
+            "labels": labels,
+            "pixel_values": rng.normal(
+                size=(self.n_patches, self.patch_dim)
+            ).astype(np.float32),
+            "mrope_position_ids": pos.astype(np.int32),
+        }
+
+    def __iter__(self) -> Iterator[dict[str, Any]]:
         for i in range(len(self)):
             yield self[i]

@@ -37,7 +37,7 @@ from automodel_tpu.models.deepseek_v3.model import (
 from automodel_tpu.models.llama.model import Constrain, _dense_init
 from automodel_tpu.models.qwen3_moe.model import forward_hidden as moe_forward_hidden
 from automodel_tpu.ops.attention import sdpa
-from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.norms import layer_norm, rms_norm
 from automodel_tpu.ops.rope import apply_rope
 
 NEG_INF = float(np.finfo(np.float32).min) / 2
@@ -97,10 +97,7 @@ def init_indexer_layer(cfg: DeepseekV32Config, backend: BackendConfig, key, L: i
     }
 
 
-def _layer_norm(x, scale, bias, eps=1e-5):  # torch nn.LayerNorm default eps
-    from automodel_tpu.ops.norms import layer_norm
 
-    return layer_norm(x, scale, bias, eps)
 
 
 def indexer_topk_mask(
@@ -118,9 +115,10 @@ def indexer_topk_mask(
     nope = hd - rope
 
     q = (q_resid @ ip["wq_b"]["kernel"].astype(x.dtype)).reshape(B, S, Hn, hd)
-    k = _layer_norm(
+    k = layer_norm(
         x @ ip["wk"]["kernel"].astype(x.dtype),
         ip["k_norm"]["scale"], ip["k_norm"]["bias"],
+        eps=1e-5,  # torch nn.LayerNorm default
     )  # [B, S, hd] single shared head
 
     q_nope, q_pe = q[..., :nope], q[..., nope:]
@@ -160,6 +158,23 @@ def indexer_topk_mask(
     return mask[:, None]  # [B, 1, S, S]
 
 
+_warned_sdpa_only = False
+
+
+def _warn_sdpa_only(requested: str) -> None:
+    global _warned_sdpa_only
+    if not _warned_sdpa_only:
+        _warned_sdpa_only = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "deepseek_v32 sparse attention runs on masked sdpa (additive "
+            "top-k bias); backend.attn=%r is ignored — O(S^2) logits are "
+            "materialized per layer until a sparse flash kernel lands.",
+            requested,
+        )
+
+
 def mla_sparse_block(
     cfg: DeepseekV32Config,
     backend: BackendConfig,
@@ -195,6 +210,8 @@ def mla_sparse_block(
     q_rot, k_rot = apply_rope(q_rot, k_rot, cos, sin, interleave=cfg.rope_interleave)
     k_rot = jnp.broadcast_to(k_rot, (B, S, N, rope))
 
+    if backend.attn != "sdpa":
+        _warn_sdpa_only(backend.attn)
     sparse = indexer_topk_mask(
         cfg, lp["indexer"], x, qa, cos, sin, segment_ids=segment_ids
     )

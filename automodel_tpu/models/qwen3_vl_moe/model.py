@@ -47,6 +47,10 @@ class Qwen3VLMoeConfig:
     video_token_id: int = 151656
     vision_start_token_id: int = 151652
     mrope_section: tuple = (24, 20, 20)
+    # fixed PER-SAMPLE image grids for the recipe/data path (grids are
+    # shape-defining, so training batches use one static bucket; set via
+    # hf_config.training_image_grid_thw). () → grids must be passed per call.
+    training_image_grid_thw: tuple = ()
 
     @classmethod
     def from_hf(cls, hf_cfg: Any) -> "Qwen3VLMoeConfig":
@@ -65,6 +69,10 @@ class Qwen3VLMoeConfig:
             video_token_id=get("video_token_id", 151656),
             vision_start_token_id=get("vision_start_token_id", 151652),
             mrope_section=tuple(rs.get("mrope_section", (24, 20, 20))),
+            training_image_grid_thw=tuple(
+                tuple(int(v) for v in g)
+                for g in (get("training_image_grid_thw") or ())
+            ),
         )
 
     # loss/metrics address the LM config uniformly across families
@@ -154,6 +162,7 @@ class Qwen3VLMoeForConditionalGeneration:
         pixel_values: Optional[jnp.ndarray] = None,  # [P_total, patch_dim]
         image_grid_thw=None,  # STATIC tuple of (t, h, w)
         position_ids: Optional[jnp.ndarray] = None,  # [3, B, S] mrope
+        mrope_position_ids: Optional[jnp.ndarray] = None,  # [B, 3, S] (collated)
         segment_ids: Optional[jnp.ndarray] = None,
         constrain=None,
         **kw: Any,
@@ -161,9 +170,24 @@ class Qwen3VLMoeForConditionalGeneration:
         cfg = self.config
         constrain = constrain or (lambda x, s: x)
         cd = self.backend.compute_jnp_dtype
+        if mrope_position_ids is not None:
+            # batch-collated layout (data/vlm.py) → the [3, B, S] the rope
+            # table consumes
+            position_ids = jnp.transpose(mrope_position_ids, (1, 0, 2))
         embeds = params["embed"]["embedding"].astype(cd)[input_ids]
         deepstack = None
         if pixel_values is not None:
+            if image_grid_thw is None:
+                # recipe/data path: per-sample static grids from the config,
+                # repeated across the batch (data/vlm.py concatenates each
+                # sample's patches in batch order)
+                if not cfg.training_image_grid_thw:
+                    raise ValueError(
+                        "pixel_values given without image_grid_thw; set "
+                        "hf_config.training_image_grid_thw for the recipe "
+                        "path or pass image_grid_thw explicitly"
+                    )
+                image_grid_thw = cfg.training_image_grid_thw * input_ids.shape[0]
             grid = tuple(tuple(int(v) for v in g) for g in image_grid_thw)
             feats, deep = vision_tower(
                 cfg.vision, self.backend, params["vision"], pixel_values, grid

@@ -26,6 +26,7 @@ import numpy as np
 from automodel_tpu.models.common.config import BackendConfig
 from automodel_tpu.models.llama.model import ACT_FNS, _dense_init
 from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.ops.norms import layer_norm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,8 +79,6 @@ class Qwen3VLVisionConfig:
 
 
 def _ln(x: jnp.ndarray, p: dict, eps: float = 1e-6) -> jnp.ndarray:
-    from automodel_tpu.ops.norms import layer_norm
-
     return layer_norm(x, p["scale"], p["bias"], eps)
 
 

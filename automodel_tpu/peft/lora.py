@@ -146,6 +146,7 @@ def make_lora_loss_fn(
     base_params: Any,
     cfg: PeftConfig,
     graft_patterns: Sequence[str] = (),
+    base_transform=None,
 ):
     """Wrap a (params, mb) loss into an (adapters, mb) loss.
 
@@ -156,7 +157,11 @@ def make_lora_loss_fn(
 
     ``graft_patterns`` (the model's ``lora_graft_patterns``) selects adapter
     paths applied activation-side via :func:`graft_lora`; the rest go through
-    the merged formulation."""
+    the merged formulation.
+
+    ``base_transform`` maps the bound base tree before use inside jit — the
+    QLoRA hook (quantization.qlora.nf4_dequantize_tree): bound_params stays
+    NF4-packed in HBM, weights materialize transiently per step."""
 
     def _graftable(p: str) -> bool:
         return p.endswith("/kernel") and any(
@@ -164,6 +169,8 @@ def make_lora_loss_fn(
         )
 
     def loss_fn(lora_params, mb, base):
+        if base_transform is not None:
+            base = base_transform(base)
         frozen = jax.lax.stop_gradient(base)
         graft = {p: ab for p, ab in lora_params.items() if _graftable(p)}
         merged = {p: ab for p, ab in lora_params.items() if not _graftable(p)}

@@ -1,0 +1,23 @@
+from automodel_tpu.quantization.qat import (
+    QATConfig,
+    fake_quant_weight,
+    make_qat_loss_fn,
+)
+from automodel_tpu.quantization.qlora import (
+    QLoRAConfig,
+    nf4_dequantize,
+    nf4_dequantize_tree,
+    nf4_quantize,
+    nf4_quantize_tree,
+)
+
+__all__ = [
+    "QATConfig",
+    "fake_quant_weight",
+    "make_qat_loss_fn",
+    "QLoRAConfig",
+    "nf4_quantize",
+    "nf4_dequantize",
+    "nf4_quantize_tree",
+    "nf4_dequantize_tree",
+]

@@ -1,0 +1,138 @@
+"""Quantization-aware training: fake-quant with straight-through gradients.
+
+Parity: reference quantization/qat.py (QATConfig → torchao
+Int4WeightOnlyQATQuantizer / Int8DynActInt4WeightQATQuantizer, with delayed
+fake-quant enablement via enable/disable hooks, :125-146). TPU-native
+design: fake quantization is a pure PARAM TRANSFORM applied inside the loss
+— no module surgery — with the straight-through estimator
+``w + stop_grad(q(w) - w)`` so gradients flow as identity. Delayed
+enablement rides the traced optimizer step (``loss_fn.needs_step``,
+training/train_step.py): before ``start_step`` the transform is a no-op via
+``jnp.where``, after it the quantized weights are used — one compiled
+program, no re-trace at the boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import logging
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+_QUANTIZER_TYPES = ("int4_weight_only", "int8_dynact_int4weight")
+
+
+@dataclasses.dataclass(frozen=True)
+class QATConfig:
+    """Reference: quantization/qat.py:46. ``target_modules`` are fnmatch
+    patterns over param paths; the default hits every projection kernel and
+    leaves embeddings/norms full-precision (torchao quantizes nn.Linear)."""
+
+    quantizer_type: str = "int8_dynact_int4weight"
+    groupsize: int = 32
+    start_step: int = 0  # delayed fake-quant enablement
+    target_modules: Sequence[str] = ("*kernel",)
+
+    def __post_init__(self):
+        if self.quantizer_type not in _QUANTIZER_TYPES:
+            raise ValueError(
+                f"Unknown quantizer_type {self.quantizer_type!r}; "
+                f"supported: {_QUANTIZER_TYPES}"
+            )
+        if self.quantizer_type == "int8_dynact_int4weight":
+            logger.info(
+                "QAT int8_dynact_int4weight: int4 groupwise weight fake-quant "
+                "is simulated; int8 dynamic ACTIVATION fake-quant is not (it "
+                "needs per-matmul activation hooks — weight error dominates "
+                "int4 QAT, activation simulation is a follow-up)."
+            )
+
+
+def fake_quant_weight(w: jnp.ndarray, groupsize: int = 32, bits: int = 4) -> jnp.ndarray:
+    """Symmetric per-group fake quantization over the INPUT (second-to-last)
+    dim with a straight-through gradient (torchao groupwise int4 semantics:
+    qmin/qmax = -8/7, scale = absmax/qmax per group). The input dim must
+    divide the groupsize — silently widening the group would train against
+    different quantization noise than deployment applies."""
+    *lead, din, dout = w.shape
+    if din % groupsize:
+        raise ValueError(
+            f"fake_quant_weight: input dim {din} not divisible by "
+            f"groupsize {groupsize}"
+        )
+    g = groupsize
+    qmax = 2 ** (bits - 1) - 1
+    w32 = w.astype(jnp.float32)
+    grp = w32.reshape(*lead, din // g, g, dout)
+    scale = jnp.abs(grp).max(axis=-2, keepdims=True) / qmax
+    scale = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(grp / scale), -(qmax + 1), qmax) * scale
+    q = q.reshape(w.shape).astype(w.dtype)
+    return w + jax.lax.stop_gradient(q - w)  # STE
+
+
+_warned_skipped: set = set()
+
+
+def _matched_paths(params: Any, cfg: QATConfig) -> set:
+    from automodel_tpu.parallel.plans import path_str
+
+    out = set()
+    skipped = []
+
+    def visit(path, leaf):
+        p = path_str(path)
+        if getattr(leaf, "ndim", 0) >= 2 and any(
+            fnmatch.fnmatch(p, pat) for pat in cfg.target_modules
+        ):
+            if leaf.shape[-2] % cfg.groupsize:
+                skipped.append(p)  # deployment would skip/pad these the same
+            else:
+                out.add(p)
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    key = tuple(sorted(skipped))
+    if skipped and key not in _warned_skipped:
+        _warned_skipped.add(key)
+        logger.warning(
+            "QAT: skipping %d leaves whose input dim does not divide "
+            "groupsize=%d (kept full precision): %s",
+            len(skipped), cfg.groupsize, skipped[:6],
+        )
+    return out
+
+
+def apply_fake_quant(params: Any, cfg: QATConfig, enabled) -> Any:
+    """Transform matched leaves; ``enabled`` may be a traced bool (delayed
+    enablement — both branches are cheap elementwise ops)."""
+    from automodel_tpu.parallel.plans import path_str
+
+    matched = _matched_paths(params, cfg)
+
+    def visit(path, leaf):
+        if path_str(path) not in matched:
+            return leaf
+        fq = fake_quant_weight(leaf, cfg.groupsize)
+        return jnp.where(enabled, fq, leaf)
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def make_qat_loss_fn(base_loss_fn, cfg: QATConfig):
+    """Wrap a (params, mb) loss so matched weights are fake-quantized from
+    ``cfg.start_step`` on. The train step passes the traced optimizer step
+    (``needs_step`` protocol)."""
+
+    def loss_fn(params, mb, step=None):
+        enabled = (
+            jnp.asarray(True) if step is None else step >= cfg.start_step
+        )
+        return base_loss_fn(apply_fake_quant(params, cfg, enabled), mb)
+
+    loss_fn.needs_step = True
+    return loss_fn

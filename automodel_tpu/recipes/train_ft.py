@@ -98,6 +98,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
             pkw = dict(pcfg or {})
             pkw.pop("_target_", None)
+            # peft.qlora: {blocksize, ...} → NF4-quantize the frozen base
+            self._qlora_cfg = pkw.pop("qlora", None)
             self.peft_config = PeftConfig(**pkw)
             lora = init_lora_params(
                 jax.random.key(cfg.get("seed", 42) + 1), self.auto.params, self.peft_config
@@ -130,12 +132,40 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self.loss_fn = make_causal_lm_loss(
             self.model, loss=loss_name, constrain=self.auto.constrain, **lcfg
         )
+        qat_cfg = cfg.get("qat")
+        if qat_cfg is not None:
+            if self.peft_config is not None:
+                raise ValueError(
+                    "qat: and peft: are mutually exclusive — QAT fake-"
+                    "quantizes the TRAINED weights; with LoRA the base is "
+                    "frozen (use peft.qlora for a quantized base instead)"
+                )
+            from automodel_tpu.quantization import QATConfig, make_qat_loss_fn
+
+            qd = dict(qat_cfg or {})
+            qd.pop("_target_", None)
+            self.loss_fn = make_qat_loss_fn(self.loss_fn, QATConfig(**qd))
         if self.peft_config is not None:
             from automodel_tpu.peft import make_lora_loss_fn
 
+            base_tree, base_transform = self.auto.params, None
+            if self._qlora_cfg is not None:
+                from automodel_tpu.quantization import (
+                    QLoRAConfig,
+                    nf4_dequantize_tree,
+                    nf4_quantize_tree,
+                )
+
+                qc = QLoRAConfig(
+                    **({} if self._qlora_cfg is True else dict(self._qlora_cfg))
+                )
+                base_tree = nf4_quantize_tree(self.auto.params, qc, ctx=self.mesh_ctx)
+                base_transform = nf4_dequantize_tree
+                logger.info("QLoRA: NF4-quantized base (blocksize=%d)", qc.blocksize)
             self.loss_fn = make_lora_loss_fn(
-                self.loss_fn, self.auto.params, self.peft_config,
+                self.loss_fn, base_tree, self.peft_config,
                 graft_patterns=getattr(self.model, "lora_graft_patterns", ()),
+                base_transform=base_transform,
             )
         post_step = getattr(self.model, "post_step_fn", None) if self.peft_config is None else None
         self.train_step = build_train_step(
@@ -188,8 +218,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
     def _build_auto(self, mcfg: Any, backend: dict):
         """Subclass hook (biencoder recipe wraps the model)."""
         if mcfg.get("pretrained_model_name_or_path"):
+            ov = mcfg.get("hf_config_overrides")
             return auto_model.from_pretrained(
-                mcfg.pretrained_model_name_or_path, self.mesh_ctx, backend
+                mcfg.pretrained_model_name_or_path, self.mesh_ctx, backend,
+                hf_config_overrides=(
+                    ov.to_dict() if isinstance(ov, ConfigNode) else ov
+                ),
             )
         hf_config = mcfg.get("hf_config")
         return auto_model.from_config(

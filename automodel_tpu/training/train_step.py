@@ -58,17 +58,25 @@ def build_train_step(
     # argument — closures over device trees become captured constants baked
     # into every lowering (GBs for large bases)
     bound_params = getattr(loss_fn, "bound_params", None)
+    # a loss_fn may also want the optimizer step (QAT delayed fake-quant
+    # enablement, quantization/qat.py) — passed as a traced kwarg
+    needs_step = getattr(loss_fn, "needs_step", False)
 
-    def call_loss(params, mb, bound):
-        out = loss_fn(params, mb, bound) if bound is not None else loss_fn(params, mb)
+    def call_loss(params, mb, bound, step):
+        kw = {"step": step} if needs_step else {}
+        out = (
+            loss_fn(params, mb, bound, **kw)
+            if bound is not None
+            else loss_fn(params, mb, **kw)
+        )
         if len(out) == 3:
             return out
         loss_sum, n = out
         return loss_sum, n, {}
 
-    def mb_value_and_grad(params, mb, bound):
+    def mb_value_and_grad(params, mb, bound, step):
         def wrapped(p):
-            loss_sum, n, extras = call_loss(p, mb, bound)
+            loss_sum, n, extras = call_loss(p, mb, bound, step)
             return loss_sum.astype(jnp.float32), (n, extras)
         val, grads = jax.value_and_grad(wrapped, has_aux=True)(params)
         if grad_mask is not None:
@@ -83,7 +91,9 @@ def build_train_step(
 
         def body(carry, mb):
             g_acc, l_acc, n_acc = carry
-            (loss_sum, (n, extras)), grads = mb_value_and_grad(state.params, mb, bound)
+            (loss_sum, (n, extras)), grads = mb_value_and_grad(
+                state.params, mb, bound, state.step
+            )
             g_acc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), g_acc, grads
             )
@@ -139,11 +149,17 @@ def build_eval_step(
 ) -> Callable[[TrainState, dict], dict]:
     """Validation step: microbatch-scanned loss sum + token count."""
     bound_params = getattr(loss_fn, "bound_params", None)
+    needs_step = getattr(loss_fn, "needs_step", False)
 
     def step_fn(state: TrainState, batch: dict, bound=None) -> dict:
         def body(carry, mb):
             l_acc, n_acc = carry
-            out = loss_fn(state.params, mb, bound) if bound is not None else loss_fn(state.params, mb)
+            kw = {"step": state.step} if needs_step else {}
+            out = (
+                loss_fn(state.params, mb, bound, **kw)
+                if bound is not None
+                else loss_fn(state.params, mb, **kw)
+            )
             loss_sum, n = out[:2]
             return (l_acc + loss_sum.astype(jnp.float32), n_acc + n), None
 
@@ -174,7 +190,10 @@ def make_causal_lm_loss(
     def loss_fn(params, mb):
         kw = {
             k: mb[k]
-            for k in ("position_ids", "segment_ids", "pixel_values")
+            for k in (
+                "position_ids", "segment_ids", "pixel_values",
+                "mrope_position_ids",
+            )
             if k in mb and mb[k] is not None
         }
         if loss in ("fused_linear_ce", "vocab_parallel_ce"):

@@ -205,3 +205,63 @@ def test_trains_with_frozen_tower(parity_setup):
         vis_before,
         jax.device_get(state.params["vision"]),
     )
+
+
+def test_recipe_path_e2e():
+    """The shipped finetune-vlm recipe drives Qwen3-VL-MoE end to end:
+    MockQwen3VLDataset → vlm_collater (patch pixel layout + mrope stacking)
+    → make_causal_lm_loss kw forwarding → frozen tower training."""
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.finetune_vlm import FinetuneRecipeForVLM
+
+    grid = (1, 4, 4)
+    cfg = ConfigNode({
+        "seed": 0,
+        "model": {
+            "hf_config": {
+                "architectures": ["Qwen3VLMoeForConditionalGeneration"],
+                "text_config": {
+                    "vocab_size": 256, "hidden_size": 32,
+                    "intermediate_size": 64, "moe_intermediate_size": 16,
+                    "num_hidden_layers": 2, "num_attention_heads": 4,
+                    "num_key_value_heads": 2, "head_dim": 8,
+                    "num_experts": 4, "num_experts_per_tok": 2,
+                    "model_type": "qwen3_vl_moe_text",
+                    "rope_theta": 10000.0,
+                    "rope_scaling": {"rope_type": "default",
+                                     "mrope_section": [2, 1, 1]},
+                },
+                "vision_config": {
+                    "depth": 2, "hidden_size": 16, "intermediate_size": 32,
+                    "num_heads": 2, "patch_size": 4, "temporal_patch_size": 2,
+                    "spatial_merge_size": 2, "out_hidden_size": 32,
+                    "num_position_embeddings": 36,
+                    "deepstack_visual_indexes": [0, 1],
+                },
+                "image_token_id": 250,
+                "vision_start_token_id": 251,
+                "training_image_grid_thw": [list(grid)],
+            },
+            "backend": {"attn": "sdpa", "experts": "dense",
+                        "param_dtype": "float32", "compute_dtype": "float32"},
+        },
+        "distributed": {"dp_shard": -1, "platform": "cpu"},
+        "freeze": {"patterns": ["vision*"]},
+        "dataset": {
+            "_target_": "automodel_tpu.data.vlm.MockQwen3VLDataset",
+            "vocab_size": 256, "seq_length": 32, "grid_thw": list(grid),
+            "patch_size": 4, "temporal_patch_size": 2,
+            "image_token_id": 250, "vision_start_token_id": 251,
+            "num_samples": 32,
+        },
+        "dataloader": {"global_batch_size": 8},
+        "step_scheduler": {"max_steps": 8, "num_epochs": 4, "log_every_steps": 4},
+        "optimizer": {"name": "adamw", "lr": 0.01},
+        "loss_fn": {"name": "masked_ce"},
+        "checkpoint": {"enabled": False},
+        "logging": {"metrics_path": "/tmp/qwen3vl_recipe_metrics.jsonl"},
+    })
+    recipe = FinetuneRecipeForVLM(cfg)
+    recipe.setup()
+    last = recipe.run_train_validation_loop()
+    assert np.isfinite(float(last["loss"]))
