@@ -276,8 +276,9 @@ class MockQwen3VLDataset:
         start = 1 + (i % 3)
         ids[start] = self.vision_start
         ids[start + 1 : start + 1 + self.merged] = self.image_token_id
-        labels = np.concatenate([ids[1:], [IGNORE_INDEX]]).astype(np.int64)
-        labels[np.asarray(ids)[: self.seq_length] == self.image_token_id] = IGNORE_INDEX
+        # UNSHIFTED labels — default_collater applies the next-token shift
+        # (collators.py), same contract as every other dataset here
+        labels = np.where(ids == self.image_token_id, IGNORE_INDEX, ids).astype(np.int64)
         pos = get_rope_index(cfg, np.asarray(ids)[None], [self.grid])[:, 0]
         return {
             "input_ids": ids.astype(np.int64),

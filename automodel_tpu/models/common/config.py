@@ -52,6 +52,10 @@ class BackendConfig:
     # per-tensor dynamic scaling — see ops/fp8.py; reference:
     # quantization/fp8.py + BackendConfig.te_fp8)
     fp8: bool = False
+    # fp8 for the EXPERT grouped matmuls: e4m3 with 128×128 blockwise weight
+    # scales + per-tensor dynamic activation scales, straight-through grads
+    # (reference GroupedExpertsFP8, components/moe/experts.py:478)
+    fp8_experts: bool = False
     # ring attention with causally load-balanced zigzag seq layout —
     # requires the DATA permuted via parallel.cp.apply_zigzag
     cp_zigzag: bool = False

@@ -144,9 +144,9 @@ def _layer(
 ) -> jnp.ndarray:
     B, S, D = h.shape
     x = gemma_rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
-    q = _proj(x, lp["attn"]["q_proj"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-    k = _proj(x, lp["attn"]["k_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-    v = _proj(x, lp["attn"]["v_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q = _proj(x, lp["attn"]["q_proj"], backend.fp8).reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = _proj(x, lp["attn"]["k_proj"], backend.fp8).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = _proj(x, lp["attn"]["v_proj"], backend.fp8).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     if cfg.qk_norm:
         q = gemma_rms_norm(q, lp["attn"]["q_norm"]["scale"], cfg.rms_eps)
         k = gemma_rms_norm(k, lp["attn"]["k_norm"]["scale"], cfg.rms_eps)
@@ -171,14 +171,15 @@ def _layer(
         block_q=backend.attn_block_q,
         block_kv=backend.attn_block_kv,
     )
-    attn_out = _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"])
+    attn_out = _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"], backend.fp8)
     h = h + gemma_rms_norm(attn_out, lp["post_attn_norm"]["scale"], cfg.rms_eps)
     h = constrain(h, ("batch", "seq", None))
     y = gemma_rms_norm(h, lp["pre_ffn_norm"]["scale"], cfg.rms_eps)
     act = ACT_FNS[cfg.act]
     mlp = _proj(
-        act(_proj(y, lp["mlp"]["gate_proj"])) * _proj(y, lp["mlp"]["up_proj"]),
-        lp["mlp"]["down_proj"],
+        act(_proj(y, lp["mlp"]["gate_proj"], backend.fp8))
+        * _proj(y, lp["mlp"]["up_proj"], backend.fp8),
+        lp["mlp"]["down_proj"], backend.fp8,
     )
     h = h + gemma_rms_norm(mlp, lp["post_ffn_norm"]["scale"], cfg.rms_eps)
     return constrain(h, ("batch", "seq", None))
@@ -195,9 +196,6 @@ def forward_hidden(
     inputs_embeds: Optional[jnp.ndarray] = None,
     bidir_groups: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    from automodel_tpu.ops import fp8 as _fp8
-
-    _fp8.set_enabled(backend.fp8)
     cd = backend.compute_jnp_dtype
     B, S = input_ids.shape
     if position_ids is None:

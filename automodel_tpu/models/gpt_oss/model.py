@@ -107,9 +107,9 @@ def _layer(cfg, backend, h, lp, flags, cos, sin, segment_ids, constrain):
 
     B, S, D = h.shape
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
-    q = _proj(x, lp["attn"]["q_proj"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-    k = _proj(x, lp["attn"]["k_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-    v = _proj(x, lp["attn"]["v_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q = _proj(x, lp["attn"]["q_proj"], backend.fp8).reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = _proj(x, lp["attn"]["k_proj"], backend.fp8).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = _proj(x, lp["attn"]["v_proj"], backend.fp8).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     q, k = apply_rope(q, k, cos, sin)
     attn_out = windowed_attention(
         q,
@@ -126,7 +126,7 @@ def _layer(cfg, backend, h, lp, flags, cos, sin, segment_ids, constrain):
         block_q=backend.attn_block_q,
         block_kv=backend.attn_block_kv,
     )
-    h = h + _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"])
+    h = h + _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"], backend.fp8)
     h = constrain(h, ("batch", "seq", None))
     x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_eps)
     out, aux = moe_block(
@@ -138,6 +138,7 @@ def _layer(cfg, backend, h, lp, flags, cos, sin, segment_ids, constrain):
         fake_gate=backend.fake_balanced_gate,
         constrain=constrain,
         platform=backend.platform,
+        fp8=backend.fp8_experts,
     )
     h = h + out
     return constrain(h, ("batch", "seq", None)), aux
@@ -152,9 +153,6 @@ def forward_hidden(
     segment_ids: Optional[jnp.ndarray] = None,
     constrain: Constrain = _noop_constrain,
 ) -> tuple[jnp.ndarray, MoEModelAux]:
-    from automodel_tpu.ops import fp8 as _fp8
-
-    _fp8.set_enabled(backend.fp8)
     cd = backend.compute_jnp_dtype
     B, S = input_ids.shape
     if position_ids is None:

@@ -90,10 +90,10 @@ def init_params(cfg: TransformerConfig, backend: BackendConfig, key: jax.Array) 
     return params
 
 
-def _proj(x: jnp.ndarray, p: dict) -> jnp.ndarray:
+def _proj(x: jnp.ndarray, p: dict, fp8: bool = False) -> jnp.ndarray:
     from automodel_tpu.ops import fp8 as _fp8
 
-    y = _fp8.maybe_fp8_dot(x, p["kernel"], _fp8.is_enabled())
+    y = _fp8.maybe_fp8_dot(x, p["kernel"], fp8)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     if "lora_A" in p:
@@ -128,9 +128,9 @@ def attention_block(
     """Pre-norm attention + residual; shared across dense and MoE families."""
     B, S, D = h.shape
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
-    q = _proj(x, lp["attn"]["q_proj"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-    k = _proj(x, lp["attn"]["k_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-    v = _proj(x, lp["attn"]["v_proj"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q = _proj(x, lp["attn"]["q_proj"], backend.fp8).reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = _proj(x, lp["attn"]["k_proj"], backend.fp8).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    v = _proj(x, lp["attn"]["v_proj"], backend.fp8).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
     if cfg.qk_norm:
         q = rms_norm(q, lp["attn"]["q_norm"]["scale"], cfg.rms_eps)
         k = rms_norm(k, lp["attn"]["k_norm"]["scale"], cfg.rms_eps)
@@ -152,7 +152,7 @@ def attention_block(
             else {}
         ),
     )
-    h = h + _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"])
+    h = h + _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"], backend.fp8)
     return constrain(h, ("batch", "seq", None))
 
 
@@ -172,7 +172,11 @@ def decoder_layer(
     )
     x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_eps)
     act = ACT_FNS[cfg.act]
-    mlp = _proj(act(_proj(x, lp["mlp"]["gate_proj"])) * _proj(x, lp["mlp"]["up_proj"]), lp["mlp"]["down_proj"])
+    mlp = _proj(
+        act(_proj(x, lp["mlp"]["gate_proj"], backend.fp8))
+        * _proj(x, lp["mlp"]["up_proj"], backend.fp8),
+        lp["mlp"]["down_proj"], backend.fp8,
+    )
     h = h + mlp
     return constrain(h, ("batch", "seq", None))
 
@@ -187,9 +191,6 @@ def forward_hidden(
     constrain: Constrain = _noop_constrain,
 ) -> jnp.ndarray:
     """Embed + decoder stack → final-norm hidden states [B, S, D]."""
-    from automodel_tpu.ops import fp8 as _fp8
-
-    _fp8.set_enabled(backend.fp8)  # trace-time switch for _proj
     cd = backend.compute_jnp_dtype
     if position_ids is None:
         position_ids = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)

@@ -183,9 +183,6 @@ def forward_hidden(
     an mrope cos/sin table, and ``(visual_mask [B,S,1], ds [n_deep,B,S,D])``
     visual embeds added to the hidden states after each of the first n_deep
     layers (HF Qwen3VLMoeTextModel._deepstack_process)."""
-    from automodel_tpu.ops import fp8 as _fp8
-
-    _fp8.set_enabled(backend.fp8)
     cd = backend.compute_jnp_dtype
     moe = cfg.moe
     if position_ids is None:
@@ -234,6 +231,7 @@ def forward_hidden(
             fake_gate=backend.fake_balanced_gate,
             constrain=constrain,
             platform=backend.platform,
+            fp8=backend.fp8_experts,
         )
         hh = hh + out
         return constrain(hh, ("batch", "seq", None)), aux

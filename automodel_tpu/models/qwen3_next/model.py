@@ -288,6 +288,7 @@ def forward_hidden(
                 fake_gate=backend.fake_balanced_gate,
                 constrain=constrain,
                 platform=backend.platform,
+                fp8=backend.fp8_experts,
             )
             return constrain(h + out, ("batch", "seq", None)), aux
 

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from automodel_tpu.moe.config import MoEConfig
 from automodel_tpu.moe.gate import GateOutput
+from automodel_tpu.ops.fp8 import fp8_qdq_blockwise, fp8_qdq_tensor
 from automodel_tpu.ops.grouped_matmul import ragged_dot
 
 Act = Callable[[jnp.ndarray], jnp.ndarray]
@@ -137,8 +138,13 @@ def ragged_experts(
     cfg: MoEConfig,
     act2: Act,
     platform: str | None = None,
+    fp8: bool = False,
 ) -> jnp.ndarray:
-    """Dropless sort + ragged_dot grouped matmul (single-slice hot path)."""
+    """Dropless sort + ragged_dot grouped matmul (single-slice hot path).
+
+    ``fp8``: e4m3 QDQ on both grouped-matmul operands — 128×128 blockwise
+    scales on the expert weights, per-tensor dynamic on activations, STE
+    grads (reference GroupedExpertsFP8, components/moe/experts.py:478)."""
     T, D = x.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
     flat_expert = gate_out.topk_idx.reshape(-1)  # [T*K]
@@ -148,13 +154,20 @@ def ragged_experts(
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
     sorted_expert = flat_expert[order]
 
-    gu = ragged_dot(xs, weights["gate_up"].astype(xs.dtype), group_sizes,
-                    platform=platform)
+    w_gu = weights["gate_up"].astype(xs.dtype)
+    w_dn = weights["down"].astype(xs.dtype)
+    if fp8:
+        xs = fp8_qdq_tensor(xs)
+        w_gu = fp8_qdq_blockwise(w_gu)
+        w_dn = fp8_qdq_blockwise(w_dn)
+    gu = ragged_dot(xs, w_gu, group_sizes, platform=platform)
     if "gate_up_bias" in weights:
         gu = gu + weights["gate_up_bias"].astype(xs.dtype)[sorted_expert]
     g, u = _split_gate_up(gu, cfg.interleaved_gate_up)
-    ys = ragged_dot(act2(g, u), weights["down"].astype(xs.dtype), group_sizes,
-                    platform=platform)
+    h_mid = act2(g, u)
+    if fp8:
+        h_mid = fp8_qdq_tensor(h_mid)
+    ys = ragged_dot(h_mid, w_dn, group_sizes, platform=platform)
     if "down_bias" in weights:
         ys = ys + weights["down_bias"].astype(xs.dtype)[sorted_expert]
 
@@ -172,6 +185,7 @@ def a2a_experts(
     act2: Act,
     ctx,  # parallel.mesh.MeshContext | None
     platform: str | None = None,
+    fp8: bool = False,
 ) -> jnp.ndarray:
     """Dropless token-exchange EP dispatch (reference DeepEP dispatcher,
     token_dispatcher.py:339 + fused_a2a.py:102 → shard_map + lax.all_to_all).
@@ -190,7 +204,8 @@ def a2a_experts(
     if ctx is None or ctx.ep_size == 1:
         # single-slice: the ragged path is already dropless
         return ragged_experts(
-            x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform
+            x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform,
+            fp8=fp8,
         ).reshape(B, S, D)
 
     from automodel_tpu.parallel.mesh import MeshAxisName as A
@@ -237,7 +252,7 @@ def a2a_experts(
     body = functools.partial(
         _a2a_body,
         ep=ep, ep_axis=A.EP, E=E, E_loc=E_loc, C=C, D=D, K=K,
-        act2=act2, tp_axis=A.TP, platform=platform,
+        act2=act2, tp_axis=A.TP, platform=platform, fp8=fp8,
     )
     idx = gate_out.topk_idx.reshape(B, S, K)
     cw = gate_out.topk_weights.reshape(B, S, K)
@@ -250,7 +265,7 @@ def a2a_experts(
 
 
 def _a2a_body(xb, idxb, cwb, wd, *, ep, ep_axis, E, E_loc, C, D, K, act2,
-              tp_axis=None, platform=None):
+              tp_axis=None, platform=None, fp8=False):
     """The per-device token-exchange block. Requires `ep_axis` (and, when
     ``tp_axis`` is set, that axis too) to be MANUAL in the calling context —
     either a2a_experts' own shard_map, or a pipeline region already manual
@@ -289,12 +304,20 @@ def _a2a_body(xb, idxb, cwb, wd, *, ep, ep_axis, E, E_loc, C, D, K, act2,
     sid = jnp.minimum(recv_id[order2], E_loc - 1)
     gsz = jnp.bincount(recv_id, length=E_loc).astype(jnp.int32)  # sentinel drops
 
-    g = ragged_dot(xs2, wd["gw"].astype(xs2.dtype), gsz, platform=platform)
-    u = ragged_dot(xs2, wd["uw"].astype(xs2.dtype), gsz, platform=platform)
+    w_g, w_u = wd["gw"].astype(xs2.dtype), wd["uw"].astype(xs2.dtype)
+    w_d = wd["dw"].astype(xs2.dtype)
+    if fp8:
+        xs2 = fp8_qdq_tensor(xs2)
+        w_g, w_u, w_d = (fp8_qdq_blockwise(w) for w in (w_g, w_u, w_d))
+    g = ragged_dot(xs2, w_g, gsz, platform=platform)
+    u = ragged_dot(xs2, w_u, gsz, platform=platform)
     if "gb" in wd:
         g = g + wd["gb"].astype(g.dtype)[sid]
         u = u + wd["ub"].astype(u.dtype)[sid]
-    y = ragged_dot(act2(g, u), wd["dw"].astype(xs2.dtype), gsz, platform=platform)
+    h_mid = act2(g, u)
+    if fp8:
+        h_mid = fp8_qdq_tensor(h_mid)
+    y = ragged_dot(h_mid, w_d, gsz, platform=platform)
     if "db" in wd:
         if tp_axis is not None:  # partial over tp: bias on one tp shard only
             y = y + jnp.where(
@@ -328,6 +351,7 @@ def a2a_experts_manual(
     ep: int,
     ep_axis: str = "ep",
     platform: str | None = None,
+    fp8: bool = False,
 ) -> jnp.ndarray:
     """a2a dispatch for contexts where `ep` is ALREADY a manual axis (the
     pp×ep pipeline region). tp must not shard the expert weights here
@@ -357,7 +381,7 @@ def a2a_experts_manual(
     return _a2a_body(
         x, idx, cw, wd,
         ep=ep, ep_axis=ep_axis, E=E, E_loc=E_loc, C=C, D=D, K=K,
-        act2=act2, tp_axis=None, platform=platform,
+        act2=act2, tp_axis=None, platform=platform, fp8=fp8,
     )
 
 
@@ -369,28 +393,47 @@ def _noop_constrain(a, spec):
     return a
 
 
+_warned_fp8_backend: set = set()
+
+
+def _warn_fp8_unsupported(name: str) -> None:
+    if name not in _warned_fp8_backend:
+        _warned_fp8_backend.add(name)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "fp8_experts=True but experts=%r does not implement the fp8 "
+            "path — running in full precision (use 'ragged' or 'a2a').", name
+        )
+
+
 def _run_dense(x, gate_out, weights, cfg, act2, *, ctx=None,
-               constrain=_noop_constrain, platform=None):
+               constrain=_noop_constrain, platform=None, fp8=False):
+    if fp8:
+        _warn_fp8_unsupported("dense")
     B, S, D = x.shape
     return dense_experts(x.reshape(-1, D), gate_out, weights, cfg, act2).reshape(B, S, D)
 
 
 def _run_gspmd(x, gate_out, weights, cfg, act2, *, ctx=None,
-               constrain=_noop_constrain, platform=None):
+               constrain=_noop_constrain, platform=None, fp8=False):
+    if fp8:
+        _warn_fp8_unsupported("gspmd")
     return gspmd_experts(x, gate_out, weights, cfg, act2, constrain=constrain)
 
 
 def _run_ragged(x, gate_out, weights, cfg, act2, *, ctx=None,
-                constrain=_noop_constrain, platform=None):
+                constrain=_noop_constrain, platform=None, fp8=False):
     B, S, D = x.shape
     return ragged_experts(
-        x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform
+        x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform, fp8=fp8
     ).reshape(B, S, D)
 
 
 def _run_a2a(x, gate_out, weights, cfg, act2, *, ctx=None,
-             constrain=_noop_constrain, platform=None):
-    return a2a_experts(x, gate_out, weights, cfg, act2, ctx, platform=platform)
+             constrain=_noop_constrain, platform=None, fp8=False):
+    return a2a_experts(x, gate_out, weights, cfg, act2, ctx, platform=platform,
+                       fp8=fp8)
 
 
 EXPERT_BACKENDS = {

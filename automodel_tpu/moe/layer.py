@@ -51,6 +51,7 @@ def moe_block(
     fake_gate: bool = False,
     constrain: Callable = lambda a, s: a,
     platform: Optional[str] = None,
+    fp8: bool = False,
 ) -> tuple[jnp.ndarray, MoEAux]:
     B, S, D = x.shape
     xt = x.reshape(-1, D)
@@ -86,7 +87,7 @@ def moe_block(
     )
     routed = backend_fn(
         x, gout, mp["experts"], cfg, act2,
-        ctx=ctx, constrain=constrain, platform=platform,
+        ctx=ctx, constrain=constrain, platform=platform, fp8=fp8,
     )
 
     out = routed

@@ -161,6 +161,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 )
                 base_tree = nf4_quantize_tree(self.auto.params, qc, ctx=self.mesh_ctx)
                 base_transform = nf4_dequantize_tree
+                # drop the full-precision base so HBM really holds the packed
+                # codes only (the loss binds base_tree; adapters checkpoint
+                # separately)
+                self.auto.params = None
                 logger.info("QLoRA: NF4-quantized base (blocksize=%d)", qc.blocksize)
             self.loss_fn = make_lora_loss_fn(
                 self.loss_fn, base_tree, self.peft_config,
