@@ -207,7 +207,7 @@ def forward_hidden(
         # scattered projected image features in (gemma3_vl/model.py)
         h = inputs_embeds.astype(cd)
     else:
-        h = params["embed"]["embedding"].astype(cd)[input_ids]
+        h = constrain(params["embed"]["embedding"], (None, None)).astype(cd)[input_ids]
         h = h * jnp.asarray(cfg.embed_scale, cd)
     h = constrain(h, ("batch", "seq", None))
 

@@ -157,7 +157,7 @@ def forward_hidden(
     B, S = input_ids.shape
     if position_ids is None:
         position_ids = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-    h = params["embed"]["embedding"].astype(cd)[input_ids]
+    h = constrain(params["embed"]["embedding"], (None, None)).astype(cd)[input_ids]
     h = constrain(h, ("batch", "seq", None))
     cos, sin = rope_table(position_ids, cfg.head_dim, cfg.rope)
     sw = cfg.sliding_window or S

@@ -195,7 +195,7 @@ def forward_hidden(
     if position_ids is None:
         position_ids = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
         position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
-    h = params["embed"]["embedding"].astype(cd)[input_ids]
+    h = constrain(params["embed"]["embedding"], (None, None)).astype(cd)[input_ids]
     if cfg.embed_scale != 1.0:
         h = h * jnp.asarray(cfg.embed_scale, cd)
     h = constrain(h, ("batch", "seq", None))

@@ -28,7 +28,8 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Any, Sequence
+import math
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
@@ -81,7 +82,15 @@ LOGICAL_AXIS_RULES: dict[str, tuple[str, ...]] = {
 @dataclasses.dataclass
 class MeshConfig:
     """Parallelism degrees. -1 for dp_shard means 'infer from world size'
-    (reference: mesh_utils.py:160-168)."""
+    (reference: mesh_utils.py:160-168).
+
+    ``dcn`` (multi-slice only): per-axis degrees laid across the DATA-CENTER
+    NETWORK (between ICI slices) instead of ICI; the per-axis ICI degree is
+    axis_total / dcn[axis]. Default (empty) lays pp/dp_replicate/dp_shard
+    across slices automatically; ep/tp/cp never default over DCN (latency-
+    bound collectives) and require an explicit entry here (reference hybrid
+    topology note, init_utils.py:90-163; jax
+    mesh_utils.create_hybrid_device_mesh)."""
 
     dp_replicate: int = 1
     dp_shard: int = -1  # total data-shard degree INCLUDING ep (dp_shard_total)
@@ -89,6 +98,7 @@ class MeshConfig:
     cp: int = 1
     pp: int = 1
     ep: int = 1
+    dcn: Optional[dict] = None
 
     def validate(self, world_size: int) -> "MeshConfig":
         cfg = dataclasses.replace(self)
@@ -203,6 +213,60 @@ class MeshContext:
         return f"MeshContext(shape={dict(self.mesh.shape)})"
 
 
+def hybrid_mesh_shapes(
+    config: MeshConfig, world_size: int, n_processes: int
+) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Split the mesh shape into (ici_shape, dcn_shape) for
+    `mesh_utils.create_hybrid_device_mesh` on a multi-host DCN×ICI topology.
+
+    ``config.dcn`` gives per-axis DCN degrees; their product must equal the
+    DCN granule count (number of ICI slices) and each must divide its axis.
+    Default: greedily lay the OUTER axes (pp, dp_replicate, dp_shard) across
+    slices in order — the axes whose collectives amortize over DCN — and
+    refuse to split tp/cp/ep implicitly (latency-bound collectives: tp/cp
+    all-reduces and the MoE token all-to-all; declare MeshConfig.dcn
+    explicitly to override)."""
+    cfg = config.validate(world_size)
+    axes = {
+        "pp": cfg.pp,
+        "dp_replicate": cfg.dp_replicate,
+        "dp_shard": cfg.dp_shard // cfg.ep,
+        "ep": cfg.ep,
+        "cp": cfg.cp,
+        "tp": cfg.tp,
+    }
+    dcn = dict(cfg.dcn or {})
+    if dcn:
+        unknown = set(dcn) - set(axes)
+        if unknown:
+            raise ValueError(f"dcn axes {sorted(unknown)} not mesh axes {list(axes)}")
+        prod = int(np.prod(list(dcn.values())))
+        if prod != n_processes:
+            raise ValueError(
+                f"dcn degrees {dcn} product {prod} != process count {n_processes}"
+            )
+        for a, d in dcn.items():
+            if d < 1 or axes[a] % d:
+                raise ValueError(f"dcn[{a}]={d} must divide axis degree {axes[a]}")
+    else:
+        rem = n_processes
+        for a in ("pp", "dp_replicate", "dp_shard"):
+            g = math.gcd(axes[a], rem)
+            if g > 1:
+                dcn[a] = g
+                rem //= g
+        if rem != 1:
+            raise ValueError(
+                f"cannot lay {n_processes} DCN granules across "
+                f"{ {a: axes[a] for a in ('pp', 'dp_replicate', 'dp_shard')} } "
+                "without splitting ep/tp/cp over DCN (latency-bound "
+                "collectives); set MeshConfig.dcn explicitly to opt in"
+            )
+    dcn_shape = tuple(dcn.get(a, 1) for a in axes)
+    ici_shape = tuple(axes[a] // dcn.get(a, 1) for a in axes)
+    return ici_shape, dcn_shape
+
+
 def build_mesh(
     config: MeshConfig | None = None,
     devices: Sequence[jax.Device] | None = None,
@@ -212,6 +276,9 @@ def build_mesh(
 
     The mesh axis ``dp_shard`` holds ``dp_shard_total // ep`` so the flat
     product over ``(dp_shard, ep)`` equals the configured data-shard degree.
+    Multi-host (jax.process_count() > 1 over the given devices) goes through
+    `create_hybrid_device_mesh` so DCN-crossing axes are the ones declared
+    (or defaulted) by :func:`hybrid_mesh_shapes`.
     """
     if config is None:
         config = MeshConfig(**degrees)
@@ -225,28 +292,85 @@ def build_mesh(
         config.cp,
         config.tp,
     )
-    try:
-        from jax.experimental import mesh_utils as jmu
+    # DCN granules are ICI SLICES, not processes: a multi-host single-slice
+    # pod (e.g. v4-32, ICI spans hosts) builds a plain device mesh; only
+    # genuinely DCN-connected multi-slice topologies go hybrid. Devices
+    # without slice_index (CPU multi-process) count as one slice.
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    n_slices = 1 if None in slice_ids else len(slice_ids)
+    from jax.experimental import mesh_utils as jmu
 
-        dev_array = jmu.create_device_mesh(shape, devices=devices)
-    except (ValueError, NotImplementedError, AssertionError) as e:
-        # CPU/host platforms without torus assignment. On real TPU this
-        # fallback loses topology-aware placement — make it loud.
-        logger.warning(
-            "create_device_mesh failed (%s); falling back to flat device order. "
-            "On TPU hardware this loses ICI-aware placement.", e
+    if n_slices > 1:
+        ici_shape, dcn_shape = hybrid_mesh_shapes(config, len(devices), n_slices)
+        dev_array = jmu.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices
         )
-        dev_array = np.array(devices).reshape(shape)
-    mesh = Mesh(dev_array, MeshAxisName.ALL)
+        logger.info("Hybrid DCN×ICI mesh: ici=%s dcn=%s", ici_shape, dcn_shape)
+    else:
+        try:
+            dev_array = jmu.create_device_mesh(shape, devices=devices)
+        except (ValueError, NotImplementedError, AssertionError) as e:
+            # CPU/host platforms without torus assignment. On real TPU this
+            # fallback loses topology-aware placement — make it loud.
+            logger.warning(
+                "create_device_mesh failed (%s); falling back to flat device "
+                "order. On TPU hardware this loses ICI-aware placement.", e
+            )
+            dev_array = np.array(devices).reshape(shape)
+    mesh = Mesh(dev_array.reshape(shape), MeshAxisName.ALL)
     logger.info("Built mesh %s", dict(mesh.shape))
     return MeshContext(mesh, config)
 
 
-def initialize_distributed(**kwargs: Any) -> None:
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    **kwargs: Any,
+) -> None:
     """Multi-host init (reference: init_utils.py:90 NCCL init → here
     `jax.distributed.initialize` over the TPU runtime; single-process is a
-    no-op because JAX is single-controller)."""
+    no-op because JAX is single-controller).
+
+    Args fall back to the env the launchers render (launcher/slurm.py:24-29,
+    launcher/k8s.py): JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID. On TPU pods with none of these set,
+    `jax.distributed.initialize()` discovers the topology itself — we only
+    call it when a multi-host env is actually declared. Validated before
+    dialing so a bad rendezvous fails fast with a config error instead of a
+    hang at the coordinator timeout."""
     import os
 
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") or kwargs.get("coordinator_address"):
-        jax.distributed.initialize(**kwargs)
+    env = os.environ
+    coordinator_address = coordinator_address or env.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and env.get("JAX_NUM_PROCESSES"):
+        num_processes = int(env["JAX_NUM_PROCESSES"])
+    if process_id is None and env.get("JAX_PROCESS_ID"):
+        process_id = int(env["JAX_PROCESS_ID"])
+    if not coordinator_address:
+        return  # single process / TPU-pod auto-discovery happens lazily
+    if num_processes is None or process_id is None:
+        raise ValueError(
+            "JAX_COORDINATOR_ADDRESS is set but JAX_NUM_PROCESSES / "
+            "JAX_PROCESS_ID are not — the launchers export all three "
+            "(launcher/slurm.py, launcher/k8s.py)"
+        )
+    if num_processes < 1 or not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"invalid process topology: process_id={process_id} "
+            f"num_processes={num_processes}"
+        )
+    if ":" not in coordinator_address:
+        raise ValueError(
+            f"coordinator_address {coordinator_address!r} must be host:port"
+        )
+    logger.info(
+        "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+        coordinator_address, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
