@@ -1,0 +1,234 @@
+"""Mistral3 VLM (Mistral3ForConditionalGeneration), TPU-native.
+
+Parity: HF modeling_mistral3.py + modeling_pixtral.py — Pixtral vision tower
+(conv patch embed ≡ one linear, RMS ln_pre, 2-D rotary over the patch grid,
+per-image bidirectional attention, llama-style SwiGLU blocks) → multimodal
+projector (RMSNorm with the TEXT eps, spatial patch merger via an
+unfold-style regrouping + merging linear, two-layer GELU projector) → image
+features scattered over ``[IMG]`` token positions of the Mistral text stack
+(the existing llama family). Reference: components/models/mistral3 (which
+wraps the same HF modules; its text side reuses their common MoE/dense
+scaffolding).
+
+Image sizes are shape-defining, so the training path assumes every image in
+a batch is the configured ``image_size`` square (the HF processor's resize
+target); the parity tests exercise exactly that layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.models.llama.model import (
+    ACT_FNS,
+    SHARDING_RULES as TEXT_RULES,
+    forward_hidden as text_forward_hidden,
+    init_params as init_text_params,
+)
+from automodel_tpu.models.mistral3.vision import (
+    PixtralVisionConfig,
+    init_vision_params,
+    vision_tower,
+)
+from automodel_tpu.ops.norms import rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class Mistral3Config:
+    text: TransformerConfig
+    vision: PixtralVisionConfig
+    spatial_merge_size: int = 2
+    image_token_index: int = 10
+    projector_hidden_act: str = "gelu"
+    multimodal_projector_bias: bool = False
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "Mistral3Config":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        return cls(
+            text=TransformerConfig.from_hf(get("text_config")),
+            vision=PixtralVisionConfig.from_hf(get("vision_config")),
+            spatial_merge_size=get("spatial_merge_size", 2),
+            image_token_index=get("image_token_index", 10),
+            projector_hidden_act=get("projector_hidden_act", "gelu"),
+            multimodal_projector_bias=bool(get("multimodal_projector_bias", False)),
+        )
+
+    @property
+    def logits_soft_cap(self):
+        return self.text.logits_soft_cap
+
+    @property
+    def vocab_size(self) -> int:
+        return self.text.vocab_size
+
+    @property
+    def hidden_size(self) -> int:
+        return self.text.hidden_size
+
+
+def init_projector_params(cfg: Mistral3Config, backend: BackendConfig, key) -> dict:
+    from automodel_tpu.models.llama.model import _dense_init
+
+    pd = backend.param_jnp_dtype
+    dv, dt, ms = cfg.vision.hidden_size, cfg.text.hidden_size, cfg.spatial_merge_size
+    ks = jax.random.split(key, 3)
+    p = {
+        "norm": {"scale": jnp.ones((dv,), pd)},
+        "patch_merger": {"kernel": _dense_init(ks[0], (dv * ms**2, dv), pd)},
+        "linear_1": {"kernel": _dense_init(ks[1], (dv, dt), pd)},
+        "linear_2": {"kernel": _dense_init(ks[2], (dt, dt), pd)},
+    }
+    if cfg.multimodal_projector_bias:
+        p["linear_1"]["bias"] = jnp.zeros((dt,), pd)
+        p["linear_2"]["bias"] = jnp.zeros((dt,), pd)
+    return p
+
+
+def _merge_patches(feats: jnp.ndarray, h: int, w: int, ms: int) -> jnp.ndarray:
+    """[h·w, d] grid tokens → [(h/ms)·(w/ms), d·ms²] in torch-unfold order
+    (feature vector = [d, ki, kj] with d slowest)."""
+    d = feats.shape[-1]
+    g = feats.reshape(h // ms, ms, w // ms, ms, d)
+    return g.transpose(0, 2, 4, 1, 3).reshape((h // ms) * (w // ms), d * ms * ms)
+
+
+def project_image_features(
+    cfg: Mistral3Config, pp: dict, feats: jnp.ndarray, grid_hw: tuple
+) -> jnp.ndarray:
+    """Tower output [P_total, dv] → [P_total/ms², D_text] (HF
+    Mistral3MultiModalProjector.forward)."""
+    ms = cfg.spatial_merge_size
+    act = ACT_FNS[cfg.projector_hidden_act]
+    x = rms_norm(feats, pp["norm"]["scale"], cfg.text.rms_eps)
+    outs, off = [], 0
+    for h, w in grid_hw:
+        outs.append(_merge_patches(x[off : off + h * w], h, w, ms))
+        off += h * w
+    x = jnp.concatenate(outs, axis=0) @ pp["patch_merger"]["kernel"].astype(x.dtype)
+    y = x @ pp["linear_1"]["kernel"].astype(x.dtype)
+    if "bias" in pp["linear_1"]:
+        y = y + pp["linear_1"]["bias"].astype(x.dtype)
+    y = act(y) @ pp["linear_2"]["kernel"].astype(x.dtype)
+    if "bias" in pp["linear_2"]:
+        y = y + pp["linear_2"]["bias"].astype(x.dtype)
+    return y
+
+
+@dataclasses.dataclass
+class Mistral3ForConditionalGeneration:
+    config: Mistral3Config
+    backend: BackendConfig = BackendConfig()
+
+    # the text stack is llama's; its projections consume grafted LoRA
+    lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel", "*/mlp/*_proj/kernel")
+
+    def init(self, key: jax.Array) -> dict:
+        kt, kv, kp = jax.random.split(key, 3)
+        p = {"text": init_text_params(self.config.text, self.backend, kt)}
+        p["vision"] = init_vision_params(self.config.vision, self.backend, kv)
+        p["projector"] = init_projector_params(self.config, self.backend, kp)
+        return p
+
+    def hidden(
+        self,
+        params: dict,
+        input_ids: jnp.ndarray,
+        pixel_values: Optional[jnp.ndarray] = None,  # [N_img, C·ps², H/ps·W/ps] patches
+        image_sizes=None,  # static tuple of (H, W) per image; default full square
+        constrain=None,
+        **kw: Any,
+    ) -> jnp.ndarray:
+        cfg = self.config
+        constrain = constrain or (lambda x, s: x)
+        cd = self.backend.compute_jnp_dtype
+        tp = params["text"]
+        embeds = constrain(tp["embed"]["embedding"], (None, None)).astype(cd)[input_ids]
+        if pixel_values is not None:
+            ps = cfg.vision.patch_size
+            if image_sizes is None:
+                image_sizes = ((cfg.vision.image_size, cfg.vision.image_size),) * int(
+                    pixel_values.shape[0]
+                )
+            grid_hw = tuple((h // ps, w // ps) for h, w in image_sizes)
+            feats = vision_tower(
+                cfg.vision, self.backend, params["vision"], pixel_values, grid_hw
+            )
+            feats = project_image_features(cfg, params["projector"], feats, grid_hw)
+            mask = (input_ids == cfg.image_token_index).reshape(-1)
+            idx = jnp.cumsum(mask) - 1
+            flat = embeds.reshape(-1, embeds.shape[-1])
+            take = feats[jnp.clip(idx, 0, feats.shape[0] - 1)].astype(flat.dtype)
+            embeds = jnp.where(mask[:, None], take, flat).reshape(embeds.shape)
+        # run the llama stack on the prepared embeddings via the embedding
+        # swap-in trick: temporarily replace the table lookup by providing
+        # inputs through a params copy is NOT possible (functional) — the
+        # llama forward_hidden embeds internally, so we inline its body here
+        from automodel_tpu.models.llama.model import (
+            _layer_sliding_window,
+            decoder_layer,
+        )
+        from automodel_tpu.ops.rope import rope_table
+
+        tcfg = cfg.text
+        B, S = input_ids.shape
+        position_ids = kw.get("position_ids")
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(
+                jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+            )
+        segment_ids = kw.get("segment_ids")
+        h = constrain(embeds, ("batch", "seq", None))
+        cos, sin = rope_table(position_ids, tcfg.rope_dim or tcfg.head_dim, tcfg.rope)
+
+        def maybe_remat(fn):
+            if self.backend.remat == "full":
+                return jax.checkpoint(
+                    fn, policy=jax.checkpoint_policies.nothing_saveable
+                )
+            if self.backend.remat == "selective":
+                return jax.checkpoint(
+                    fn,
+                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                )
+            return fn
+
+        def layer_fn(carry, lp):
+            return (
+                decoder_layer(
+                    tcfg, self.backend, carry, lp, cos, sin, segment_ids,
+                    constrain, _layer_sliding_window(tcfg, 0),
+                ),
+                None,
+            )
+
+        h, _ = jax.lax.scan(maybe_remat(layer_fn), h, tp["layers"])
+        return rms_norm(h, tp["final_norm"]["scale"], tcfg.rms_eps)
+
+    def __call__(self, params: dict, input_ids: jnp.ndarray, **kw: Any):
+        h = self.hidden(params, input_ids, **kw)
+        logits = h @ self.lm_head(params).astype(h.dtype)
+        return logits
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        tp = params["text"]
+        if self.config.text.tie_embeddings:
+            return tp["embed"]["embedding"].T
+        return tp["lm_head"]["kernel"]
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return [
+            (r"^vision/", ()),
+            (r"^projector/", ()),
+            *[(r"^text/" + pat.lstrip("^"), spec) for pat, spec in TEXT_RULES],
+            *TEXT_RULES,
+        ]
