@@ -87,7 +87,15 @@ class GemmaConfig(TransformerConfig):
             sliding_window=get("sliding_window", 4096),
             qk_norm=model_type in ("gemma3", "gemma3_text"),
             tie_embeddings=bool(get("tie_word_embeddings", True)),
-            act=get("hidden_activation", get("hidden_act", "gelu_pytorch_tanh")),
+            # legacy gemma-1 configs say hidden_act="gelu" but HF deliberately
+            # runs the tanh approximation regardless (the gemma activation
+            # fix); ACT_FNS["gelu"] is now exact-erf, so remap here
+            act=(
+                "gelu_pytorch_tanh"
+                if get("hidden_activation", get("hidden_act", "gelu_pytorch_tanh"))
+                in ("gelu", "gelu_pytorch_tanh")
+                else get("hidden_activation", get("hidden_act"))
+            ),
         )
         return cls(**fields)
 

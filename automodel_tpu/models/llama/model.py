@@ -32,7 +32,8 @@ _noop_constrain: Constrain = lambda x, spec: x
 
 ACT_FNS = {
     "silu": jax.nn.silu,
-    "gelu": jax.nn.gelu,
+    # HF ACT2FN["gelu"] is the exact erf form; jax defaults to tanh-approx
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
     "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
     "relu2": lambda x: jnp.square(jax.nn.relu(x)),
 }
@@ -189,15 +190,22 @@ def forward_hidden(
     position_ids: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     constrain: Constrain = _noop_constrain,
+    inputs_embeds: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Embed + decoder stack → final-norm hidden states [B, S, D]."""
+    """Embed + decoder stack → final-norm hidden states [B, S, D].
+
+    ``inputs_embeds``: VLM hook (same contract as gemma/qwen3_moe) — caller
+    already embedded text tokens and scattered projected image features."""
     cd = backend.compute_jnp_dtype
     if position_ids is None:
         position_ids = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
         position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
-    h = constrain(params["embed"]["embedding"], (None, None)).astype(cd)[input_ids]
-    if cfg.embed_scale != 1.0:
-        h = h * jnp.asarray(cfg.embed_scale, cd)
+    if inputs_embeds is not None:
+        h = inputs_embeds.astype(cd)
+    else:
+        h = constrain(params["embed"]["embedding"], (None, None)).astype(cd)[input_ids]
+        if cfg.embed_scale != 1.0:
+            h = h * jnp.asarray(cfg.embed_scale, cd)
     h = constrain(h, ("batch", "seq", None))
     cos, sin = rope_table(position_ids, cfg.rope_dim or cfg.head_dim, cfg.rope)
 

@@ -1,0 +1,19 @@
+from automodel_tpu.models.mistral3.model import (
+    Mistral3Config,
+    Mistral3ForConditionalGeneration,
+)
+from automodel_tpu.models.mistral3.state_dict_adapter import Mistral3StateDictAdapter
+from automodel_tpu.models.mistral3.vision import (
+    PixtralVisionConfig,
+    init_vision_params,
+    vision_tower,
+)
+
+__all__ = [
+    "Mistral3Config",
+    "Mistral3ForConditionalGeneration",
+    "Mistral3StateDictAdapter",
+    "PixtralVisionConfig",
+    "init_vision_params",
+    "vision_tower",
+]

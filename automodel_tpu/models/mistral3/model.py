@@ -22,7 +22,6 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
 from automodel_tpu.models.llama.model import (
@@ -53,6 +52,14 @@ class Mistral3Config:
         get = lambda k, d=None: (
             hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
         )
+        vfl = get("vision_feature_layer", -1)
+        if vfl != -1:
+            # HF sizes the projector from the selected layer(s); supporting
+            # only the default keeps a wrong-numerics load from being silent
+            raise NotImplementedError(
+                f"vision_feature_layer={vfl!r}: only -1 (last hidden state) "
+                "is supported"
+            )
         return cls(
             text=TransformerConfig.from_hf(get("text_config")),
             vision=PixtralVisionConfig.from_hf(get("vision_config")),
@@ -128,8 +135,13 @@ class Mistral3ForConditionalGeneration:
     config: Mistral3Config
     backend: BackendConfig = BackendConfig()
 
-    # the text stack is llama's; its projections consume grafted LoRA
-    lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel", "*/mlp/*_proj/kernel")
+    # the text stack is llama's; its projections consume grafted LoRA.
+    # Patterns are text-scoped: the Pixtral tower reads kernels directly and
+    # would silently train dead adapters (peft/lora.py:119).
+    lora_graft_patterns = (
+        "text/*/attn/[qkvo]_proj/kernel",
+        "text/*/mlp/*_proj/kernel",
+    )
 
     def init(self, key: jax.Array) -> dict:
         kt, kv, kp = jax.random.split(key, 3)
@@ -152,6 +164,8 @@ class Mistral3ForConditionalGeneration:
         cd = self.backend.compute_jnp_dtype
         tp = params["text"]
         embeds = constrain(tp["embed"]["embedding"], (None, None)).astype(cd)[input_ids]
+        if cfg.text.embed_scale != 1.0:
+            embeds = embeds * jnp.asarray(cfg.text.embed_scale, cd)
         if pixel_values is not None:
             ps = cfg.vision.patch_size
             if image_sizes is None:
@@ -167,55 +181,27 @@ class Mistral3ForConditionalGeneration:
             idx = jnp.cumsum(mask) - 1
             flat = embeds.reshape(-1, embeds.shape[-1])
             take = feats[jnp.clip(idx, 0, feats.shape[0] - 1)].astype(flat.dtype)
+            # count mismatch (e.g. truncated image-token run) misaligns the
+            # row-major scatter → poison rather than train silently (same
+            # guard as gemma3_vl/model.py; HF raises, but counts are traced
+            # under jit)
+            count_ok = mask.sum() == feats.shape[0]
+            take = jnp.where(count_ok & (idx < feats.shape[0])[:, None], take, jnp.nan)
             embeds = jnp.where(mask[:, None], take, flat).reshape(embeds.shape)
-        # run the llama stack on the prepared embeddings via the embedding
-        # swap-in trick: temporarily replace the table lookup by providing
-        # inputs through a params copy is NOT possible (functional) — the
-        # llama forward_hidden embeds internally, so we inline its body here
-        from automodel_tpu.models.llama.model import (
-            _layer_sliding_window,
-            decoder_layer,
+        return text_forward_hidden(
+            cfg.text, self.backend, tp, input_ids,
+            position_ids=kw.get("position_ids"),
+            segment_ids=kw.get("segment_ids"),
+            constrain=constrain,
+            inputs_embeds=embeds,
         )
-        from automodel_tpu.ops.rope import rope_table
-
-        tcfg = cfg.text
-        B, S = input_ids.shape
-        position_ids = kw.get("position_ids")
-        if position_ids is None:
-            position_ids = jnp.broadcast_to(
-                jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
-            )
-        segment_ids = kw.get("segment_ids")
-        h = constrain(embeds, ("batch", "seq", None))
-        cos, sin = rope_table(position_ids, tcfg.rope_dim or tcfg.head_dim, tcfg.rope)
-
-        def maybe_remat(fn):
-            if self.backend.remat == "full":
-                return jax.checkpoint(
-                    fn, policy=jax.checkpoint_policies.nothing_saveable
-                )
-            if self.backend.remat == "selective":
-                return jax.checkpoint(
-                    fn,
-                    policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                )
-            return fn
-
-        def layer_fn(carry, lp):
-            return (
-                decoder_layer(
-                    tcfg, self.backend, carry, lp, cos, sin, segment_ids,
-                    constrain, _layer_sliding_window(tcfg, 0),
-                ),
-                None,
-            )
-
-        h, _ = jax.lax.scan(maybe_remat(layer_fn), h, tp["layers"])
-        return rms_norm(h, tp["final_norm"]["scale"], tcfg.rms_eps)
 
     def __call__(self, params: dict, input_ids: jnp.ndarray, **kw: Any):
         h = self.hidden(params, input_ids, **kw)
         logits = h @ self.lm_head(params).astype(h.dtype)
+        if self.config.logits_soft_cap is not None:
+            cap = self.config.logits_soft_cap
+            logits = cap * jnp.tanh(logits / cap)
         return logits
 
     def lm_head(self, params: dict) -> jnp.ndarray:
@@ -226,9 +212,4 @@ class Mistral3ForConditionalGeneration:
 
     @property
     def sharding_rules(self) -> list[tuple[str, tuple]]:
-        return [
-            (r"^vision/", ()),
-            (r"^projector/", ()),
-            *[(r"^text/" + pat.lstrip("^"), spec) for pat, spec in TEXT_RULES],
-            *TEXT_RULES,
-        ]
+        return [(r"^vision/", ()), (r"^projector/", ()), *TEXT_RULES]

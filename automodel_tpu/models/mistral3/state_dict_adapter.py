@@ -1,0 +1,125 @@
+"""HF ⇄ native adapter for Mistral3 (Mistral3ForConditionalGeneration).
+
+Text keys delegate to the llama-family adapter (the Mistral text stack IS
+the llama layout) with the ``model.`` → ``model.language_model.`` prefix
+rewrite and a ``("text", …)`` path prefix; the Pixtral tower and the
+multimodal projector map leaf-by-leaf. Parity target: reference
+components/models/mistral3 (which round-trips through the HF modules).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.llama.state_dict_adapter import LlamaStateDictAdapter
+from automodel_tpu.models.mistral3.model import Mistral3Config
+
+_V = "model.vision_tower"
+_P = "model.multi_modal_projector"
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+class Mistral3StateDictAdapter:
+    def __init__(self, config: Mistral3Config):
+        self.config = config
+        self.text_adapter = LlamaStateDictAdapter(config.text)
+
+    @staticmethod
+    def _to_vlm_key(k: str) -> str:
+        if k.startswith("model."):
+            return "model.language_model." + k[len("model."):]
+        return k  # lm_head.weight stays top-level
+
+    def _vision_plans(self) -> list[tuple[tuple[str, ...], str, bool]]:
+        """(native path under vision/layers, hf key template, transpose)."""
+        tmpl = _V + ".transformer.layers.{i}."
+        plans = [
+            (("attention_norm", "scale"), tmpl + "attention_norm.weight", False),
+            (("ffn_norm", "scale"), tmpl + "ffn_norm.weight", False),
+        ]
+        for m in ("q", "k", "v", "o"):
+            plans.append(
+                (("attn", f"{m}_proj", "kernel"), tmpl + f"attention.{m}_proj.weight", True)
+            )
+        for m in ("gate", "up", "down"):
+            plans.append(
+                (("mlp", f"{m}_proj", "kernel"), tmpl + f"feed_forward.{m}_proj.weight", True)
+            )
+        return plans
+
+    def _projector_plans(self) -> list[tuple[tuple[str, ...], str, bool]]:
+        plans = [
+            (("norm", "scale"), _P + ".norm.weight", False),
+            (("patch_merger", "kernel"), _P + ".patch_merger.merging_layer.weight", True),
+            (("linear_1", "kernel"), _P + ".linear_1.weight", True),
+            (("linear_2", "kernel"), _P + ".linear_2.weight", True),
+        ]
+        if self.config.multimodal_projector_bias:
+            plans += [
+                (("linear_1", "bias"), _P + ".linear_1.bias", False),
+                (("linear_2", "bias"), _P + ".linear_2.bias", False),
+            ]
+        return plans
+
+    def iter_from_hf(
+        self, get_tensor: Callable[[str], np.ndarray]
+    ) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        for path, val in self.text_adapter.iter_from_hf(
+            lambda k: get_tensor(self._to_vlm_key(k))
+        ):
+            yield ("text", *path), val
+
+        pc = get_tensor(_V + ".patch_conv.weight")  # [D, C, ps, ps]
+        yield (("vision", "patch_embed", "kernel"), _t(pc.reshape(pc.shape[0], -1)))
+        yield (("vision", "ln_pre", "scale"), get_tensor(_V + ".ln_pre.weight"))
+        for sub, tmpl, tr in self._vision_plans():
+            vals = [get_tensor(tmpl.format(i=i)) for i in range(self.config.vision.num_layers)]
+            yield (("vision", "layers", *sub), np.stack([_t(v) if tr else v for v in vals]))
+
+        for sub, key, tr in self._projector_plans():
+            v = get_tensor(key)
+            yield (("projector", *sub), _t(v) if tr else v)
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        for key, val in self.text_adapter.to_hf(params["text"]):
+            yield self._to_vlm_key(key), val
+
+        vis = params["vision"]
+        cfg = self.config.vision
+        pc = _t(np.asarray(vis["patch_embed"]["kernel"]))
+        yield (_V + ".patch_conv.weight",
+               pc.reshape(cfg.hidden_size, cfg.num_channels, cfg.patch_size, cfg.patch_size))
+        yield (_V + ".ln_pre.weight", np.asarray(vis["ln_pre"]["scale"]))
+
+        def leaf(tree, sub):
+            x = tree
+            for s in sub:
+                x = x[s]
+            return np.asarray(x)
+
+        for sub, tmpl, tr in self._vision_plans():
+            stacked = leaf(vis["layers"], sub)
+            for i in range(cfg.num_layers):
+                v = stacked[i]
+                yield tmpl.format(i=i), _t(v) if tr else v
+        for sub, key, tr in self._projector_plans():
+            v = leaf(params["projector"], sub)
+            yield key, _t(v) if tr else v
+
+    def hf_keys(self) -> list[str]:
+        keys = [self._to_vlm_key(k) for k in self.text_adapter.hf_keys()]
+        keys += [_V + ".patch_conv.weight", _V + ".ln_pre.weight"]
+        for sub, tmpl, _ in self._vision_plans():
+            keys += [tmpl.format(i=i) for i in range(self.config.vision.num_layers)]
+        keys += [k for _, k, _ in self._projector_plans()]
+        return keys

@@ -1,0 +1,185 @@
+"""Pixtral vision tower, TPU-native (mistral3's image encoder).
+
+Parity: HF ``PixtralVisionModel`` (modeling_pixtral.py) as consumed by
+Mistral3ForConditionalGeneration — stride=patch conv patch embed (≡ one MXU
+GEMM over flattened patches), RMS ``ln_pre``, llama-style pre-RMSNorm blocks
+with SwiGLU feed-forward and NO projection biases, 2-D rotary whose
+frequency table interleaves row freqs (even channels) and column freqs (odd
+channels), and per-image block-diagonal bidirectional attention
+(generate_block_attention_mask ≡ segment ids here). Reference:
+components/models/mistral3/model.py (which wraps the same HF tower).
+
+TPU notes: patch grids are STATIC (python tuples), so positions/segment ids
+are numpy; blocks run as one ``lax.scan`` over stacked params; attention is
+plain sdpa — vision sequences are ≤ a few thousand patches, XLA fuses the
+O(P²) path onto the MXU without a flash kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.llama.model import ACT_FNS, _dense_init
+from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import apply_rope
+
+
+@dataclasses.dataclass(frozen=True)
+class PixtralVisionConfig:
+    hidden_size: int = 32
+    intermediate_size: int = 64
+    num_layers: int = 2
+    num_heads: int = 2
+    image_size: int = 64
+    patch_size: int = 16
+    num_channels: int = 3
+    rope_theta: float = 10_000.0
+    hidden_act: str = "gelu"  # HF PixtralVisionConfig default
+    rms_eps: float = 1e-5  # PixtralAttentionLayer hardcodes eps=1e-5
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "PixtralVisionConfig":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        return cls(
+            hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            image_size=get("image_size"),
+            patch_size=get("patch_size"),
+            num_channels=get("num_channels", 3),
+            rope_theta=get("rope_theta", 10_000.0),
+            hidden_act=get("hidden_act", "gelu"),
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.num_channels * self.patch_size**2
+
+    @property
+    def max_patches_per_side(self) -> int:
+        return self.image_size // self.patch_size
+
+
+def init_vision_params(cfg: PixtralVisionConfig, backend: BackendConfig, key) -> dict:
+    pd = backend.param_jnp_dtype
+    D, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    ks = jax.random.split(key, 8)
+
+    def stack(k, shape):
+        return _dense_init(k, (L, *shape), pd, in_axis=1)
+
+    return {
+        "patch_embed": {"kernel": _dense_init(ks[0], (cfg.patch_dim, D), pd)},
+        "ln_pre": {"scale": jnp.ones((D,), pd)},
+        "layers": {
+            "attention_norm": {"scale": jnp.ones((L, D), pd)},
+            "ffn_norm": {"scale": jnp.ones((L, D), pd)},
+            "attn": {
+                "q_proj": {"kernel": stack(ks[1], (D, D))},
+                "k_proj": {"kernel": stack(ks[2], (D, D))},
+                "v_proj": {"kernel": stack(ks[3], (D, D))},
+                "o_proj": {"kernel": stack(ks[4], (D, D))},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": stack(ks[5], (D, I))},
+                "up_proj": {"kernel": stack(ks[6], (D, I))},
+                "down_proj": {"kernel": stack(ks[7], (I, D))},
+            },
+        },
+    }
+
+
+def _extract_patches(cfg: PixtralVisionConfig, pixel_values: jnp.ndarray,
+                     grid_hw) -> jnp.ndarray:
+    """Images → [P_total, patch_dim] with feature order [C, pi, pj] (the
+    flattened conv kernel's layout), patches row-major per image.
+
+    Accepts [N, C, H, W] raw images (cropped per image to grid_hw, like HF's
+    ``patch_embeds[..., :h, :w]``) or the torch-unfold layout
+    [N, C·ps², P_img].
+    """
+    ps = cfg.patch_size
+    if pixel_values.ndim == 3:  # already unfolded, full grid per image
+        return jnp.swapaxes(pixel_values, 1, 2).reshape(-1, pixel_values.shape[1])
+    n, c, H, W = pixel_values.shape
+    gh, gw = H // ps, W // ps
+    x = pixel_values.reshape(n, c, gh, ps, gw, ps)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(n, gh, gw, c * ps * ps)
+    outs = []
+    for i, (h, w) in enumerate(grid_hw):
+        outs.append(x[i, :h, :w].reshape(h * w, -1))
+    return jnp.concatenate(outs, axis=0)
+
+
+def _rope_tables(cfg: PixtralVisionConfig, grid_hw, dtype) -> tuple:
+    """cos/sin [1, P_total, head_dim] — HF PixtralRotaryEmbedding: channel
+    2j rotates with row·freq[2j], channel 2j+1 with col·freq[2j+1] (even
+    inv-freq indices are row frequencies, odd are column frequencies)."""
+    hd = cfg.head_dim
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(0, hd, 2) / hd))  # [hd/2]
+    rows, cols = [], []
+    for h, w in grid_hw:
+        rr, cc = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        rows.append(rr.ravel())
+        cols.append(cc.ravel())
+    rows = np.concatenate(rows)[:, None]  # [P, 1]
+    cols = np.concatenate(cols)[:, None]
+    half = np.concatenate([rows * inv[None, ::2], cols * inv[None, 1::2]], axis=1)
+    emb = np.concatenate([half, half], axis=1)  # [P, hd]
+    return (
+        jnp.asarray(np.cos(emb), dtype)[None],
+        jnp.asarray(np.sin(emb), dtype)[None],
+    )
+
+
+def vision_tower(
+    cfg: PixtralVisionConfig,
+    backend: BackendConfig,
+    params: dict,
+    pixel_values: jnp.ndarray,
+    grid_hw,  # static tuple of (h_patches, w_patches) per image
+) -> jnp.ndarray:
+    """→ last hidden state [P_total, hidden_size]."""
+    cd = backend.compute_jnp_dtype
+    act = ACT_FNS[cfg.hidden_act]
+    eps = cfg.rms_eps
+    N, H = cfg.num_heads, cfg.head_dim
+
+    x = _extract_patches(cfg, pixel_values.astype(cd), grid_hw)
+    x = x @ params["patch_embed"]["kernel"].astype(cd)
+    x = rms_norm(x, params["ln_pre"]["scale"], eps)
+
+    cos, sin = _rope_tables(cfg, grid_hw, jnp.float32)
+    seg = np.repeat(np.arange(len(grid_hw)), [h * w for h, w in grid_hw])
+    seg = jnp.asarray(seg.astype(np.int32))[None]  # [1, P]
+    P = x.shape[0]
+
+    def layer_fn(h, lp):
+        y = rms_norm(h, lp["attention_norm"]["scale"], eps)
+        q = (y @ lp["attn"]["q_proj"]["kernel"].astype(cd)).reshape(1, P, N, H)
+        k = (y @ lp["attn"]["k_proj"]["kernel"].astype(cd)).reshape(1, P, N, H)
+        v = (y @ lp["attn"]["v_proj"]["kernel"].astype(cd)).reshape(1, P, N, H)
+        q, k = apply_rope(q, k, cos, sin)
+        attn = sdpa(q, k, v, causal=False, segment_ids=seg).reshape(1, P, N * H)
+        h = h + (attn @ lp["attn"]["o_proj"]["kernel"].astype(cd))[0]
+        y = rms_norm(h, lp["ffn_norm"]["scale"], eps)
+        g = act(y @ lp["mlp"]["gate_proj"]["kernel"].astype(cd))
+        u = y @ lp["mlp"]["up_proj"]["kernel"].astype(cd)
+        return h + (g * u) @ lp["mlp"]["down_proj"]["kernel"].astype(cd), None
+
+    h, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    return h

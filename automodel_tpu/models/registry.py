@@ -158,6 +158,18 @@ def _qwen3_vl_moe_builder(hf_config: Any, backend: BackendConfig):
     )
 
 
+@register_architecture("Mistral3ForConditionalGeneration")
+def _mistral3_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.mistral3 import (
+        Mistral3Config,
+        Mistral3ForConditionalGeneration,
+        Mistral3StateDictAdapter,
+    )
+
+    cfg = Mistral3Config.from_hf(hf_config)
+    return Mistral3ForConditionalGeneration(cfg, backend), Mistral3StateDictAdapter(cfg)
+
+
 @register_architecture("DeepseekV32ForCausalLM")
 def _deepseek_v32_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.deepseek_v32 import (
