@@ -89,12 +89,14 @@ class GemmaConfig(TransformerConfig):
             tie_embeddings=bool(get("tie_word_embeddings", True)),
             # legacy gemma-1 configs say hidden_act="gelu" but HF deliberately
             # runs the tanh approximation regardless (the gemma activation
-            # fix); ACT_FNS["gelu"] is now exact-erf, so remap here
+            # fix); ACT_FNS["gelu"] is now exact-erf, so remap here. NB:
+            # transformers GemmaConfig carries an EXPLICIT hidden_activation
+            # of None — `or` (not a get default) must do the fallthrough.
             act=(
                 "gelu_pytorch_tanh"
-                if get("hidden_activation", get("hidden_act", "gelu_pytorch_tanh"))
+                if (get("hidden_activation") or get("hidden_act") or "gelu_pytorch_tanh")
                 in ("gelu", "gelu_pytorch_tanh")
-                else get("hidden_activation", get("hidden_act"))
+                else (get("hidden_activation") or get("hidden_act"))
             ),
         )
         return cls(**fields)

@@ -163,14 +163,13 @@ class Gemma3VLForConditionalGeneration:
             feats_at = img_flat[jnp.clip(idx, 0, img_flat.shape[0] - 1)]
             # any count mismatch (excess OR missing image tokens — e.g. a
             # truncated image run) misaligns the row-major scatter, so poison
-            # ALL image features, not just the out-of-range tail
+            # GLOBALLY: a row-level poison selects no rows when zero image
+            # tokens survive and the images would drop silently
             count_ok = mask.sum() == img_flat.shape[0]
-            feats_at = jnp.where(
-                count_ok & (idx < img_flat.shape[0])[:, None], feats_at, jnp.nan
-            )
             h = jnp.where(
                 mask[:, None], feats_at, h.reshape(B * S, -1)
             ).reshape(B, S, -1)
+            h = h * jnp.where(count_ok, 1.0, jnp.nan).astype(h.dtype)
             groups = image_group_ids(input_ids, cfg.image_token_id)
         return forward_hidden(
             cfg.text, self.backend, tp, input_ids,

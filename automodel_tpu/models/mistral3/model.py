@@ -182,12 +182,13 @@ class Mistral3ForConditionalGeneration:
             flat = embeds.reshape(-1, embeds.shape[-1])
             take = feats[jnp.clip(idx, 0, feats.shape[0] - 1)].astype(flat.dtype)
             # count mismatch (e.g. truncated image-token run) misaligns the
-            # row-major scatter → poison rather than train silently (same
-            # guard as gemma3_vl/model.py; HF raises, but counts are traced
-            # under jit)
+            # row-major scatter → poison rather than train silently (HF
+            # raises, but counts are traced under jit). The poison is GLOBAL:
+            # with zero surviving image tokens a row-level poison would
+            # select no rows and the images would drop silently.
             count_ok = mask.sum() == feats.shape[0]
-            take = jnp.where(count_ok & (idx < feats.shape[0])[:, None], take, jnp.nan)
             embeds = jnp.where(mask[:, None], take, flat).reshape(embeds.shape)
+            embeds = embeds * jnp.where(count_ok, 1.0, jnp.nan).astype(embeds.dtype)
         return text_forward_hidden(
             cfg.text, self.backend, tp, input_ids,
             position_ids=kw.get("position_ids"),

@@ -131,6 +131,74 @@ def gspmd_experts(
     return out.astype(x.dtype)
 
 
+def _float0_zero(a: jnp.ndarray):
+    import numpy as np
+
+    return np.zeros(a.shape, jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _dispatch_take(x, order, inv, K):
+    """xs[p] = x[order[p] // K] with a gather-only VJP.
+
+    Autodiff's VJP of this gather is a scatter-add onto [T, D] — the single
+    most expensive op in the old MoE step (XLA scatter runs ~4x slower than
+    a gather at bench shape, PROFILE_MOE_r04.md). Because ``order`` is a
+    bijection over the T·K picks, dx[t] = Σ_k dxs[inv[t·K+k]] is a pure
+    gather + K-fold dense sum instead. order/inv are explicit args (not a
+    closure) so the function stays remat/checkpoint-safe."""
+    return jnp.take(x, order // K, axis=0)
+
+
+def _dispatch_take_fwd(x, order, inv, K):
+    return _dispatch_take(x, order, inv, K), (order, inv, x.shape[0])
+
+
+def _dispatch_take_bwd(K, res, dxs):
+    order, inv, T = res
+    dx = jnp.take(dxs, inv, axis=0).reshape(T, K, dxs.shape[-1]).sum(axis=1)
+    return dx, _float0_zero(order), _float0_zero(inv)
+
+
+_dispatch_take.defvjp(_dispatch_take_fwd, _dispatch_take_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _sorted_combine(ys, w, order, inv, K):
+    """out[t] = Σ_k w[t,k] · ys[inv[t·K+k]] → [T, D] fp32.
+
+    Replaces the fp32 ``.at[token_of].add`` scatter combine (~5ms/layer at
+    bench shape) with an unsort GATHER + dense weighted K-fold sum (~1.5ms);
+    the hand-written VJP keeps the backward scatter-free too (d_ys is a
+    gather of dout rows scaled by the pick weight)."""
+    T, D = w.shape[0], ys.shape[-1]
+    yu = jnp.take(ys, inv, axis=0).reshape(T, K, D)
+    return jnp.einsum(
+        "tkd,tk->td", yu, w.astype(yu.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _sorted_combine_fwd(ys, w, order, inv, K):
+    return _sorted_combine(ys, w, order, inv, K), (ys, w, order, inv)
+
+
+def _sorted_combine_bwd(K, res, dout):
+    ys, w, order, inv = res
+    T, D = w.shape[0], ys.shape[-1]
+    # pick p came from token order[p]//K with weight wflat[order[p]]
+    dys = (
+        jnp.take(dout, order // K, axis=0)
+        * jnp.take(w.reshape(-1), order)[:, None].astype(dout.dtype)
+    ).astype(ys.dtype)
+    yu = jnp.take(ys, inv, axis=0).reshape(T, K, D)
+    dw = jnp.einsum("td,tkd->tk", dout, yu.astype(dout.dtype)).astype(w.dtype)
+    return dys, dw, _float0_zero(order), _float0_zero(inv)
+
+
+_sorted_combine.defvjp(_sorted_combine_fwd, _sorted_combine_bwd)
+
+
 def ragged_experts(
     x: jnp.ndarray,  # [T, D]
     gate_out: GateOutput,
@@ -142,6 +210,11 @@ def ragged_experts(
 ) -> jnp.ndarray:
     """Dropless sort + ragged_dot grouped matmul (single-slice hot path).
 
+    Dispatch and combine are expressed as permutation GATHERS with custom
+    VJPs (no XLA scatter anywhere in fwd or bwd — see PROFILE_MOE_r04.md for
+    why); group sizes reuse the gate's expert_counts (an exact bincount of
+    topk_idx, moe/gate.py).
+
     ``fp8``: e4m3 QDQ on both grouped-matmul operands — 128×128 blockwise
     scales on the expert weights, per-tensor dynamic on activations, STE
     grads (reference GroupedExpertsFP8, components/moe/experts.py:478)."""
@@ -149,10 +222,10 @@ def ragged_experts(
     E, K = cfg.num_experts, cfg.num_experts_per_tok
     flat_expert = gate_out.topk_idx.reshape(-1)  # [T*K]
     order = jnp.argsort(flat_expert)  # stable
-    token_of = order // K
-    xs = x[token_of]  # [T*K, D] sorted by expert
-    group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+    inv = jnp.argsort(order)  # sorted position of pick (t, k)
+    group_sizes = gate_out.expert_counts.astype(jnp.int32)
     sorted_expert = flat_expert[order]
+    xs = _dispatch_take(x, order, inv, K)  # [T*K, D] sorted by expert
 
     w_gu = weights["gate_up"].astype(xs.dtype)
     w_dn = weights["down"].astype(xs.dtype)
@@ -171,9 +244,7 @@ def ragged_experts(
     if "down_bias" in weights:
         ys = ys + weights["down_bias"].astype(xs.dtype)[sorted_expert]
 
-    wflat = gate_out.topk_weights.reshape(-1)[order]  # aligned with ys
-    out = jnp.zeros((T, D), jnp.float32)
-    out = out.at[token_of].add(ys.astype(jnp.float32) * wflat[:, None].astype(jnp.float32))
+    out = _sorted_combine(ys, gate_out.topk_weights, order, inv, K)
     return out.astype(x.dtype)
 
 

@@ -33,22 +33,38 @@ REPS = int(os.environ.get("PROFILE_REPS", 32))
 
 
 def timed(name, fn, c0, *args, flops=0.0, bytes_moved=0.0, reps=REPS):
-    """fn: (carry, *args) -> carry. The carry must flow through the op."""
+    """fn: (carry, *args) -> carry. The carry must flow through the op.
 
-    @jax.jit
-    def loop(c, args):
-        def body(c, _):
-            return fn(c, *args), None
+    Per-iteration time comes from the SLOPE between a short and a long loop
+    ((t_4r - t_r)/3r): each jitted call pays ~120ms of tunnel RPC latency
+    (dispatch + device_get) which a single-loop timing would smear into the
+    per-iter number; the slope cancels it."""
 
-        c, _ = jax.lax.scan(body, c, None, length=reps)
-        return c
+    def make(n):
+        @jax.jit
+        def loop(c, args):
+            def body(c, _):
+                return fn(c, *args), None
 
-    out = loop(c0, args)
-    jax.block_until_ready(jax.device_get(jax.tree.leaves(out)[0].ravel()[0]))
+            c, _ = jax.lax.scan(body, c, None, length=n)
+            return c
+
+        return loop
+
+    loop_s, loop_l = make(reps), make(4 * reps)
+
+    def run(loop):
+        out = loop(c0, args)
+        jax.block_until_ready(jax.device_get(jax.tree.leaves(out)[0].ravel()[0]))
+
+    run(loop_s)  # compile
+    run(loop_l)
     t0 = time.perf_counter()
-    out = loop(c0, args)
-    jax.block_until_ready(jax.device_get(jax.tree.leaves(out)[0].ravel()[0]))
-    dt = (time.perf_counter() - t0) / reps
+    run(loop_s)
+    t1 = time.perf_counter()
+    run(loop_l)
+    t2 = time.perf_counter()
+    dt = ((t2 - t1) - (t1 - t0)) / (3 * reps)
     line = f"{name:<36} {dt*1e3:8.2f} ms"
     if flops:
         line += f"  {flops/dt/1e12:7.1f} TFLOP/s"
