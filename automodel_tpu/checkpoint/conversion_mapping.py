@@ -115,6 +115,12 @@ class RemappedReader:
 # rename to the canonical qwen3-moe-style keys the MoE adapter reads
 MIXTRAL_RENAMES = (
     Rename(r"^(.*\.)block_sparse_moe\.gate\.weight$", r"\1mlp.gate.weight"),
+    # MiniMax-M2 keeps the mixtral block layout and adds the DeepSeek-style
+    # aux-free correction bias on the router
+    Rename(
+        r"^(.*\.)block_sparse_moe\.gate\.e_score_correction_bias$",
+        r"\1mlp.gate.e_score_correction_bias",
+    ),
     Rename(
         r"^(.*\.)block_sparse_moe\.experts\.(\d+)\.w1\.weight$",
         r"\1mlp.experts.\2.gate_proj.weight",

@@ -106,6 +106,9 @@ class TransformerConfig:
     attention_bias: bool = False
     mlp_bias: bool = False
     qk_norm: bool = False  # qwen3-style per-head RMSNorm on q/k
+    # MiniMax-M2 style: RMSNorm over the FLATTENED q/k projection dims
+    # (num_heads*head_dim) before the head reshape, instead of per-head
+    qk_norm_flat: bool = False
     act: str = "silu"
     embed_scale: float = 1.0  # gemma multiplies embeddings by sqrt(hidden)
     logits_soft_cap: Optional[float] = None

@@ -79,8 +79,10 @@ def init_params(cfg: TransformerConfig, backend: BackendConfig, key: jax.Array) 
         layers["mlp"]["up_proj"]["bias"] = jnp.zeros((L, I), pd)
         layers["mlp"]["down_proj"]["bias"] = jnp.zeros((L, D), pd)
     if cfg.qk_norm:
-        layers["attn"]["q_norm"] = {"scale": jnp.ones((L, cfg.head_dim), pd)}
-        layers["attn"]["k_norm"] = {"scale": jnp.ones((L, cfg.head_dim), pd)}
+        qd = cfg.q_dim if cfg.qk_norm_flat else cfg.head_dim
+        kd = cfg.kv_dim if cfg.qk_norm_flat else cfg.head_dim
+        layers["attn"]["q_norm"] = {"scale": jnp.ones((L, qd), pd)}
+        layers["attn"]["k_norm"] = {"scale": jnp.ones((L, kd), pd)}
     params = {
         "embed": {"embedding": jax.random.normal(keys[7], (cfg.vocab_size, D)).astype(pd) * 0.02},
         "layers": layers,
@@ -91,10 +93,22 @@ def init_params(cfg: TransformerConfig, backend: BackendConfig, key: jax.Array) 
     return params
 
 
+def _maybe_nf4(kernel):
+    """NF4-packed kernels (QLoRA bound base) dequantize HERE — inside the
+    layer scan body — so only ONE layer's bf16 weights exist at a time; a
+    dequant at the loss top would materialize the whole stack (15.3GB for
+    8B). quantization/qlora.py packs stacked leaves per layer for this."""
+    if isinstance(kernel, dict) and "codes" in kernel:
+        from automodel_tpu.quantization.qlora import nf4_dequantize
+
+        return nf4_dequantize(kernel)
+    return kernel
+
+
 def _proj(x: jnp.ndarray, p: dict, fp8: bool = False) -> jnp.ndarray:
     from automodel_tpu.ops import fp8 as _fp8
 
-    y = _fp8.maybe_fp8_dot(x, p["kernel"], fp8)
+    y = _fp8.maybe_fp8_dot(x, _maybe_nf4(p["kernel"]), fp8)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     if "lora_A" in p:
@@ -129,10 +143,16 @@ def attention_block(
     """Pre-norm attention + residual; shared across dense and MoE families."""
     B, S, D = h.shape
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
-    q = _proj(x, lp["attn"]["q_proj"], backend.fp8).reshape(B, S, cfg.num_heads, cfg.head_dim)
-    k = _proj(x, lp["attn"]["k_proj"], backend.fp8).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    q = _proj(x, lp["attn"]["q_proj"], backend.fp8)
+    k = _proj(x, lp["attn"]["k_proj"], backend.fp8)
     v = _proj(x, lp["attn"]["v_proj"], backend.fp8).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-    if cfg.qk_norm:
+    if cfg.qk_norm and cfg.qk_norm_flat:
+        # MiniMax-M2: RMSNorm over flattened projection dims pre-reshape
+        q = rms_norm(q, lp["attn"]["q_norm"]["scale"], cfg.rms_eps)
+        k = rms_norm(k, lp["attn"]["k_norm"]["scale"], cfg.rms_eps)
+    q = q.reshape(B, S, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm and not cfg.qk_norm_flat:
         q = rms_norm(q, lp["attn"]["q_norm"]["scale"], cfg.rms_eps)
         k = rms_norm(k, lp["attn"]["k_norm"]["scale"], cfg.rms_eps)
     q, k = apply_rope(q, k, cos, sin)
@@ -243,7 +263,7 @@ def forward_hidden(
 def lm_head_kernel(cfg: TransformerConfig, params: dict) -> jnp.ndarray:
     if cfg.tie_embeddings:
         return params["embed"]["embedding"].T
-    return params["lm_head"]["kernel"]
+    return _maybe_nf4(params["lm_head"]["kernel"])
 
 
 def forward(
@@ -285,6 +305,11 @@ SHARDING_RULES: list[tuple[str, tuple]] = [
 
 @dataclasses.dataclass
 class LlamaForCausalLM:
+    """supports_packed_nf4: every kernel this family consumes flows through
+    _proj/lm_head_kernel, which dequantize NF4-packed dicts per layer inside
+    the scan (QLoRA without materializing the full-precision stack)."""
+
+    supports_packed_nf4 = True
     """Bundled config + backend with the functional API underneath."""
 
     config: TransformerConfig

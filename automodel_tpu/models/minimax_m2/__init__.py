@@ -1,0 +1,6 @@
+from automodel_tpu.models.minimax_m2.model import (
+    MiniMaxM2Config,
+    MiniMaxM2ForCausalLM,
+)
+
+__all__ = ["MiniMaxM2Config", "MiniMaxM2ForCausalLM"]

@@ -1,0 +1,74 @@
+"""MiniMax-M2, TPU-native.
+
+Parity: reference components/models/minimax_m2/{model,layers}.py — a
+llama-layout MoE decoder whose distinctive features are all config, not new
+machinery:
+
+- attention with optional RMSNorm over the FLATTENED q/k projection dims
+  (reference layers.py:71-84: "HF MiniMax applies RMSNorm over flattened
+  q/k projection dims before head reshape") → ``qk_norm_flat``;
+- partial rotary via ``rope_parameters.partial_rotary_factor``
+  (model.py:125-135; at scaling_factor 1.0 the reference's yarn-style
+  RotaryEmbedding reduces to plain RoPE);
+- sigmoid-scored router with an ALWAYS-present e_score_correction_bias
+  (model.py:88-107: force_e_score_correction_bias=True), top-k weight
+  normalization, no shared experts, swiglu experts whose width is
+  ``intermediate_size`` and count ``num_local_experts``.
+
+The block/forward machinery is the shared MoE family (qwen3_moe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.qwen3_moe.model import (
+    MoEForCausalLM,
+    MoETransformerConfig,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MiniMaxM2Config(MoETransformerConfig):
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "MiniMaxM2Config":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        base = MoETransformerConfig.from_hf(hf_cfg)
+        score = str(get("scoring_func", "sigmoid")).lower()
+        score = "softmax" if score == "softmax" else "sigmoid"
+        moe = dataclasses.replace(
+            base.moe,
+            score_func=score,
+            softmax_before_topk=score == "softmax",
+            # reference forces the aux-free correction bias regardless of
+            # topk_method (model.py:106 force_e_score_correction_bias=True)
+            expert_bias=True,
+            bias_update_factor=0.001,
+            norm_topk_prob=True,
+            num_shared_experts=0,
+            shared_expert_gate=False,
+        )
+        rp = get("rope_parameters") or {}
+        prf = (
+            rp.get("partial_rotary_factor", 1.0)
+            if isinstance(rp, dict)
+            else get("partial_rotary_factor", 1.0)
+        )
+        fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
+        fields.update(
+            moe=moe,
+            qk_norm=bool(get("use_qk_norm", False)),
+            qk_norm_flat=bool(get("use_qk_norm", False)),
+            partial_rotary_factor=float(prf or 1.0),
+        )
+        return cls(**fields)
+
+
+@dataclasses.dataclass
+class MiniMaxM2ForCausalLM(MoEForCausalLM):
+    config: MiniMaxM2Config = None
+    backend: BackendConfig = BackendConfig()

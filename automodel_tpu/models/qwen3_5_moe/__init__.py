@@ -1,0 +1,13 @@
+from automodel_tpu.models.qwen3_5_moe.model import (
+    Qwen3_5MoeConfig,
+    Qwen3_5MoeForConditionalGeneration,
+)
+from automodel_tpu.models.qwen3_5_moe.state_dict_adapter import (
+    Qwen3_5MoeStateDictAdapter,
+)
+
+__all__ = [
+    "Qwen3_5MoeConfig",
+    "Qwen3_5MoeForConditionalGeneration",
+    "Qwen3_5MoeStateDictAdapter",
+]

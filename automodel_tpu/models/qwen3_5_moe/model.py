@@ -1,0 +1,100 @@
+"""Qwen3.5-MoE (text decoder of Qwen3_5MoeForConditionalGeneration), TPU-native.
+
+Parity: reference components/models/qwen3_5_moe/model.py — the Qwen3-Next
+hybrid block VERBATIM (linear-attention gated DeltaNet + gated full
+attention, MoE with one sigmoid-gated shared expert on every layer,
+zero-centered norms) with exactly two deltas:
+
+- the GatedDeltaNet uses SEPARATE input projections ``in_proj_qkv`` /
+  ``in_proj_z`` / ``in_proj_b`` / ``in_proj_a`` instead of Qwen3-Next's
+  fused ``in_proj_qkvz``/``in_proj_ba`` (reference model.py:75-82); the qkv
+  projection keeps the per-k-head grouping, z/b/a are flat per v-head;
+- HF config nests the text fields under ``text_config`` (the top-level
+  Qwen3_5MoeConfig is a VL composite).
+
+The vision tower is NOT part of this backend (the reference's backend also
+delegates vision to stock HF modules, model.py:178-193); passing
+``pixel_values`` raises. M-RoPE with uniform text positions reduces exactly
+to standard RoPE, so text training uses the inherited rope path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.llama.model import _dense_init
+from automodel_tpu.models.qwen3_next.model import (
+    SHARDING_RULES as NEXT_RULES,
+    Qwen3NextConfig,
+    Qwen3NextForCausalLM,
+    init_params as init_next_params,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Qwen3_5MoeConfig(Qwen3NextConfig):
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "Qwen3_5MoeConfig":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        text = get("text_config") or hf_cfg
+        base = Qwen3NextConfig.from_hf(text)
+        fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
+        return cls(**fields)
+
+
+def init_params(cfg: Qwen3_5MoeConfig, backend: BackendConfig, key: jax.Array) -> dict:
+    """Qwen3-Next init with the fused DeltaNet inputs replaced by the four
+    split projections (same total parameter count)."""
+    params = init_next_params(cfg, backend, key)
+    pd = backend.param_jnp_dtype
+    D, Ll = cfg.hidden_size, cfg.n_linear
+    nv = cfg.linear_num_value_heads
+    ks = jax.random.split(jax.random.fold_in(key, 35), 4)
+
+    def stack(k, shape):
+        return _dense_init(k, (Ll, *shape), pd, in_axis=1)
+
+    la = params["linear_attn"]
+    del la["in_qkvz"], la["in_ba"]
+    la["in_qkv"] = {"kernel": stack(ks[0], (D, 2 * cfg.key_dim + cfg.value_dim))}
+    la["in_z"] = {"kernel": stack(ks[1], (D, cfg.value_dim))}
+    la["in_b"] = {"kernel": stack(ks[2], (D, nv))}
+    la["in_a"] = {"kernel": stack(ks[3], (D, nv))}
+    return params
+
+
+SHARDING_RULES: list[tuple[str, tuple]] = [
+    (r"linear_attn/in_qkv/kernel$", (None, "fsdp", "tensor")),
+    (r"linear_attn/in_z/kernel$", (None, "fsdp", "tensor")),
+    (r"linear_attn/in_[ba]/kernel$", (None, "fsdp", None)),
+    *[r for r in NEXT_RULES if "in_qkvz" not in r[0] and "in_ba" not in r[0]],
+]
+
+
+@dataclasses.dataclass
+class Qwen3_5MoeForConditionalGeneration(Qwen3NextForCausalLM):
+    config: Qwen3_5MoeConfig = None
+
+    def init(self, key: jax.Array) -> dict:
+        return init_params(self.config, self.backend, key)
+
+    def hidden(self, params, input_ids, **kw):
+        if kw.pop("pixel_values", None) is not None:
+            raise NotImplementedError(
+                "qwen3_5_moe backend is text-only (the reference backend "
+                "delegates vision to stock HF modules, which do not exist "
+                "here); train the LM on pre-embedded multimodal data or use "
+                "qwen3_vl_moe for the VL path"
+            )
+        return super().hidden(params, input_ids, **kw)
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return SHARDING_RULES

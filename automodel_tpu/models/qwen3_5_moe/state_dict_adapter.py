@@ -1,0 +1,171 @@
+"""HF ⇄ native adapter for Qwen3.5-MoE.
+
+Parity target: reference components/models/qwen3_5_moe/state_dict_adapter.py.
+HF layout facts encoded there: keys live under ``model.language_model.``;
+experts are AGGREGATED 3-D tensors ``mlp.experts.gate_up_proj
+[E, 2I, D]`` / ``mlp.experts.down_proj [E, D, I]`` (transposed vs the
+x @ W layout → transpose(1, 2) both ways); the shared expert is
+``mlp.shared_expert.*`` (singular); the DeltaNet ships the four SPLIT
+projections; vision keys pass through untouched (text-only backend).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.qwen3_5_moe.model import Qwen3_5MoeConfig
+from automodel_tpu.models.qwen3_next.state_dict_adapter import (
+    Qwen3NextStateDictAdapter,
+    _t,
+)
+
+_P = "model.language_model"
+
+
+class Qwen3_5MoeStateDictAdapter(Qwen3NextStateDictAdapter):
+    def __init__(self, config: Qwen3_5MoeConfig):
+        super().__init__(config)
+
+    # split DeltaNet projections (reference model.py:75-82)
+    _LINEAR = [
+        (("in_qkv", "kernel"), "linear_attn.in_proj_qkv.weight", True),
+        (("in_z", "kernel"), "linear_attn.in_proj_z.weight", True),
+        (("in_b", "kernel"), "linear_attn.in_proj_b.weight", True),
+        (("in_a", "kernel"), "linear_attn.in_proj_a.weight", True),
+        (("dt_bias",), "linear_attn.dt_bias", False),
+        (("A_log",), "linear_attn.A_log", False),
+        (("norm", "scale"), "linear_attn.norm.weight", False),
+        (("out_proj", "kernel"), "linear_attn.out_proj.weight", True),
+    ]
+
+    def iter_from_hf(self, get_tensor: Callable[[str], np.ndarray]):
+        c = self.config
+        L = c.num_layers
+
+        def lg(k: str) -> np.ndarray:
+            return get_tensor(f"{_P}.{k}")
+
+        yield ("embed", "embedding"), lg("embed_tokens.weight")
+        yield ("final_norm", "scale"), lg("norm.weight")
+        if not c.tie_embeddings:
+            yield ("lm_head", "kernel"), _t(get_tensor("lm_head.weight"))
+
+        for name, hf in [("input_norm", "input_layernorm"),
+                         ("post_attn_norm", "post_attention_layernorm")]:
+            yield ("layers", name, "scale"), np.stack(
+                [lg(f"layers.{i}.{hf}.weight") for i in range(L)], 0
+            )
+
+        yield ("layers", "moe", "router", "weight"), np.stack(
+            [_t(lg(f"layers.{i}.mlp.gate.weight")) for i in range(L)], 0
+        )
+        # aggregated expert tensors: [E, 2I, D] / [E, D, I] → transpose(1, 2)
+        yield ("layers", "moe", "experts", "gate_up"), np.stack(
+            [lg(f"layers.{i}.mlp.experts.gate_up_proj").transpose(0, 2, 1)
+             for i in range(L)], 0
+        )
+        yield ("layers", "moe", "experts", "down"), np.stack(
+            [lg(f"layers.{i}.mlp.experts.down_proj").transpose(0, 2, 1)
+             for i in range(L)], 0
+        )
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            yield ("layers", "moe", "shared", name, "kernel"), np.stack(
+                [_t(lg(f"layers.{i}.mlp.shared_expert.{name}.weight"))
+                 for i in range(L)], 0
+            )
+        yield ("layers", "moe", "shared_gate", "kernel"), np.stack(
+            [_t(lg(f"layers.{i}.mlp.shared_expert_gate.weight"))
+             for i in range(L)], 0
+        )
+
+        for path, suffix, tr in self._FULL:
+            rows = [lg(f"layers.{i}.{suffix}") for i in self.full_ids]
+            yield ("full_attn", *path), np.stack(
+                [_t(r) if tr else r for r in rows], 0
+            )
+        for path, suffix, tr in self._LINEAR:
+            rows = [lg(f"layers.{i}.{suffix}") for i in self.linear_ids]
+            yield ("linear_attn", *path), np.stack(
+                [_t(r) if tr else r for r in rows], 0
+            )
+        yield ("linear_attn", "conv", "weight"), np.stack(
+            [lg(f"layers.{i}.linear_attn.conv1d.weight")[:, 0, :]
+             for i in self.linear_ids], 0
+        )
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        c = self.config
+        L = c.num_layers
+        yield f"{_P}.embed_tokens.weight", np.asarray(params["embed"]["embedding"])
+        yield f"{_P}.norm.weight", np.asarray(params["final_norm"]["scale"])
+        if not c.tie_embeddings:
+            yield "lm_head.weight", _t(np.asarray(params["lm_head"]["kernel"]))
+        for name, hf in [("input_norm", "input_layernorm"),
+                         ("post_attn_norm", "post_attention_layernorm")]:
+            leaf = np.asarray(params["layers"][name]["scale"])
+            for i in range(L):
+                yield f"{_P}.layers.{i}.{hf}.weight", leaf[i]
+        router = np.asarray(params["layers"]["moe"]["router"]["weight"])
+        gu = np.asarray(params["layers"]["moe"]["experts"]["gate_up"])
+        dn = np.asarray(params["layers"]["moe"]["experts"]["down"])
+        for i in range(L):
+            yield f"{_P}.layers.{i}.mlp.gate.weight", _t(router[i])
+            yield (f"{_P}.layers.{i}.mlp.experts.gate_up_proj",
+                   np.ascontiguousarray(gu[i].transpose(0, 2, 1)))
+            yield (f"{_P}.layers.{i}.mlp.experts.down_proj",
+                   np.ascontiguousarray(dn[i].transpose(0, 2, 1)))
+            for name in ("gate_proj", "up_proj", "down_proj"):
+                yield (
+                    f"{_P}.layers.{i}.mlp.shared_expert.{name}.weight",
+                    _t(np.asarray(params["layers"]["moe"]["shared"][name]["kernel"][i])),
+                )
+            yield (
+                f"{_P}.layers.{i}.mlp.shared_expert_gate.weight",
+                _t(np.asarray(params["layers"]["moe"]["shared_gate"]["kernel"][i])),
+            )
+
+        def leaf_of(root, path):
+            node = root
+            for kk in path:
+                node = node[kk]
+            return np.asarray(node)
+
+        for path, suffix, tr in self._FULL:
+            leaf = leaf_of(params["full_attn"], path)
+            for row, i in enumerate(self.full_ids):
+                yield f"{_P}.layers.{i}.{suffix}", (_t(leaf[row]) if tr else leaf[row])
+        for path, suffix, tr in self._LINEAR:
+            leaf = leaf_of(params["linear_attn"], path)
+            for row, i in enumerate(self.linear_ids):
+                yield f"{_P}.layers.{i}.{suffix}", (_t(leaf[row]) if tr else leaf[row])
+        conv = np.asarray(params["linear_attn"]["conv"]["weight"])
+        for row, i in enumerate(self.linear_ids):
+            yield f"{_P}.layers.{i}.linear_attn.conv1d.weight", conv[row][:, None, :]
+
+    def hf_keys(self) -> list[str]:
+        return [k for k, _ in self.to_hf_shapes()]
+
+    def to_hf_shapes(self):
+        c = self.config
+        L = c.num_layers
+        yield f"{_P}.embed_tokens.weight", None
+        yield f"{_P}.norm.weight", None
+        if not c.tie_embeddings:
+            yield "lm_head.weight", None
+        for i in range(L):
+            yield f"{_P}.layers.{i}.input_layernorm.weight", None
+            yield f"{_P}.layers.{i}.post_attention_layernorm.weight", None
+            yield f"{_P}.layers.{i}.mlp.gate.weight", None
+            yield f"{_P}.layers.{i}.mlp.experts.gate_up_proj", None
+            yield f"{_P}.layers.{i}.mlp.experts.down_proj", None
+            for n in ("gate_proj", "up_proj", "down_proj"):
+                yield f"{_P}.layers.{i}.mlp.shared_expert.{n}.weight", None
+            yield f"{_P}.layers.{i}.mlp.shared_expert_gate.weight", None
+        for _, suffix, _tr in self._FULL:
+            for i in self.full_ids:
+                yield f"{_P}.layers.{i}.{suffix}", None
+        for _, suffix, _tr in self._LINEAR + [((), "linear_attn.conv1d.weight", False)]:
+            for i in self.linear_ids:
+                yield f"{_P}.layers.{i}.{suffix}", None

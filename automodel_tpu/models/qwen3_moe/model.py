@@ -123,8 +123,11 @@ def _init_attn_layer(cfg: TransformerConfig, backend: BackendConfig, key, L: int
         attn["k_proj"]["bias"] = jnp.zeros((L, cfg.kv_dim), pd)
         attn["v_proj"]["bias"] = jnp.zeros((L, cfg.kv_dim), pd)
     if cfg.qk_norm:
-        attn["q_norm"] = {"scale": jnp.ones((L, cfg.head_dim), pd)}
-        attn["k_norm"] = {"scale": jnp.ones((L, cfg.head_dim), pd)}
+        # minimax-m2 norms the FLATTENED projection dims (qk_norm_flat)
+        qd = cfg.q_dim if cfg.qk_norm_flat else cfg.head_dim
+        kd = cfg.kv_dim if cfg.qk_norm_flat else cfg.head_dim
+        attn["q_norm"] = {"scale": jnp.ones((L, qd), pd)}
+        attn["k_norm"] = {"scale": jnp.ones((L, kd), pd)}
     return {
         "attn": attn,
         "input_norm": {"scale": jnp.ones((L, D), pd)},

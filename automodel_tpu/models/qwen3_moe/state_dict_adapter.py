@@ -28,6 +28,9 @@ def _t(x: np.ndarray) -> np.ndarray:
 _VARIANT_KEY_STYLES: dict[str, list[tuple[str, str]]] = {
     "mixtral": [
         (r"\.mlp\.gate\.weight$", ".block_sparse_moe.gate.weight"),
+        # minimax-m2 (mixtral dialect + deepseek-style aux-free router bias)
+        (r"\.mlp\.gate\.e_score_correction_bias$",
+         ".block_sparse_moe.gate.e_score_correction_bias"),
         (r"\.mlp\.experts\.(\d+)\.gate_proj\.weight$", r".block_sparse_moe.experts.\1.w1.weight"),
         (r"\.mlp\.experts\.(\d+)\.up_proj\.weight$", r".block_sparse_moe.experts.\1.w3.weight"),
         (r"\.mlp\.experts\.(\d+)\.down_proj\.weight$", r".block_sparse_moe.experts.\1.w2.weight"),

@@ -182,18 +182,31 @@ def _linear_attn_layer(cfg, x, lp):
     hk, hv = cfg.linear_key_head_dim, cfg.linear_value_head_dim
     ratio = nv // nk
 
-    qkvz = x @ lp["in_qkvz"]["kernel"].astype(x.dtype)
-    ba = x @ lp["in_ba"]["kernel"].astype(x.dtype)
-    # HF fix_query_key_value_ordering: grouped per k-head
-    qkvz = qkvz.reshape(B, S, nk, 2 * hk + 2 * ratio * hv)
-    q = qkvz[..., :hk]
-    k = qkvz[..., hk : 2 * hk]
-    vz = qkvz[..., 2 * hk :].reshape(B, S, nk, 2, ratio * hv)
-    v = vz[..., 0, :].reshape(B, S, nv, hv)
-    z = vz[..., 1, :].reshape(B, S, nv, hv)
-    ba = ba.reshape(B, S, nk, 2 * ratio)
-    b = ba[..., :ratio].reshape(B, S, nv)
-    a = ba[..., ratio:].reshape(B, S, nv)
+    if "in_qkvz" in lp:
+        qkvz = x @ lp["in_qkvz"]["kernel"].astype(x.dtype)
+        ba = x @ lp["in_ba"]["kernel"].astype(x.dtype)
+        # HF fix_query_key_value_ordering: grouped per k-head
+        qkvz = qkvz.reshape(B, S, nk, 2 * hk + 2 * ratio * hv)
+        q = qkvz[..., :hk]
+        k = qkvz[..., hk : 2 * hk]
+        vz = qkvz[..., 2 * hk :].reshape(B, S, nk, 2, ratio * hv)
+        v = vz[..., 0, :].reshape(B, S, nv, hv)
+        z = vz[..., 1, :].reshape(B, S, nv, hv)
+        ba = ba.reshape(B, S, nk, 2 * ratio)
+        b = ba[..., :ratio].reshape(B, S, nv)
+        a = ba[..., ratio:].reshape(B, S, nv)
+    else:
+        # Qwen3.5-MoE native GatedDeltaNet: SEPARATE in_proj_qkv/z/b/a
+        # (reference models/qwen3_5_moe/model.py:75-82); qkv keeps the same
+        # per-k-head grouping [q | k | v·ratio], z/b/a are flat per v-head
+        qkv = x @ lp["in_qkv"]["kernel"].astype(x.dtype)
+        qkv = qkv.reshape(B, S, nk, 2 * hk + ratio * hv)
+        q = qkv[..., :hk]
+        k = qkv[..., hk : 2 * hk]
+        v = qkv[..., 2 * hk :].reshape(B, S, nv, hv)
+        z = (x @ lp["in_z"]["kernel"].astype(x.dtype)).reshape(B, S, nv, hv)
+        b = x @ lp["in_b"]["kernel"].astype(x.dtype)  # [B, S, nv]
+        a = x @ lp["in_a"]["kernel"].astype(x.dtype)
 
     # conv over concat(q,k,v) flat channels, then silu
     mixed = jnp.concatenate(

@@ -158,6 +158,37 @@ def _qwen3_vl_moe_builder(hf_config: Any, backend: BackendConfig):
     )
 
 
+@register_architecture("MiniMaxM2ForCausalLM")
+def _minimax_m2_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.minimax_m2 import MiniMaxM2Config, MiniMaxM2ForCausalLM
+    from automodel_tpu.models.qwen3_moe import MoEStateDictAdapter
+
+    cfg = MiniMaxM2Config.from_hf(hf_config)
+    # MiniMax-M2 keeps the mixtral block_sparse_moe w1/w3/w2 key dialect
+    # (reference minimax_m2/state_dict_adapter.py expert regex) — load-side
+    # renames ride the conversion mapping, save-side the mixtral key style
+    return MiniMaxM2ForCausalLM(cfg, backend), MoEStateDictAdapter(
+        cfg, hf_key_style="mixtral"
+    )
+
+
+@register_architecture(
+    "Qwen3_5MoeForConditionalGeneration", "Qwen3_5MoeForCausalLM"
+)
+def _qwen3_5_moe_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.qwen3_5_moe import (
+        Qwen3_5MoeConfig,
+        Qwen3_5MoeForConditionalGeneration,
+        Qwen3_5MoeStateDictAdapter,
+    )
+
+    cfg = Qwen3_5MoeConfig.from_hf(hf_config)
+    return (
+        Qwen3_5MoeForConditionalGeneration(cfg, backend),
+        Qwen3_5MoeStateDictAdapter(cfg),
+    )
+
+
 @register_architecture("Mistral3ForConditionalGeneration")
 def _mistral3_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.mistral3 import (

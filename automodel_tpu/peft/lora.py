@@ -95,6 +95,13 @@ def merge_lora(base_params: Any, lora_params: dict, cfg: PeftConfig) -> Any:
         p = path_str(path)
         if p not in lora_params:
             return leaf
+        if isinstance(leaf, dict) and "codes" in leaf:
+            raise ValueError(
+                f"adapter at {p!r} targets an NF4-packed base kernel; QLoRA "
+                "adapters must be activation-side (add the path to the "
+                "model's lora_graft_patterns) — merging would materialize "
+                "the full-precision stack"
+            )
         ab = lora_params[p]
         delta = jnp.einsum(
             "...ir,...ro->...io",
@@ -103,7 +110,12 @@ def merge_lora(base_params: Any, lora_params: dict, cfg: PeftConfig) -> Any:
         )
         return (leaf.astype(jnp.float32) + scale * delta).astype(leaf.dtype)
 
-    return jax.tree_util.tree_map_with_path(visit, base_params)
+    # NF4-packed kernels are dicts — treat them as leaves so the adapter
+    # guard above fires instead of silently mapping over codes/scales
+    return jax.tree_util.tree_map_with_path(
+        visit, base_params,
+        is_leaf=lambda x: isinstance(x, dict) and "codes" in x,
+    )
 
 
 def graft_lora(base_params: Any, lora_params: dict, cfg: PeftConfig) -> Any:

@@ -42,20 +42,56 @@ class QLoRAConfig:
     min_size: int = 1 << 16
 
 
-def nf4_quantize(w: jnp.ndarray, blocksize: int = 64) -> dict:
-    """→ {codes uint8 [n/2] (two nibbles), scales f32 [n/bs], shape, dtype}."""
-    flat = np.asarray(w, np.float32).reshape(-1)
-    n = flat.size
-    if n % blocksize:
-        raise ValueError(f"leaf size {n} not divisible by blocksize {blocksize}")
+# midpoints of the sorted codebook: nearest-code via searchsorted is exact
+# and O(n) memory (the [n, 16] |v - code| broadcast is ~64 bytes/param —
+# a 2B-param stacked leaf would need >100GB of host RAM)
+_NF4_MID = (NF4_CODE[1:] + NF4_CODE[:-1]) / 2.0
+
+
+def _nf4_pack_flat(flat: np.ndarray, blocksize: int) -> tuple[np.ndarray, np.ndarray]:
     blocks = flat.reshape(-1, blocksize)
     scales = np.abs(blocks).max(axis=1)
     scales = np.maximum(scales, 1e-12)
     normed = blocks / scales[:, None]
-    # nearest codebook entry
-    idx = np.abs(normed[..., None] - NF4_CODE[None, None]).argmin(-1).astype(np.uint8)
-    idx = idx.reshape(-1)
+    idx = np.searchsorted(_NF4_MID, normed.reshape(-1)).astype(np.uint8)
     packed = (idx[0::2] << 4) | idx[1::2]
+    return packed, scales.astype(np.float32)
+
+
+def nf4_quantize(w: jnp.ndarray, blocksize: int = 64, stacked: bool = False) -> dict:
+    """→ {codes uint8, scales f32, meta}.
+
+    Flat layout: codes [n/2], scales [n/bs]. ``stacked`` (leading layer axis,
+    the lax.scan layout): codes [L, n_row/2], scales [L, n_row/bs] quantized
+    per layer so a scan body can slice one layer's packed weights and
+    dequantize ONLY that layer — the whole-tree dequant-at-loss-top approach
+    materializes every layer at once inside jit (15.3GB for an 8B base,
+    instant OOM on a 16GB chip)."""
+    if stacked:
+        arr = np.asarray(w)
+        L = arr.shape[0]
+        n_row = arr[0].size
+        if n_row % blocksize:
+            raise ValueError(f"layer size {n_row} not divisible by {blocksize}")
+        codes_rows, scale_rows = [], []
+        for l in range(L):  # per-layer host loop bounds peak RAM to one layer
+            c, s = _nf4_pack_flat(
+                np.asarray(arr[l], np.float32).reshape(-1), blocksize
+            )
+            codes_rows.append(c)
+            scale_rows.append(s)
+        return {
+            "codes": jnp.asarray(np.stack(codes_rows)),
+            "scales": jnp.asarray(np.stack(scale_rows)),
+            "meta": _Nf4Meta(
+                shape=tuple(w.shape), dtype=str(w.dtype), blocksize=blocksize,
+                stacked=True,
+            ),
+        }
+    flat = np.asarray(w, np.float32).reshape(-1)
+    if flat.size % blocksize:
+        raise ValueError(f"leaf size {flat.size} not divisible by blocksize {blocksize}")
+    packed, scales = _nf4_pack_flat(flat, blocksize)
     return {
         "codes": jnp.asarray(packed),
         "scales": jnp.asarray(scales),
@@ -71,17 +107,25 @@ class _Nf4Meta:
     shape: tuple
     dtype: str
     blocksize: int
+    stacked: bool = False
 
 
 def nf4_dequantize(q: dict) -> jnp.ndarray:
+    """Inverse of nf4_quantize (inside jit). For a stacked leaf, a 1-D codes
+    array means ONE layer's slice (a lax.scan body sliced the leading axis)
+    → dequantizes to meta.shape[1:]."""
     meta = q["meta"]
     codes, scales = q["codes"], q["scales"]
+    shape = meta.shape
+    if meta.stacked and codes.ndim == 1:
+        shape = meta.shape[1:]
+    codes, scales = codes.reshape(-1), scales.reshape(-1)
     hi = (codes >> 4).astype(jnp.int32)
     lo = (codes & 0xF).astype(jnp.int32)
     idx = jnp.stack([hi, lo], axis=1).reshape(-1)
     table = jnp.asarray(NF4_CODE)
     vals = table[idx].reshape(-1, meta.blocksize) * scales[:, None]
-    return vals.reshape(meta.shape).astype(meta.dtype)
+    return vals.reshape(shape).astype(meta.dtype)
 
 
 def _is_quantized(x: Any) -> bool:
@@ -121,7 +165,9 @@ def nf4_quantize_tree(params: Any, cfg: QLoRAConfig = QLoRAConfig(), ctx=None) -
             and leaf.size % cfg.blocksize == 0
             and any(fnmatch.fnmatch(p, pat) for pat in cfg.target_modules)
         ):
-            q = nf4_quantize(leaf, cfg.blocksize)
+            # leaves with a leading layer axis keep it in the packed layout
+            # so the layer scan slices them and dequantizes per layer
+            q = nf4_quantize(leaf, cfg.blocksize, stacked=leaf.ndim >= 3)
             return {"codes": place(q["codes"]), "scales": place(q["scales"]),
                     "meta": q["meta"]}
         return leaf

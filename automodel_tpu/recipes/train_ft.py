@@ -148,24 +148,31 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if self.peft_config is not None:
             from automodel_tpu.peft import make_lora_loss_fn
 
-            base_tree, base_transform = self.auto.params, None
+            base_tree = self.auto.params
             if self._qlora_cfg is not None:
-                from automodel_tpu.quantization import (
-                    QLoRAConfig,
-                    nf4_dequantize_tree,
-                    nf4_quantize_tree,
-                )
+                from automodel_tpu.quantization import QLoRAConfig, nf4_quantize_tree
 
                 qc = QLoRAConfig(
                     **({} if self._qlora_cfg is True else dict(self._qlora_cfg))
                 )
                 base_tree = nf4_quantize_tree(self.auto.params, qc, ctx=self.mesh_ctx)
-                base_transform = nf4_dequantize_tree
                 # drop the full-precision base so HBM really holds the packed
                 # codes only (the loss binds base_tree; adapters checkpoint
-                # separately)
+                # separately). Models that consume packed kernels natively
+                # (llama _maybe_nf4) dequantize PER LAYER inside the scan and
+                # need no base_transform — a whole-tree dequant at the loss
+                # top materializes every layer at once (15.3GB for 8B).
+                # Other families still dequantize at the loss top (correct,
+                # memory-bounded by model size).
                 self.auto.params = None
                 logger.info("QLoRA: NF4-quantized base (blocksize=%d)", qc.blocksize)
+            base_transform = None
+            if self._qlora_cfg is not None and not getattr(
+                self.model, "supports_packed_nf4", False
+            ):
+                from automodel_tpu.quantization import nf4_dequantize_tree
+
+                base_transform = nf4_dequantize_tree
             self.loss_fn = make_lora_loss_fn(
                 self.loss_fn, base_tree, self.peft_config,
                 graft_patterns=getattr(self.model, "lora_graft_patterns", ()),

@@ -69,6 +69,16 @@ def dense_transformer_flops_per_token(
     return 3.0 * fwd  # fwd + bwd(2x)
 
 
+def avg_attended_context(seq_len: int, window: Optional[int] = None) -> float:
+    """Average number of attended positions per token under a causal mask,
+    optionally with a sliding window (reference gpt-oss accounting,
+    utils/flops_utils.py:606-617: w(w+1)/2 + (S-w)·w attended pairs)."""
+    if window is not None and window < seq_len:
+        pairs = window * (window + 1) / 2 + (seq_len - window) * window
+        return pairs / seq_len
+    return seq_len * 0.5
+
+
 def moe_transformer_flops_per_token(
     hidden_size: int,
     num_layers: int,
@@ -83,20 +93,32 @@ def moe_transformer_flops_per_token(
     dense_intermediate_size: int = 0,
     num_dense_layers: int = 0,
     causal: bool = True,
+    layer_windows: Optional[list] = None,
 ) -> float:
     """Training FLOPs per token for a MoE decoder: only ACTIVE experts count
-    (reference mixtral/qwen3 formulas, utils/flops_utils.py:120-172)."""
+    (reference mixtral/qwen3 formulas, utils/flops_utils.py:120-172).
+
+    ``layer_windows``: per-layer sliding window (None = full attention) —
+    windowed layers attend to ~window positions, not seq/2, and counting
+    them at full length would inflate MFU (reference gpt-oss accounting,
+    utils/flops_utils.py:652-697)."""
     q_dim = num_heads * head_dim
     kv_dim = num_kv_heads * head_dim
-    attn = 2 * (hidden_size * (q_dim + 2 * kv_dim) + q_dim * hidden_size)
-    attn += 2 * 2 * q_dim * seq_len * (0.5 if causal else 1.0)
+    attn_proj = 2 * (hidden_size * (q_dim + 2 * kv_dim) + q_dim * hidden_size)
+    if layer_windows is None:
+        layer_windows = [None] * num_layers
+    attn_sdp_total = sum(
+        2 * 2 * q_dim * (avg_attended_context(seq_len, w) if causal else seq_len)
+        for w in layer_windows
+    )
     moe_mlp = 2 * 3 * hidden_size * (
         moe_intermediate_size * num_active_experts + shared_expert_intermediate
     )
     dense_mlp = 2 * 3 * hidden_size * dense_intermediate_size
     n_moe = num_layers - num_dense_layers
     fwd = (
-        num_layers * attn
+        num_layers * attn_proj
+        + attn_sdp_total
         + n_moe * moe_mlp
         + num_dense_layers * dense_mlp
         + 2 * hidden_size * vocab_size
@@ -108,7 +130,15 @@ def flops_per_token_for_config(cfg: Any, seq_len: int) -> float:
     """Dispatch on a TransformerConfig-like object (dense or MoE)."""
     moe = getattr(cfg, "moe", None)
     if moe is not None:
+        layer_types = getattr(cfg, "layer_types", None) or None
+        windows = None
+        if layer_types and getattr(cfg, "sliding_window", None):
+            windows = [
+                cfg.sliding_window if lt == "sliding_attention" else None
+                for lt in layer_types
+            ]
         return moe_transformer_flops_per_token(
+            layer_windows=windows,
             hidden_size=cfg.hidden_size,
             num_layers=cfg.num_layers,
             moe_intermediate_size=moe.moe_intermediate_size,

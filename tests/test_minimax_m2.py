@@ -1,0 +1,137 @@
+"""MiniMax-M2: config mapping (sigmoid router + forced correction bias,
+flat qk-norm, partial rotary), flat-norm numerics, mixtral-dialect adapter
+round-trip, registry train smoke. Reference parity target:
+components/models/minimax_m2 (no HF qwen-style module exists to diff
+against — transformers has no minimax_m2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.minimax_m2 import MiniMaxM2Config, MiniMaxM2ForCausalLM
+from automodel_tpu.models.registry import resolve_architecture
+
+FP32 = BackendConfig(
+    attn="sdpa", param_dtype="float32", compute_dtype="float32",
+    experts="dense", scan_layers=False,
+)
+
+
+def _hf_cfg():
+    return {
+        "architectures": ["MiniMaxM2ForCausalLM"],
+        "model_type": "minimax_m2",
+        "vocab_size": 128,
+        "hidden_size": 32,
+        "intermediate_size": 16,  # expert width in minimax layout
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "head_dim": 8,
+        "num_local_experts": 4,
+        "num_experts_per_tok": 2,
+        "scoring_func": "sigmoid",
+        "use_qk_norm": True,
+        "rope_parameters": {"partial_rotary_factor": 0.5, "rope_theta": 10_000.0},
+        "rope_theta": 10_000.0,
+        "rms_norm_eps": 1e-6,
+        "tie_word_embeddings": False,
+    }
+
+
+def test_config_mapping():
+    cfg = MiniMaxM2Config.from_hf(_hf_cfg())
+    assert cfg.moe.score_func == "sigmoid"
+    assert cfg.moe.expert_bias and cfg.moe.bias_update_factor > 0
+    assert cfg.moe.num_experts == 4 and cfg.moe.moe_intermediate_size == 16
+    assert cfg.moe.num_shared_experts == 0
+    assert cfg.qk_norm and cfg.qk_norm_flat
+    assert cfg.partial_rotary_factor == 0.5
+    assert cfg.rope_dim == 4  # head_dim 8 * 0.5
+
+
+def test_flat_qk_norm_shapes_and_numerics():
+    cfg = MiniMaxM2Config.from_hf(_hf_cfg())
+    model = MiniMaxM2ForCausalLM(cfg, FP32)
+    params = model.init(jax.random.PRNGKey(0))
+    qn = params["moe_layers"]["attn"]["q_norm"]["scale"]
+    kn = params["moe_layers"]["attn"]["k_norm"]["scale"]
+    assert qn.shape == (2, cfg.q_dim)  # flattened dims, not head_dim
+    assert kn.shape == (2, cfg.kv_dim)
+
+    # the flat norm normalizes over the WHOLE q projection, not per head:
+    # verify against a direct numpy computation of the normed q
+    from automodel_tpu.models.llama.model import attention_block, _noop_constrain
+    from automodel_tpu.ops.norms import rms_norm
+
+    lp = jax.tree.map(lambda x: x[0], params["moe_layers"])
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.normal(size=(1, 4, 32)), jnp.float32)
+    x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
+    q = np.asarray(x @ lp["attn"]["q_proj"]["kernel"])
+    expect = q / np.sqrt((q**2).mean(-1, keepdims=True) + cfg.rms_eps)
+    got = np.asarray(rms_norm(jnp.asarray(q), lp["attn"]["q_norm"]["scale"], cfg.rms_eps))
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    cos = jnp.ones((1, 4, cfg.rope_dim), jnp.float32)
+    sin = jnp.zeros((1, 4, cfg.rope_dim), jnp.float32)
+    out = attention_block(cfg, FP32, h, lp, cos, sin, None, _noop_constrain)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_adapter_round_trip_mixtral_dialect():
+    hf = _hf_cfg()
+    builder = resolve_architecture(hf)
+    model, adapter = builder(hf, FP32)
+    params = jax.tree.map(np.asarray, model.init(jax.random.PRNGKey(1)))
+    out = dict(adapter.to_hf(params))
+    assert any(".block_sparse_moe.experts.0.w1.weight" in k for k in out)
+    assert any(".block_sparse_moe.gate.e_score_correction_bias" in k for k in out)
+    assert any(".self_attn.q_norm.weight" in k for k in out)
+
+    # load side rides the conversion-mapping renames, as from_pretrained does
+    from automodel_tpu.checkpoint.conversion_mapping import detect_remaps
+    from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+    class _DictReader:
+        def __init__(self, d):
+            self.d = d
+
+        def keys(self):
+            return list(self.d)
+
+        def get_tensor(self, k):
+            return self.d[k]
+
+        def info(self, k):
+            return "F32", tuple(self.d[k].shape)
+
+        def close(self):
+            pass
+
+    reader = detect_remaps(_DictReader(out)) or _DictReader(out)
+    back = assemble_tree(adapter.iter_from_hf(reader.get_tensor))
+    for p, v in jax.tree_util.tree_leaves_with_path(params):
+        got = back
+        for kk in p:
+            got = got[kk.key]
+        np.testing.assert_allclose(got, v, atol=1e-6, err_msg=str(p))
+
+
+def test_registry_train_smoke():
+    hf = _hf_cfg()
+    model, _ = resolve_architecture(hf)(hf, FP32)
+    assert isinstance(model, MiniMaxM2ForCausalLM)
+    params = model.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.default_rng(2).integers(0, 128, (2, 12)))
+
+    def loss(p):
+        logits, aux = model(p, ids)
+        return jnp.mean(logits.astype(jnp.float32) ** 2) + aux.aux_loss
+
+    g = jax.grad(loss)(params)
+    gn = jax.tree_util.tree_reduce(
+        lambda a, x: a + jnp.sum(jnp.abs(x.astype(jnp.float32))), g, 0.0
+    )
+    assert bool(jnp.isfinite(gn)) and float(gn) > 0
