@@ -1,0 +1,23 @@
+from automodel_tpu.models.kimi_k25_vl.model import (
+    KimiK25VLConfig,
+    KimiK25VLForConditionalGeneration,
+)
+from automodel_tpu.models.kimi_k25_vl.state_dict_adapter import (
+    KimiK25VLStateDictAdapter,
+)
+from automodel_tpu.models.kimi_k25_vl.vision import (
+    MoonViT3dConfig,
+    init_vision_params,
+    tpool_patch_merger,
+    vision_tower,
+)
+
+__all__ = [
+    "KimiK25VLConfig",
+    "KimiK25VLForConditionalGeneration",
+    "KimiK25VLStateDictAdapter",
+    "MoonViT3dConfig",
+    "init_vision_params",
+    "tpool_patch_merger",
+    "vision_tower",
+]

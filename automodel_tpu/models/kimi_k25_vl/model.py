@@ -1,0 +1,202 @@
+"""Kimi K2.5-VL (KimiK25VLForConditionalGeneration), TPU-native.
+
+Parity: reference components/models/kimi_k25_vl/model.py — the MoonViT3d
+tower (vision.py) feeding a PatchMerger-MLP projector (pre-LayerNorm over
+the vision width, flatten the k² merge group, linear→gelu→linear to the
+text width, model.py:493-525), image features scattered over
+``media_placeholder_token_id`` positions of a DeepSeek-V3 text stack (the
+reference wraps its own DeepseekV3 backend the same way, model.py:557-620).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.deepseek_v3.model import (
+    DeepseekV3Config,
+    DeepseekV3ForCausalLM,
+    SHARDING_RULES as TEXT_RULES,
+    init_params as init_text_params,
+)
+from automodel_tpu.models.kimi_k25_vl.vision import (
+    MoonViT3dConfig,
+    init_vision_params,
+    tpool_patch_merger,
+    vision_tower,
+)
+from automodel_tpu.models.llama.model import ACT_FNS, _dense_init
+from automodel_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class KimiK25VLConfig:
+    text: DeepseekV3Config
+    vision: MoonViT3dConfig
+    media_placeholder_token_id: int = 163605
+    projector_ln_eps: float = 1e-5
+    mm_hidden_size: Optional[int] = None  # defaults to vision hidden
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "KimiK25VLConfig":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        vision = MoonViT3dConfig.from_hf(get("vision_config") or {})
+        return cls(
+            text=DeepseekV3Config.from_hf(get("text_config")),
+            vision=vision,
+            media_placeholder_token_id=get("media_placeholder_token_id", 163605),
+            projector_ln_eps=get("projector_ln_eps", 1e-5),
+            mm_hidden_size=get("mm_hidden_size") or vision.hidden_size,
+        )
+
+    @property
+    def logits_soft_cap(self):
+        return self.text.logits_soft_cap
+
+    @property
+    def vocab_size(self) -> int:
+        return self.text.vocab_size
+
+    @property
+    def hidden_size(self) -> int:
+        return self.text.hidden_size
+
+    @property
+    def moe(self):
+        return self.text.moe  # flops accounting dispatches on the MoE config
+
+    @property
+    def num_layers(self):
+        return self.text.num_layers
+
+    @property
+    def intermediate_size(self):
+        return self.text.intermediate_size
+
+    @property
+    def num_heads(self):
+        return self.text.num_heads
+
+    @property
+    def num_kv_heads(self):
+        return self.text.num_kv_heads
+
+    @property
+    def head_dim(self):
+        return self.text.head_dim
+
+
+def init_projector_params(cfg: KimiK25VLConfig, backend: BackendConfig, key) -> dict:
+    pd = backend.param_jnp_dtype
+    kh, kw = cfg.vision.merge_kernel_size
+    mm = cfg.mm_hidden_size or cfg.vision.hidden_size
+    hid = mm * kh * kw
+    ks = jax.random.split(key, 2)
+    return {
+        "pre_norm": {"scale": jnp.ones((mm,), pd), "bias": jnp.zeros((mm,), pd)},
+        "linear_1": {
+            "kernel": _dense_init(ks[0], (hid, hid), pd),
+            "bias": jnp.zeros((hid,), pd),
+        },
+        "linear_2": {
+            "kernel": _dense_init(ks[1], (hid, cfg.text.hidden_size), pd),
+            "bias": jnp.zeros((cfg.text.hidden_size,), pd),
+        },
+    }
+
+
+def project_image_features(
+    cfg: KimiK25VLConfig, pp: dict, feats: jnp.ndarray
+) -> jnp.ndarray:
+    """Merged tower output [M, k², d_v] → [M, D_text] (reference
+    KimiK25VLMultiModalProjector.forward)."""
+    act = ACT_FNS["gelu"]  # GELUActivation = exact erf
+    x = layer_norm(
+        feats, pp["pre_norm"]["scale"], pp["pre_norm"]["bias"], cfg.projector_ln_eps
+    )
+    x = x.reshape(x.shape[0], -1)
+    x = x @ pp["linear_1"]["kernel"].astype(x.dtype) + pp["linear_1"]["bias"].astype(x.dtype)
+    x = act(x)
+    return x @ pp["linear_2"]["kernel"].astype(x.dtype) + pp["linear_2"]["bias"].astype(x.dtype)
+
+
+@dataclasses.dataclass
+class KimiK25VLForConditionalGeneration:
+    config: KimiK25VLConfig
+    backend: BackendConfig = BackendConfig()
+
+    def __post_init__(self):
+        self._text = DeepseekV3ForCausalLM(self.config.text, self.backend)
+
+    def init(self, key: jax.Array) -> dict:
+        kt, kv, kp = jax.random.split(key, 3)
+        p = {"text": init_text_params(self.config.text, self.backend, kt)}
+        p["vision"] = init_vision_params(self.config.vision, self.backend, kv)
+        p["projector"] = init_projector_params(self.config, self.backend, kp)
+        return p
+
+    def _embed_multimodal(self, params, input_ids, pixel_values, grid_thw, constrain):
+        cfg = self.config
+        cd = self.backend.compute_jnp_dtype
+        tp = params["text"]
+        embeds = constrain(tp["embed"]["embedding"], (None, None)).astype(cd)[input_ids]
+        if pixel_values is None:
+            return embeds
+        feats = vision_tower(
+            cfg.vision, self.backend, params["vision"], pixel_values, grid_thw
+        )
+        merged = tpool_patch_merger(feats, grid_thw, cfg.vision.merge_kernel_size)
+        proj = project_image_features(cfg, params["projector"], merged)
+        mask = (input_ids == cfg.media_placeholder_token_id).reshape(-1)
+        idx = jnp.cumsum(mask) - 1
+        flat = embeds.reshape(-1, embeds.shape[-1])
+        take = proj[jnp.clip(idx, 0, proj.shape[0] - 1)].astype(flat.dtype)
+        # count mismatch → GLOBAL NaN poison (same guard as the other VLMs)
+        count_ok = mask.sum() == proj.shape[0]
+        embeds = jnp.where(mask[:, None], take, flat).reshape(embeds.shape)
+        return embeds * jnp.where(count_ok, 1.0, jnp.nan).astype(embeds.dtype)
+
+    def hidden(
+        self,
+        params: dict,
+        input_ids: jnp.ndarray,
+        pixel_values: Optional[jnp.ndarray] = None,  # [P_total, patch_dim]
+        grid_thw=None,  # static tuple of (t, h, w) per media item
+        constrain=None,
+        **kw: Any,
+    ):
+        constrain = constrain or (lambda x, s: x)
+        embeds = self._embed_multimodal(
+            params, input_ids, pixel_values, grid_thw, constrain
+        )
+        return self._text.hidden(
+            params["text"], input_ids, inputs_embeds=embeds,
+            constrain=constrain, **kw,
+        )
+
+    def __call__(self, params: dict, input_ids: jnp.ndarray, **kw: Any):
+        h, aux = self.hidden(params, input_ids, **kw)
+        logits = h @ self.lm_head(params).astype(h.dtype)
+        return logits, aux
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        return self._text.lm_head(params["text"])
+
+    def post_step_fn(self, params: dict, extras: dict) -> dict:
+        out = dict(params)
+        out["text"] = self._text.post_step_fn(params["text"], extras)
+        return out
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return [
+            (r"^vision/", ()),
+            (r"^projector/", ()),
+            *TEXT_RULES,
+        ]

@@ -1,0 +1,126 @@
+"""HF ⇄ native adapter for Kimi K2.5-VL.
+
+Parity target: reference components/models/kimi_k25_vl/state_dict_adapter.py
+— HF keys live under ``language_model.model.`` / ``language_model.lm_head.``
+(DeepSeek-V3 text, delegated to the deepseek adapter with a prefix rewrite),
+``vision_tower.`` (MoonViT3d leaves, conv patch embed flattened to one
+[patch_dim, D] kernel), and ``mm_projector.`` whose Sequential indices map
+``proj.0`` → linear_1 and ``proj.2`` → linear_2 (reference adapter:368-370).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.deepseek_v3.state_dict_adapter import (
+    DeepseekV3StateDictAdapter,
+)
+from automodel_tpu.models.kimi_k25_vl.model import KimiK25VLConfig
+
+_V = "vision_tower"
+_P = "mm_projector"
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+class KimiK25VLStateDictAdapter:
+    def __init__(self, config: KimiK25VLConfig):
+        self.config = config
+        self.text_adapter = DeepseekV3StateDictAdapter(config.text)
+
+    @staticmethod
+    def _to_vlm_key(k: str) -> str:
+        if k.startswith("model."):
+            return "language_model." + k
+        if k.startswith("lm_head."):
+            return "language_model." + k
+        return k
+
+    def _block_plans(self) -> list[tuple[tuple[str, ...], str, bool]]:
+        tmpl = _V + ".encoder.blocks.{i}."
+        plans = []
+        for name, native in (("norm0", "norm0"), ("norm1", "norm1")):
+            plans.append(((native, "scale"), tmpl + name + ".weight", False))
+            plans.append(((native, "bias"), tmpl + name + ".bias", False))
+        for name in ("wqkv", "wo"):
+            plans.append(((name, "kernel"), tmpl + name + ".weight", True))
+            plans.append(((name, "bias"), tmpl + name + ".bias", False))
+        for hf, native in (("mlp.fc0", "fc0"), ("mlp.fc1", "fc1")):
+            plans.append(((native, "kernel"), tmpl + hf + ".weight", True))
+            plans.append(((native, "bias"), tmpl + hf + ".bias", False))
+        return plans
+
+    def _flat_plans(self) -> list[tuple[tuple[str, ...], str, bool]]:
+        return [
+            (("vision", "pos_emb", "weight"), _V + ".patch_embed.pos_emb.weight", False),
+            (("vision", "patch_embed", "bias"), _V + ".patch_embed.proj.bias", False),
+            (("vision", "final_norm", "scale"), _V + ".encoder.final_layernorm.weight", False),
+            (("vision", "final_norm", "bias"), _V + ".encoder.final_layernorm.bias", False),
+            (("projector", "pre_norm", "scale"), _P + ".pre_norm.weight", False),
+            (("projector", "pre_norm", "bias"), _P + ".pre_norm.bias", False),
+            (("projector", "linear_1", "kernel"), _P + ".proj.0.weight", True),
+            (("projector", "linear_1", "bias"), _P + ".proj.0.bias", False),
+            (("projector", "linear_2", "kernel"), _P + ".proj.2.weight", True),
+            (("projector", "linear_2", "bias"), _P + ".proj.2.bias", False),
+        ]
+
+    def iter_from_hf(
+        self, get_tensor: Callable[[str], np.ndarray]
+    ) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        for path, val in self.text_adapter.iter_from_hf(
+            lambda k: get_tensor(self._to_vlm_key(k))
+        ):
+            yield ("text", *path), val
+
+        pc = get_tensor(_V + ".patch_embed.proj.weight")  # [D, C, ps, ps]
+        yield (("vision", "patch_embed", "kernel"), _t(pc.reshape(pc.shape[0], -1)))
+        for path, key, tr in self._flat_plans():
+            v = get_tensor(key)
+            yield (path, _t(v) if tr else v)
+        for sub, tmpl, tr in self._block_plans():
+            vals = [
+                get_tensor(tmpl.format(i=i))
+                for i in range(self.config.vision.num_layers)
+            ]
+            yield (("vision", "blocks", *sub),
+                   np.stack([_t(v) if tr else v for v in vals]))
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        for key, val in self.text_adapter.to_hf(params["text"]):
+            yield self._to_vlm_key(key), val
+
+        cfg = self.config.vision
+        pc = _t(np.asarray(params["vision"]["patch_embed"]["kernel"]))
+        yield (_V + ".patch_embed.proj.weight",
+               pc.reshape(cfg.hidden_size, cfg.num_channels,
+                          cfg.patch_size, cfg.patch_size))
+
+        def leaf(tree, path):
+            x = tree
+            for s in path:
+                x = x[s]
+            return np.asarray(x)
+
+        for path, key, tr in self._flat_plans():
+            v = leaf(params, path)
+            yield key, _t(v) if tr else v
+        for sub, tmpl, tr in self._block_plans():
+            stacked = leaf(params["vision"]["blocks"], sub)
+            for i in range(cfg.num_layers):
+                v = stacked[i]
+                yield tmpl.format(i=i), _t(v) if tr else v
+
+    def vlm_keys(self, params: Any) -> list[str]:
+        """All HF keys this adapter emits (needs params — the text adapter
+        enumerates keys by walking the tree)."""
+        keys = [k for k, _ in self.to_hf(params)]
+        return keys

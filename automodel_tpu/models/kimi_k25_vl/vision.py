@@ -1,0 +1,227 @@
+"""MoonViT3d vision tower (Kimi K2.5-VL's image/video encoder), TPU-native.
+
+Parity: reference components/models/kimi_k25_vl/model.py:228-490 — patch
+conv (≡ one linear over the flattened 14×14 patch), learnable 2-D position
+embedding bicubically interpolated per grid plus a FIXED 1-D sincos temporal
+table, 2-D rotary whose pairwise-complex channels alternate x/y rotations
+per frequency (Rope2DPosEmbRepeated: freq j uses theta^(-4j/hd); channel
+pair 2j rotates by x·f_j, pair 2j+1 by y·f_j, repeated over frames),
+pre-LayerNorm blocks with fused biased wqkv + biased wo and a gelu-tanh MLP,
+per-sample full attention (cu_seqlens ≡ segment ids), final LayerNorm, and
+the ``sd2_tpool`` merger (spatial k×k regroup + temporal mean →
+[n_merged, k², d] per sample).
+
+TPU notes: grids are STATIC python tuples, so positions/segments are numpy;
+blocks run as one lax.scan; the bicubic pos-emb interpolation uses
+jax.image.resize (differentiable — the table trains).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.llama.model import ACT_FNS, _dense_init
+from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class MoonViT3dConfig:
+    patch_size: int = 14
+    init_pos_emb_height: int = 64
+    init_pos_emb_width: int = 64
+    init_pos_emb_time: int = 4
+    num_heads: int = 16
+    num_layers: int = 27
+    hidden_size: int = 1152
+    intermediate_size: int = 4304
+    merge_kernel_size: tuple = (2, 2)
+    num_channels: int = 3
+    rope_theta: float = 10_000.0
+    ln_eps: float = 1e-5  # nn.LayerNorm default
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "MoonViT3dConfig":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        return cls(
+            patch_size=get("patch_size", 14),
+            init_pos_emb_height=get("init_pos_emb_height", 64),
+            init_pos_emb_width=get("init_pos_emb_width", 64),
+            init_pos_emb_time=get("init_pos_emb_time", 4),
+            num_heads=get("num_attention_heads", 16),
+            num_layers=get("num_hidden_layers", 27),
+            hidden_size=get("hidden_size", 1152),
+            intermediate_size=get("intermediate_size", 4304),
+            merge_kernel_size=tuple(get("merge_kernel_size", (2, 2))),
+            rope_theta=10_000.0,
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.num_channels * self.patch_size**2
+
+
+def _sincos_time_table(dim: int, t_size: int) -> np.ndarray:
+    """[t_size, dim] fixed temporal embedding (reference
+    get_1d_sincos_pos_embed: sin half then cos half)."""
+    omega = 1.0 / 10_000 ** (np.arange(dim // 2, dtype=np.float32) / (dim / 2.0))
+    out = np.arange(t_size, dtype=np.float32)[:, None] * omega[None]
+    return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+
+def init_vision_params(cfg: MoonViT3dConfig, backend: BackendConfig, key) -> dict:
+    pd = backend.param_jnp_dtype
+    D, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    ks = jax.random.split(key, 8)
+
+    def stack(k, shape):
+        return _dense_init(k, (L, *shape), pd, in_axis=1)
+
+    def zeros(*shape):
+        return jnp.zeros(shape, pd)
+
+    return {
+        "patch_embed": {
+            "kernel": _dense_init(ks[0], (cfg.patch_dim, D), pd),
+            "bias": zeros(D),
+        },
+        "pos_emb": {
+            "weight": jax.random.normal(
+                ks[1], (cfg.init_pos_emb_height, cfg.init_pos_emb_width, D)
+            ).astype(pd)
+        },
+        "blocks": {
+            "norm0": {"scale": jnp.ones((L, D), pd), "bias": zeros(L, D)},
+            "norm1": {"scale": jnp.ones((L, D), pd), "bias": zeros(L, D)},
+            "wqkv": {"kernel": stack(ks[2], (D, 3 * D)), "bias": zeros(L, 3 * D)},
+            "wo": {"kernel": stack(ks[3], (D, D)), "bias": zeros(L, D)},
+            "fc0": {"kernel": stack(ks[4], (D, I)), "bias": zeros(L, I)},
+            "fc1": {"kernel": stack(ks[5], (I, D)), "bias": zeros(L, D)},
+        },
+        "final_norm": {"scale": jnp.ones((D,), pd), "bias": zeros(D)},
+    }
+
+
+def _pos_embed(cfg: MoonViT3dConfig, weight: jnp.ndarray, grid_thw) -> jnp.ndarray:
+    """Learnable 2-D table, bicubic-resized per grid, plus the fixed sincos
+    temporal table for multi-frame samples → [P_total, D]."""
+    D = weight.shape[-1]
+    time_tab = jnp.asarray(
+        _sincos_time_table(D, cfg.init_pos_emb_time), weight.dtype
+    )
+    outs = []
+    for t, h, w in grid_thw:
+        if t > cfg.init_pos_emb_time:
+            raise ValueError(f"t={t} exceeds init_pos_emb_time={cfg.init_pos_emb_time}")
+        if (h, w) == (cfg.init_pos_emb_height, cfg.init_pos_emb_width):
+            pe2d = weight.reshape(-1, D)
+        else:
+            pe2d = jax.image.resize(weight, (h, w, D), method="bicubic").reshape(-1, D)
+        if t == 1:
+            outs.append(pe2d)
+        else:
+            pe3d = pe2d[None] + time_tab[:t, None, :]
+            outs.append(pe3d.reshape(-1, D))
+    return jnp.concatenate(outs, axis=0)
+
+
+def _rope_tables(cfg: MoonViT3dConfig, grid_thw) -> tuple:
+    """cos/sin [P_total, head_dim/2]: pairwise-complex rotation angles,
+    alternating x/y per frequency, repeated over frames (reference
+    Rope2DPosEmbRepeated + _apply_rope_vision)."""
+    hd = cfg.head_dim
+    nfreq = hd // 4
+    freqs = 1.0 / (cfg.rope_theta ** (np.arange(0, hd, 4)[:nfreq] / hd))
+    angs = []
+    for t, h, w in grid_thw:
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        xa = xx.reshape(-1, 1) * freqs[None]  # [h*w, nfreq]
+        ya = yy.reshape(-1, 1) * freqs[None]
+        a = np.stack([xa, ya], axis=-1).reshape(h * w, 2 * nfreq)  # interleave
+        angs.append(np.tile(a, (t, 1)))
+    ang = np.concatenate(angs, axis=0)
+    return jnp.asarray(np.cos(ang), jnp.float32), jnp.asarray(np.sin(ang), jnp.float32)
+
+
+def _rope_pairwise(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x [P, N, H] rotated as H/2 complex pairs: (x0+ix1)·e^{iθ}."""
+    P, N, H = x.shape
+    xf = x.astype(jnp.float32).reshape(P, N, H // 2, 2)
+    c, s = cos[:, None, :], sin[:, None, :]
+    out0 = xf[..., 0] * c - xf[..., 1] * s
+    out1 = xf[..., 0] * s + xf[..., 1] * c
+    return jnp.stack([out0, out1], axis=-1).reshape(P, N, H).astype(x.dtype)
+
+
+def vision_tower(
+    cfg: MoonViT3dConfig,
+    backend: BackendConfig,
+    params: dict,
+    pixel_values: jnp.ndarray,  # [P_total, patch_dim]
+    grid_thw,  # static tuple of (t, h, w)
+) -> jnp.ndarray:
+    """→ last hidden state [P_total, hidden_size] (pre-merger)."""
+    cd = backend.compute_jnp_dtype
+    eps = cfg.ln_eps
+    N, H = cfg.num_heads, cfg.head_dim
+    act = ACT_FNS["gelu_pytorch_tanh"]  # reference block activation
+
+    x = pixel_values.astype(cd) @ params["patch_embed"]["kernel"].astype(cd)
+    x = x + params["patch_embed"]["bias"].astype(cd)
+    x = x + _pos_embed(cfg, params["pos_emb"]["weight"].astype(cd), grid_thw)
+
+    cos, sin = _rope_tables(cfg, grid_thw)
+    seg = np.repeat(
+        np.arange(len(grid_thw)), [t * h * w for t, h, w in grid_thw]
+    ).astype(np.int32)
+    seg = jnp.asarray(seg)[None]
+    P = x.shape[0]
+
+    def layer_fn(h, lp):
+        y = layer_norm(h, lp["norm0"]["scale"], lp["norm0"]["bias"], eps)
+        qkv = y @ lp["wqkv"]["kernel"].astype(cd) + lp["wqkv"]["bias"].astype(cd)
+        q, k, v = jnp.split(qkv.reshape(P, 3 * N, H), 3, axis=1)
+        q = _rope_pairwise(q, cos, sin)
+        k = _rope_pairwise(k, cos, sin)
+        attn = sdpa(q[None], k[None], v[None], causal=False, segment_ids=seg)[0]
+        h = h + (attn.reshape(P, N * H) @ lp["wo"]["kernel"].astype(cd)
+                 + lp["wo"]["bias"].astype(cd))
+        y = layer_norm(h, lp["norm1"]["scale"], lp["norm1"]["bias"], eps)
+        y = act(y @ lp["fc0"]["kernel"].astype(cd) + lp["fc0"]["bias"].astype(cd))
+        h = h + (y @ lp["fc1"]["kernel"].astype(cd) + lp["fc1"]["bias"].astype(cd))
+        return h, None
+
+    h, _ = jax.lax.scan(layer_fn, x, params["blocks"])
+    return layer_norm(
+        h, params["final_norm"]["scale"], params["final_norm"]["bias"], eps
+    )
+
+
+def tpool_patch_merger(
+    x: jnp.ndarray, grid_thw, merge_kernel_size: tuple
+) -> jnp.ndarray:
+    """sd2_tpool: per sample, spatial k×k regroup + mean over frames →
+    concatenated [sum n_merged, kh·kw, d] (reference tpool_patch_merger)."""
+    d = x.shape[-1]
+    kh, kw = merge_kernel_size
+    outs, off = [], 0
+    for t, h, w in grid_thw:
+        seq = x[off : off + t * h * w]
+        off += t * h * w
+        nh, nw = h // kh, w // kw
+        g = seq.reshape(t, nh, kh, nw, kw, d)
+        g = g.transpose(0, 1, 3, 2, 4, 5).astype(jnp.float32).mean(axis=0)
+        outs.append(g.reshape(nh * nw, kh * kw, d).astype(x.dtype))
+    return jnp.concatenate(outs, axis=0)

@@ -305,12 +305,13 @@ SHARDING_RULES: list[tuple[str, tuple]] = [
 
 @dataclasses.dataclass
 class LlamaForCausalLM:
-    """supports_packed_nf4: every kernel this family consumes flows through
+    """Bundled config + backend with the functional API underneath.
+
+    supports_packed_nf4: every kernel this family consumes flows through
     _proj/lm_head_kernel, which dequantize NF4-packed dicts per layer inside
     the scan (QLoRA without materializing the full-precision stack)."""
 
     supports_packed_nf4 = True
-    """Bundled config + backend with the functional API underneath."""
 
     config: TransformerConfig
     backend: BackendConfig = BackendConfig()

@@ -53,14 +53,16 @@ class MiniMaxM2Config(MoETransformerConfig):
             shared_expert_gate=False,
         )
         rp = get("rope_parameters") or {}
-        prf = (
-            rp.get("partial_rotary_factor", 1.0)
-            if isinstance(rp, dict)
-            else get("partial_rotary_factor", 1.0)
-        )
+        if not isinstance(rp, dict):
+            rp = {}
+        prf = rp.get("partial_rotary_factor") or get("partial_rotary_factor", 1.0)
+        rope = base.rope
+        if rp.get("rope_theta"):  # new HF convention nests theta here
+            rope = dataclasses.replace(rope, theta=float(rp["rope_theta"]))
         fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
         fields.update(
             moe=moe,
+            rope=rope,
             qk_norm=bool(get("use_qk_norm", False)),
             qk_norm_flat=bool(get("use_qk_norm", False)),
             partial_rotary_factor=float(prf or 1.0),

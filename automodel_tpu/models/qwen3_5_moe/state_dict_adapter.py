@@ -25,9 +25,6 @@ _P = "model.language_model"
 
 
 class Qwen3_5MoeStateDictAdapter(Qwen3NextStateDictAdapter):
-    def __init__(self, config: Qwen3_5MoeConfig):
-        super().__init__(config)
-
     # split DeltaNet projections (reference model.py:75-82)
     _LINEAR = [
         (("in_qkv", "kernel"), "linear_attn.in_proj_qkv.weight", True),

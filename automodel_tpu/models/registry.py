@@ -158,6 +158,21 @@ def _qwen3_vl_moe_builder(hf_config: Any, backend: BackendConfig):
     )
 
 
+@register_architecture("KimiK25VLForConditionalGeneration", "KimiVLForConditionalGeneration_K25")
+def _kimi_k25_vl_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.kimi_k25_vl import (
+        KimiK25VLConfig,
+        KimiK25VLForConditionalGeneration,
+        KimiK25VLStateDictAdapter,
+    )
+
+    cfg = KimiK25VLConfig.from_hf(hf_config)
+    return (
+        KimiK25VLForConditionalGeneration(cfg, backend),
+        KimiK25VLStateDictAdapter(cfg),
+    )
+
+
 @register_architecture("MiniMaxM2ForCausalLM")
 def _minimax_m2_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.minimax_m2 import MiniMaxM2Config, MiniMaxM2ForCausalLM

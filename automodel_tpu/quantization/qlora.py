@@ -36,9 +36,18 @@ NF4_CODE = np.array(
 @dataclasses.dataclass(frozen=True)
 class QLoRAConfig:
     blocksize: int = 64
-    # leaves to quantize: the big projection kernels; embeddings/norms and
-    # anything small stay full precision (bnb skips non-Linear the same way)
-    target_modules: Sequence[str] = ("*kernel",)
+    # leaves to quantize: the big PER-LAYER projection kernels. Embeddings,
+    # norms and anything small stay full precision (bnb skips non-Linear the
+    # same way); the lm_head stays bf16 too — it feeds the chunked CE where
+    # a jit-time dequant of its 134M-param code array blew a 32GiB XLA
+    # allocation at 8B, and a bf16 head is only ~0.25GB
+    # ("*layers*kernel" covers llama/moe family trees; the qwen3-next hybrid
+    # families keep attention in top-level full_attn/linear_attn subtrees)
+    target_modules: Sequence[str] = (
+        "*layers*kernel",
+        "full_attn/*kernel",
+        "linear_attn/*kernel",
+    )
     min_size: int = 1 << 16
 
 

@@ -1,0 +1,89 @@
+"""REAL 2-process jax.distributed integration test (VERDICT r3 #4/#5: the
+multi-host init had only ever been exercised by unit tests faking env vars).
+
+Spawns two OS processes with a localhost coordinator; each contributes 2
+virtual CPU devices to one GLOBAL 4-device mesh and runs
+initialize_distributed → build_mesh → from_config → 4 jitted train steps.
+The loss sequence must match a single-process run on the same 4-device
+topology bit-for-bit-ish (fp32 tolerance), proving cross-process collectives
+and the env-driven init really execute. Reference equivalent: 2-GPU torchrun
+functional tests (L2_CP_*.sh)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multiprocess_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _clean_env() -> dict:
+    env = dict(os.environ)
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_COORDINATOR_ADDRESS",
+              "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        env.pop(k, None)
+    return env
+
+
+def _run_single() -> list:
+    env = _clean_env()
+    env["LOCAL_DEVICES"] = "4"
+    env["DP"] = "4"
+    out = subprocess.run(
+        [sys.executable, _WORKER], env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [l for l in out.stdout.splitlines() if l.startswith("LOSSES ")][-1]
+    return json.loads(line[len("LOSSES "):])
+
+
+def test_two_process_training_matches_single_process():
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = _clean_env()
+        env.update(
+            LOCAL_DEVICES="2",
+            DP="4",
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, _WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            stdout, stderr = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("2-process run hung (coordinator rendezvous?)")
+        assert p.returncode == 0, stderr[-2000:]
+        outs.append(stdout)
+
+    losses = []
+    for stdout in outs:
+        line = [l for l in stdout.splitlines() if l.startswith("LOSSES ")][-1]
+        losses.append(json.loads(line[len("LOSSES "):]))
+    # both processes observe the same global loss
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    assert losses[0][-1] < losses[0][0], losses[0]
+
+    single = _run_single()
+    np.testing.assert_allclose(losses[0], single, rtol=1e-5, atol=1e-6)

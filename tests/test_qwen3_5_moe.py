@@ -122,7 +122,7 @@ def test_registry_train_smoke():
 
     hf = {
         "architectures": ["Qwen3_5MoeForConditionalGeneration"],
-        "text_config": _tiny_cfg() and {
+        "text_config": {
             "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
             "moe_intermediate_size": 16, "num_hidden_layers": 2,
             "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 8,
