@@ -1,0 +1,15 @@
+from automodel_tpu.diffusion.dit import (
+    DiTConfig,
+    DiTModel,
+    make_diffusion_loss,
+    timestep_embedding,
+)
+from automodel_tpu.diffusion.pipeline import AutoDiffusionPipeline
+
+__all__ = [
+    "AutoDiffusionPipeline",
+    "DiTConfig",
+    "DiTModel",
+    "make_diffusion_loss",
+    "timestep_embedding",
+]

@@ -1,0 +1,104 @@
+"""AutoDiffusionPipeline: per-component placement + parallelization.
+
+Parity: reference `NeMoAutoDiffusionPipeline.from_pretrained`
+(_diffusers/auto_diffusion_pipeline.py:79-140) — load a multi-component
+diffusion pipeline, move every module to its device/dtype, and parallelize
+the components named in a per-component scheme. TPU-native shape of the
+same idea: components are (model, params) pairs; the ``parallel_scheme``
+maps component name → sharding rules applied via GSPMD (the reference's
+FSDP2Manager slot); unmapped components are replicated on the mesh.
+
+Diffusers checkpoints: loading through the `diffusers` library is
+import-gated (not in this image); the in-tree DiT component loads from a
+plain safetensors/HF layout. ``from_components`` is the library-first path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+
+from automodel_tpu.parallel.mesh import MeshContext
+from automodel_tpu.parallel.plans import make_constrain, shard_params
+
+
+@dataclasses.dataclass
+class AutoDiffusionPipeline:
+    components: dict  # name -> (model, params)
+    mesh_ctx: Optional[MeshContext] = None
+
+    @classmethod
+    def from_components(
+        cls,
+        components: dict,  # name -> (model, params)
+        mesh_ctx: Optional[MeshContext] = None,
+        parallel_scheme: Optional[dict] = None,  # name -> sharding rules
+    ) -> "AutoDiffusionPipeline":
+        """Place every component on the mesh: named components shard by
+        their rules (reference: parallel_scheme FSDP2Manager mapping),
+        the rest replicate (reference: plain device move)."""
+        placed = {}
+        for name, (model, params) in components.items():
+            if mesh_ctx is not None:
+                rules = (parallel_scheme or {}).get(
+                    name, getattr(model, "sharding_rules", [])
+                )
+                replicate_all = [(r".*", ())]
+                params = shard_params(
+                    mesh_ctx, params, rules if rules else replicate_all
+                )
+            placed[name] = (model, params)
+        return cls(components=placed, mesh_ctx=mesh_ctx)
+
+    @classmethod
+    def from_pretrained(
+        cls,
+        path: str,
+        mesh_ctx: Optional[MeshContext] = None,
+        parallel_scheme: Optional[dict] = None,
+        **kwargs: Any,
+    ) -> "AutoDiffusionPipeline":
+        """Load a Diffusers pipeline directory. Requires the `diffusers`
+        package for the component zoo (import-gated like data/delta_lake);
+        directories containing only an in-tree DiT (`dit_config.json` +
+        safetensors) load without it."""
+        import json
+        import os
+
+        dit_cfg = os.path.join(path, "dit_config.json")
+        if os.path.exists(dit_cfg):
+            from automodel_tpu.checkpoint.hf_io import HFCheckpointReader, assemble_tree
+            from automodel_tpu.diffusion.dit import DiTConfig, DiTModel
+
+            with open(dit_cfg) as f:
+                cfg = DiTConfig.from_hf(json.load(f))
+            model = DiTModel(cfg)
+            reader = HFCheckpointReader(path)
+            params = assemble_tree(
+                (tuple(k.split("/")), reader.get_tensor(k)) for k in reader.keys()
+            )
+            params = jax.tree.map(jax.numpy.asarray, params)
+            return cls.from_components(
+                {"transformer": (model, params)}, mesh_ctx, parallel_scheme
+            )
+        try:
+            import diffusers  # noqa: F401
+        except ImportError as e:  # pragma: no cover - gated dependency
+            raise ImportError(
+                "loading a multi-component Diffusers pipeline requires the "
+                "`diffusers` package (not in this image); use "
+                "AutoDiffusionPipeline.from_components with in-tree models, "
+                "or a DiT directory (dit_config.json + safetensors)"
+            ) from e
+        raise NotImplementedError(
+            "generic diffusers-pipeline ingestion is not wired yet; use "
+            "from_components"
+        )
+
+    def constrain(self):
+        return make_constrain(self.mesh_ctx)
+
+    def __getitem__(self, name: str):
+        return self.components[name]

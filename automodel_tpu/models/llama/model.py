@@ -116,7 +116,15 @@ def _proj(x: jnp.ndarray, p: dict, fp8: bool = False) -> jnp.ndarray:
         # A). The merged form W+s·A@B forces the layer-scan backward to carry
         # a full-rank [L,in,out] dW accumulator — at 3B+ that alone OOMs a
         # 16GB chip; the two rank-r matmuls here never materialize it.
-        y = y + (x @ p["lora_A"].astype(x.dtype)) @ p["lora_B"].astype(x.dtype)
+        xa = x
+        if "lora_drop_seed" in p:
+            # input-side adapter dropout (reference LinearLoRA placement);
+            # seeds are per-step/site/layer, grafted by make_lora_loss_fn
+            key = jax.random.wrap_key_data(p["lora_drop_seed"])
+            keep = 1.0 - p["lora_drop_rate"]
+            mask = jax.random.bernoulli(key, keep, x.shape)
+            xa = x * mask.astype(x.dtype) / keep.astype(x.dtype)
+        y = y + (xa @ p["lora_A"].astype(x.dtype)) @ p["lora_B"].astype(x.dtype)
     return y
 
 

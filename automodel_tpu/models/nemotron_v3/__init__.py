@@ -1,0 +1,19 @@
+from automodel_tpu.models.nemotron_v3.model import (
+    NemotronV3Config,
+    NemotronV3ForCausalLM,
+)
+from automodel_tpu.models.nemotron_v3.ssd import (
+    mamba2_chunk_scan,
+    mamba2_reference,
+)
+from automodel_tpu.models.nemotron_v3.state_dict_adapter import (
+    NemotronV3StateDictAdapter,
+)
+
+__all__ = [
+    "NemotronV3Config",
+    "NemotronV3ForCausalLM",
+    "NemotronV3StateDictAdapter",
+    "mamba2_chunk_scan",
+    "mamba2_reference",
+]

@@ -1,0 +1,128 @@
+"""Mamba2 SSD chunked scan (Nemotron-V3's sequence mixer), TPU-native.
+
+Parity: the reference consumes mamba_ssm's fused Triton kernel
+(mamba_split_conv1d_scan_combined, components/models/nemotron_v3/layers.py:
+230-265). This is the same state-space-duality math as one jittable chunked
+formulation (Mamba2 paper §6): per-head scalar decay a_t = exp(dt_t·A_h),
+rank-N state updated by B_t·(dt_t x_t), read by C_t —
+
+    intra-chunk: attn-like [C, C] masked matmul with decay weights;
+    inter-chunk: a lax.scan carrying the [H, N, P] state per batch.
+
+Structurally the twin of qwen3_next/delta.py (gated DeltaNet) minus the
+(I - A)^-1 triangular solve — Mamba2's update has no delta-rule correction.
+Packed sequences reset via the same -50 log-decay injection at segment
+starts (offsets cancel within a segment, cross-segment terms carry
+exp(-50) ≈ 2e-22).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mamba2_chunk_scan(
+    x: jnp.ndarray,  # [B, S, H, P] inputs per head
+    dt: jnp.ndarray,  # [B, S, H] softplus'd step sizes
+    A: jnp.ndarray,  # [H] negative per-head decay rates
+    Bm: jnp.ndarray,  # [B, S, G, N] input matrices (G groups, GQA-style)
+    Cm: jnp.ndarray,  # [B, S, G, N] output matrices
+    D: jnp.ndarray,  # [H] skip connection
+    chunk_size: int = 64,
+    segment_ids: jnp.ndarray | None = None,  # [B, S] packed-doc boundaries
+) -> jnp.ndarray:
+    """→ [B, S, H, P]. y_t = C_t · state_t + D·x_t with
+    state_t = a_t · state_{t-1} + B_t (dt_t x_t)."""
+    in_dtype = x.dtype
+    B, S, H, P = x.shape
+    G, N = Bm.shape[2], Bm.shape[3]
+    rep = H // G
+
+    xf = x.astype(jnp.float32)
+    dtf = dt.astype(jnp.float32)
+    Bf = Bm.astype(jnp.float32)
+    Cf = Cm.astype(jnp.float32)
+    g = dtf * A.astype(jnp.float32)[None, None, :]  # [B, S, H] log-decay
+    if segment_ids is not None:
+        prev = jnp.pad(segment_ids, ((0, 0), (1, 0)), constant_values=-1)[:, :S]
+        starts = (segment_ids != prev).astype(jnp.float32)
+        g = g - 50.0 * starts[..., None]
+
+    pad = (-S) % chunk_size
+    if pad:
+        zp = lambda a: jnp.pad(a, ((0, 0), (0, pad)) + ((0, 0),) * (a.ndim - 2))
+        xf, dtf, Bf, Cf, g = zp(xf), zp(dtf), zp(Bf), zp(Cf), zp(g)
+    Sp = S + pad
+    n, C = Sp // chunk_size, chunk_size
+
+    # chunk layouts: [B, H, n, C, ...] / [B, G, n, C, N]
+    xh = (xf * dtf[..., None]).transpose(0, 2, 1, 3).reshape(B, H, n, C, P)
+    gh = g.transpose(0, 2, 1).reshape(B, H, n, C)
+    Bh = Bf.transpose(0, 2, 1, 3).reshape(B, G, n, C, N)
+    Ch = Cf.transpose(0, 2, 1, 3).reshape(B, G, n, C, N)
+
+    g_cum = jnp.cumsum(gh, axis=-1)  # [B, H, n, C]
+    tril = jnp.tril(jnp.ones((C, C), bool))
+
+    # group → head broadcast index for C·B scores
+    head_of_group = jnp.arange(H) // rep
+
+    def chunk_step(state, xs):
+        # state [B, H, N, P]
+        x_i, g_i, B_i, C_i = xs  # [B,H,C,P], [B,H,C], [B,G,C,N] x2
+        Bh_i = B_i[:, head_of_group]  # [B, H, C, N]
+        Ch_i = C_i[:, head_of_group]
+        # double-where keeps the masked upper triangle's exp from inf·0 NaNs
+        diff = jnp.where(tril, g_i[..., :, None] - g_i[..., None, :], 0.0)
+        scores = jnp.where(
+            tril,
+            jnp.einsum("bhcn,bhmn->bhcm", Ch_i, Bh_i) * jnp.exp(diff),
+            0.0,
+        )
+        y = jnp.einsum("bhcm,bhmp->bhcp", scores, x_i)
+        # read the carried state, decayed to each position
+        y = y + jnp.einsum(
+            "bhcn,bhnp->bhcp", Ch_i * jnp.exp(g_i)[..., None], state
+        )
+        g_last = g_i[..., -1]
+        state = state * jnp.exp(g_last)[..., None, None] + jnp.einsum(
+            "bhcn,bhcp->bhnp",
+            Bh_i * jnp.exp(g_last[..., None] - g_i)[..., None],
+            x_i,
+        )
+        return state, y
+
+    state0 = jnp.zeros((B, H, N, P), jnp.float32)
+    xs = tuple(
+        jnp.moveaxis(a, 2, 0) for a in (xh, g_cum, Bh, Ch)
+    )
+    _, ys = jax.lax.scan(chunk_step, state0, xs)  # [n, B, H, C, P]
+    y = jnp.moveaxis(ys, 0, 2).reshape(B, H, Sp, P)[:, :, :S]
+    y = y.transpose(0, 2, 1, 3)
+    y = y + x.astype(jnp.float32) * D.astype(jnp.float32)[None, None, :, None]
+    return y.astype(in_dtype)
+
+
+def mamba2_reference(x, dt, A, Bm, Cm, D, segment_ids=None):
+    """Naive sequential recurrence (fp64-ish fp32) — test oracle only."""
+    B, S, H, P = x.shape
+    G, N = Bm.shape[2], Bm.shape[3]
+    rep = H // G
+    y = jnp.zeros((B, S, H, P), jnp.float32)
+    state = jnp.zeros((B, H, N, P), jnp.float32)
+    out = []
+    prev_seg = None
+    for t in range(S):
+        a = jnp.exp(dt[:, t] * A[None, :])  # [B, H]
+        if segment_ids is not None and t > 0:
+            reset = (segment_ids[:, t] != segment_ids[:, t - 1]).astype(jnp.float32)
+            a = a * (1.0 - reset)[:, None]
+        Bt = jnp.repeat(Bm[:, t], rep, axis=1)  # [B, H, N]
+        Ct = jnp.repeat(Cm[:, t], rep, axis=1)
+        state = state * a[..., None, None] + jnp.einsum(
+            "bhn,bhp->bhnp", Bt, x[:, t] * dt[:, t][..., None]
+        )
+        out.append(jnp.einsum("bhn,bhnp->bhp", Ct, state))
+    y = jnp.stack(out, axis=1)
+    return y + x * D[None, None, :, None]

@@ -1,0 +1,187 @@
+"""HF ⇄ native adapter for Nemotron-V3.
+
+Parity target: reference components/models/nemotron_v3/state_dict_adapter.py
+— HF keys live under ``backbone.`` (embed_tokens, layers.{i}.norm,
+layers.{i}.mixer.*, norm_f) with per-type mixer leaves; experts are split
+per-expert ``mixer.experts.{j}.{up,down}_proj.weight`` (ReLU² non-gated →
+the fused tensor is [E, D, I], no gate half); the router carries a constant
+``mixer.gate.e_score_correction_bias`` buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.nemotron_v3.model import NemotronV3Config
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+class NemotronV3StateDictAdapter:
+    def __init__(self, config: NemotronV3Config):
+        self.config = config
+        self.ids = {
+            kind: [i for i, t in enumerate(config.layers_block_type) if t == kind]
+            for kind in ("mamba", "attention", "mlp", "moe")
+        }
+
+    def _mamba_plans(self):
+        c = self.config
+        plans = [
+            (("in_proj", "kernel"), "mixer.in_proj.weight", "t"),
+            (("dt_bias",), "mixer.dt_bias", "id"),
+            (("A_log",), "mixer.A_log", "id"),
+            (("D",), "mixer.D", "id"),
+            (("norm", "scale"), "mixer.norm.weight", "id"),
+            (("out_proj", "kernel"), "mixer.out_proj.weight", "t"),
+            (("conv", "weight"), "mixer.conv1d.weight", "conv"),
+        ]
+        if c.use_conv_bias:
+            plans.append((("conv", "bias"), "mixer.conv1d.bias", "id"))
+        if c.use_bias:
+            plans.append((("in_proj", "bias"), "mixer.in_proj.bias", "id"))
+            plans.append((("out_proj", "bias"), "mixer.out_proj.bias", "id"))
+        return plans
+
+    def _attn_plans(self):
+        c = self.config
+        plans = []
+        for p in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            plans.append(((p, "kernel"), f"mixer.{p}.weight", "t"))
+            if c.attention_bias:
+                plans.append(((p, "bias"), f"mixer.{p}.bias", "id"))
+        return plans
+
+    def _mlp_plans(self):
+        c = self.config
+        plans = [
+            (("up_proj", "kernel"), "mixer.up_proj.weight", "t"),
+            (("down_proj", "kernel"), "mixer.down_proj.weight", "t"),
+        ]
+        if c.mlp_bias:
+            plans.append((("up_proj", "bias"), "mixer.up_proj.bias", "id"))
+            plans.append((("down_proj", "bias"), "mixer.down_proj.bias", "id"))
+        return plans
+
+    @staticmethod
+    def _tx(v: np.ndarray, how: str) -> np.ndarray:
+        if how == "t":
+            return _t(v)
+        if how == "conv":  # [C, 1, K] depthwise → [C, K]
+            return v[:, 0, :]
+        return v
+
+    @staticmethod
+    def _untx(v: np.ndarray, how: str) -> np.ndarray:
+        if how == "t":
+            return _t(v)
+        if how == "conv":
+            return v[:, None, :]
+        return v
+
+    def iter_from_hf(
+        self, get_tensor: Callable[[str], np.ndarray]
+    ) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        c = self.config
+        L = c.num_layers
+        yield ("embed", "embedding"), get_tensor("backbone.embed_tokens.weight")
+        yield ("final_norm", "scale"), get_tensor("backbone.norm_f.weight")
+        if not c.tie_embeddings:
+            yield ("lm_head", "kernel"), _t(get_tensor("lm_head.weight"))
+        yield ("layers", "norm", "scale"), np.stack(
+            [get_tensor(f"backbone.layers.{i}.norm.weight") for i in range(L)], 0
+        )
+
+        for kind, plans in (
+            ("mamba", self._mamba_plans()),
+            ("attention", self._attn_plans()),
+            ("mlp", self._mlp_plans()),
+        ):
+            tree = {"mamba": "mamba", "attention": "attn", "mlp": "mlp"}[kind]
+            if not self.ids[kind]:
+                continue
+            for sub, suffix, how in plans:
+                rows = [
+                    self._tx(get_tensor(f"backbone.layers.{i}.{suffix}"), how)
+                    for i in self.ids[kind]
+                ]
+                yield ((tree, *sub), np.stack(rows, 0))
+
+        if self.ids["moe"]:
+            moe = c.moe
+            routers, biases, gus, dns, sh_up, sh_dn = [], [], [], [], [], []
+            for i in self.ids["moe"]:
+                base = f"backbone.layers.{i}.mixer"
+                routers.append(_t(get_tensor(f"{base}.gate.weight")))
+                biases.append(get_tensor(f"{base}.gate.e_score_correction_bias"))
+                gus.append(np.stack(
+                    [_t(get_tensor(f"{base}.experts.{j}.up_proj.weight"))
+                     for j in range(moe.num_experts)], 0))
+                dns.append(np.stack(
+                    [_t(get_tensor(f"{base}.experts.{j}.down_proj.weight"))
+                     for j in range(moe.num_experts)], 0))
+                sh_up.append(_t(get_tensor(f"{base}.shared_experts.up_proj.weight")))
+                sh_dn.append(_t(get_tensor(f"{base}.shared_experts.down_proj.weight")))
+            yield ("moe", "router", "weight"), np.stack(routers, 0)
+            yield ("moe", "router", "bias"), np.stack(biases, 0)
+            yield ("moe", "experts", "gate_up"), np.stack(gus, 0)
+            yield ("moe", "experts", "down"), np.stack(dns, 0)
+            yield ("moe", "shared", "up_proj", "kernel"), np.stack(sh_up, 0)
+            yield ("moe", "shared", "down_proj", "kernel"), np.stack(sh_dn, 0)
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        c = self.config
+        L = c.num_layers
+        yield "backbone.embed_tokens.weight", np.asarray(params["embed"]["embedding"])
+        yield "backbone.norm_f.weight", np.asarray(params["final_norm"]["scale"])
+        if not c.tie_embeddings:
+            yield "lm_head.weight", _t(np.asarray(params["lm_head"]["kernel"]))
+        norms = np.asarray(params["layers"]["norm"]["scale"])
+        for i in range(L):
+            yield f"backbone.layers.{i}.norm.weight", norms[i]
+
+        def leaf(tree, sub):
+            x = tree
+            for s in sub:
+                x = x[s]
+            return np.asarray(x)
+
+        for kind, plans in (
+            ("mamba", self._mamba_plans()),
+            ("attention", self._attn_plans()),
+            ("mlp", self._mlp_plans()),
+        ):
+            tree = {"mamba": "mamba", "attention": "attn", "mlp": "mlp"}[kind]
+            if not self.ids[kind]:
+                continue
+            for sub, suffix, how in plans:
+                stacked = leaf(params[tree], sub)
+                for row, i in enumerate(self.ids[kind]):
+                    yield f"backbone.layers.{i}.{suffix}", self._untx(stacked[row], how)
+
+        if self.ids["moe"]:
+            moe = c.moe
+            router = leaf(params["moe"], ("router", "weight"))
+            bias = leaf(params["moe"], ("router", "bias"))
+            gu = leaf(params["moe"], ("experts", "gate_up"))
+            dn = leaf(params["moe"], ("experts", "down"))
+            su = leaf(params["moe"], ("shared", "up_proj", "kernel"))
+            sd = leaf(params["moe"], ("shared", "down_proj", "kernel"))
+            for row, i in enumerate(self.ids["moe"]):
+                base = f"backbone.layers.{i}.mixer"
+                yield f"{base}.gate.weight", _t(router[row])
+                yield f"{base}.gate.e_score_correction_bias", bias[row]
+                for j in range(moe.num_experts):
+                    yield f"{base}.experts.{j}.up_proj.weight", _t(gu[row, j])
+                    yield f"{base}.experts.{j}.down_proj.weight", _t(dn[row, j])
+                yield f"{base}.shared_experts.up_proj.weight", _t(su[row])
+                yield f"{base}.shared_experts.down_proj.weight", _t(sd[row])

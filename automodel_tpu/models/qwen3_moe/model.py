@@ -247,6 +247,15 @@ def forward_hidden(
     if deepstack is not None:
         # run the first n_deep layers unstacked, adding the deepstack visual
         # embeds at image positions after each, then scan the homogeneous rest
+        if moe.num_dense_layers:
+            # HF injects after the first n_deep DECODER layers overall; with
+            # first_k_dense_replace > 0 this loop (over MoE layers only)
+            # would shift the injection points — no shipped deepstack model
+            # has dense lead layers, so fail loudly rather than drift
+            raise NotImplementedError(
+                "deepstack injection with first_k_dense_replace "
+                f"(num_dense_layers={moe.num_dense_layers}) is not supported"
+            )
         vis_mask, ds = deepstack  # [B,S,1], [n_deep,B,S,D]
         nd = ds.shape[0]
         counts_l, aux_l = [], []

@@ -19,15 +19,26 @@ def l2norm(x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
     return x * jax.lax.rsqrt((x * x).sum(-1, keepdims=True) + eps)
 
 
-def causal_conv1d(x: jnp.ndarray, weight: jnp.ndarray) -> jnp.ndarray:
+def causal_conv1d(
+    x: jnp.ndarray, weight: jnp.ndarray, segment_ids: jnp.ndarray | None = None
+) -> jnp.ndarray:
     """Depthwise causal conv over the seq dim. x: [B, S, C]; weight: [C, K]
-    (HF conv1d.weight squeezed). No bias (qwen3-next convs are bias-free)."""
+    (HF conv1d.weight squeezed). No bias (qwen3-next convs are bias-free).
+
+    ``segment_ids`` [B, S]: packed-sequence boundaries — taps that would mix
+    a PREVIOUS document's tokens into this one are zeroed (each document
+    sees the same left-zero-padding it would unpacked)."""
     K = weight.shape[-1]
-    xp = jnp.pad(x, ((0, 0), (K - 1, 0), (0, 0)))
     S = x.shape[1]
-    out = jnp.zeros_like(x)
-    for j in range(K):  # K is 4 — unrolled adds fuse into one kernel
-        out = out + xp[:, j : j + S, :] * weight[:, j][None, None, :]
+    xp = jnp.pad(x, ((0, 0), (K - 1, 0), (0, 0)))
+    out = x * weight[:, K - 1][None, None, :]
+    for j in range(1, K):  # K is 4 — unrolled adds fuse into one kernel
+        tap = xp[:, K - 1 - j : K - 1 - j + S, :]  # x shifted right by j
+        if segment_ids is not None:
+            sp = jnp.pad(segment_ids, ((0, 0), (j, 0)), constant_values=-1)
+            same = (sp[:, :S] == segment_ids)[..., None]
+            tap = tap * same.astype(tap.dtype)
+        out = out + tap * weight[:, K - 1 - j][None, None, :]
     return out
 
 
@@ -38,9 +49,18 @@ def chunk_gated_delta_rule(
     g: jnp.ndarray,  # [B, S, H] log-decay
     beta: jnp.ndarray,  # [B, S, H] write strength
     chunk_size: int = 64,
+    segment_ids: jnp.ndarray | None = None,  # [B, S] packed-doc boundaries
 ) -> jnp.ndarray:
     """→ [B, S, H, dv]. Matches torch_chunk_gated_delta_rule with
-    use_qk_l2norm_in_kernel=True (l2 normalization applied here)."""
+    use_qk_l2norm_in_kernel=True (l2 normalization applied here).
+
+    Packed sequences: a segment START token gets an extra -50 on its
+    log-decay. Within a segment the offsets cancel exactly in every
+    g_cum[t] - g_cum[s] difference, while any cross-segment term carries
+    exp(-50) ≈ 2e-22 — the recurrent state, the intra-chunk decay matrix,
+    and the chunk-state handoff all reset at document boundaries with NO
+    change to the chunked algorithm (the reference THD path gets this from
+    fla's varlen kernels)."""
     in_dtype = query.dtype
     B, S, H, dk = query.shape
     dv = value.shape[-1]
@@ -50,6 +70,10 @@ def chunk_gated_delta_rule(
     v = value.astype(jnp.float32)
     g = g.astype(jnp.float32)
     b = beta.astype(jnp.float32)
+    if segment_ids is not None:
+        prev = jnp.pad(segment_ids, ((0, 0), (1, 0)), constant_values=-1)[:, :S]
+        starts = (segment_ids != prev).astype(jnp.float32)  # [B, S]
+        g = g - 50.0 * starts[..., None]
 
     pad = (-S) % chunk_size
     if pad:

@@ -175,8 +175,9 @@ def _full_attn_layer(cfg, backend, x, ap, cos, sin, segment_ids):
     return out @ ap["o_proj"]["kernel"].astype(x.dtype)
 
 
-def _linear_attn_layer(cfg, x, lp):
-    """Gated DeltaNet (HF Qwen3NextGatedDeltaNet)."""
+def _linear_attn_layer(cfg, x, lp, segment_ids=None):
+    """Gated DeltaNet (HF Qwen3NextGatedDeltaNet). ``segment_ids`` reset the
+    conv window and the delta-rule state at packed-document boundaries."""
     B, S, D = x.shape
     nk, nv = cfg.linear_num_key_heads, cfg.linear_num_value_heads
     hk, hv = cfg.linear_key_head_dim, cfg.linear_value_head_dim
@@ -212,7 +213,9 @@ def _linear_attn_layer(cfg, x, lp):
     mixed = jnp.concatenate(
         [q.reshape(B, S, -1), k.reshape(B, S, -1), v.reshape(B, S, -1)], axis=-1
     )
-    mixed = jax.nn.silu(causal_conv1d(mixed, lp["conv"]["weight"].astype(x.dtype)))
+    mixed = jax.nn.silu(
+        causal_conv1d(mixed, lp["conv"]["weight"].astype(x.dtype), segment_ids)
+    )
     q = mixed[..., : cfg.key_dim].reshape(B, S, nk, hk)
     k = mixed[..., cfg.key_dim : 2 * cfg.key_dim].reshape(B, S, nk, hk)
     v = mixed[..., 2 * cfg.key_dim :].reshape(B, S, nv, hv)
@@ -224,7 +227,9 @@ def _linear_attn_layer(cfg, x, lp):
     q = jnp.repeat(q, ratio, axis=2)
     k = jnp.repeat(k, ratio, axis=2)
 
-    core = chunk_gated_delta_rule(q, k, v, g, beta)  # [B, S, nv, hv]
+    core = chunk_gated_delta_rule(
+        q, k, v, g, beta, segment_ids=segment_ids
+    )  # [B, S, nv, hv]
 
     # gated RMSNorm (standard weight, silu(z) gate) in fp32
     cf = core.astype(jnp.float32)
@@ -245,15 +250,6 @@ def forward_hidden(
 ) -> tuple[jnp.ndarray, MoEModelAux]:
     cd = backend.compute_jnp_dtype
     B, S = input_ids.shape
-    if segment_ids is not None:
-        # the conv + delta-rule recurrence would leak context across packed
-        # document boundaries; fail loudly until segment resets exist in the
-        # chunked kernel
-        raise NotImplementedError(
-            "qwen3-next linear-attention layers do not support packed "
-            "sequences (segment_ids) yet — the recurrent state has no "
-            "segment reset; use unpacked batches"
-        )
     if position_ids is None:
         position_ids = jnp.broadcast_to(
             jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
@@ -285,7 +281,9 @@ def forward_hidden(
         else:
             lp = jax.tree.map(lambda x: x[i_lin], params["linear_attn"])
             i_lin += 1
-            mixer = lambda x, lp=lp: _linear_attn_layer(cfg, x, lp)
+            mixer = lambda x, lp=lp: _linear_attn_layer(
+                cfg, x, lp, segment_ids=segment_ids
+            )
 
         def layer(h, norm_p=norm_p, mixer=mixer):
             x = gemma_rms_norm(h, norm_p["input_norm"]["scale"], cfg.rms_eps)

@@ -1,0 +1,13 @@
+from automodel_tpu.models.qwen3_omni_moe.model import (
+    Qwen3OmniMoeThinkerConfig,
+    Qwen3OmniMoeThinkerForCausalLM,
+)
+from automodel_tpu.models.qwen3_omni_moe.state_dict_adapter import (
+    Qwen3OmniMoeStateDictAdapter,
+)
+
+__all__ = [
+    "Qwen3OmniMoeThinkerConfig",
+    "Qwen3OmniMoeThinkerForCausalLM",
+    "Qwen3OmniMoeStateDictAdapter",
+]

@@ -1,0 +1,32 @@
+"""HF ⇄ native adapter for the Qwen3-Omni-MoE thinker.
+
+Parity target: reference components/models/qwen3_omni_moe/state_dict_adapter
+— the qwen3-moe key plan under the ``thinker.model.`` / ``thinker.lm_head.``
+prefix (reference adapter:43-55 injects the same prefix). Audio/vision tower
+keys in the checkpoint are untouched by training and skipped.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+from automodel_tpu.models.qwen3_moe.state_dict_adapter import MoEStateDictAdapter
+from automodel_tpu.models.qwen3_omni_moe.model import Qwen3OmniMoeThinkerConfig
+
+
+class Qwen3OmniMoeStateDictAdapter(MoEStateDictAdapter):
+    def __init__(self, config: Qwen3OmniMoeThinkerConfig):
+        super().__init__(config)
+
+    @staticmethod
+    def _to_omni_key(k: str) -> str:
+        if k.startswith("model.") or k.startswith("lm_head."):
+            return "thinker." + k
+        return k
+
+    def iter_from_hf(self, get_tensor: Callable):
+        yield from super().iter_from_hf(lambda k: get_tensor(self._to_omni_key(k)))
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, Any]]:
+        for k, v in super().to_hf(params):
+            yield self._to_omni_key(k), v

@@ -158,6 +158,38 @@ def _qwen3_vl_moe_builder(hf_config: Any, backend: BackendConfig):
     )
 
 
+@register_architecture(
+    "NemotronV3ForCausalLM", "NemotronHForCausalLM"
+)
+def _nemotron_v3_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.nemotron_v3 import (
+        NemotronV3Config,
+        NemotronV3ForCausalLM,
+        NemotronV3StateDictAdapter,
+    )
+
+    cfg = NemotronV3Config.from_hf(hf_config)
+    return NemotronV3ForCausalLM(cfg, backend), NemotronV3StateDictAdapter(cfg)
+
+
+@register_architecture(
+    "Qwen3OmniMoeForConditionalGeneration",
+    "Qwen3OmniMoeThinkerForConditionalGeneration",
+)
+def _qwen3_omni_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.qwen3_omni_moe import (
+        Qwen3OmniMoeStateDictAdapter,
+        Qwen3OmniMoeThinkerConfig,
+        Qwen3OmniMoeThinkerForCausalLM,
+    )
+
+    cfg = Qwen3OmniMoeThinkerConfig.from_hf(hf_config)
+    return (
+        Qwen3OmniMoeThinkerForCausalLM(cfg, backend),
+        Qwen3OmniMoeStateDictAdapter(cfg),
+    )
+
+
 @register_architecture("KimiK25VLForConditionalGeneration", "KimiVLForConditionalGeneration_K25")
 def _kimi_k25_vl_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.kimi_k25_vl import (

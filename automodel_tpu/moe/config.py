@@ -45,8 +45,14 @@ class MoEConfig:
     # linear bias on the router that feeds both selection and weights
     interleaved_gate_up: bool = False
     expert_mlp_bias: bool = False
-    activation: str = "swiglu"  # swiglu | swiglu_oai
+    activation: str = "swiglu"  # swiglu | swiglu_oai | relu2 (non-gated)
     router_linear_bias: bool = False
+
+    @property
+    def gated(self) -> bool:
+        """Gated experts carry fused [.., D, 2I] gate_up weights; non-gated
+        (nemotron relu2) carry [.., D, I] up-only weights."""
+        return self.activation != "relu2"
 
     def __post_init__(self):
         if self.score_func not in ("softmax", "sigmoid"):

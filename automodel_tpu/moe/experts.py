@@ -56,14 +56,16 @@ def _ffn(
     w: dict,
     act2: Act,
     interleaved: bool = False,
+    gated: bool = True,
 ) -> jnp.ndarray:
     """h: [..., D] → [..., D] through one expert's weights dict
-    {gate_up [D,2I], down [I,D], (gate_up_bias [2I], down_bias [D])}.
-    `act2(gate, up)` is the two-argument gated activation."""
+    {gate_up [D,2I] (or [D,I] non-gated), down [I,D], (…biases)}.
+    `act2(gate, up)` is the two-argument gated activation; non-gated experts
+    (nemotron relu2) skip the split and act2 ignores its second operand."""
     gu = h @ w["gate_up"].astype(h.dtype)
     if "gate_up_bias" in w:
         gu = gu + w["gate_up_bias"].astype(h.dtype)
-    g, u = _split_gate_up(gu, interleaved)
+    g, u = _split_gate_up(gu, interleaved) if gated else (gu, gu)
     out = act2(g, u) @ w["down"].astype(h.dtype)
     if "down_bias" in w:
         out = out + w["down_bias"].astype(h.dtype)
@@ -84,7 +86,7 @@ def dense_experts(
         jnp.arange(x.shape[0])[:, None], gate_out.topk_idx
     ].add(gate_out.topk_weights)
     ys = jax.vmap(
-        lambda w: _ffn(x, w, act2, cfg.interleaved_gate_up), in_axes=0, out_axes=0
+        lambda w: _ffn(x, w, act2, cfg.interleaved_gate_up, cfg.gated), in_axes=0, out_axes=0
     )(weights)  # [E, T, D]
     return jnp.einsum("etd,te->td", ys, cw)
 
@@ -122,7 +124,7 @@ def gspmd_experts(
     )
     expert_in = constrain(expert_in, ("expert", "expert_batch", None, None))
     expert_out = jax.vmap(
-        lambda h, w: _ffn(h, w, act2, cfg.interleaved_gate_up)
+        lambda h, w: _ffn(h, w, act2, cfg.interleaved_gate_up, cfg.gated)
     )(expert_in, weights)  # [E, B, C, D]
     expert_out = constrain(expert_out, ("expert", "expert_batch", None, None))
     out = jnp.einsum(
@@ -236,7 +238,7 @@ def ragged_experts(
     gu = ragged_dot(xs, w_gu, group_sizes, platform=platform)
     if "gate_up_bias" in weights:
         gu = gu + weights["gate_up_bias"].astype(xs.dtype)[sorted_expert]
-    g, u = _split_gate_up(gu, cfg.interleaved_gate_up)
+    g, u = _split_gate_up(gu, cfg.interleaved_gate_up) if cfg.gated else (gu, gu)
     h_mid = act2(g, u)
     if fp8:
         h_mid = fp8_qdq_tensor(h_mid)
@@ -279,6 +281,11 @@ def a2a_experts(
             fp8=fp8,
         ).reshape(B, S, D)
 
+    if not cfg.gated:
+        raise NotImplementedError(
+            "non-gated (relu2) experts are not wired into the a2a dispatcher "
+            "yet — use experts='ragged' or 'gspmd' for nemotron-v3 EP"
+        )
     from automodel_tpu.parallel.mesh import MeshAxisName as A
     from jax.sharding import PartitionSpec as P
 
@@ -429,6 +436,11 @@ def a2a_experts_manual(
     (parallel.pp restricts ep_manual mode to tp=1)."""
     Bl, Sl, D = x.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
+    if not cfg.gated:
+        raise NotImplementedError(
+            "non-gated (relu2) experts are not wired into the a2a dispatcher "
+            "yet — use experts='ragged' or 'gspmd' for nemotron-v3 EP"
+        )
     if E % ep:
         raise ValueError(f"num_experts={E} must be divisible by ep={ep}")
     E_loc = E // ep

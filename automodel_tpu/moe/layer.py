@@ -39,6 +39,12 @@ def make_act2(cfg: MoEConfig, base_act: Callable) -> Callable:
             return (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
 
         return act2
+    if cfg.activation == "relu2":
+        # nemotron-v3 non-gated experts: square-ReLU on the single up
+        # projection (the u operand is the same array, ignored)
+        import jax
+
+        return lambda g, u: jnp.square(jax.nn.relu(g))
     return lambda g, u: base_act(g) * u
 
 
@@ -93,9 +99,13 @@ def moe_block(
     out = routed
     if "shared" in mp:
         sp = mp["shared"]
-        g = xt @ sp["gate_proj"]["kernel"].astype(xt.dtype)
         u = xt @ sp["up_proj"]["kernel"].astype(xt.dtype)
-        shared = (act(g) * u) @ sp["down_proj"]["kernel"].astype(xt.dtype)
+        if "gate_proj" in sp:
+            g = xt @ sp["gate_proj"]["kernel"].astype(xt.dtype)
+            mid = act(g) * u
+        else:  # non-gated shared expert (nemotron relu2)
+            mid = act2(u, u)
+        shared = mid @ sp["down_proj"]["kernel"].astype(xt.dtype)
         if "shared_gate" in mp:
             sg = jnp.asarray(xt @ mp["shared_gate"]["kernel"].astype(xt.dtype))
             shared = shared * jnp.asarray(jnp.reciprocal(1 + jnp.exp(-sg)))
@@ -129,7 +139,7 @@ def init_moe_params(
     p = {
         "router": {"weight": init(k[0], D, E, fan_in=D)},
         "experts": {
-            "gate_up": init(k[1], E, D, 2 * I, fan_in=D),
+            "gate_up": init(k[1], E, D, (2 * I if cfg.gated else I), fan_in=D),
             "down": init(k[2], E, I, D, fan_in=I),
         },
     }
@@ -138,16 +148,17 @@ def init_moe_params(
     if cfg.router_linear_bias:
         p["router"]["linear_bias"] = jnp.zeros(shape(E), jnp.float32)
     if cfg.expert_mlp_bias:
-        p["experts"]["gate_up_bias"] = jnp.zeros(shape(E, 2 * I), dtype)
+        p["experts"]["gate_up_bias"] = jnp.zeros(shape(E, (2 * I if cfg.gated else I)), dtype)
         p["experts"]["down_bias"] = jnp.zeros(shape(E, D), dtype)
     if cfg.num_shared_experts > 0:
         SI = cfg.shared_expert_intermediate_size or cfg.moe_intermediate_size
         SI = SI * cfg.num_shared_experts
         p["shared"] = {
-            "gate_proj": {"kernel": init(k[3], D, SI, fan_in=D)},
             "up_proj": {"kernel": init(k[4], D, SI, fan_in=D)},
             "down_proj": {"kernel": init(k[5], SI, D, fan_in=SI)},
         }
+        if cfg.gated:
+            p["shared"]["gate_proj"] = {"kernel": init(k[3], D, SI, fan_in=D)}
         if cfg.shared_expert_gate:
             p["shared_gate"] = {"kernel": jnp.zeros(shape(D, 1), dtype)}
     return p

@@ -282,6 +282,14 @@ def attention(
         raise ValueError(
             f"Unknown attention backend {backend!r}; available: {sorted(ATTENTION_BACKENDS)}"
         )
+    if backend == "ring" and kwargs.get("sinks") is not None:
+        # composition hole (documented): the ring blockwise kernels have no
+        # sink column; sinks models (gpt-oss) are short-context, so CP is
+        # rejected loudly rather than silently dropping the sinks
+        raise NotImplementedError(
+            "attention sinks are not supported on the ring (context-"
+            "parallel) backend yet; use attn='sdpa' or 'flash'"
+        )
     if backend == "flash":
         kwargs["platform"] = platform
     return fn(q, k, v, **kwargs)

@@ -231,7 +231,15 @@ def ragged_dot(
     platform: str | None = None,
 ) -> jnp.ndarray:
     """Drop-in for `jax.lax.ragged_dot`: Pallas gmm on TPU (or under
-    AUTOMODEL_GMM_INTERPRET=1 anywhere), XLA's ragged_dot elsewhere."""
+    AUTOMODEL_GMM_INTERPRET=1 anywhere), XLA's ragged_dot elsewhere.
+
+    PRECONDITION (TPU path): rows at indices >= sum(group_sizes) are NOT
+    covered by any work unit and return uninitialized memory — callers must
+    either have sum(group_sizes) == lhs rows (the MoE dispatch paths do:
+    group sizes are exact bincounts of the picks) or never read the tail
+    (the a2a path's sentinel rows route to an explicit zero row instead).
+    Zeroing the tail here would cost an [M, N] select per call on the
+    hottest op in the MoE step."""
     if interpret is None:
         interpret = _interpret_requested()
     if not (interpret or _pallas_eligible(platform)):

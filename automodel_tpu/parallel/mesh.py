@@ -214,7 +214,7 @@ class MeshContext:
 
 
 def hybrid_mesh_shapes(
-    config: MeshConfig, world_size: int, n_processes: int
+    config: MeshConfig, world_size: int, n_slices: int
 ) -> tuple[tuple[int, ...], tuple[int, ...]]:
     """Split the mesh shape into (ici_shape, dcn_shape) for
     `mesh_utils.create_hybrid_device_mesh` on a multi-host DCN×ICI topology.
@@ -241,15 +241,16 @@ def hybrid_mesh_shapes(
         if unknown:
             raise ValueError(f"dcn axes {sorted(unknown)} not mesh axes {list(axes)}")
         prod = int(np.prod(list(dcn.values())))
-        if prod != n_processes:
+        if prod != n_slices:
             raise ValueError(
-                f"dcn degrees {dcn} product {prod} != process count {n_processes}"
+                f"dcn degrees {dcn} product {prod} != DCN granule (slice) count "
+                f"{n_slices}"
             )
         for a, d in dcn.items():
             if d < 1 or axes[a] % d:
                 raise ValueError(f"dcn[{a}]={d} must divide axis degree {axes[a]}")
     else:
-        rem = n_processes
+        rem = n_slices
         for a in ("pp", "dp_replicate", "dp_shard"):
             g = math.gcd(axes[a], rem)
             if g > 1:
@@ -257,7 +258,7 @@ def hybrid_mesh_shapes(
                 rem //= g
         if rem != 1:
             raise ValueError(
-                f"cannot lay {n_processes} DCN granules across "
+                f"cannot lay {n_slices} DCN granules across "
                 f"{ {a: axes[a] for a in ('pp', 'dp_replicate', 'dp_shard')} } "
                 "without splitting ep/tp/cp over DCN (latency-bound "
                 "collectives); set MeshConfig.dcn explicitly to opt in"

@@ -39,16 +39,12 @@ class PeftConfig:
     target_modules: Sequence[str] = ("*attn/q_proj*", "*attn/v_proj*")
     dim: int = 8
     alpha: float = 16.0
+    # input-side dropout on the adapter branch (y = Wx + BA·drop(x), the
+    # reference LinearLoRA placement) — applied activation-side via grafted
+    # per-site/per-layer PRNG seeds, so it requires every dropout-bearing
+    # adapter to be GRAFTABLE (model lora_graft_patterns)
     dropout: float = 0.0
     use_rslora: bool = False  # scale = alpha/sqrt(dim) instead of alpha/dim
-
-    def __post_init__(self):
-        if self.dropout:
-            raise NotImplementedError(
-                "LoRA dropout requires activation-side application; the "
-                "merged formulation supports dropout=0 only (the reference "
-                "default)."
-            )
 
     @property
     def scale(self) -> float:
@@ -148,6 +144,8 @@ def graft_lora(base_params: Any, lora_params: dict, cfg: PeftConfig) -> Any:
         upd = {
             "lora_A": (a.astype(jnp.float32) * scale).astype(a.dtype),
             "lora_B": ab["lora_B"],
+            # dropout seeds/rates (train-time graft) pass through to _proj
+            **{k: v for k, v in ab.items() if k.startswith("lora_drop")},
         }
         out = _insert(out, parts[:-1], upd)
     return out
@@ -159,6 +157,7 @@ def make_lora_loss_fn(
     cfg: PeftConfig,
     graft_patterns: Sequence[str] = (),
     base_transform=None,
+    dropout_seed: int = 0,
 ):
     """Wrap a (params, mb) loss into an (adapters, mb) loss.
 
@@ -180,19 +179,61 @@ def make_lora_loss_fn(
             fnmatch.fnmatch(p, pat) for pat in graft_patterns
         )
 
-    def loss_fn(lora_params, mb, base):
-        if base_transform is not None:
-            base = base_transform(base)
-        frozen = jax.lax.stop_gradient(base)
-        graft = {p: ab for p, ab in lora_params.items() if _graftable(p)}
-        merged = {p: ab for p, ab in lora_params.items() if not _graftable(p)}
-        params = graft_lora(frozen, graft, cfg) if graft else frozen
-        if merged:
-            params = merge_lora(params, merged, cfg)
-        return base_loss_fn(params, mb)
+    def _make(train: bool):
+        use_dropout = train and cfg.dropout > 0.0
 
-    loss_fn.bound_params = base_params
-    return loss_fn
+        def loss_fn(lora_params, mb, base, step=None, mb_index=None):
+            if base_transform is not None:
+                base = base_transform(base)
+            frozen = jax.lax.stop_gradient(base)
+            graft = {p: ab for p, ab in lora_params.items() if _graftable(p)}
+            merged = {p: ab for p, ab in lora_params.items() if not _graftable(p)}
+            if use_dropout:
+                if merged:
+                    raise NotImplementedError(
+                        f"LoRA dropout needs activation-side adapters; "
+                        f"{sorted(merged)} are not graftable on this model"
+                    )
+                # per-step, per-site, per-layer seeds ride the grafted tree;
+                # the consuming projection (_proj) draws the bernoulli mask
+                step_key = jax.random.fold_in(
+                    jax.random.key(0x10AA ^ dropout_seed), step
+                )
+                if mb_index is not None:
+                    # independent masks per grad-accumulation microbatch
+                    step_key = jax.random.fold_in(step_key, mb_index)
+                graft = dict(graft)
+                for i, (p, ab) in enumerate(sorted(graft.items())):
+                    site = jax.random.fold_in(step_key, i)
+                    lead = ab["lora_A"].shape[:-2]
+                    if lead:
+                        seeds = jax.vmap(
+                            lambda j: jax.random.key_data(
+                                jax.random.fold_in(site, j)
+                            )
+                        )(jnp.arange(lead[0]))
+                        rate = jnp.full(lead[:1], cfg.dropout, jnp.float32)
+                    else:
+                        seeds = jax.random.key_data(site)
+                        rate = jnp.float32(cfg.dropout)
+                    graft[p] = {
+                        **ab, "lora_drop_seed": seeds, "lora_drop_rate": rate,
+                    }
+            params = graft_lora(frozen, graft, cfg) if graft else frozen
+            if merged:
+                params = merge_lora(params, merged, cfg)
+            return base_loss_fn(params, mb)
+
+        loss_fn.bound_params = base_params
+        loss_fn.needs_step = use_dropout
+        loss_fn.needs_mb_index = use_dropout
+        return loss_fn
+
+    train_fn = _make(train=True)
+    if cfg.dropout > 0.0:
+        # dropout is train-only; build_eval_step should use this variant
+        train_fn.eval_loss_fn = _make(train=False)
+    return train_fn
 
 
 def lora_sharding_rules(base_rules: list, lora_params: dict) -> list:

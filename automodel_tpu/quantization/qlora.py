@@ -58,12 +58,19 @@ _NF4_MID = (NF4_CODE[1:] + NF4_CODE[:-1]) / 2.0
 
 
 def _nf4_pack_flat(flat: np.ndarray, blocksize: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pack layout: byte j of a block holds elements j (hi nibble) and
+    j+bs/2 (lo nibble) — HALF-BLOCK split, NOT adjacent-pair interleave.
+    Dequantizing adjacent pairs needs an [N, 2] stack whose TPU (8,128)
+    layout pads the 2-wide dim to 128 — a 64x memory expansion that OOMed
+    the 8B QLoRA step; the half-block layout dequantizes as a concat of two
+    large contiguous halves instead."""
     blocks = flat.reshape(-1, blocksize)
     scales = np.abs(blocks).max(axis=1)
     scales = np.maximum(scales, 1e-12)
     normed = blocks / scales[:, None]
-    idx = np.searchsorted(_NF4_MID, normed.reshape(-1)).astype(np.uint8)
-    packed = (idx[0::2] << 4) | idx[1::2]
+    idx = np.searchsorted(_NF4_MID, normed).astype(np.uint8)  # [nb, bs]
+    half = blocksize // 2
+    packed = ((idx[:, :half] << 4) | (idx[:, half:])).reshape(-1)
     return packed, scales.astype(np.float32)
 
 
@@ -122,18 +129,21 @@ class _Nf4Meta:
 def nf4_dequantize(q: dict) -> jnp.ndarray:
     """Inverse of nf4_quantize (inside jit). For a stacked leaf, a 1-D codes
     array means ONE layer's slice (a lax.scan body sliced the leading axis)
-    → dequantizes to meta.shape[1:]."""
+    → dequantizes to meta.shape[1:]. Uses the half-block pack layout (see
+    _nf4_pack_flat) so the unpack is a concat of two contiguous halves —
+    no TPU-hostile [N, 2] intermediate."""
     meta = q["meta"]
     codes, scales = q["codes"], q["scales"]
     shape = meta.shape
     if meta.stacked and codes.ndim == 1:
         shape = meta.shape[1:]
-    codes, scales = codes.reshape(-1), scales.reshape(-1)
-    hi = (codes >> 4).astype(jnp.int32)
-    lo = (codes & 0xF).astype(jnp.int32)
-    idx = jnp.stack([hi, lo], axis=1).reshape(-1)
+    half = meta.blocksize // 2
+    codes = codes.reshape(-1, half)  # [nblocks, bs/2]
+    scales = scales.reshape(-1)
     table = jnp.asarray(NF4_CODE)
-    vals = table[idx].reshape(-1, meta.blocksize) * scales[:, None]
+    hi = table[(codes >> 4).astype(jnp.int32)]
+    lo = table[(codes & 0xF).astype(jnp.int32)]
+    vals = jnp.concatenate([hi, lo], axis=1) * scales[:, None]
     return vals.reshape(shape).astype(meta.dtype)
 
 

@@ -79,8 +79,6 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
         kd = dict(cfg.get("kd", {}) or {})
         ratio = float(kd.get("ratio", 0.5))
         temperature = float(kd.get("temperature", 1.0))
-        if self.peft_config is not None:
-            raise NotImplementedError("KD+LoRA composition not wired yet")
         self.loss_fn = make_kd_loss(
             self.model,
             self.teacher.model,
@@ -89,11 +87,35 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
             ratio,
             temperature,
         )
-        post_step = getattr(self.model, "post_step_fn", None)
+        if self.peft_config is not None:
+            # KD + LoRA (reference recipes/llm/kd.py supports PEFT): wrap the
+            # KD loss exactly like train_ft wraps the CE loss — adapters are
+            # the trainables (super().setup() already built state over them),
+            # student base rides bound_params, teacher stays frozen inside
+            # make_kd_loss's stop_gradient
+            if getattr(self, "_qlora_cfg", None) is not None:
+                raise NotImplementedError("KD+QLoRA composition not supported")
+            from automodel_tpu.peft import make_lora_loss_fn
+
+            self.loss_fn = make_lora_loss_fn(
+                self.loss_fn,
+                self.auto.params,
+                self.peft_config,
+                graft_patterns=getattr(self.model, "lora_graft_patterns", ()),
+                dropout_seed=cfg.get("seed", 42),
+            )
+        post_step = (
+            getattr(self.model, "post_step_fn", None)
+            if self.peft_config is None
+            else None
+        )
         self.train_step = build_train_step(
             self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step
         )
-        self.eval_step = build_eval_step(self.loss_fn)
+        # eval must not apply LoRA dropout — use the train=False variant
+        self.eval_step = build_eval_step(
+            getattr(self.loss_fn, "eval_loss_fn", self.loss_fn)
+        )
         logger.info("KD: ratio=%.2f temperature=%.2f", ratio, temperature)
 
 

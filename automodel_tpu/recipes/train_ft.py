@@ -177,13 +177,17 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.loss_fn, base_tree, self.peft_config,
                 graft_patterns=getattr(self.model, "lora_graft_patterns", ()),
                 base_transform=base_transform,
+                dropout_seed=cfg.get("seed", 42),
             )
         post_step = getattr(self.model, "post_step_fn", None) if self.peft_config is None else None
         self.train_step = build_train_step(
             self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step,
             grad_mask=getattr(self, "grad_mask", None),
         )
-        self.eval_step = build_eval_step(self.loss_fn)
+        # eval must not apply LoRA dropout — use the train=False variant
+        self.eval_step = build_eval_step(
+            getattr(self.loss_fn, "eval_loss_fn", self.loss_fn)
+        )
 
         # data
         self.dataloader = self._build_dataloader(cfg.get("dataset"), cfg.get("dataloader", {}))

@@ -59,11 +59,16 @@ def build_train_step(
     # into every lowering (GBs for large bases)
     bound_params = getattr(loss_fn, "bound_params", None)
     # a loss_fn may also want the optimizer step (QAT delayed fake-quant
-    # enablement, quantization/qat.py) — passed as a traced kwarg
+    # enablement, quantization/qat.py) — passed as a traced kwarg. LoRA
+    # dropout additionally folds the microbatch index so accumulation
+    # microbatches draw independent masks.
     needs_step = getattr(loss_fn, "needs_step", False)
+    needs_mb_index = getattr(loss_fn, "needs_mb_index", False)
 
-    def call_loss(params, mb, bound, step):
+    def call_loss(params, mb, bound, step, mb_index=None):
         kw = {"step": step} if needs_step else {}
+        if needs_mb_index:
+            kw["mb_index"] = mb_index
         out = (
             loss_fn(params, mb, bound, **kw)
             if bound is not None
@@ -74,9 +79,9 @@ def build_train_step(
         loss_sum, n = out
         return loss_sum, n, {}
 
-    def mb_value_and_grad(params, mb, bound, step):
+    def mb_value_and_grad(params, mb, bound, step, mb_index=None):
         def wrapped(p):
-            loss_sum, n, extras = call_loss(p, mb, bound, step)
+            loss_sum, n, extras = call_loss(p, mb, bound, step, mb_index)
             return loss_sum.astype(jnp.float32), (n, extras)
         val, grads = jax.value_and_grad(wrapped, has_aux=True)(params)
         if grad_mask is not None:
@@ -89,17 +94,21 @@ def build_train_step(
         grads0 = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params)
         carry0 = (grads0, jnp.float32(0.0), jnp.int32(0))
 
-        def body(carry, mb):
+        def body(carry, mb_and_i):
+            mb, mb_i = mb_and_i
             g_acc, l_acc, n_acc = carry
             (loss_sum, (n, extras)), grads = mb_value_and_grad(
-                state.params, mb, bound, state.step
+                state.params, mb, bound, state.step, mb_i
             )
             g_acc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), g_acc, grads
             )
             return (g_acc, l_acc + loss_sum, n_acc + n), extras
 
-        (grads, loss_sum, n_tokens), extras_stacked = jax.lax.scan(body, carry0, batch)
+        n_mb = jax.tree.leaves(batch)[0].shape[0]
+        (grads, loss_sum, n_tokens), extras_stacked = jax.lax.scan(
+            body, carry0, (batch, jnp.arange(n_mb, dtype=jnp.int32))
+        )
         extras_sum = jax.tree.map(lambda x: x.sum(axis=0), extras_stacked)
         denom = jnp.maximum(n_tokens, 1).astype(jnp.float32)
         grads = jax.tree.map(lambda g: g / denom, grads)

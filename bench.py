@@ -207,7 +207,29 @@ def _run(hf, backend, batch, seq, steps, ctx, lora=False, qlora=False):
     return tps_chip, flops_per_token_for_config(auto.model.config, seq)
 
 
+def _probe_tpu(timeout_s: int = 300) -> bool:
+    """Check the (tunneled) TPU backend in a SUBPROCESS with a timeout —
+    a dead tunnel blocks jax's backend init for many minutes, which would
+    otherwise hang the whole bench. On failure the main process pins the
+    cpu platform BEFORE its own backend init, so the smoke path still runs."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, sys; sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)"],
+            timeout=timeout_s, capture_output=True,
+        )
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
 def main() -> None:
+    if not _probe_tpu():
+        print("[bench] TPU backend unavailable; pinning cpu", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+
     from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
     from automodel_tpu.utils.flops_utils import calculate_mfu, device_peak_tflops
 

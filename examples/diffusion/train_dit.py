@@ -1,0 +1,58 @@
+"""Minimal DiT diffusion training example (reference §2.4: diffusion row —
+examples/diffusion + NeMoAutoDiffusionPipeline).
+
+Trains a small class-conditional DiT with the DDPM epsilon loss on random
+latents (swap `make_batch` for a real latent dataset). Runs on CPU devices
+or the chip:
+
+    python examples/diffusion/train_dit.py
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.diffusion import AutoDiffusionPipeline, DiTConfig, DiTModel, make_diffusion_loss
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.optim.builders import build_optimizer
+from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+from automodel_tpu.training.train_state import TrainState
+from automodel_tpu.training.train_step import build_train_step
+
+
+def make_batch(rng, b, cfg):
+    return {
+        "x": np.asarray(rng.normal(size=(1, b, cfg.image_size, cfg.image_size, cfg.in_channels)), np.float32),
+        "y": np.asarray(rng.integers(0, cfg.num_classes, (1, b)), np.int32),
+        "step_seed": np.asarray(rng.integers(0, 1 << 30, (1, 1)), np.int32),
+    }
+
+
+def main():
+    ctx = build_mesh(MeshConfig(dp_shard=-1))
+    cfg = DiTConfig(image_size=32, patch_size=4, in_channels=4,
+                    hidden_size=256, num_layers=4, num_heads=4, num_classes=10)
+    model = DiTModel(cfg, BackendConfig(param_dtype="float32", compute_dtype="float32"))
+    pipe = AutoDiffusionPipeline.from_components(
+        {"transformer": (model, model.init(jax.random.PRNGKey(0)))}, ctx,
+    )
+    model, params = pipe["transformer"]
+    loss_fn = make_diffusion_loss(model)
+    opt = build_optimizer(name="adamw", lr=1e-4)
+    state = TrainState.create(params, jax.jit(opt.init)(params))
+    step = build_train_step(loss_fn, opt)
+    rng = np.random.default_rng(0)
+    for i in range(20):
+        state, m = step(state, make_batch(rng, 8, cfg))
+        if i % 5 == 0:
+            print(f"step {i}: loss={float(m['loss']):.4f}")
+    print("final loss:", float(m["loss"]))
+
+
+if __name__ == "__main__":
+    main()

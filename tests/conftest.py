@@ -19,6 +19,11 @@ os.environ["JAX_PLATFORMS"] = ""  # allow cpu alongside any preregistered backen
 
 import jax  # noqa: E402
 
+# pin the RUNTIME platform selection to cpu: this skips initializing the
+# preregistered axon TPU plugin entirely, so the unit suite neither contends
+# for the tunneled chip nor hangs when the tunnel is down (observed: a dead
+# tunnel blocks backends() init for minutes per process)
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 try:
     _cpus = jax.devices("cpu")

@@ -18,6 +18,8 @@ os.environ["JAX_PLATFORMS"] = ""  # axon is force-registered; cpu must coexist
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
+
+jax.config.update("jax_platforms", "cpu")  # never touch the tunneled chip
 import numpy as np
 
 from automodel_tpu import auto_model

@@ -48,7 +48,7 @@ def test_benchmark_recipe_cli(tmp_path):
             },
             "backend": {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32"},
         },
-        "distributed": {"dp_shard": 1},
+        "distributed": {"dp_shard": -1},
         "dataset": {
             "_target_": "automodel_tpu.data.sft.MockSFTDataset",
             "num_samples": 64,

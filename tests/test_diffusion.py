@@ -1,0 +1,91 @@
+"""DiT diffusion: patchify round-trip, adaLN-Zero identity init, DDPM loss
+decreases, pipeline per-component sharded placement, LoRA dropout
+integration. Reference parity target: _diffusers/auto_diffusion_pipeline.py
++ the Wan DiT strategy (parallelizer.py:281)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.diffusion import (
+    AutoDiffusionPipeline,
+    DiTConfig,
+    DiTModel,
+    make_diffusion_loss,
+)
+from automodel_tpu.models.common.config import BackendConfig
+
+FP32 = BackendConfig(param_dtype="float32", compute_dtype="float32")
+
+
+def _tiny():
+    cfg = DiTConfig(image_size=16, patch_size=4, in_channels=3,
+                    hidden_size=64, num_layers=2, num_heads=2, num_classes=5)
+    return cfg, DiTModel(cfg, FP32)
+
+
+def test_patchify_round_trip():
+    cfg, model = _tiny()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 16, 16, 3)), jnp.float32)
+    p = model.patchify(x)
+    assert p.shape == (2, cfg.num_patches, cfg.patch_dim)
+    # unpatchify inverts patchify when out_channels == in_channels
+    back = model.unpatchify(p.reshape(2, cfg.num_patches, -1))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-6)
+
+
+def test_adaln_zero_identity_at_init():
+    """adaLN-Zero: zero-gated blocks + zero output head → the initial model
+    output is exactly zero regardless of input (the DiT identity-start)."""
+    cfg, model = _tiny()
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 16, 16, 3)), jnp.float32)
+    out = model(params, x, jnp.asarray([0, 500]), jnp.asarray([1, 2]))
+    assert out.shape == (2, 16, 16, 3)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_ddpm_training_loss_decreases():
+    from automodel_tpu.optim.builders import build_optimizer
+    from automodel_tpu.training.train_state import TrainState
+    from automodel_tpu.training.train_step import build_train_step
+
+    cfg, model = _tiny()
+    params = model.init(jax.random.PRNGKey(0))
+    loss_fn = make_diffusion_loss(model, num_train_timesteps=100)
+    opt = build_optimizer(name="adamw", lr=3e-3)
+    state = TrainState.create(params, jax.jit(opt.init)(params))
+    step = build_train_step(loss_fn, opt)
+    rng = np.random.default_rng(0)
+    # fixed clean latents; fresh noise each step via step_seed
+    x = np.asarray(rng.normal(size=(1, 8, 16, 16, 3)), np.float32)
+    losses = []
+    for i in range(12):
+        b = {"x": x, "y": np.asarray(rng.integers(0, 5, (1, 8)), np.int32),
+             "step_seed": np.asarray([[i]], np.int32)}
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]), losses
+
+
+def test_pipeline_sharded_placement(devices8):
+    from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    ctx = build_mesh(MeshConfig(dp_shard=4, tp=2), devices=devices8)
+    cfg, model = _tiny()
+    params = model.init(jax.random.PRNGKey(0))
+    pipe = AutoDiffusionPipeline.from_components(
+        {"transformer": (model, params),
+         "vae": (None, {"w": jnp.ones((8, 8))})},  # unmapped → replicated
+        ctx,
+    )
+    _, tp = pipe["transformer"]
+    spec = tp["blocks"]["qkv"]["kernel"].sharding.spec
+    assert "tensor" not in str(spec)  # logical axes resolved to mesh axes
+    assert str(spec) != "PartitionSpec()"
+    _, vp = pipe["vae"]
+    assert str(vp["w"].sharding.spec) == "PartitionSpec()"

@@ -29,7 +29,7 @@ def test_kd_recipe_learns(tmp_path):
             "model": {"hf_config": TINY, "backend": FP32},
             "teacher_model": {"hf_config": teacher_cfg, "backend": FP32},
             "kd": {"ratio": 0.5, "temperature": 2.0},
-            "distributed": {"dp_shard": 1},
+            "distributed": {"dp_shard": -1},
             "dataset": {
                 "_target_": "automodel_tpu.data.sft.MockSFTDataset",
                 "num_samples": 32,
@@ -46,6 +46,65 @@ def test_kd_recipe_learns(tmp_path):
     r.setup()
     last = r.run_train_validation_loop()
     assert np.isfinite(last["loss"])
+
+
+def test_kd_with_lora_trains_adapters_only(tmp_path):
+    """KD + PEFT composition (reference recipes/llm/kd.py supports PEFT):
+    adapter grads flow, the student base and the teacher stay frozen."""
+    import jax
+
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.kd import KDRecipeForNextTokenPrediction
+
+    teacher_cfg = dict(TINY, num_hidden_layers=3)
+    cfg = ConfigNode(
+        {
+            "seed": 0,
+            "model": {"hf_config": TINY, "backend": FP32},
+            "teacher_model": {"hf_config": teacher_cfg, "backend": FP32},
+            "kd": {"ratio": 0.5, "temperature": 2.0},
+            "peft": {"target_modules": ["*attn/q_proj*", "*attn/v_proj*"],
+                     "dim": 4, "alpha": 8},
+            "distributed": {"dp_shard": -1},
+            "dataset": {
+                "_target_": "automodel_tpu.data.sft.MockSFTDataset",
+                "num_samples": 32,
+                "seq_length": 16,
+                "vocab_size": 128,
+            },
+            "dataloader": {"global_batch_size": 8},
+            "step_scheduler": {"max_steps": 3},
+            "optimizer": {"name": "adamw", "lr": 2e-3},
+            "logging": {"metrics_path": str(tmp_path / "m.jsonl")},
+        }
+    )
+    r = KDRecipeForNextTokenPrediction(cfg)
+    r.setup()
+    # trainables are the adapters only
+    paths = {"/".join(str(getattr(k, "key", k)) for k in p)
+             for p, _ in jax.tree_util.tree_leaves_with_path(r.state.params)}
+    assert all("lora_A" in p or "lora_B" in p for p in paths), paths
+    base_before = jax.tree.map(np.asarray, r.loss_fn.bound_params)
+    teacher_before = jax.tree.map(np.asarray, r.teacher.params)
+    last = r.run_train_validation_loop()
+    assert np.isfinite(last["loss"])
+    # adapters moved (lora_B leaves become nonzero after steps)
+    moved = any(
+        float(np.abs(np.asarray(v["lora_B"])).sum()) > 0
+        for v in r.state.params.values()
+    )
+    assert moved
+    # base + teacher untouched
+    for (p, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(base_before),
+        jax.tree.leaves(r.loss_fn.bound_params),
+    ):
+        np.testing.assert_array_equal(a, np.asarray(b), err_msg=str(p))
+    for (p, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(teacher_before),
+        jax.tree.leaves(r.teacher.params),
+    ):
+        np.testing.assert_array_equal(a, np.asarray(b), err_msg=str(p))
 
 
 def test_kd_requires_teacher():

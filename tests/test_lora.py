@@ -136,7 +136,7 @@ def test_recipe_with_peft(tmp_path):
         {
             "seed": 3,
             "model": {"hf_config": HF, "backend": FP32},
-            "distributed": {"dp_shard": 1},
+            "distributed": {"dp_shard": -1},
             "peft": {"target_modules": ["*attn/[qv]_proj*"], "dim": 4},
             "dataset": {
                 "_target_": "automodel_tpu.data.sft.MockSFTDataset",
@@ -208,3 +208,49 @@ def test_lora_loss_fn_grafts_for_supporting_model():
                 np.asarray(gg[p][w]), np.asarray(gm[p][w]), atol=1e-4,
                 err_msg=f"{p}/{w}",
             )
+
+
+def test_lora_dropout_train_vs_eval():
+    """Input-side adapter dropout (reference LinearLoRA placement): stochastic
+    across steps AND microbatches in train, absent in the eval variant."""
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu import auto_model
+    from automodel_tpu.peft import PeftConfig, init_lora_params, make_lora_loss_fn
+    from automodel_tpu.training.train_step import make_causal_lm_loss
+
+    hf = {
+        "architectures": ["LlamaForCausalLM"], "model_type": "llama",
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "head_dim": 16,
+    }
+    auto = auto_model.from_config(
+        hf, None, {"attn": "sdpa", "param_dtype": "float32",
+                   "compute_dtype": "float32"}, seed=0)
+    cfg = PeftConfig(target_modules=("*attn/q_proj*",), dim=4, alpha=8,
+                     dropout=0.5)
+    adapters = init_lora_params(jax.random.key(1), auto.params, cfg)
+    # make adapters nonzero so dropout changes the output
+    adapters = jax.tree.map(lambda x: x + 0.05, adapters)
+    base_loss = make_causal_lm_loss(auto.model)
+    lf = make_lora_loss_fn(
+        base_loss, auto.params, cfg,
+        graft_patterns=auto.model.lora_graft_patterns,
+    )
+    assert lf.needs_step and lf.needs_mb_index
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 16)))
+    mb = {"input_ids": ids, "labels": ids}
+
+    l_s0 = float(lf(adapters, mb, lf.bound_params, step=0, mb_index=0)[0])
+    l_s1 = float(lf(adapters, mb, lf.bound_params, step=1, mb_index=0)[0])
+    l_m1 = float(lf(adapters, mb, lf.bound_params, step=0, mb_index=1)[0])
+    assert l_s0 != l_s1  # per-step masks differ
+    assert l_s0 != l_m1  # per-microbatch masks differ
+
+    ev = lf.eval_loss_fn
+    e0 = float(ev(adapters, mb, ev.bound_params)[0])
+    e1 = float(ev(adapters, mb, ev.bound_params)[0])
+    assert e0 == e1  # deterministic, no dropout
+    assert e0 != l_s0

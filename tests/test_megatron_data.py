@@ -128,7 +128,7 @@ def test_pretrain_e2e_with_megatron_data(corpus, tmp_path):
                 },
                 "backend": {"attn": "sdpa", "compute_dtype": "float32", "param_dtype": "float32"},
             },
-            "distributed": {"dp_shard": 1},
+            "distributed": {"dp_shard": -1},
             "dataset": {
                 "_target_": "automodel_tpu.data.megatron.gpt_dataset.MegatronPretraining",
                 "paths": str(corpus),
@@ -145,3 +145,85 @@ def test_pretrain_e2e_with_megatron_data(corpus, tmp_path):
     r.setup()
     last = r.run_train_validation_loop()
     assert np.isfinite(last["loss"])
+
+
+def test_build_mapping_structure():
+    """build_mapping (reference helpers.cpp:266): rows partition each doc's
+    sentences in order (pre-shuffle), targets within [2, max], C++ and
+    fallback agree structurally and the C++ path is deterministic."""
+    import numpy as np
+
+    from automodel_tpu.data.megatron import helpers as H
+
+    rng = np.random.default_rng(0)
+    n_docs = 12
+    sent_counts = rng.integers(1, 9, n_docs)
+    docs = np.concatenate([[0], np.cumsum(sent_counts)]).astype(np.int64)
+    sizes = rng.integers(5, 60, int(docs[-1])).astype(np.int32)
+    sizes[3] = 600  # long sentence → its whole doc must be skipped
+    kwargs = dict(num_epochs=1, max_num_samples=10_000, max_seq_length=64,
+                  short_seq_prob=0.2, seed=7, min_num_sent=2)
+
+    for impl in (H.build_mapping, H._build_mapping_py):
+        rows = impl(docs, sizes, **kwargs)
+        assert rows.shape[1] == 3 and len(rows) > 0
+        assert (rows[:, 0] < rows[:, 1]).all()
+        assert (rows[:, 2] >= 2).all() and (rows[:, 2] <= 64).all()
+        # no row crosses a document boundary; the long-sentence doc is absent
+        long_doc = int(np.searchsorted(docs, 3, side="right") - 1)
+        for s0, s1, _ in rows:
+            d = int(np.searchsorted(docs, s0, side="right") - 1)
+            assert s1 <= docs[d + 1]
+            assert d != long_doc
+        # per-doc coverage: each qualifying doc's rows tile its sentences
+        # contiguously (one epoch: first row starts at docs[d], consecutive
+        # rows abut, last ends at docs[d+1])
+        for d in range(n_docs):
+            dr = rows[(rows[:, 0] >= docs[d]) & (rows[:, 1] <= docs[d + 1])]
+            if not len(dr):
+                continue
+            dr = dr[np.argsort(dr[:, 0])]
+            assert dr[0, 0] == docs[d]
+            assert dr[-1, 1] == docs[d + 1]
+            assert (dr[1:, 0] == dr[:-1, 1]).all()
+    if H._load() is not None:
+        a = H.build_mapping(docs, sizes, **kwargs)
+        b = H.build_mapping(docs, sizes, **kwargs)
+        np.testing.assert_array_equal(a, b)
+
+
+def test_build_blocks_mapping_structure():
+    import numpy as np
+
+    from automodel_tpu.data.megatron import helpers as H
+
+    rng = np.random.default_rng(1)
+    n_docs = 8
+    sent_counts = rng.integers(2, 7, n_docs)
+    docs = np.concatenate([[0], np.cumsum(sent_counts)]).astype(np.int64)
+    sizes = rng.integers(5, 40, int(docs[-1])).astype(np.int32)
+    titles = rng.integers(2, 10, n_docs).astype(np.int32)
+    rows = H.build_blocks_mapping(
+        docs, sizes, titles, num_epochs=1, max_num_samples=10_000,
+        max_seq_length=48, seed=3,
+    )
+    assert rows.shape[1] == 4 and len(rows) > 0
+    assert (rows[:, 0] < rows[:, 1]).all()
+    for s0, s1, d, _bid in rows:
+        assert docs[d] <= s0 and s1 <= docs[d + 1]
+    # block ids unique within the epoch
+    assert len(set(rows[:, 3].tolist())) == len(rows)
+
+
+def test_build_exhaustive_blending_indices_exact_counts():
+    import numpy as np
+
+    from automodel_tpu.data.megatron import helpers as H
+
+    sizes = np.asarray([5, 2, 9], np.int64)
+    d_idx, s_idx = H.build_exhaustive_blending_indices(sizes)
+    assert len(d_idx) == 16
+    for d, n in enumerate(sizes):
+        sel = d_idx == d
+        assert sel.sum() == n
+        np.testing.assert_array_equal(np.sort(s_idx[sel]), np.arange(n))

@@ -126,3 +126,33 @@ def test_train_step_on_mesh(devices8):
         state, m = step(state, batch)
         losses.append(float(jax.device_get(m["loss"])))
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_packed_matches_unpacked(setup):
+    """VERDICT r3 #8: segment resets in the conv window + chunked delta
+    recurrence — a 2-doc packed row must reproduce each doc's unpacked
+    logits (the reference trains hybrids packed via the THD path)."""
+    _, _, cfg, _, _, params, model = setup
+    rng = np.random.default_rng(7)
+    la, lb = 40, 56  # spans several delta chunks? chunk=64; crosses chunk bdry
+    doc_a = rng.integers(0, 96, (1, la))
+    doc_b = rng.integers(0, 96, (1, lb))
+
+    ref_a, _ = model(params, jnp.asarray(doc_a))
+    ref_b, _ = model(params, jnp.asarray(doc_b))
+
+    packed = jnp.asarray(np.concatenate([doc_a, doc_b], axis=1))
+    seg = jnp.asarray(
+        np.concatenate([np.zeros((1, la)), np.ones((1, lb))], axis=1), jnp.int32
+    )
+    pos = jnp.asarray(
+        np.concatenate([np.arange(la)[None], np.arange(lb)[None]], axis=1),
+        jnp.int32,
+    )
+    got, _ = model(params, packed, segment_ids=seg, position_ids=pos)
+    np.testing.assert_allclose(
+        np.asarray(got[:, :la]), np.asarray(ref_a), atol=2e-4, rtol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[:, la:]), np.asarray(ref_b), atol=2e-4, rtol=2e-3
+    )

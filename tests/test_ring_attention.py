@@ -181,3 +181,33 @@ def test_zigzag_recipe_e2e(tmp_path, devices8):
     )
     last = main(cfg)
     assert np.isfinite(float(last["loss"]))
+
+
+def test_ring_rejects_sinks_loudly():
+    """Composition hole (VERDICT r3 weak #6): GPT-OSS attention sinks can't
+    ride the ring/CP backend — the matrix documents the loud failure (sinks
+    models are short-context, so CP composition is low-urgency)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from automodel_tpu.ops.attention import attention
+
+    q = jnp.asarray(np.zeros((1, 8, 2, 4), np.float32))
+    # outside a CP context the ring backend itself is uninstalled — either
+    # way the composition fails LOUDLY, never silently dropping the sinks
+    with pytest.raises((NotImplementedError, RuntimeError)):
+        attention(q, q, q, backend="ring", sinks=jnp.zeros((2,)))
+    from automodel_tpu.ops import attention as A
+
+    had = "ring" in A.ATTENTION_BACKENDS
+    installed = A.ATTENTION_BACKENDS.get("ring")
+    A.ATTENTION_BACKENDS["ring"] = lambda *a, **k: None  # pretend installed
+    try:
+        with pytest.raises(NotImplementedError, match="sinks"):
+            attention(q, q, q, backend="ring", sinks=jnp.zeros((2,)))
+    finally:
+        if had:
+            A.ATTENTION_BACKENDS["ring"] = installed
+        else:
+            del A.ATTENTION_BACKENDS["ring"]

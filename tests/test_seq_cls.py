@@ -29,7 +29,7 @@ def test_seq_cls_recipe_learns(tmp_path):
                 },
                 "num_labels": 2,
             },
-            "distributed": {"dp_shard": 1},
+            "distributed": {"dp_shard": -1},
             "dataset": {
                 "_target_": "automodel_tpu.data.sft.MockSeqClsDataset",
                 "num_samples": 64,
