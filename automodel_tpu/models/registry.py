@@ -158,6 +158,18 @@ def _qwen3_vl_moe_builder(hf_config: Any, backend: BackendConfig):
     )
 
 
+@register_architecture("Step3p5ForCausalLM", "Step3P5ForCausalLM")
+def _step3p5_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.step3p5 import (
+        Step3p5Config,
+        Step3p5ForCausalLM,
+        Step3p5StateDictAdapter,
+    )
+
+    cfg = Step3p5Config.from_hf(hf_config)
+    return Step3p5ForCausalLM(cfg, backend), Step3p5StateDictAdapter(cfg)
+
+
 @register_architecture(
     "NemotronV3ForCausalLM", "NemotronHForCausalLM"
 )

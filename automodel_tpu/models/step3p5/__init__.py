@@ -1,0 +1,7 @@
+from automodel_tpu.models.step3p5.model import (
+    Step3p5Config,
+    Step3p5ForCausalLM,
+)
+from automodel_tpu.models.step3p5.state_dict_adapter import Step3p5StateDictAdapter
+
+__all__ = ["Step3p5Config", "Step3p5ForCausalLM", "Step3p5StateDictAdapter"]

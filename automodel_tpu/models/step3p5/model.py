@@ -1,0 +1,405 @@
+"""Step-3.5 (Step3p5ForCausalLM), TPU-native.
+
+Parity: reference components/models/step3p5/{model,layers}.py — a dense/MoE
+decoder whose heterogeneity is all per-layer config:
+
+- attention: per-head q/k RMSNorm, optional HEAD-WISE sigmoid gate
+  (``g_proj`` [D, num_heads], layers.py:330-345), per-layer rope theta and
+  partial-rotary factor (theta^(i/rotary_dim) convention, layers.py:100-105),
+  ``use_rope_layers`` NoPE mask, and ``layer_types`` sliding layers that use
+  DIFFERENT head counts (``attention_other_setting``) plus a window;
+- FFN: plain SwiGLU MLP with optional clamp (``swiglu_limits_shared``), or —
+  on ``moe_layers_enum`` layers — a sigmoid/softmax-routed MoE (optional
+  router linear bias, per-layer ``swiglu_limits`` clamp on the experts)
+  PLUS a separate always-on shared SwiGLU expert (``share_expert_dims``).
+
+TPU structure: layer kinds split into stacked subtrees (full/sliding
+attention may have different shapes; mlp vs moe+shared); the layer loop is
+unrolled with static per-layer settings, like the other hybrid families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.models.llama.model import _dense_init, _noop_constrain
+from automodel_tpu.moe.config import MoEConfig
+from automodel_tpu.moe.layer import init_moe_params, moe_block
+from automodel_tpu.ops.attention import attention
+from automodel_tpu.ops.norms import rms_norm
+from automodel_tpu.ops.rope import RopeConfig, apply_rope, rope_table
+
+
+@dataclasses.dataclass(frozen=True)
+class Step3p5Config(TransformerConfig):
+    moe: Optional[MoEConfig] = None
+    layer_types: tuple = ()
+    moe_layers: tuple = ()  # layer indices with MoE FFN
+    # sliding layers may use different head counts (attention_other_setting)
+    sliding_num_heads: int = 0
+    sliding_num_kv_heads: int = 0
+    use_head_wise_attn_gate: bool = False
+    use_rope_layers: tuple = ()  # per-layer bool; () = all rope
+    rope_thetas: tuple = ()  # per-layer theta; () = uniform cfg.rope.theta
+    partial_rotary_factors: tuple = ()
+    share_expert_dim: int = 0
+    swiglu_limits: tuple = ()  # per-layer expert clamp (0/None = off)
+    swiglu_limits_shared: tuple = ()  # per-layer mlp/shared-expert clamp
+
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "Step3p5Config":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        base = TransformerConfig.from_hf(hf_cfg)
+        L = base.num_layers
+        lt = tuple(get("layer_types") or ("full_attention",) * L)
+        moe_enum = get("moe_layers_enum")
+        if moe_enum is None:
+            moe_layers: tuple = ()
+        elif isinstance(moe_enum, str):
+            moe_layers = tuple(int(i) for i in moe_enum.split(",") if i != "")
+        else:
+            moe_layers = tuple(int(i) for i in moe_enum)
+        moe = None
+        if moe_layers:
+            moe = MoEConfig(
+                num_experts=get("moe_num_experts"),
+                num_experts_per_tok=get("moe_top_k", 2),
+                moe_intermediate_size=get("moe_intermediate_size")
+                or base.intermediate_size,
+                num_shared_experts=0,  # shared expert is a separate module
+                score_func=(
+                    "sigmoid"
+                    if get("moe_router_activation", "softmax") == "sigmoid"
+                    else "softmax"
+                ),
+                softmax_before_topk=True,
+                route_scale=get("moe_router_scaling_factor", 1.0) or 1.0,
+                norm_topk_prob=True,
+                aux_loss_coeff=0.0,
+                router_linear_bias=bool(get("use_moe_router_bias", False)),
+            )
+        other = get("attention_other_setting") or {}
+        oget = lambda k, d: (
+            other.get(k, d) if isinstance(other, dict) else getattr(other, k, d)
+        )
+        rt = get("rope_theta", 10_000.0)
+        thetas = tuple(float(t) for t in rt) if isinstance(rt, (list, tuple)) else ()
+        prf = get("partial_rotary_factors")
+        n_kv = get("num_attention_groups") or base.num_kv_heads
+        fields = {f.name: getattr(base, f.name) for f in dataclasses.fields(base)}
+        fields.update(
+            moe=moe,
+            num_kv_heads=n_kv,
+            layer_types=lt,
+            moe_layers=moe_layers,
+            sliding_num_heads=oget("num_attention_heads", base.num_heads),
+            sliding_num_kv_heads=oget("num_attention_groups", n_kv),
+            use_head_wise_attn_gate=bool(get("use_head_wise_attn_gate", False)),
+            use_rope_layers=tuple(bool(v) for v in (get("use_rope_layers") or ())),
+            rope_thetas=thetas,
+            partial_rotary_factors=tuple(float(v) for v in (prf or ())),
+            share_expert_dim=get("share_expert_dims")
+            or get("share_expert_dim")
+            or base.intermediate_size,
+            swiglu_limits=tuple(get("swiglu_limits") or ()),
+            swiglu_limits_shared=tuple(get("swiglu_limits_shared") or ()),
+            sliding_window=get("sliding_window", None),
+        )
+        return cls(**fields)
+
+    def layer_heads(self, i: int) -> tuple[int, int]:
+        if self.layer_types[i] == "sliding_attention":
+            return self.sliding_num_heads, self.sliding_num_kv_heads
+        return self.num_heads, self.num_kv_heads
+
+    def layer_rope(self, i: int) -> tuple[Optional[RopeConfig], int]:
+        """(rope config, rotary_dim) for layer i; (None, 0) = NoPE layer."""
+        if self.use_rope_layers and i < len(self.use_rope_layers):
+            if not self.use_rope_layers[i]:
+                return None, 0
+        theta = (
+            self.rope_thetas[i]
+            if self.rope_thetas and i < len(self.rope_thetas)
+            else self.rope.theta
+        )
+        prf = (
+            self.partial_rotary_factors[i]
+            if self.partial_rotary_factors and i < len(self.partial_rotary_factors)
+            else 1.0
+        )
+        rotary_dim = int(self.head_dim * prf)
+        return dataclasses.replace(self.rope, theta=theta), rotary_dim
+
+    def layer_limit(self, i: int, shared: bool) -> Optional[float]:
+        lims = self.swiglu_limits_shared if shared else self.swiglu_limits
+        if lims and i < len(lims) and lims[i]:
+            return float(lims[i])
+        return None
+
+    def count_kind(self, kind: str) -> int:
+        if kind in ("full_attention", "sliding_attention"):
+            return sum(t == kind for t in self.layer_types)
+        if kind == "moe":
+            return len(self.moe_layers)
+        return self.num_layers - len(self.moe_layers)  # mlp
+
+
+def init_params(cfg: Step3p5Config, backend: BackendConfig, key: jax.Array) -> dict:
+    pd = backend.param_jnp_dtype
+    D = cfg.hidden_size
+    L = cfg.num_layers
+    keys = jax.random.split(key, 20)
+
+    def stack(k, n, shape):
+        return _dense_init(k, (n, *shape), pd, in_axis=1)
+
+    params: dict = {
+        "embed": {
+            "embedding": jax.random.normal(keys[0], (cfg.vocab_size, D)).astype(pd)
+            * 0.02
+        },
+        "layers": {
+            "input_norm": {"scale": jnp.ones((L, D), pd)},
+            "post_attn_norm": {"scale": jnp.ones((L, D), pd)},
+        },
+        "final_norm": {"scale": jnp.ones((D,), pd)},
+    }
+
+    def attn_stack(n, nh, nkv, kbase):
+        hd = cfg.head_dim
+        a = {
+            "q_proj": {"kernel": stack(keys[kbase], n, (D, nh * hd))},
+            "k_proj": {"kernel": stack(keys[kbase + 1], n, (D, nkv * hd))},
+            "v_proj": {"kernel": stack(keys[kbase + 2], n, (D, nkv * hd))},
+            "o_proj": {"kernel": stack(keys[kbase + 3], n, (nh * hd, D))},
+            "q_norm": {"scale": jnp.ones((n, hd), pd)},
+            "k_norm": {"scale": jnp.ones((n, hd), pd)},
+        }
+        if cfg.use_head_wise_attn_gate:
+            a["g_proj"] = {"kernel": stack(keys[kbase + 4], n, (D, nh))}
+        return a
+
+    nf = cfg.count_kind("full_attention")
+    ns = cfg.count_kind("sliding_attention")
+    if nf:
+        params["attn_full"] = attn_stack(nf, cfg.num_heads, cfg.num_kv_heads, 1)
+    if ns:
+        params["attn_sliding"] = attn_stack(
+            ns, cfg.sliding_num_heads, cfg.sliding_num_kv_heads, 6
+        )
+
+    n_mlp = cfg.count_kind("mlp")
+    if n_mlp:
+        I = cfg.intermediate_size
+        params["mlp"] = {
+            "gate_proj": {"kernel": stack(keys[11], n_mlp, (D, I))},
+            "up_proj": {"kernel": stack(keys[12], n_mlp, (D, I))},
+            "down_proj": {"kernel": stack(keys[13], n_mlp, (I, D))},
+        }
+    n_moe = cfg.count_kind("moe")
+    if n_moe:
+        params["moe"] = init_moe_params(keys[14], cfg.moe, D, pd, n_layers=n_moe)
+        S = cfg.share_expert_dim
+        params["share_expert"] = {
+            "gate_proj": {"kernel": stack(keys[15], n_moe, (D, S))},
+            "up_proj": {"kernel": stack(keys[16], n_moe, (D, S))},
+            "down_proj": {"kernel": stack(keys[17], n_moe, (S, D))},
+        }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _dense_init(keys[18], (D, cfg.vocab_size), pd)}
+    return params
+
+
+def _swiglu(x, p, limit: Optional[float]):
+    g = jax.nn.silu(x @ p["gate_proj"]["kernel"].astype(x.dtype))
+    u = x @ p["up_proj"]["kernel"].astype(x.dtype)
+    if limit is not None:
+        # reference Step3p5MLP.forward: clamp AFTER silu on the gate,
+        # symmetric clamp on up
+        g = jnp.minimum(g, limit)
+        u = jnp.clip(u, -limit, limit)
+    return (g * u) @ p["down_proj"]["kernel"].astype(x.dtype)
+
+
+def _attn_layer(cfg, backend, x, ap, cos_sin, nh, nkv, window, segment_ids):
+    B, S, D = x.shape
+    hd = cfg.head_dim
+    q = (x @ ap["q_proj"]["kernel"].astype(x.dtype)).reshape(B, S, nh, hd)
+    k = (x @ ap["k_proj"]["kernel"].astype(x.dtype)).reshape(B, S, nkv, hd)
+    v = (x @ ap["v_proj"]["kernel"].astype(x.dtype)).reshape(B, S, nkv, hd)
+    q = rms_norm(q, ap["q_norm"]["scale"], cfg.rms_eps)
+    k = rms_norm(k, ap["k_norm"]["scale"], cfg.rms_eps)
+    if cos_sin is not None:
+        q, k = apply_rope(q, k, *cos_sin)
+    out = attention(
+        q, k, v, backend=backend.attn, platform=backend.platform,
+        causal=True, segment_ids=segment_ids, sliding_window=window,
+        **(
+            {"block_q": backend.attn_block_q, "block_kv": backend.attn_block_kv}
+            if backend.attn == "flash"
+            else {}
+        ),
+    )
+    if "g_proj" in ap:
+        gate = x @ ap["g_proj"]["kernel"].astype(x.dtype)  # [B, S, nh]
+        out = out * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(out.dtype)[
+            ..., None
+        ]
+    return out.reshape(B, S, nh * hd) @ ap["o_proj"]["kernel"].astype(x.dtype)
+
+
+def forward_hidden(
+    cfg: Step3p5Config,
+    backend: BackendConfig,
+    params: dict,
+    input_ids: jnp.ndarray,
+    position_ids=None,
+    segment_ids=None,
+    constrain=_noop_constrain,
+):
+    from automodel_tpu.models.qwen3_moe.model import MoEModelAux
+
+    cd = backend.compute_jnp_dtype
+    B, S = input_ids.shape
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+        )
+    h = constrain(params["embed"]["embedding"], (None, None)).astype(cd)[input_ids]
+    h = constrain(h, ("batch", "seq", None))
+
+    # per-(theta, rotary_dim) rope tables, computed once and reused
+    tables: dict = {}
+
+    def get_table(rope_cfg, rotary_dim):
+        key = (rope_cfg.theta, rotary_dim)
+        if key not in tables:
+            tables[key] = rope_table(position_ids, rotary_dim, rope_cfg)
+        return tables[key]
+
+    def maybe_remat(fn):
+        if backend.remat == "full":
+            return jax.checkpoint(fn, policy=jax.checkpoint_policies.nothing_saveable)
+        if backend.remat == "selective":
+            return jax.checkpoint(
+                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        return fn
+
+    idx = {"full_attention": 0, "sliding_attention": 0, "mlp": 0, "moe": 0}
+    counts_l, aux_l = [], []
+    for i, lt in enumerate(cfg.layer_types):
+        nh, nkv = cfg.layer_heads(i)
+        window = cfg.sliding_window if lt == "sliding_attention" else None
+        tree = "attn_sliding" if lt == "sliding_attention" else "attn_full"
+        ap = jax.tree.map(lambda a: a[idx[lt]], params[tree])
+        idx[lt] += 1
+        rope_cfg, rotary_dim = cfg.layer_rope(i)
+        cos_sin = get_table(rope_cfg, rotary_dim) if rope_cfg is not None else None
+
+        is_moe = i in cfg.moe_layers
+        kind = "moe" if is_moe else "mlp"
+        j = idx[kind]
+        idx[kind] += 1
+        in_scale = params["layers"]["input_norm"]["scale"][i]
+        post_scale = params["layers"]["post_attn_norm"]["scale"][i]
+        lim = cfg.layer_limit(i, shared=False)
+        lim_sh = cfg.layer_limit(i, shared=True)
+
+        if is_moe:
+            mp = jax.tree.map(lambda a: a[j], params["moe"])
+            sp = jax.tree.map(lambda a: a[j], params["share_expert"])
+            moe_cfg = (
+                dataclasses.replace(cfg.moe, activation_limit=lim)
+                if lim is not None
+                else cfg.moe
+            )
+
+            def ffn(y, mp=mp, sp=sp, moe_cfg=moe_cfg, lim_sh=lim_sh):
+                routed, aux = moe_block(
+                    y, mp, moe_cfg, jax.nn.silu,
+                    experts_backend=backend.experts,
+                    fake_gate=backend.fake_balanced_gate,
+                    constrain=constrain,
+                    platform=backend.platform,
+                    fp8=backend.fp8_experts,
+                )
+                return routed + _swiglu(y, sp, lim_sh), aux
+        else:
+            pp = jax.tree.map(lambda a: a[j], params["mlp"])
+
+            def ffn(y, pp=pp, lim_sh=lim_sh):
+                return _swiglu(y, pp, lim_sh), None
+
+        def layer(h, ap=ap, cos_sin=cos_sin, nh=nh, nkv=nkv, window=window,
+                  ffn=ffn, in_scale=in_scale, post_scale=post_scale):
+            x = rms_norm(h, in_scale, cfg.rms_eps)
+            h = h + _attn_layer(
+                cfg, backend, x, ap, cos_sin, nh, nkv, window, segment_ids
+            )
+            h = constrain(h, ("batch", "seq", None))
+            x = rms_norm(h, post_scale, cfg.rms_eps)
+            out, aux = ffn(x)
+            return constrain(h + out, ("batch", "seq", None)), aux
+
+        h, aux = maybe_remat(layer)(h)
+        if aux is not None:
+            counts_l.append(aux.expert_counts)
+            aux_l.append(aux.aux_loss)
+
+    h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
+    if counts_l:
+        return h, MoEModelAux(jnp.stack(counts_l), jnp.stack(aux_l).sum())
+    return h, MoEModelAux(jnp.zeros((0, 1), jnp.int32), jnp.float32(0.0))
+
+
+SHARDING_RULES: list[tuple[str, tuple]] = [
+    (r"layers/.*norm/scale$", (None, None)),
+    (r"attn_(full|sliding)/[qkvg]_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"attn_(full|sliding)/o_proj/kernel$", (None, "tensor", "fsdp")),
+    (r"attn_(full|sliding)/[qk]_norm/scale$", (None, None)),
+    (r"(mlp|share_expert)/(gate|up)_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"(mlp|share_expert)/down_proj/kernel$", (None, "tensor", "fsdp")),
+    (r"moe/router/weight$", (None, None, None)),
+    (r"moe/router/(bias|linear_bias)$", (None, None)),
+    (r"moe/experts/gate_up$", (None, "expert", "expert_fsdp", "tensor")),
+    (r"moe/experts/down$", (None, "expert", "tensor", "expert_fsdp")),
+    (r"embed/embedding$", ("tensor", "fsdp")),
+    (r"final_norm/scale$", (None,)),
+    (r"lm_head/kernel$", ("fsdp", "tensor")),
+]
+
+
+@dataclasses.dataclass
+class Step3p5ForCausalLM:
+    config: Step3p5Config
+    backend: BackendConfig = BackendConfig()
+
+    def init(self, key: jax.Array) -> dict:
+        return init_params(self.config, self.backend, key)
+
+    def hidden(self, params, input_ids, **kw):
+        return forward_hidden(self.config, self.backend, params, input_ids, **kw)
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        if self.config.tie_embeddings:
+            return params["embed"]["embedding"].T
+        return params["lm_head"]["kernel"]
+
+    def __call__(self, params, input_ids, **kw):
+        h, aux = self.hidden(params, input_ids, **kw)
+        return h @ self.lm_head(params).astype(h.dtype), aux
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return SHARDING_RULES
+
+    def post_step_fn(self, params: dict, extras: dict) -> dict:
+        return params

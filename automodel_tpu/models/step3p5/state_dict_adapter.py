@@ -1,0 +1,173 @@
+"""HF ⇄ native adapter for Step-3.5.
+
+Parity target: reference components/models/step3p5/state_dict_adapter.py.
+HF stores experts as GROUPED tensors ``moe.gate_proj.weight [E, I, D]`` /
+``moe.up_proj.weight [E, I, D]`` / ``moe.down_proj.weight [E, D, I]`` (the
+adapter fuses gate|up and transposes into the x@W layout), the router as
+``moe.gate.weight [E, D]`` (+ optional ``moe.gate.bias [E]``), the shared
+expert as ``share_expert.{gate,up,down}_proj.weight``, and the attention /
+mlp / norm leaves llama-style under ``model.layers.{i}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.step3p5.model import Step3p5Config
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+class Step3p5StateDictAdapter:
+    def __init__(self, config: Step3p5Config):
+        self.config = config
+        c = config
+        self.full_ids = [
+            i for i, t in enumerate(c.layer_types) if t == "full_attention"
+        ]
+        self.sliding_ids = [
+            i for i, t in enumerate(c.layer_types) if t == "sliding_attention"
+        ]
+        self.moe_ids = list(c.moe_layers)
+        self.mlp_ids = [i for i in range(c.num_layers) if i not in c.moe_layers]
+
+    def _attn_plans(self):
+        plans = []
+        for p in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            plans.append(((p, "kernel"), f"self_attn.{p}.weight", True))
+        plans.append((("q_norm", "scale"), "self_attn.q_norm.weight", False))
+        plans.append((("k_norm", "scale"), "self_attn.k_norm.weight", False))
+        if self.config.use_head_wise_attn_gate:
+            plans.append((("g_proj", "kernel"), "self_attn.g_proj.weight", True))
+        return plans
+
+    _SWIGLU = [
+        (("gate_proj", "kernel"), "{m}.gate_proj.weight", True),
+        (("up_proj", "kernel"), "{m}.up_proj.weight", True),
+        (("down_proj", "kernel"), "{m}.down_proj.weight", True),
+    ]
+
+    def iter_from_hf(
+        self, get_tensor: Callable[[str], np.ndarray]
+    ) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        c = self.config
+        L = c.num_layers
+        yield ("embed", "embedding"), get_tensor("model.embed_tokens.weight")
+        yield ("final_norm", "scale"), get_tensor("model.norm.weight")
+        if not c.tie_embeddings:
+            yield ("lm_head", "kernel"), _t(get_tensor("lm_head.weight"))
+        for name, hf in (("input_norm", "input_layernorm"),
+                         ("post_attn_norm", "post_attention_layernorm")):
+            yield ("layers", name, "scale"), np.stack(
+                [get_tensor(f"model.layers.{i}.{hf}.weight") for i in range(L)], 0
+            )
+        for tree, ids in (("attn_full", self.full_ids),
+                          ("attn_sliding", self.sliding_ids)):
+            if not ids:
+                continue
+            for sub, suffix, tr in self._attn_plans():
+                rows = [get_tensor(f"model.layers.{i}.{suffix}") for i in ids]
+                yield ((tree, *sub), np.stack([_t(r) if tr else r for r in rows]))
+        if self.mlp_ids:
+            for sub, tmpl, _ in self._SWIGLU:
+                rows = [
+                    _t(get_tensor(f"model.layers.{i}.{tmpl.format(m='mlp')}"))
+                    for i in self.mlp_ids
+                ]
+                yield (("mlp", *sub), np.stack(rows))
+        if self.moe_ids:
+            routers, gus, dns = [], [], []
+            biases = []
+            for i in self.moe_ids:
+                base = f"model.layers.{i}.moe"
+                routers.append(_t(get_tensor(f"{base}.gate.weight")))  # [D, E]
+                if c.moe.router_linear_bias:
+                    biases.append(get_tensor(f"{base}.gate.bias"))
+                g = get_tensor(f"{base}.gate_proj.weight")  # [E, I, D]
+                u = get_tensor(f"{base}.up_proj.weight")
+                d = get_tensor(f"{base}.down_proj.weight")  # [E, D, I]
+                gus.append(np.concatenate(
+                    [g.transpose(0, 2, 1), u.transpose(0, 2, 1)], axis=-1
+                ))  # [E, D, 2I]
+                dns.append(d.transpose(0, 2, 1))  # [E, I, D]
+            yield ("moe", "router", "weight"), np.stack(routers)
+            if biases:
+                yield ("moe", "router", "linear_bias"), np.stack(biases)
+            yield ("moe", "experts", "gate_up"), np.stack(gus)
+            yield ("moe", "experts", "down"), np.stack(dns)
+            for sub, tmpl, _ in self._SWIGLU:
+                rows = [
+                    _t(get_tensor(f"model.layers.{i}.{tmpl.format(m='share_expert')}"))
+                    for i in self.moe_ids
+                ]
+                yield (("share_expert", *sub), np.stack(rows))
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
+
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        c = self.config
+        L = c.num_layers
+        yield "model.embed_tokens.weight", np.asarray(params["embed"]["embedding"])
+        yield "model.norm.weight", np.asarray(params["final_norm"]["scale"])
+        if not c.tie_embeddings:
+            yield "lm_head.weight", _t(np.asarray(params["lm_head"]["kernel"]))
+        for name, hf in (("input_norm", "input_layernorm"),
+                         ("post_attn_norm", "post_attention_layernorm")):
+            leaf = np.asarray(params["layers"][name]["scale"])
+            for i in range(L):
+                yield f"model.layers.{i}.{hf}.weight", leaf[i]
+
+        def leaf_of(tree, sub):
+            x = tree
+            for s in sub:
+                x = x[s]
+            return np.asarray(x)
+
+        for tree, ids in (("attn_full", self.full_ids),
+                          ("attn_sliding", self.sliding_ids)):
+            if not ids:
+                continue
+            for sub, suffix, tr in self._attn_plans():
+                stacked = leaf_of(params[tree], sub)
+                for row, i in enumerate(ids):
+                    yield f"model.layers.{i}.{suffix}", (
+                        _t(stacked[row]) if tr else stacked[row]
+                    )
+        if self.mlp_ids:
+            for sub, tmpl, _ in self._SWIGLU:
+                stacked = leaf_of(params["mlp"], sub)
+                for row, i in enumerate(self.mlp_ids):
+                    yield f"model.layers.{i}.{tmpl.format(m='mlp')}", _t(stacked[row])
+        if self.moe_ids:
+            router = leaf_of(params["moe"], ("router", "weight"))
+            gu = leaf_of(params["moe"], ("experts", "gate_up"))
+            dn = leaf_of(params["moe"], ("experts", "down"))
+            bias = (
+                leaf_of(params["moe"], ("router", "linear_bias"))
+                if c.moe.router_linear_bias
+                else None
+            )
+            I = dn.shape[2]
+            for row, i in enumerate(self.moe_ids):
+                base = f"model.layers.{i}.moe"
+                yield f"{base}.gate.weight", _t(router[row])
+                if bias is not None:
+                    yield f"{base}.gate.bias", bias[row]
+                yield (f"{base}.gate_proj.weight",
+                       np.ascontiguousarray(gu[row, :, :, :I].transpose(0, 2, 1)))
+                yield (f"{base}.up_proj.weight",
+                       np.ascontiguousarray(gu[row, :, :, I:].transpose(0, 2, 1)))
+                yield (f"{base}.down_proj.weight",
+                       np.ascontiguousarray(dn[row].transpose(0, 2, 1)))
+            for sub, tmpl, _ in self._SWIGLU:
+                stacked = leaf_of(params["share_expert"], sub)
+                for row, i in enumerate(self.moe_ids):
+                    yield (f"model.layers.{i}.{tmpl.format(m='share_expert')}",
+                           _t(stacked[row]))

@@ -46,6 +46,9 @@ class MoEConfig:
     interleaved_gate_up: bool = False
     expert_mlp_bias: bool = False
     activation: str = "swiglu"  # swiglu | swiglu_oai | relu2 (non-gated)
+    # step-3.5 per-layer clamp: silu(gate) capped at +limit, up clamped to
+    # [-limit, limit] (reference step3p5 MoEConfig.activation_limit)
+    activation_limit: Optional[float] = None
     router_linear_bias: bool = False
 
     @property

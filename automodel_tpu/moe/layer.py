@@ -39,6 +39,19 @@ def make_act2(cfg: MoEConfig, base_act: Callable) -> Callable:
             return (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
 
         return act2
+    if cfg.activation_limit is not None:
+        if cfg.activation != "swiglu":
+            raise NotImplementedError(
+                f"activation_limit is only defined for gated swiglu experts "
+                f"(step3p5), not activation={cfg.activation!r}"
+            )
+        lim = float(cfg.activation_limit)
+
+        def act2_lim(g, u):
+            g = jnp.minimum(base_act(g), lim)
+            return g * jnp.clip(u, -lim, lim)
+
+        return act2_lim
     if cfg.activation == "relu2":
         # nemotron-v3 non-gated experts: square-ReLU on the single up
         # projection (the u operand is the same array, ignored)
@@ -102,7 +115,11 @@ def moe_block(
         u = xt @ sp["up_proj"]["kernel"].astype(xt.dtype)
         if "gate_proj" in sp:
             g = xt @ sp["gate_proj"]["kernel"].astype(xt.dtype)
-            mid = act(g) * u
+            if cfg.activation_limit is not None:
+                lim = float(cfg.activation_limit)
+                mid = jnp.minimum(act(g), lim) * jnp.clip(u, -lim, lim)
+            else:
+                mid = act(g) * u
         else:  # non-gated shared expert (nemotron relu2)
             mid = act2(u, u)
         shared = mid @ sp["down_proj"]["kernel"].astype(xt.dtype)
