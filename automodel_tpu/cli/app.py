@@ -13,13 +13,13 @@ import sys
 
 from automodel_tpu.config.arg_parser import parse_args_and_load_config
 
-COMMANDS = ("finetune", "pretrain", "kd", "benchmark")
+COMMANDS = ("finetune", "pretrain", "kd", "benchmark", "mine")
 DOMAINS = ("llm", "vlm", "biencoder")
 
 
 def _usage() -> str:
     return (
-        "usage: automodel_tpu <finetune|pretrain|kd|benchmark> <llm|vlm|biencoder> "
+        "usage: automodel_tpu <finetune|pretrain|kd|benchmark|mine> <llm|vlm|biencoder> "
         "-c config.yaml [--dotted.key=value ...]"
     )
 
@@ -82,6 +82,7 @@ def main(argv: list[str] | None = None) -> int:
         ("kd", "llm"): "automodel_tpu.recipes.kd",
         ("finetune", "vlm"): "automodel_tpu.recipes.finetune_vlm",
         ("finetune", "biencoder"): "automodel_tpu.recipes.train_biencoder",
+        ("mine", "biencoder"): "automodel_tpu.recipes.mine_hard_negatives",
     }
     module_name = recipe_modules.get((command, domain))
     if module_name is not None:

@@ -123,3 +123,64 @@ def test_biencoder_recipe_e2e(tmp_path, devices8):
     last = main(cfg)
     assert np.isfinite(last["loss"])
     assert (tmp_path / "bi_metrics.jsonl").exists()
+
+
+def test_mine_hard_negatives_recipe(tmp_path):
+    """Hard-negative mining pipeline (reference recipes/biencoder/
+    mine_hard_negatives.py): positives excluded, margin drops near-positives
+    (threshold from the MIN positive score), num_negatives respected,
+    JSONL written."""
+    import json
+
+    import numpy as np
+
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.mine_hard_negatives import MineHardNegativesRecipe
+
+    rng = np.random.default_rng(0)
+    corpus = [
+        {"id": f"d{i}", "input_ids": rng.integers(1, 120, 12).tolist()}
+        for i in range(24)
+    ]
+    queries = []
+    for qi in range(6):
+        # positive = a near-copy of the query tokens → high similarity
+        q_ids = rng.integers(1, 120, 12).tolist()
+        corpus[qi]["input_ids"] = list(q_ids)  # make doc qi the positive
+        queries.append({"input_ids": q_ids, "pos_doc_ids": [f"d{qi}"]})
+
+    cfg = ConfigNode(
+        {
+            "seed": 0,
+            "model": {
+                "hf_config": {
+                    "architectures": ["LlamaForCausalLM"], "model_type": "llama",
+                    "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+                    "num_hidden_layers": 2, "num_attention_heads": 4,
+                    "num_key_value_heads": 2, "head_dim": 8,
+                },
+                "backend": {"attn": "sdpa", "param_dtype": "float32",
+                            "compute_dtype": "float32"},
+            },
+            "distributed": {"dp_shard": -1},
+            "data": {"queries": queries, "corpus": corpus},
+            "mining": {"num_negatives": 3, "hard_neg_margin": 0.95,
+                       "hard_neg_margin_type": "perc", "embed_batch_size": 8,
+                       "max_length": 12},
+            "output_path": str(tmp_path / "mined.jsonl"),
+        }
+    )
+    r = MineHardNegativesRecipe(cfg)
+    r.setup()
+    rows = r.mine()
+    assert len(rows) == 6
+    for qi, row in enumerate(rows):
+        assert f"d{qi}" not in row["neg_doc_ids"]  # positive excluded
+        assert len(row["neg_doc_ids"]) <= 3
+        assert len(row["neg_scores"]) == len(row["neg_doc_ids"])
+        # identical-token positive scores ~1 (normalized embeddings)
+        assert row["pos_scores"] and row["pos_scores"][0] > 0.99
+        thr = min(row["pos_scores"]) * 0.95
+        assert all(s < thr for s in row["neg_scores"])
+    lines = open(tmp_path / "mined.jsonl").read().strip().splitlines()
+    assert len(lines) == 6 and json.loads(lines[0])["neg_doc_ids"]
