@@ -239,6 +239,7 @@ def forward_hidden(
             constrain=constrain,
             platform=backend.platform,
             fp8=backend.fp8_experts,
+            act_name=cfg.act,
         )
         hh = hh + out
         return constrain(hh, ("batch", "seq", None)), aux

@@ -300,6 +300,7 @@ def forward_hidden(
                 constrain=constrain,
                 platform=backend.platform,
                 fp8=backend.fp8_experts,
+                act_name=cfg.act,
             )
             return constrain(h + out, ("batch", "seq", None)), aux
 

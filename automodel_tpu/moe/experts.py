@@ -491,7 +491,8 @@ def _warn_fp8_unsupported(name: str) -> None:
 
 
 def _run_dense(x, gate_out, weights, cfg, act2, *, ctx=None,
-               constrain=_noop_constrain, platform=None, fp8=False):
+               constrain=_noop_constrain, platform=None, fp8=False,
+               act_name="silu"):
     if fp8:
         _warn_fp8_unsupported("dense")
     B, S, D = x.shape
@@ -499,14 +500,16 @@ def _run_dense(x, gate_out, weights, cfg, act2, *, ctx=None,
 
 
 def _run_gspmd(x, gate_out, weights, cfg, act2, *, ctx=None,
-               constrain=_noop_constrain, platform=None, fp8=False):
+               constrain=_noop_constrain, platform=None, fp8=False,
+               act_name="silu"):
     if fp8:
         _warn_fp8_unsupported("gspmd")
     return gspmd_experts(x, gate_out, weights, cfg, act2, constrain=constrain)
 
 
 def _run_ragged(x, gate_out, weights, cfg, act2, *, ctx=None,
-                constrain=_noop_constrain, platform=None, fp8=False):
+                constrain=_noop_constrain, platform=None, fp8=False,
+                act_name="silu"):
     B, S, D = x.shape
     return ragged_experts(
         x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform, fp8=fp8
@@ -514,12 +517,77 @@ def _run_ragged(x, gate_out, weights, cfg, act2, *, ctx=None,
 
 
 def _run_a2a(x, gate_out, weights, cfg, act2, *, ctx=None,
-             constrain=_noop_constrain, platform=None, fp8=False):
+             constrain=_noop_constrain, platform=None, fp8=False,
+             act_name="silu"):
     return a2a_experts(x, gate_out, weights, cfg, act2, ctx, platform=platform,
                        fp8=fp8)
 
 
+def ragged_fused_experts(
+    x: jnp.ndarray,  # [T, D]
+    gate_out: GateOutput,
+    weights: dict,
+    cfg: MoEConfig,
+    act2: Act,  # unused — the kernel applies the activation from cfg
+    platform: str | None = None,
+    act_name: str = "silu",
+) -> jnp.ndarray:
+    """ragged_experts with the WHOLE expert MLP in one Pallas kernel
+    (ops/fused_expert_mlp): the [T·K, 2I] gate_up output and the [T·K, I]
+    activation never touch HBM. Same dropless sort + permutation-gather
+    dispatch/combine; backward recomputes through the two-gmm composition."""
+    from automodel_tpu.ops.fused_expert_mlp import fused_expert_mlp
+
+    if "gate_up_bias" in weights or "down_bias" in weights:
+        raise NotImplementedError(
+            "experts='ragged_fused' does not carry expert biases yet "
+            "(gpt-oss) — use experts='ragged'"
+        )
+    if not cfg.gated:
+        raise NotImplementedError(
+            "experts='ragged_fused' supports gated swiglu experts only"
+        )
+    if cfg.activation not in ("swiglu", "swiglu_oai") or (
+        cfg.activation == "swiglu" and act_name != "silu"
+    ):
+        raise NotImplementedError(
+            f"experts='ragged_fused' implements silu-gated swiglu and "
+            f"swiglu_oai in-kernel, not activation={cfg.activation!r} with "
+            f"base act {act_name!r}"
+        )
+    T, D = x.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    flat_expert = gate_out.topk_idx.reshape(-1)
+    order = jnp.argsort(flat_expert)
+    inv = jnp.argsort(order)
+    group_sizes = gate_out.expert_counts.astype(jnp.int32)
+    xs = _dispatch_take(x, order, inv, K)
+    gw, uw = _split_gate_up(weights["gate_up"], cfg.interleaved_gate_up)
+    act_kind = "swiglu_oai" if cfg.activation == "swiglu_oai" else "swiglu"
+    limit = cfg.activation_limit
+    ys = fused_expert_mlp(
+        xs, gw.astype(xs.dtype), uw.astype(xs.dtype),
+        weights["down"].astype(xs.dtype), group_sizes,
+        act_kind, limit, platform, None,
+    )
+    out = _sorted_combine(ys, gate_out.topk_weights, order, inv, K)
+    return out.astype(x.dtype)
+
+
+def _run_ragged_fused(x, gate_out, weights, cfg, act2, *, ctx=None,
+                      constrain=_noop_constrain, platform=None, fp8=False,
+                      act_name="silu"):
+    if fp8:
+        _warn_fp8_unsupported("ragged_fused")
+    B, S, D = x.shape
+    return ragged_fused_experts(
+        x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform,
+        act_name=act_name,
+    ).reshape(B, S, D)
+
+
 EXPERT_BACKENDS = {
+    "ragged_fused": _run_ragged_fused,
     "dense": _run_dense,
     "gspmd": _run_gspmd,
     "ragged": _run_ragged,

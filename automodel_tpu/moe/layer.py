@@ -71,6 +71,7 @@ def moe_block(
     constrain: Callable = lambda a, s: a,
     platform: Optional[str] = None,
     fp8: bool = False,
+    act_name: str = "silu",
 ) -> tuple[jnp.ndarray, MoEAux]:
     B, S, D = x.shape
     xt = x.reshape(-1, D)
@@ -107,6 +108,7 @@ def moe_block(
     routed = backend_fn(
         x, gout, mp["experts"], cfg, act2,
         ctx=ctx, constrain=constrain, platform=platform, fp8=fp8,
+        act_name=act_name,
     )
 
     out = routed

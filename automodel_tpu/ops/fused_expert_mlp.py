@@ -1,0 +1,189 @@
+"""Fused MoE expert MLP: gate_up matmul + gated activation + down matmul in
+ONE Pallas kernel (forward only; the backward recomputes through the
+separate grouped matmuls).
+
+Motivation (PROFILE_MOE_r04.md): the two-kernel expert path writes the
+[T·K, 2I] gate_up output and the [T·K, I] activation to HBM and reads them
+back (~600MB per layer at bench shape). Here both stay in VMEM: per work
+unit (m-tile × group) the kernel loops I-chunks on the grid, computing
+``acc += act(lhs @ Wgu[:, chunk]) @ Wd[chunk, :]`` with an fp32 accumulator
+— the down-projection contraction is summable over I-chunks, so the
+intermediate never materializes. Rows are lhs-masked (write-only outputs;
+boundary tiles accumulate across consecutive work units like
+ops/grouped_matmul._tgmm).
+
+Same dropless semantics and work-unit plan as ops/grouped_matmul (reference
+capability: the fused SwiGLU+GEMM epilogues TE/DeepEP provide on GPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from automodel_tpu.ops.grouped_matmul import (
+    _interpret_requested,
+    _pallas_eligible,
+    _plan,
+    _round_up,
+    ragged_dot,
+)
+
+
+def _kernel(wg, wt, ws, we, lhs_ref, wgu_ref, wd_ref, out_ref, acc,
+            *, tm, n_ic, act_kind, limit, W):
+    w = pl.program_id(0)
+    ic = pl.program_id(1)
+    t = wt[w]
+    first = jnp.logical_or(w == 0, wt[jnp.maximum(w - 1, 0)] != t)
+    last = jnp.logical_or(w == W - 1, wt[jnp.minimum(w + 1, W - 1)] != t)
+
+    @pl.when(jnp.logical_and(ic == 0, first))
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    rows = t * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0)
+    lmask = (rows >= ws[w]) & (rows < we[w])
+    lhs = jnp.where(lmask, lhs_ref[...], jnp.zeros_like(lhs_ref))
+
+    gu = jax.lax.dot_general(
+        lhs, wgu_ref[0, 0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [tm, 2*ic_size]
+    half = gu.shape[-1] // 2
+    g, u = gu[:, :half], gu[:, half:]
+    if act_kind == "swiglu_oai":
+        g = jnp.minimum(g, 7.0)
+        u = jnp.clip(u, -7.0, 7.0)
+        mid = (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
+    else:
+        mid = jax.nn.silu(g)
+        if limit is not None:
+            mid = jnp.minimum(mid, limit)
+            u = jnp.clip(u, -limit, limit)
+        mid = mid * u
+    acc[...] += jax.lax.dot_general(
+        mid.astype(lhs_ref.dtype), wd_ref[0, 0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(jnp.logical_and(ic == n_ic - 1, last))
+    def _():
+        out_ref[...] = acc[...].astype(out_ref.dtype)
+
+
+def _fwd(lhs, gate, up, down, group_sizes, act_kind, limit, interpret):
+    """lhs [M, D] sorted by group; gate/up [G, D, I] (pre-split halves);
+    down [G, I, D] → [M, D]."""
+    M, D = lhs.shape
+    G, _, I = gate.shape
+    tm = 512
+    ic = min(_round_up(I, 128), 512)
+    Mp, Dp, Ip = _round_up(M, tm), _round_up(D, 128), _round_up(I, ic)
+    if (Mp, Dp) != (M, D):
+        lhs = jnp.pad(lhs, ((0, Mp - M), (0, Dp - D)))
+    if (Dp, Ip) != (D, I):
+        gate = jnp.pad(gate, ((0, 0), (0, Dp - D), (0, Ip - I)))
+        up = jnp.pad(up, ((0, 0), (0, Dp - D), (0, Ip - I)))
+        down = jnp.pad(down, ((0, 0), (0, Ip - I), (0, Dp - D)))
+    # interleave [gate_chunk | up_chunk] per I-chunk so one rhs block carries
+    # both halves of the chunk
+    n_ic = Ip // ic
+    wgu = jnp.concatenate(
+        [gate.reshape(G, Dp, n_ic, ic), up.reshape(G, Dp, n_ic, ic)], axis=-1
+    )  # [G, Dp, n_ic, 2ic]
+    wgu = wgu.transpose(0, 2, 1, 3).reshape(G, n_ic, Dp, 2 * ic)
+    wd = down.reshape(G, n_ic, ic, Dp)
+
+    wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)
+    W = Mp // tm + G
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, tm=tm, n_ic=n_ic, act_kind=act_kind, limit=limit, W=W
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(W, n_ic),
+            in_specs=[
+                pl.BlockSpec((tm, Dp), lambda w, i, wg, wt, ws, we: (wt[w], 0)),
+                pl.BlockSpec(
+                    (1, 1, Dp, 2 * ic),
+                    lambda w, i, wg, wt, ws, we: (wg[w], i, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, ic, Dp), lambda w, i, wg, wt, ws, we: (wg[w], i, 0, 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (tm, Dp), lambda w, i, wg, wt, ws, we: (wt[w], 0)
+            ),
+            scratch_shapes=[pltpu.VMEM((tm, Dp), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((Mp, Dp), lhs.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(wg, wt, ws, we, lhs, wgu, wd)
+    return out[:M, :D]
+
+
+def _reference(lhs, gate, up, down, group_sizes, act_kind, limit, platform):
+    """The two-grouped-matmul composition — the backward path and the
+    numerics reference."""
+    gu_g = ragged_dot(lhs, gate, group_sizes, platform=platform)
+    gu_u = ragged_dot(lhs, up, group_sizes, platform=platform)
+    if act_kind == "swiglu_oai":
+        g = jnp.minimum(gu_g, 7.0)
+        u = jnp.clip(gu_u, -7.0, 7.0)
+        mid = (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
+    else:
+        mid = jax.nn.silu(gu_g)
+        if limit is not None:
+            mid = jnp.minimum(mid, limit)
+            gu_u = jnp.clip(gu_u, -limit, limit)
+        mid = mid * gu_u
+    return ragged_dot(mid.astype(lhs.dtype), down, group_sizes, platform=platform)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def fused_expert_mlp(lhs, gate, up, down, group_sizes,
+                     act_kind="swiglu", limit=None, platform=None,
+                     interpret=None):
+    """Forward through the fused kernel; backward recomputes via the
+    composition (the standard fused-fwd/recompute-bwd trade: the fwd —
+    which remat re-runs — saves the HBM round trips; the bwd needs the
+    intermediates anyway)."""
+    if interpret is None:
+        interpret = _interpret_requested()
+    if not (interpret or _pallas_eligible(platform)):
+        return _reference(lhs, gate, up, down, group_sizes, act_kind, limit, platform)
+    return _fwd(lhs, gate, up, down, group_sizes, act_kind, limit, interpret)
+
+
+def _vjp_fwd(lhs, gate, up, down, group_sizes, act_kind, limit, platform, interpret):
+    y = fused_expert_mlp(
+        lhs, gate, up, down, group_sizes, act_kind, limit, platform, interpret
+    )
+    return y, (lhs, gate, up, down, group_sizes)
+
+
+def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
+    lhs, gate, up, down, group_sizes = res
+
+    def f(args):
+        lhs_, g_, u_, d_ = args
+        return _reference(lhs_, g_, u_, d_, group_sizes, act_kind, limit, platform)
+
+    _, vjp = jax.vjp(f, (lhs, gate, up, down))
+    (dl, dg, du, dd), = vjp(dy)
+    return dl, dg, du, dd, None
+
+
+fused_expert_mlp.defvjp(_vjp_fwd, _vjp_bwd)

@@ -289,3 +289,56 @@ def test_a2a_single_slice_falls_back_to_ragged():
     np.testing.assert_allclose(
         np.asarray(out).reshape(24, 16), np.asarray(ref), rtol=1e-5, atol=1e-6
     )
+
+
+def test_ragged_fused_matches_ragged(monkeypatch):
+    """experts='ragged_fused' (one-kernel expert MLP): numerics + grads
+    match the two-gmm ragged path, incl. swiglu_oai and unbalanced groups
+    with an empty expert (interpret mode)."""
+    monkeypatch.setenv("AUTOMODEL_GMM_INTERPRET", "1")
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu.moe.config import MoEConfig
+    from automodel_tpu.moe.experts import ragged_experts, ragged_fused_experts
+    from automodel_tpu.moe.gate import GateOutput
+    from automodel_tpu.moe.layer import make_act2
+
+    rng = np.random.default_rng(0)
+    T, D, I, E, K = 48, 16, 8, 4, 2
+    x = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)
+    # unbalanced routing with expert 2 EMPTY
+    idx_np = rng.choice([0, 1, 3], size=(T, K)).astype(np.int32)
+    idx = jnp.asarray(idx_np)
+    w = jnp.asarray(rng.random((T, K)).astype(np.float32))
+    counts = jnp.bincount(idx.reshape(-1), length=E).astype(jnp.int32)
+    gout = GateOutput(idx, w, counts, jnp.float32(0))
+
+    for activation in ("swiglu", "swiglu_oai"):
+        cfg = MoEConfig(num_experts=E, num_experts_per_tok=K,
+                        moe_intermediate_size=I, activation=activation,
+                        interleaved_gate_up=activation == "swiglu_oai")
+        act2 = make_act2(cfg, jax.nn.silu)
+        weights = {
+            "gate_up": jnp.asarray(rng.normal(size=(E, D, 2 * I)) * 0.2,
+                                   jnp.float32),
+            "down": jnp.asarray(rng.normal(size=(E, I, D)) * 0.2, jnp.float32),
+        }
+
+        def f_ref(args):
+            x_, wt = args
+            y = ragged_experts(x_, gout, wt, cfg, act2)
+            return jnp.sum(jnp.sin(y)), y
+
+        def f_fused(args):
+            x_, wt = args
+            y = ragged_fused_experts(x_, gout, wt, cfg, act2)
+            return jnp.sum(jnp.sin(y)), y
+
+        (l1, y1), g1 = jax.value_and_grad(f_ref, has_aux=True)((x, weights))
+        (l2, y2), g2 = jax.value_and_grad(f_fused, has_aux=True)((x, weights))
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(y1),
+                                   atol=1e-4, rtol=1e-4, err_msg=activation)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-4, rtol=1e-4, err_msg=activation)
