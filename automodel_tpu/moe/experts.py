@@ -403,16 +403,26 @@ def _a2a_body(xb, idxb, cwb, wd, *, ep, ep_axis, E, E_loc, C, D, K, act2,
             )
         else:
             y = y + wd["db"].astype(y.dtype)[sid]
-    y = jnp.zeros_like(y).at[order2].set(y)  # back to recv order
+    # permutations invert as forward GATHERS (out[p[i]] = y[i] is exactly
+    # y[argsort(p)]). NB: plain gathers on purpose — the gather-only
+    # custom-VJP helpers (_perm_take/_sorted_combine) cannot be used inside
+    # this MANUAL shard_map region: the region's transpose then fails
+    # shard_map's static replication (vma) inference on the custom_vjp
+    # outputs. The backward therefore pays autodiff's scatter for these two
+    # gathers — a known cost of the manual region, not of the single-chip
+    # hot path (which uses the custom-VJP helpers).
+    y = y[jnp.argsort(order2)]  # back to recv order
     y = a2a(y)  # [ep*C, D] back in my send layout
     y = jnp.concatenate([y, jnp.zeros((1, D), y.dtype)], 0)[dst]  # dropped → 0
-    y = jnp.zeros_like(y).at[order].set(y)  # original pick order
+    y = y[jnp.argsort(order)]  # original pick order
 
-    cwf = cwb.reshape(T * K, 1).astype(jnp.float32)
-    out = (
-        jnp.zeros((T, D), jnp.float32)
-        .at[jnp.arange(T * K, dtype=jnp.int32) // K]
-        .add(y.astype(jnp.float32) * cwf)
+    # picks of token t are rows [t*K, t*K+K) → combine is a dense reshape
+    # + weighted K-fold sum, no scatter in the forward
+    out = jnp.einsum(
+        "tkd,tk->td",
+        y.reshape(T, K, D),
+        cwb.reshape(T, K),
+        preferred_element_type=jnp.float32,
     )
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)  # down-proj partials, deferred to [T, D]
