@@ -46,7 +46,10 @@ class BackendConfig:
     fake_balanced_gate: bool = False  # deterministic routing for benchmarks
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
-    remat: str = "none"  # none | full | selective
+    # none | full | selective | full_save_dispatch (full remat but the MoE
+    # sort permutations survive — skips re-argsorting T*K picks per layer
+    # in the recompute pass; memory cost 2 int32 [T*K] leaves per layer)
+    remat: str = "none"
     scan_layers: bool = True
     # fp8 matmul recipe for dense projections (e4m3 fwd / e5m2 grads,
     # per-tensor dynamic scaling — see ops/fp8.py; reference:
@@ -70,7 +73,7 @@ class BackendConfig:
             raise ValueError(
                 f"Unknown attn backend {self.attn!r}; available: {sorted(ATTENTION_BACKENDS)}"
             )
-        if self.remat not in ("none", "full", "selective"):
+        if self.remat not in ("none", "full", "selective", "full_save_dispatch"):
             raise ValueError(f"Unknown remat policy {self.remat!r}")
         from automodel_tpu.moe.experts import EXPERT_BACKENDS
 

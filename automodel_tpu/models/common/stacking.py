@@ -24,6 +24,16 @@ import jax.numpy as jnp
 def remat_wrap(f: Callable, remat: str) -> Callable:
     if remat == "full":
         return jax.checkpoint(f, policy=jax.checkpoint_policies.nothing_saveable)
+    if remat == "full_save_dispatch":
+        # full remat, but the tagged MoE sort permutations survive the
+        # boundary (moe/experts.py _name_ckpt) — the recompute pass skips
+        # the per-layer argsorts over T*K picks
+        return jax.checkpoint(
+            f,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "moe_sort_order", "moe_sort_inv"
+            ),
+        )
     if remat == "selective":
         return jax.checkpoint(
             f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable

@@ -245,13 +245,9 @@ def forward_hidden(
             )
             return out, None
 
-        if backend.remat == "full":
-            return jax.checkpoint(layer_fn, policy=jax.checkpoint_policies.nothing_saveable)
-        if backend.remat == "selective":
-            return jax.checkpoint(
-                layer_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
-        return layer_fn
+        from automodel_tpu.models.common.stacking import remat_wrap
+
+        return remat_wrap(layer_fn, backend.remat)
 
     L = cfg.num_layers
     # mixed full/windowed layers force per-layer calls; the homogeneous case

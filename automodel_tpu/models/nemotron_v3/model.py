@@ -283,13 +283,9 @@ def forward_hidden(
     h = constrain(h, ("batch", "seq", None))
 
     def maybe_remat(fn):
-        if backend.remat == "full":
-            return jax.checkpoint(fn, policy=jax.checkpoint_policies.nothing_saveable)
-        if backend.remat == "selective":
-            return jax.checkpoint(
-                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
-        return fn
+        from automodel_tpu.models.common.stacking import remat_wrap
+
+        return remat_wrap(fn, backend.remat)
 
     idx = {"mamba": 0, "attention": 0, "mlp": 0, "moe": 0}
     counts_l, aux_l = [], []

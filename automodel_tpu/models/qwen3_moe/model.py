@@ -205,13 +205,9 @@ def forward_hidden(
     )
 
     def maybe_remat(fn):
-        if backend.remat == "full":
-            return jax.checkpoint(fn, policy=jax.checkpoint_policies.nothing_saveable)
-        if backend.remat == "selective":
-            return jax.checkpoint(
-                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
-        return fn
+        from automodel_tpu.models.common.stacking import remat_wrap
+
+        return remat_wrap(fn, backend.remat)
 
     if "dense_layers" in params:
         def dense_fn(carry, lp):

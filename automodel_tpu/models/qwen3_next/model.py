@@ -259,13 +259,9 @@ def forward_hidden(
     cos, sin = rope_table(position_ids, cfg.rope_dim or cfg.head_dim, cfg.rope)
 
     def maybe_remat(fn):
-        if backend.remat == "full":
-            return jax.checkpoint(fn, policy=jax.checkpoint_policies.nothing_saveable)
-        if backend.remat == "selective":
-            return jax.checkpoint(
-                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
-        return fn
+        from automodel_tpu.models.common.stacking import remat_wrap
+
+        return remat_wrap(fn, backend.remat)
 
     counts_l, aux_l = [], []
     i_full = i_lin = 0

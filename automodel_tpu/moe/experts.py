@@ -133,6 +133,15 @@ def gspmd_experts(
     return out.astype(x.dtype)
 
 
+def _name_ckpt(x: jnp.ndarray, name: str) -> jnp.ndarray:
+    """checkpoint_name tag: under remat='full_save_dispatch' these values
+    are SAVED across the remat boundary (policy save_only_these_names), so
+    the recompute pass skips re-argsorting the T·K picks."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(x, name)
+
+
 def _float0_zero(a: jnp.ndarray):
     import numpy as np
 
@@ -223,8 +232,8 @@ def ragged_experts(
     T, D = x.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
     flat_expert = gate_out.topk_idx.reshape(-1)  # [T*K]
-    order = jnp.argsort(flat_expert)  # stable
-    inv = jnp.argsort(order)  # sorted position of pick (t, k)
+    order = _name_ckpt(jnp.argsort(flat_expert), "moe_sort_order")  # stable
+    inv = _name_ckpt(jnp.argsort(order), "moe_sort_inv")
     group_sizes = gate_out.expert_counts.astype(jnp.int32)
     sorted_expert = flat_expert[order]
     xs = _dispatch_take(x, order, inv, K)  # [T*K, D] sorted by expert
@@ -352,7 +361,9 @@ def _a2a_body(xb, idxb, cwb, wd, *, ep, ep_axis, E, E_loc, C, D, K, act2,
     T = Bl * Sl
     xt = xb.reshape(T, D)
     flat = idxb.reshape(T * K)
-    order = jnp.argsort(flat, stable=True)  # sorted-pick → original-pick
+    order = _name_ckpt(
+        jnp.argsort(flat, stable=True), "moe_sort_order"
+    )  # sorted-pick → original-pick
     sorted_e = flat[order]
     xs = xt[order // K]  # [T*K, D] picks sorted by global expert id
 
@@ -377,7 +388,9 @@ def _a2a_body(xb, idxb, cwb, wd, *, ep, ep_axis, E, E_loc, C, D, K, act2,
     )
     recv_x, recv_id = a2a(send_x), a2a(send_id)  # [ep*C, ...] by sender
 
-    order2 = jnp.argsort(recv_id, stable=True)  # sentinel E_loc sorts last
+    order2 = _name_ckpt(
+        jnp.argsort(recv_id, stable=True), "moe_sort_inv"
+    )  # sentinel E_loc sorts last
     xs2 = recv_x[order2]
     sid = jnp.minimum(recv_id[order2], E_loc - 1)
     gsz = jnp.bincount(recv_id, length=E_loc).astype(jnp.int32)  # sentinel drops
@@ -568,8 +581,8 @@ def ragged_fused_experts(
     T, D = x.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
     flat_expert = gate_out.topk_idx.reshape(-1)
-    order = jnp.argsort(flat_expert)
-    inv = jnp.argsort(order)
+    order = _name_ckpt(jnp.argsort(flat_expert), "moe_sort_order")
+    inv = _name_ckpt(jnp.argsort(order), "moe_sort_inv")
     group_sizes = gate_out.expert_counts.astype(jnp.int32)
     xs = _dispatch_take(x, order, inv, K)
     gw, uw = _split_gate_up(weights["gate_up"], cfg.interleaved_gate_up)

@@ -133,3 +133,40 @@ def test_train_step_ep_sharded(devices8):
     assert "moe_aux_loss" in metrics and "expert_load_imbalance" in metrics
     bias_after = np.asarray(state.params["moe_layers"]["moe"]["router"]["bias"])
     assert not np.array_equal(bias_before, bias_after)  # aux-free update ran
+
+
+def test_full_save_dispatch_remat_matches_full():
+    """remat='full_save_dispatch' (sort permutations saved across the remat
+    boundary) must produce identical loss and grads to remat='full'."""
+    import jax
+    import jax.numpy as jnp
+
+    from automodel_tpu import auto_model
+
+    hf = {
+        "architectures": ["Qwen3MoeForCausalLM"], "model_type": "qwen3_moe",
+        "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+        "moe_intermediate_size": 16, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 8,
+        "num_experts": 4, "num_experts_per_tok": 2, "norm_topk_prob": True,
+    }
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 16)))
+
+    def run(remat):
+        auto = auto_model.from_config(
+            hf, None, {"attn": "sdpa", "param_dtype": "float32",
+                       "compute_dtype": "float32", "experts": "ragged",
+                       "remat": remat}, seed=0)
+
+        def loss(p):
+            logits, aux = auto.model(p, ids)
+            return jnp.mean(logits.astype(jnp.float32) ** 2) + aux.aux_loss
+
+        return jax.jit(jax.value_and_grad(loss))(auto.params)
+
+    l_full, g_full = run("full")
+    l_sd, g_sd = run("full_save_dispatch")
+    np.testing.assert_allclose(float(l_sd), float(l_full), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_sd)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
+                                   atol=1e-6)
