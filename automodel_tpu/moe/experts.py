@@ -561,11 +561,6 @@ def ragged_fused_experts(
     dispatch/combine; backward recomputes through the two-gmm composition."""
     from automodel_tpu.ops.fused_expert_mlp import fused_expert_mlp
 
-    if "gate_up_bias" in weights or "down_bias" in weights:
-        raise NotImplementedError(
-            "experts='ragged_fused' does not carry expert biases yet "
-            "(gpt-oss) — use experts='ragged'"
-        )
     if not cfg.gated:
         raise NotImplementedError(
             "experts='ragged_fused' supports gated swiglu experts only"
@@ -586,12 +581,20 @@ def ragged_fused_experts(
     group_sizes = gate_out.expert_counts.astype(jnp.int32)
     xs = _dispatch_take(x, order, inv, K)
     gw, uw = _split_gate_up(weights["gate_up"], cfg.interleaved_gate_up)
+    gb = ub = db = None
+    if "gate_up_bias" in weights:  # gpt-oss expert biases, per I-chunk in-kernel
+        gb, ub = _split_gate_up(
+            weights["gate_up_bias"], cfg.interleaved_gate_up
+        )
+        gb, ub = gb.astype(xs.dtype), ub.astype(xs.dtype)
+    if "down_bias" in weights:
+        db = weights["down_bias"].astype(xs.dtype)
     act_kind = "swiglu_oai" if cfg.activation == "swiglu_oai" else "swiglu"
     limit = cfg.activation_limit
     ys = fused_expert_mlp(
         xs, gw.astype(xs.dtype), uw.astype(xs.dtype),
         weights["down"].astype(xs.dtype), group_sizes,
-        act_kind, limit, platform, None,
+        gb, ub, db, act_kind, limit, platform, None,
     )
     out = _sorted_combine(ys, gate_out.topk_weights, order, inv, K)
     return out.astype(x.dtype)

@@ -34,8 +34,12 @@ from automodel_tpu.ops.grouped_matmul import (
 )
 
 
-def _kernel(wg, wt, ws, we, lhs_ref, wgu_ref, wd_ref, out_ref, acc,
-            *, tm, n_ic, act_kind, limit, W):
+def _kernel(wg, wt, ws, we, lhs_ref, wgu_ref, wd_ref, *rest,
+            tm, n_ic, act_kind, limit, W, has_bias):
+    if has_bias:
+        gub_ref, db_ref, out_ref, acc = rest
+    else:
+        out_ref, acc = rest
     w = pl.program_id(0)
     ic = pl.program_id(1)
     t = wt[w]
@@ -54,6 +58,17 @@ def _kernel(wg, wt, ws, we, lhs_ref, wgu_ref, wd_ref, out_ref, acc,
         lhs, wgu_ref[0, 0], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [tm, 2*ic_size]
+    if has_bias:
+        gu = gu + gub_ref[0, 0].astype(jnp.float32)
+        # gpt-oss-style expert biases: once added, masked rows are no longer
+        # zero (act(bias)·Wd ≠ 0) — re-mask mid before the down contraction
+        # and gate the down bias on the same row window (each work unit adds
+        # it exactly once, on its first I-chunk, to its own rows only).
+        @pl.when(ic == 0)
+        def _():
+            acc[...] += jnp.where(
+                lmask, db_ref[0].astype(jnp.float32), 0.0
+            )
     half = gu.shape[-1] // 2
     g, u = gu[:, :half], gu[:, half:]
     if act_kind == "swiglu_oai":
@@ -66,6 +81,8 @@ def _kernel(wg, wt, ws, we, lhs_ref, wgu_ref, wd_ref, out_ref, acc,
             mid = jnp.minimum(mid, limit)
             u = jnp.clip(u, -limit, limit)
         mid = mid * u
+    if has_bias:
+        mid = jnp.where(lmask, mid, 0.0)
     acc[...] += jax.lax.dot_general(
         mid.astype(lhs_ref.dtype), wd_ref[0, 0],
         (((1,), (0,)), ((), ())),
@@ -77,11 +94,14 @@ def _kernel(wg, wt, ws, we, lhs_ref, wgu_ref, wd_ref, out_ref, acc,
         out_ref[...] = acc[...].astype(out_ref.dtype)
 
 
-def _fwd(lhs, gate, up, down, group_sizes, act_kind, limit, interpret):
+def _fwd(lhs, gate, up, down, group_sizes, gb, ub, db, act_kind, limit,
+         interpret):
     """lhs [M, D] sorted by group; gate/up [G, D, I] (pre-split halves);
-    down [G, I, D] → [M, D]."""
+    down [G, I, D]; optional per-expert biases gb/ub [G, I], db [G, D]
+    (gpt-oss) → [M, D]."""
     M, D = lhs.shape
     G, _, I = gate.shape
+    has_bias = gb is not None or ub is not None or db is not None
     tm = 512
     ic = min(_round_up(I, 128), 512)
     Mp, Dp, Ip = _round_up(M, tm), _round_up(D, 128), _round_up(I, ic)
@@ -100,26 +120,47 @@ def _fwd(lhs, gate, up, down, group_sizes, act_kind, limit, interpret):
     wgu = wgu.transpose(0, 2, 1, 3).reshape(G, n_ic, Dp, 2 * ic)
     wd = down.reshape(G, n_ic, ic, Dp)
 
+    operands = [lhs, wgu, wd]
+    in_specs = [
+        pl.BlockSpec((tm, Dp), lambda w, i, wg, wt, ws, we: (wt[w], 0)),
+        pl.BlockSpec(
+            (1, 1, Dp, 2 * ic),
+            lambda w, i, wg, wt, ws, we: (wg[w], i, 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, 1, ic, Dp), lambda w, i, wg, wt, ws, we: (wg[w], i, 0, 0)
+        ),
+    ]
+    if has_bias:
+        zeros_i = jnp.zeros((G, I), lhs.dtype)
+        gb = zeros_i if gb is None else gb
+        ub = zeros_i if ub is None else ub
+        db = jnp.zeros((G, D), lhs.dtype) if db is None else db
+        gb = jnp.pad(gb, ((0, 0), (0, Ip - I)))
+        ub = jnp.pad(ub, ((0, 0), (0, Ip - I)))
+        gub = jnp.concatenate(
+            [gb.reshape(G, n_ic, ic), ub.reshape(G, n_ic, ic)], axis=-1
+        )  # [G, n_ic, 2ic] — same chunk interleave as wgu
+        operands += [gub, jnp.pad(db, ((0, 0), (0, Dp - D)))]
+        in_specs += [
+            pl.BlockSpec(
+                (1, 1, 2 * ic), lambda w, i, wg, wt, ws, we: (wg[w], i, 0)
+            ),
+            pl.BlockSpec((1, Dp), lambda w, i, wg, wt, ws, we: (wg[w], 0)),
+        ]
+
     wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)
     W = Mp // tm + G
 
     out = pl.pallas_call(
         functools.partial(
-            _kernel, tm=tm, n_ic=n_ic, act_kind=act_kind, limit=limit, W=W
+            _kernel, tm=tm, n_ic=n_ic, act_kind=act_kind, limit=limit, W=W,
+            has_bias=has_bias,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=(W, n_ic),
-            in_specs=[
-                pl.BlockSpec((tm, Dp), lambda w, i, wg, wt, ws, we: (wt[w], 0)),
-                pl.BlockSpec(
-                    (1, 1, Dp, 2 * ic),
-                    lambda w, i, wg, wt, ws, we: (wg[w], i, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, ic, Dp), lambda w, i, wg, wt, ws, we: (wg[w], i, 0, 0)
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (tm, Dp), lambda w, i, wg, wt, ws, we: (wt[w], 0)
             ),
@@ -130,15 +171,26 @@ def _fwd(lhs, gate, up, down, group_sizes, act_kind, limit, interpret):
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(wg, wt, ws, we, lhs, wgu, wd)
+    )(wg, wt, ws, we, *operands)
     return out[:M, :D]
 
 
-def _reference(lhs, gate, up, down, group_sizes, act_kind, limit, platform):
+def _reference(lhs, gate, up, down, group_sizes, gb, ub, db, act_kind, limit,
+               platform):
     """The two-grouped-matmul composition — the backward path and the
     numerics reference."""
     gu_g = ragged_dot(lhs, gate, group_sizes, platform=platform)
     gu_u = ragged_dot(lhs, up, group_sizes, platform=platform)
+    if gb is not None or ub is not None or db is not None:
+        # row r belongs to group g iff cumsum[g-1] <= r < cumsum[g]
+        bounds = jnp.cumsum(group_sizes.astype(jnp.int32))
+        row_g = jnp.searchsorted(
+            bounds, jnp.arange(lhs.shape[0], dtype=jnp.int32), side="right"
+        )
+    if gb is not None:
+        gu_g = gu_g + gb.astype(gu_g.dtype)[row_g]
+    if ub is not None:
+        gu_u = gu_u + ub.astype(gu_u.dtype)[row_g]
     if act_kind == "swiglu_oai":
         g = jnp.minimum(gu_g, 7.0)
         u = jnp.clip(gu_u, -7.0, 7.0)
@@ -149,11 +201,15 @@ def _reference(lhs, gate, up, down, group_sizes, act_kind, limit, platform):
             mid = jnp.minimum(mid, limit)
             gu_u = jnp.clip(gu_u, -limit, limit)
         mid = mid * gu_u
-    return ragged_dot(mid.astype(lhs.dtype), down, group_sizes, platform=platform)
+    out = ragged_dot(mid.astype(lhs.dtype), down, group_sizes, platform=platform)
+    if db is not None:
+        out = out + db.astype(out.dtype)[row_g]
+    return out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11))
 def fused_expert_mlp(lhs, gate, up, down, group_sizes,
+                     gb=None, ub=None, db=None,
                      act_kind="swiglu", limit=None, platform=None,
                      interpret=None):
     """Forward through the fused kernel; backward recomputes via the
@@ -163,27 +219,32 @@ def fused_expert_mlp(lhs, gate, up, down, group_sizes,
     if interpret is None:
         interpret = _interpret_requested()
     if not (interpret or _pallas_eligible(platform)):
-        return _reference(lhs, gate, up, down, group_sizes, act_kind, limit, platform)
-    return _fwd(lhs, gate, up, down, group_sizes, act_kind, limit, interpret)
+        return _reference(lhs, gate, up, down, group_sizes, gb, ub, db,
+                          act_kind, limit, platform)
+    return _fwd(lhs, gate, up, down, group_sizes, gb, ub, db, act_kind, limit,
+                interpret)
 
 
-def _vjp_fwd(lhs, gate, up, down, group_sizes, act_kind, limit, platform, interpret):
+def _vjp_fwd(lhs, gate, up, down, group_sizes, gb, ub, db,
+             act_kind, limit, platform, interpret):
     y = fused_expert_mlp(
-        lhs, gate, up, down, group_sizes, act_kind, limit, platform, interpret
+        lhs, gate, up, down, group_sizes, gb, ub, db,
+        act_kind, limit, platform, interpret
     )
-    return y, (lhs, gate, up, down, group_sizes)
+    return y, (lhs, gate, up, down, group_sizes, gb, ub, db)
 
 
 def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
-    lhs, gate, up, down, group_sizes = res
+    lhs, gate, up, down, group_sizes, gb, ub, db = res
 
     def f(args):
-        lhs_, g_, u_, d_ = args
-        return _reference(lhs_, g_, u_, d_, group_sizes, act_kind, limit, platform)
+        lhs_, g_, u_, d_, gb_, ub_, db_ = args
+        return _reference(lhs_, g_, u_, d_, group_sizes, gb_, ub_, db_,
+                          act_kind, limit, platform)
 
-    _, vjp = jax.vjp(f, (lhs, gate, up, down))
-    (dl, dg, du, dd), = vjp(dy)
-    return dl, dg, du, dd, None
+    _, vjp = jax.vjp(f, (lhs, gate, up, down, gb, ub, db))
+    (dl, dg, du, dd, dgb, dub, ddb), = vjp(dy)
+    return dl, dg, du, dd, None, dgb, dub, ddb
 
 
 fused_expert_mlp.defvjp(_vjp_fwd, _vjp_bwd)

@@ -294,7 +294,9 @@ def test_a2a_single_slice_falls_back_to_ragged():
 def test_ragged_fused_matches_ragged(monkeypatch):
     """experts='ragged_fused' (one-kernel expert MLP): numerics + grads
     match the two-gmm ragged path, incl. swiglu_oai and unbalanced groups
-    with an empty expert (interpret mode)."""
+    with an empty expert (interpret mode). The swiglu_oai case carries
+    gpt-oss-style per-expert gate_up/down biases (interleaved layout) so the
+    fused kernel's in-kernel bias path is exercised, masked rows included."""
     monkeypatch.setenv("AUTOMODEL_GMM_INTERPRET", "1")
     import jax
     import jax.numpy as jnp
@@ -324,6 +326,13 @@ def test_ragged_fused_matches_ragged(monkeypatch):
                                    jnp.float32),
             "down": jnp.asarray(rng.normal(size=(E, I, D)) * 0.2, jnp.float32),
         }
+        if activation == "swiglu_oai":  # gpt-oss fingerprint: biased experts
+            weights["gate_up_bias"] = jnp.asarray(
+                rng.normal(size=(E, 2 * I)) * 0.3, jnp.float32
+            )
+            weights["down_bias"] = jnp.asarray(
+                rng.normal(size=(E, D)) * 0.3, jnp.float32
+            )
 
         def f_ref(args):
             x_, wt = args
