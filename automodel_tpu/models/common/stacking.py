@@ -31,7 +31,8 @@ def remat_wrap(f: Callable, remat: str) -> Callable:
         return jax.checkpoint(
             f,
             policy=jax.checkpoint_policies.save_only_these_names(
-                "moe_sort_order", "moe_sort_inv"
+                "moe_sort_order", "moe_sort_inv", "moe_sort_order_inv",
+                "moe_sort_inv2",
             ),
         )
     if remat == "selective":

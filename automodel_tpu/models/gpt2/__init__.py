@@ -1,0 +1,22 @@
+from automodel_tpu.models.gpt2.model import (
+    GPT2Config,
+    GPT2ForCausalLM,
+    SHARDING_RULES,
+    forward,
+    forward_hidden,
+    init_params,
+)
+from automodel_tpu.models.gpt2.state_dict_adapter import GPT2StateDictAdapter
+
+ModelClass = GPT2ForCausalLM
+
+__all__ = [
+    "GPT2Config",
+    "GPT2ForCausalLM",
+    "GPT2StateDictAdapter",
+    "ModelClass",
+    "SHARDING_RULES",
+    "forward",
+    "forward_hidden",
+    "init_params",
+]

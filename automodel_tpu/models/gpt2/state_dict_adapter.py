@@ -1,0 +1,127 @@
+"""HF ⇄ native state-dict adapter for GPT-2.
+
+HF ``GPT2LMHeadModel`` stores projection weights as Conv1D — ALREADY
+``[in, out]`` (x @ W + b), matching the native kernel convention, so unlike
+torch-Linear families no transposes are needed. The fused ``attn.c_attn``
+``[D, 3D]`` splits into the native q/k/v kernels on the LAST dim (and back
+on save); ``lm_head.weight`` is tied to ``wte`` and never emitted.
+
+Reference parity: components/models/gpt2.py builds GPT-2 from scratch and
+does not load HF checkpoints at all — HF round-trip here is framework
+surface beyond the reference, tested against transformers' GPT2LMHeadModel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.gpt2.model import GPT2Config
+
+
+class GPT2StateDictAdapter:
+    def __init__(self, config: GPT2Config):
+        self.config = config
+
+    def _plain_keys(self) -> list[tuple[tuple[str, ...], str, bool]]:
+        """(native path, hf key template, stacked) for the 1:1 leaves."""
+        plans: list[tuple[tuple[str, ...], str, bool]] = [
+            (("embed", "embedding"), "transformer.wte.weight", False),
+            (("pos_embed", "embedding"), "transformer.wpe.weight", False),
+            (("final_norm", "scale"), "transformer.ln_f.weight", False),
+            (("final_norm", "bias"), "transformer.ln_f.bias", False),
+        ]
+        per_layer = [
+            (("layers", "ln_1", "scale"), "transformer.h.{i}.ln_1.weight"),
+            (("layers", "ln_1", "bias"), "transformer.h.{i}.ln_1.bias"),
+            (("layers", "ln_2", "scale"), "transformer.h.{i}.ln_2.weight"),
+            (("layers", "ln_2", "bias"), "transformer.h.{i}.ln_2.bias"),
+            (("layers", "attn", "o_proj", "kernel"), "transformer.h.{i}.attn.c_proj.weight"),
+            (("layers", "attn", "o_proj", "bias"), "transformer.h.{i}.attn.c_proj.bias"),
+            (("layers", "mlp", "fc", "kernel"), "transformer.h.{i}.mlp.c_fc.weight"),
+            (("layers", "mlp", "fc", "bias"), "transformer.h.{i}.mlp.c_fc.bias"),
+            (("layers", "mlp", "proj", "kernel"), "transformer.h.{i}.mlp.c_proj.weight"),
+            (("layers", "mlp", "proj", "bias"), "transformer.h.{i}.mlp.c_proj.bias"),
+        ]
+        plans.extend((path, key, True) for path, key in per_layer)
+        return plans
+
+    # -- load ---------------------------------------------------------------
+    def iter_from_hf(
+        self, get_tensor: Callable[[str], np.ndarray]
+    ) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        from automodel_tpu.checkpoint.hf_io import LazyStacked
+
+        L, D = self.config.num_layers, self.config.hidden_size
+        for path, key, stacked in self._plain_keys():
+            if stacked:
+                yield path, LazyStacked(
+                    [(lambda i=i, k=key: get_tensor(k.format(i=i))) for i in range(L)]
+                )
+            else:
+                yield path, get_tensor(key)
+        # fused c_attn [D, 3D] → q/k/v kernels; bias [3D] likewise
+        for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+            yield ("layers", "attn", name, "kernel"), LazyStacked(
+                [
+                    (lambda i=i, j=j: np.ascontiguousarray(
+                        get_tensor(f"transformer.h.{i}.attn.c_attn.weight")[:, j * D:(j + 1) * D]
+                    ))
+                    for i in range(L)
+                ]
+            )
+            yield ("layers", "attn", name, "bias"), LazyStacked(
+                [
+                    (lambda i=i, j=j: np.ascontiguousarray(
+                        get_tensor(f"transformer.h.{i}.attn.c_attn.bias")[j * D:(j + 1) * D]
+                    ))
+                    for i in range(L)
+                ]
+            )
+
+    def from_hf(self, get_tensor: Callable[[str], np.ndarray]) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor))
+
+    # -- save ---------------------------------------------------------------
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        def leaf(path):
+            node = params
+            for k in path:
+                node = node[k]
+            return np.asarray(node)
+
+        L = self.config.num_layers
+        for path, key, stacked in self._plain_keys():
+            arr = leaf(path)
+            if stacked:
+                for i in range(L):
+                    yield key.format(i=i), arr[i]
+            else:
+                yield key, arr
+        qkv_k = np.concatenate(
+            [leaf(("layers", "attn", n, "kernel")) for n in ("q_proj", "k_proj", "v_proj")],
+            axis=-1,
+        )  # [L, D, 3D]
+        qkv_b = np.concatenate(
+            [leaf(("layers", "attn", n, "bias")) for n in ("q_proj", "k_proj", "v_proj")],
+            axis=-1,
+        )  # [L, 3D]
+        for i in range(L):
+            yield f"transformer.h.{i}.attn.c_attn.weight", qkv_k[i]
+            yield f"transformer.h.{i}.attn.c_attn.bias", qkv_b[i]
+
+    def hf_keys(self) -> list[str]:
+        L = self.config.num_layers
+        keys = []
+        for path, key, stacked in self._plain_keys():
+            if stacked:
+                keys.extend(key.format(i=i) for i in range(L))
+            else:
+                keys.append(key)
+        for i in range(L):
+            keys.append(f"transformer.h.{i}.attn.c_attn.weight")
+            keys.append(f"transformer.h.{i}.attn.c_attn.bias")
+        return keys

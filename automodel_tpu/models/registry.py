@@ -59,6 +59,14 @@ def _llama_builder(hf_config: Any, backend: BackendConfig):
     return LlamaForCausalLM(cfg, backend), LlamaStateDictAdapter(cfg)
 
 
+@register_architecture("GPT2LMHeadModel")
+def _gpt2_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.gpt2 import GPT2Config, GPT2ForCausalLM, GPT2StateDictAdapter
+
+    cfg = GPT2Config.from_hf(hf_config)
+    return GPT2ForCausalLM(cfg, backend), GPT2StateDictAdapter(cfg)
+
+
 @register_architecture("Gemma2ForCausalLM", "Gemma3ForCausalLM")
 def _gemma_builder(hf_config: Any, backend: BackendConfig):
     from automodel_tpu.models.gemma import (
