@@ -49,6 +49,18 @@ class GPT2Config:
         return self.hidden_size // self.num_heads
 
     @property
+    def logits_soft_cap(self):
+        return None
+
+    @property
+    def num_kv_heads(self) -> int:
+        return self.num_heads  # no GQA in GPT-2
+
+    @property
+    def moe(self):
+        return None
+
+    @property
     def intermediate_size(self) -> int:
         return 4 * self.hidden_size
 

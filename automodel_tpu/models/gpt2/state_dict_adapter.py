@@ -47,6 +47,14 @@ class GPT2StateDictAdapter:
         plans.extend((path, key, True) for path, key in per_layer)
         return plans
 
+    def _untied_head_plan(self):
+        """HF always ties gpt2's lm_head to wte (the tied key is skipped);
+        an untied from_config model round-trips its separate head. The HF
+        tensor is Linear [V, D] → kernel [D, V]."""
+        if self.config.tie_embeddings:
+            return None
+        return (("lm_head", "kernel"), "lm_head.weight")
+
     # -- load ---------------------------------------------------------------
     def iter_from_hf(
         self, get_tensor: Callable[[str], np.ndarray]
@@ -61,6 +69,9 @@ class GPT2StateDictAdapter:
                 )
             else:
                 yield path, get_tensor(key)
+        head = self._untied_head_plan()
+        if head is not None:
+            yield head[0], np.ascontiguousarray(get_tensor(head[1]).T)
         # fused c_attn [D, 3D] → q/k/v kernels; bias [3D] likewise
         for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
             yield ("layers", "attn", name, "kernel"), LazyStacked(
@@ -112,6 +123,9 @@ class GPT2StateDictAdapter:
         for i in range(L):
             yield f"transformer.h.{i}.attn.c_attn.weight", qkv_k[i]
             yield f"transformer.h.{i}.attn.c_attn.bias", qkv_b[i]
+        head = self._untied_head_plan()
+        if head is not None:
+            yield head[1], np.ascontiguousarray(leaf(head[0]).T)
 
     def hf_keys(self) -> list[str]:
         L = self.config.num_layers
@@ -124,4 +138,7 @@ class GPT2StateDictAdapter:
         for i in range(L):
             keys.append(f"transformer.h.{i}.attn.c_attn.weight")
             keys.append(f"transformer.h.{i}.attn.c_attn.bias")
+        head = self._untied_head_plan()
+        if head is not None:
+            keys.append(head[1])
         return keys

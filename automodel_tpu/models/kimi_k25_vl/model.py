@@ -40,6 +40,11 @@ class KimiK25VLConfig:
     media_placeholder_token_id: int = 163605
     projector_ln_eps: float = 1e-5
     mm_hidden_size: Optional[int] = None  # defaults to vision hidden
+    # static per-batch media grids for recipe-driven training, where the
+    # collator cannot thread a static tuple through the jitted step (same
+    # device as qwen3_vl_moe's training_image_grid_thw). () → grids must be
+    # passed per call.
+    training_image_grid_thw: tuple = ()
 
     @classmethod
     def from_hf(cls, hf_cfg: Any) -> "KimiK25VLConfig":
@@ -53,6 +58,9 @@ class KimiK25VLConfig:
             media_placeholder_token_id=get("media_placeholder_token_id", 163605),
             projector_ln_eps=get("projector_ln_eps", 1e-5),
             mm_hidden_size=get("mm_hidden_size") or vision.hidden_size,
+            training_image_grid_thw=tuple(
+                tuple(g) for g in (get("training_image_grid_thw") or ())
+            ),
         )
 
     @property
@@ -172,6 +180,15 @@ class KimiK25VLForConditionalGeneration:
         **kw: Any,
     ):
         constrain = constrain or (lambda x, s: x)
+        if pixel_values is not None and grid_thw is None:
+            grid_thw = self.config.training_image_grid_thw
+            if not grid_thw:
+                raise ValueError(
+                    "pixel_values given without grid_thw; pass the static "
+                    "grids per call or set model.training_image_grid_thw in "
+                    "the config (the recipe path cannot thread static tuples "
+                    "through the jitted step)"
+                )
         embeds = self._embed_multimodal(
             params, input_ids, pixel_values, grid_thw, constrain
         )

@@ -1,0 +1,14 @@
+from automodel_tpu.models.kimi_vl.model import (
+    KimiVLConfig,
+    KimiVLForConditionalGeneration,
+)
+from automodel_tpu.models.kimi_vl.state_dict_adapter import KimiVLStateDictAdapter
+
+ModelClass = KimiVLForConditionalGeneration
+
+__all__ = [
+    "KimiVLConfig",
+    "KimiVLForConditionalGeneration",
+    "KimiVLStateDictAdapter",
+    "ModelClass",
+]

@@ -1,0 +1,77 @@
+"""Original Kimi-VL (KimiVLForConditionalGeneration), TPU-native.
+
+Parity: reference components/models/kimivl/model.py:1-874 — the MoonViT
+vision tower (conv patch embed + learnable bicubic-interpolated 2-D position
+table + interleaved-x/y 2-D rotary, pre-LN blocks with fused biased wqkv,
+gelu-tanh MLP, final LN, 2×2 spatial patch merger), a
+pre-LN→linear→gelu→linear multi-modal projector, and a DeepSeek-V3 text
+decoder with image features scattered over ``media_placeholder_token_id``.
+
+TPU-native reuse: the K2.5-VL MoonViT3d tower at t=1 IS this tower —
+identical rope interleave (reference Rope2DPosEmb and K2.5's repeated
+variant coincide for a single frame), identical block layout, and the
+t-pool merger at one frame reduces to the reference's spatial
+``patch_merger`` — so the family SUBCLASSES the K2.5 model and only
+translates the 2-D ``grid_hws`` convention into single-frame ``grid_thw``.
+The genuinely distinct parts (single-frame config, projector/HF key layout)
+live here and in the adapter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from automodel_tpu.models.deepseek_v3.model import DeepseekV3Config
+from automodel_tpu.models.kimi_k25_vl.model import (
+    KimiK25VLConfig,
+    KimiK25VLForConditionalGeneration,
+)
+from automodel_tpu.models.kimi_k25_vl.vision import MoonViT3dConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class KimiVLConfig(KimiK25VLConfig):
+    @classmethod
+    def from_hf(cls, hf_cfg: Any) -> "KimiVLConfig":
+        get = lambda k, d=None: (
+            hf_cfg.get(k, d) if isinstance(hf_cfg, dict) else getattr(hf_cfg, k, d)
+        )
+        vision = MoonViT3dConfig.from_hf(get("vision_config") or {})
+        # the original MoonViT is single-frame: no temporal table
+        vision = dataclasses.replace(vision, init_pos_emb_time=1)
+        grid_hws = tuple(tuple(g) for g in (get("training_image_grid_hws") or ()))
+        return cls(
+            text=DeepseekV3Config.from_hf(get("text_config")),
+            vision=vision,
+            media_placeholder_token_id=get("media_placeholder_token_id", 163605),
+            mm_hidden_size=vision.hidden_size,
+            training_image_grid_thw=tuple((1, h, w) for h, w in grid_hws),
+        )
+
+
+@dataclasses.dataclass
+class KimiVLForConditionalGeneration(KimiK25VLForConditionalGeneration):
+    """All shared machinery (init, media scatter with the NaN-poison guard,
+    DeepSeek-V3 text stack, post_step_fn, sharding rules) lives in the K2.5
+    base; this family only translates the 2-D ``grid_hws`` convention into
+    the single-frame ``grid_thw`` the shared tower consumes."""
+
+    def hidden(
+        self,
+        params: dict,
+        input_ids: jnp.ndarray,
+        pixel_values: Optional[jnp.ndarray] = None,  # [P_total, patch_dim]
+        grid_hws=None,  # static tuple of (h, w) per image
+        constrain=None,
+        **kw: Any,
+    ):
+        grid_thw = (
+            None if grid_hws is None else tuple((1, h, w) for h, w in grid_hws)
+        )
+        return super().hidden(
+            params, input_ids, pixel_values=pixel_values, grid_thw=grid_thw,
+            constrain=constrain, **kw,
+        )

@@ -1,0 +1,38 @@
+"""HF ⇄ native adapter for the original Kimi-VL.
+
+Parity target: reference components/models/kimivl/model.py:770-846
+(KimiVLStateDictAdapter) — HF keys live under ``language_model.model.`` /
+``language_model.lm_head.`` (DeepSeek-V3 text), ``vision_tower.`` (MoonViT:
+same encoder key names as K2.5's tower), and ``multi_modal_projector.``
+with named ``linear_1``/``linear_2`` modules (K2.5 uses Sequential indices
+``proj.0``/``proj.2`` instead — the only layout difference, so this adapter
+subclasses the K2.5 one and overrides the projector plans)."""
+
+from __future__ import annotations
+
+from automodel_tpu.models.kimi_k25_vl.state_dict_adapter import (
+    KimiK25VLStateDictAdapter,
+    _V,
+)
+from automodel_tpu.models.kimi_vl.model import KimiVLConfig
+
+_P = "multi_modal_projector"
+
+
+class KimiVLStateDictAdapter(KimiK25VLStateDictAdapter):
+    def __init__(self, config: KimiVLConfig):
+        super().__init__(config)
+
+    def _flat_plans(self):
+        return [
+            (("vision", "pos_emb", "weight"), _V + ".patch_embed.pos_emb.weight", False),
+            (("vision", "patch_embed", "bias"), _V + ".patch_embed.proj.bias", False),
+            (("vision", "final_norm", "scale"), _V + ".encoder.final_layernorm.weight", False),
+            (("vision", "final_norm", "bias"), _V + ".encoder.final_layernorm.bias", False),
+            (("projector", "pre_norm", "scale"), _P + ".pre_norm.weight", False),
+            (("projector", "pre_norm", "bias"), _P + ".pre_norm.bias", False),
+            (("projector", "linear_1", "kernel"), _P + ".linear_1.weight", True),
+            (("projector", "linear_1", "bias"), _P + ".linear_1.bias", False),
+            (("projector", "linear_2", "kernel"), _P + ".linear_2.weight", True),
+            (("projector", "linear_2", "bias"), _P + ".linear_2.bias", False),
+        ]

@@ -1,0 +1,20 @@
+from automodel_tpu.models.nemotron_parse.model import (
+    NemotronParseConfig,
+    NemotronParseForConditionalGeneration,
+    shift_tokens_right,
+)
+from automodel_tpu.models.nemotron_parse.state_dict_adapter import (
+    NemotronParseStateDictAdapter,
+)
+from automodel_tpu.models.nemotron_parse.vision import RadioBackboneConfig
+
+ModelClass = NemotronParseForConditionalGeneration
+
+__all__ = [
+    "NemotronParseConfig",
+    "NemotronParseForConditionalGeneration",
+    "NemotronParseStateDictAdapter",
+    "RadioBackboneConfig",
+    "ModelClass",
+    "shift_tokens_right",
+]

@@ -1,0 +1,366 @@
+"""Nemotron-Parse (OCR/document-parsing VLM), TPU-native.
+
+Parity: reference components/models/nemotron_parse/model.py:1-562 — an
+encoder-decoder: RADIO vision encoder + neck (vision.py here) feeding an
+mBART-style text decoder (learned positions with the mBART +2 offset,
+pre-LN blocks with self-attention, CROSS-attention over the encoder states,
+gelu FFN; layernorm_embedding after embed+pos and a final layer_norm), a
+bias-free lm_head, and teacher-forcing via shift_tokens_right. The family
+pairs with the coordinate-weighted CE loss (ops/losses.py
+nemotron_parse_cross_entropy — the reference's only per-family loss).
+
+TPU-native: decoder layers are stacked and scanned; the cross-attention KV
+is computed once per layer from the shared encoder states (the reference
+recomputes k/v per layer the same way — no cache during training).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.llama.model import ACT_FNS, _dense_init
+from automodel_tpu.models.nemotron_parse.vision import (
+    RadioBackboneConfig,
+    backbone_forward,
+    init_backbone_params,
+    init_neck_params,
+    neck_forward,
+)
+from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.ops.norms import layer_norm
+
+Constrain = Any
+_noop_constrain = lambda x, spec: x
+
+_POS_OFFSET = 2  # MBartLearnedPositionalEmbedding reserves 2 rows
+
+
+@dataclasses.dataclass(frozen=True)
+class NemotronParseConfig:
+    vision: RadioBackboneConfig
+    vocab_size: int = 250027
+    hidden_size: int = 1024
+    num_layers: int = 12  # decoder layers
+    num_heads: int = 16
+    intermediate_size: int = 4096  # decoder_ffn_dim
+    max_positions: int = 9000  # max_sequence_length
+    scale_embedding: bool = False
+    ln_eps: float = 1e-5
+    pad_token_id: int = 1
+    decoder_start_token_id: int = 2
+    class_token_start_idx: int = 50000
+    coordinate_weight: float = 10.0
+
+    # reference image_size [2048, 1648] → the default static patch grid for
+    # recipe-driven training (pixel batches without an explicit grid_hw)
+    image_size: tuple = (2048, 1648)
+
+    def __post_init__(self):
+        # the neck's output width IS the decoder width (reference hard-codes
+        # both at 1024); keep them in lockstep whatever the caller passed
+        if self.vision.neck_width != self.hidden_size:
+            object.__setattr__(
+                self, "vision",
+                dataclasses.replace(self.vision, neck_width=self.hidden_size),
+            )
+
+    @property
+    def default_grid_hw(self) -> tuple:
+        ps = self.vision.patch_size
+        return (self.image_size[0] // ps, self.image_size[1] // ps)
+
+    @property
+    def logits_soft_cap(self):
+        return None
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_kv_heads(self) -> int:
+        return self.num_heads
+
+    @property
+    def moe(self):
+        return None
+
+    @classmethod
+    def from_hf(cls, hf: Any) -> "NemotronParseConfig":
+        get = lambda k, d=None: (
+            hf.get(k, d) if isinstance(hf, dict) else getattr(hf, k, d)
+        )
+        dec = get("decoder") or {}
+        dget = lambda k, d=None: (
+            dec.get(k, d) if isinstance(dec, dict) else getattr(dec, k, d)
+        )
+        import dataclasses as _dc
+
+        vision = _dc.replace(
+            RadioBackboneConfig.from_hf(get("encoder") or {}),
+            neck_width=dget("d_model", 1024),
+        )
+        return cls(
+            vision=vision,
+            vocab_size=dget("vocab_size", 250027),
+            hidden_size=dget("d_model", 1024),
+            num_layers=dget("decoder_layers", 12),
+            num_heads=dget("decoder_attention_heads", 16),
+            intermediate_size=dget("decoder_ffn_dim", 4096),
+            max_positions=get("max_sequence_length", None)
+            or dget("max_sequence_length", 9000),
+            image_size=tuple(get("image_size") or (2048, 1648)),
+            scale_embedding=bool(dget("scale_embedding", False)),
+            pad_token_id=get("pad_token_id", None) or dget("pad_token_id", 1),
+            decoder_start_token_id=get("decoder_start_token_id", None)
+            or dget("decoder_start_token_id", 2),
+            class_token_start_idx=get("class_token_start_idx", 50000),
+        )
+
+
+def shift_tokens_right(
+    labels: jnp.ndarray, pad_token_id: int, decoder_start_token_id: int
+) -> jnp.ndarray:
+    """Teacher forcing (HF shift_tokens_right): prepend the start token,
+    drop the last label, and replace ignore (-100) with pad."""
+    shifted = jnp.concatenate(
+        [
+            jnp.full((labels.shape[0], 1), decoder_start_token_id, labels.dtype),
+            labels[:, :-1],
+        ],
+        axis=1,
+    )
+    return jnp.where(shifted == -100, pad_token_id, shifted)
+
+
+def init_decoder_params(cfg: NemotronParseConfig, backend: BackendConfig, key) -> dict:
+    pd = backend.param_jnp_dtype
+    D, I, L, V = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers, cfg.vocab_size
+    ks = jax.random.split(key, 12)
+
+    def stack(k, shape):
+        return _dense_init(k, (L, *shape), pd, in_axis=1)
+
+    def zeros(*shape):
+        return jnp.zeros(shape, pd)
+
+    def ln(*lead):
+        return {"scale": jnp.ones((*lead, D), pd), "bias": zeros(*lead, D)}
+
+    attn = lambda k0: {
+        "q_proj": {"kernel": stack(ks[k0], (D, D)), "bias": zeros(L, D)},
+        "k_proj": {"kernel": stack(ks[k0 + 1], (D, D)), "bias": zeros(L, D)},
+        "v_proj": {"kernel": stack(ks[k0 + 2], (D, D)), "bias": zeros(L, D)},
+        "o_proj": {"kernel": stack(ks[k0 + 3], (D, D)), "bias": zeros(L, D)},
+    }
+    return {
+        "embed": {
+            "embedding": (jax.random.normal(ks[8], (V, D)) * 0.02).astype(pd)
+        },
+        "pos_embed": {
+            "embedding": (
+                jax.random.normal(ks[9], (cfg.max_positions + _POS_OFFSET, D)) * 0.02
+            ).astype(pd)
+        },
+        "layernorm_embedding": ln(),
+        "layers": {
+            "self_attn": attn(0),
+            "self_attn_layer_norm": ln(L),
+            "cross_attn": attn(4),
+            "cross_attn_layer_norm": ln(L),
+            "fc1": {"kernel": stack(ks[10], (D, I)), "bias": zeros(L, I)},
+            "fc2": {"kernel": stack(ks[11], (I, D)), "bias": zeros(L, D)},
+            "final_layer_norm": ln(L),
+        },
+        "final_norm": ln(),
+    }
+
+
+def _attn_proj(x, p):
+    return x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def decoder_forward(
+    cfg: NemotronParseConfig,
+    backend: BackendConfig,
+    params: dict,  # the decoder subtree
+    input_ids: jnp.ndarray,  # [B, S]
+    encoder_states: jnp.ndarray,  # [B, M, D]
+    constrain: Constrain = _noop_constrain,
+) -> jnp.ndarray:
+    cd = backend.compute_jnp_dtype
+    B, S = input_ids.shape
+    D, NH, HD = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    eps = cfg.ln_eps
+    act = ACT_FNS["gelu"]  # mBART activation_function="gelu" (exact erf)
+
+    scale = jnp.sqrt(jnp.float32(D)).astype(cd) if cfg.scale_embedding else 1.0
+    h = params["embed"]["embedding"].astype(cd)[input_ids] * scale
+    pos = jnp.arange(S, dtype=jnp.int32) + _POS_OFFSET
+    h = h + params["pos_embed"]["embedding"].astype(cd)[pos][None]
+    h = layer_norm(
+        h, params["layernorm_embedding"]["scale"],
+        params["layernorm_embedding"]["bias"], eps,
+    )
+    h = constrain(h, ("batch", "seq", None))
+    enc = encoder_states.astype(cd)
+    M = enc.shape[1]
+
+    def layer_fn(hcarry, lp):
+        x = layer_norm(
+            hcarry, lp["self_attn_layer_norm"]["scale"],
+            lp["self_attn_layer_norm"]["bias"], eps,
+        )
+        q = _attn_proj(x, lp["self_attn"]["q_proj"]).reshape(B, S, NH, HD)
+        k = _attn_proj(x, lp["self_attn"]["k_proj"]).reshape(B, S, NH, HD)
+        v = _attn_proj(x, lp["self_attn"]["v_proj"]).reshape(B, S, NH, HD)
+        attn = sdpa(q, k, v, causal=True).reshape(B, S, D)
+        hcarry = hcarry + _attn_proj(attn, lp["self_attn"]["o_proj"])
+
+        x = layer_norm(
+            hcarry, lp["cross_attn_layer_norm"]["scale"],
+            lp["cross_attn_layer_norm"]["bias"], eps,
+        )
+        q = _attn_proj(x, lp["cross_attn"]["q_proj"]).reshape(B, S, NH, HD)
+        k = _attn_proj(enc, lp["cross_attn"]["k_proj"]).reshape(B, M, NH, HD)
+        v = _attn_proj(enc, lp["cross_attn"]["v_proj"]).reshape(B, M, NH, HD)
+        attn = sdpa(q, k, v, causal=False).reshape(B, S, D)
+        hcarry = hcarry + _attn_proj(attn, lp["cross_attn"]["o_proj"])
+
+        x = layer_norm(
+            hcarry, lp["final_layer_norm"]["scale"],
+            lp["final_layer_norm"]["bias"], eps,
+        )
+        x = act(x @ lp["fc1"]["kernel"].astype(cd) + lp["fc1"]["bias"].astype(cd))
+        hcarry = hcarry + (
+            x @ lp["fc2"]["kernel"].astype(cd) + lp["fc2"]["bias"].astype(cd)
+        )
+        return constrain(hcarry, ("batch", "seq", None)), None
+
+    from automodel_tpu.models.common.stacking import remat_wrap
+
+    layer_fn = remat_wrap(layer_fn, backend.remat)
+    if backend.scan_layers:
+        h, _ = jax.lax.scan(layer_fn, h, params["layers"])
+    else:
+        for i in range(cfg.num_layers):
+            h, _ = layer_fn(h, jax.tree.map(lambda x: x[i], params["layers"]))
+    return layer_norm(
+        h, params["final_norm"]["scale"], params["final_norm"]["bias"], eps
+    )
+
+
+SHARDING_RULES: list[tuple[str, tuple]] = [
+    (r"^vision/", ()),
+    (r"decoder/embed/embedding$", ("tensor", "fsdp")),
+    (r"decoder/pos_embed/embedding$", (None, "fsdp")),
+    (r"decoder/layers/(self|cross)_attn/[qkv]_proj/kernel$", (None, "fsdp", "tensor")),
+    (r"decoder/layers/(self|cross)_attn/[qkv]_proj/bias$", (None, "tensor")),
+    (r"decoder/layers/(self|cross)_attn/o_proj/kernel$", (None, "tensor", "fsdp")),
+    (r"decoder/layers/fc1/kernel$", (None, "fsdp", "tensor")),
+    (r"decoder/layers/fc1/bias$", (None, "tensor")),
+    (r"decoder/layers/fc2/kernel$", (None, "tensor", "fsdp")),
+    (r"lm_head/kernel$", ("fsdp", "tensor")),
+]
+
+
+@dataclasses.dataclass
+class NemotronParseForConditionalGeneration:
+    config: NemotronParseConfig
+    backend: BackendConfig = BackendConfig()
+
+    # per-family loss defaults the recipes pick up (the only reference
+    # family that ships its own loss)
+    loss_name = "nemotron_parse"
+
+    def loss_kwargs(self) -> dict:
+        return {
+            "coordinate_weight": self.config.coordinate_weight,
+            "class_token_start_idx": self.config.class_token_start_idx,
+        }
+
+    def init(self, key: jax.Array) -> dict:
+        kb, kn, kd, kh = jax.random.split(key, 4)
+        return {
+            "vision": {
+                "backbone": init_backbone_params(self.config.vision, self.backend, kb),
+                "neck": init_neck_params(self.config.vision, self.backend, kn),
+            },
+            "decoder": init_decoder_params(self.config, self.backend, kd),
+            "lm_head": {
+                "kernel": _dense_init(
+                    kh, (self.config.hidden_size, self.config.vocab_size),
+                    self.backend.param_jnp_dtype,
+                )
+            },
+        }
+
+    def encode(
+        self,
+        params: dict,
+        pixel_patches: Optional[jnp.ndarray] = None,  # [B, N, patch_dim]
+        grid_hw: Optional[tuple] = None,  # static (h, w)
+        radio_features: Optional[jnp.ndarray] = None,  # hub-RADIO outputs
+        radio_summary: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """→ encoder states [B, M+1, 1024]. Feed either pixel patches (the
+        in-tree backbone runs) or precomputed RADIO outputs (the reference's
+        hub-backbone boundary)."""
+        if radio_features is None:
+            if pixel_patches is None:
+                raise ValueError("need pixel_patches or radio_features")
+            radio_features, radio_summary = backbone_forward(
+                self.config.vision, self.backend, params["vision"]["backbone"],
+                pixel_patches, grid_hw,
+            )
+        return neck_forward(
+            self.config.vision, params["vision"]["neck"],
+            radio_features, radio_summary, grid_hw,
+        )
+
+    def hidden(
+        self,
+        params: dict,
+        input_ids: Optional[jnp.ndarray] = None,  # decoder_input_ids
+        labels: Optional[jnp.ndarray] = None,  # teacher-forcing shortcut
+        encoder_states: Optional[jnp.ndarray] = None,
+        constrain: Constrain = None,
+        pixel_values: Optional[jnp.ndarray] = None,  # recipe-path alias
+        **encode_kw: Any,
+    ):
+        constrain = constrain or _noop_constrain
+        if pixel_values is not None and "pixel_patches" not in encode_kw:
+            # the generic loss/recipe path forwards batch["pixel_values"]
+            # ([B, N, patch_dim] pre-patchified) without a static grid —
+            # fall back to the config's image_size grid
+            encode_kw["pixel_patches"] = pixel_values
+            encode_kw.setdefault("grid_hw", self.config.default_grid_hw)
+        if encoder_states is None:
+            encoder_states = self.encode(params, **encode_kw)
+        if input_ids is None:
+            if labels is None:
+                raise ValueError("need decoder input_ids or labels")
+            input_ids = shift_tokens_right(
+                labels, self.config.pad_token_id, self.config.decoder_start_token_id
+            )
+        h = decoder_forward(
+            self.config, self.backend, params["decoder"], input_ids,
+            encoder_states, constrain,
+        )
+        return h, None
+
+    def __call__(self, params: dict, input_ids=None, **kw: Any):
+        h, _ = self.hidden(params, input_ids, **kw)
+        return h @ params["lm_head"]["kernel"].astype(h.dtype)
+
+    def lm_head(self, params: dict) -> jnp.ndarray:
+        return params["lm_head"]["kernel"]
+
+    @property
+    def sharding_rules(self) -> list[tuple[str, tuple]]:
+        return SHARDING_RULES

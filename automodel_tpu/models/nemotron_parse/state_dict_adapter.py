@@ -1,0 +1,179 @@
+"""HF ⇄ native adapter for Nemotron-Parse.
+
+Key layout follows the reference module tree
+(components/models/nemotron_parse/model.py): ``encoder.conv1/layer_norm1/
+conv2/layer_norm2/sum_proj/layer_norm3`` (the neck), ``decoder.*`` (mBART
+decoder: embed_tokens, embed_positions, layers.{i}.self_attn/encoder_attn/
+fc1/fc2 + their layer norms, layernorm_embedding, layer_norm) and
+``lm_head.weight``.
+
+The RADIO backbone boundary: hub checkpoints carry the C-RADIOv2 internals
+under ``encoder.model_encoder.*`` — an external trust_remote_code model the
+reference downloads rather than implements. The in-tree stand-in backbone
+round-trips under the same prefix with its own key names; loading a hub
+checkpoint keeps the neck/decoder/head weights and leaves the stand-in
+backbone at init (warned, not fatal), mirroring where the reference's own
+code ownership ends.
+
+Conv→linear transforms: 1×1 Conv1d [out,in,1] → [in,out] kernel; the
+(1,4)-stride Conv2d [out,in,1,4] → [4·in, out] with rows ordered
+(tap-major, channel-minor) to match the neck's reshape.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from automodel_tpu.models.nemotron_parse.model import NemotronParseConfig
+
+logger = logging.getLogger(__name__)
+
+_BB = "encoder.model_encoder.automodel_vit."  # stand-in backbone prefix
+
+
+def _t(x: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(x.T)
+
+
+def _conv1(w: np.ndarray) -> np.ndarray:  # [out, in, 1] → [in, out]
+    return _t(w[:, :, 0])
+
+
+def _conv1_inv(k: np.ndarray) -> np.ndarray:
+    return _t(k)[:, :, None]
+
+
+def _conv2(w: np.ndarray) -> np.ndarray:  # [out, in, 1, T] → [T·in, out]
+    o, c, _, t = w.shape
+    return np.ascontiguousarray(np.transpose(w[:, :, 0, :], (2, 1, 0)).reshape(t * c, o))
+
+
+def _conv2_inv(k: np.ndarray, taps: int = 4) -> np.ndarray:
+    tc, o = k.shape
+    c = tc // taps
+    return np.ascontiguousarray(
+        np.transpose(k.reshape(taps, c, o), (2, 1, 0))[:, :, None, :]
+    )
+
+
+class NemotronParseStateDictAdapter:
+    def __init__(self, config: NemotronParseConfig):
+        self.config = config
+
+    def _neck_plans(self):
+        return [
+            (("vision", "neck", "conv1", "kernel"), "encoder.conv1.weight", _conv1, _conv1_inv),
+            (("vision", "neck", "conv1", "bias"), "encoder.conv1.bias", None, None),
+            (("vision", "neck", "conv2", "kernel"), "encoder.conv2.weight", _conv2, _conv2_inv),
+            (("vision", "neck", "sum_proj", "kernel"), "encoder.sum_proj.weight", _t, _t),
+            (("vision", "neck", "sum_proj", "bias"), "encoder.sum_proj.bias", None, None),
+        ] + [
+            (("vision", "neck", f"layer_norm{i}", part),
+             f"encoder.layer_norm{i}.{hf}", None, None)
+            for i in (1, 2, 3)
+            for part, hf in (("scale", "weight"), ("bias", "bias"))
+        ]
+
+    def _decoder_flat_plans(self):
+        return [
+            (("decoder", "embed", "embedding"), "decoder.embed_tokens.weight", None, None),
+            (("decoder", "pos_embed", "embedding"), "decoder.embed_positions.weight", None, None),
+            (("decoder", "layernorm_embedding", "scale"), "decoder.layernorm_embedding.weight", None, None),
+            (("decoder", "layernorm_embedding", "bias"), "decoder.layernorm_embedding.bias", None, None),
+            (("decoder", "final_norm", "scale"), "decoder.layer_norm.weight", None, None),
+            (("decoder", "final_norm", "bias"), "decoder.layer_norm.bias", None, None),
+            (("lm_head", "kernel"), "lm_head.weight", _t, _t),
+        ]
+
+    def _layer_plans(self):
+        """(native sub-path under layers, hf sub-key, transpose)"""
+        plans = []
+        for native_attn, hf_attn in (("self_attn", "self_attn"), ("cross_attn", "encoder_attn")):
+            for native_p, hf_p in (
+                ("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                ("v_proj", "v_proj"), ("o_proj", "out_proj"),
+            ):
+                plans.append(((native_attn, native_p, "kernel"), f"{hf_attn}.{hf_p}.weight", True))
+                plans.append(((native_attn, native_p, "bias"), f"{hf_attn}.{hf_p}.bias", False))
+            ln = f"{native_attn}_layer_norm"
+            hf_ln = f"{hf_attn}_layer_norm"
+            plans.append(((ln, "scale"), f"{hf_ln}.weight", False))
+            plans.append(((ln, "bias"), f"{hf_ln}.bias", False))
+        for fc in ("fc1", "fc2"):
+            plans.append(((fc, "kernel"), f"{fc}.weight", True))
+            plans.append(((fc, "bias"), f"{fc}.bias", False))
+        plans.append((("final_layer_norm", "scale"), "final_layer_norm.weight", False))
+        plans.append((("final_layer_norm", "bias"), "final_layer_norm.bias", False))
+        return plans
+
+    def _backbone_paths(self, params_backbone: Any) -> Iterator[tuple[tuple, str]]:
+        import jax
+
+        for p, _ in jax.tree_util.tree_leaves_with_path(params_backbone):
+            path = tuple(getattr(k, "key", k) for k in p)
+            yield path, _BB + "/".join(str(s) for s in path)
+
+    # -- load ---------------------------------------------------------------
+    def iter_from_hf(
+        self, get_tensor: Callable[[str], np.ndarray], backbone_init: Any = None
+    ) -> Iterator[tuple[tuple[str, ...], np.ndarray]]:
+        from automodel_tpu.checkpoint.hf_io import LazyStacked
+
+        for path, key, tr, _ in self._neck_plans() + self._decoder_flat_plans():
+            v = get_tensor(key)
+            yield path, tr(v) if tr else v
+        L = self.config.num_layers
+        for sub, hf_sub, tr in self._layer_plans():
+            yield (("decoder", "layers", *sub), LazyStacked(
+                [
+                    (lambda i=i, s=hf_sub, t=tr: (
+                        _t(get_tensor(f"decoder.layers.{i}.{s}"))
+                        if t else get_tensor(f"decoder.layers.{i}.{s}")
+                    ))
+                    for i in range(L)
+                ]
+            ))
+        if backbone_init is not None:
+            missing = 0
+            for path, key in self._backbone_paths(backbone_init):
+                try:
+                    yield (("vision", "backbone", *path), get_tensor(key))
+                except KeyError:
+                    missing += 1
+            if missing:
+                logger.warning(
+                    "checkpoint has no in-tree backbone weights (%d leaves; a "
+                    "hub RADIO checkpoint keeps its own encoder.model_encoder "
+                    "layout) — the stand-in ViT stays at its init", missing,
+                )
+
+    def from_hf(
+        self, get_tensor: Callable[[str], np.ndarray], backbone_init: Any = None
+    ) -> dict:
+        from automodel_tpu.checkpoint.hf_io import assemble_tree
+
+        return assemble_tree(self.iter_from_hf(get_tensor, backbone_init))
+
+    # -- save ---------------------------------------------------------------
+    def to_hf(self, params: Any) -> Iterator[tuple[str, np.ndarray]]:
+        def leaf(path):
+            node = params
+            for k in path:
+                node = node[k]
+            return np.asarray(node)
+
+        for path, key, _, inv in self._neck_plans() + self._decoder_flat_plans():
+            v = leaf(path)
+            yield key, inv(v) if inv else v
+        L = self.config.num_layers
+        for sub, hf_sub, tr in self._layer_plans():
+            stacked = leaf(("decoder", "layers", *sub))
+            for i in range(L):
+                yield f"decoder.layers.{i}.{hf_sub}", (
+                    _t(stacked[i]) if tr else stacked[i]
+                )
+        for path, key in self._backbone_paths(params["vision"]["backbone"]):
+            yield key, leaf(("vision", "backbone", *path))

@@ -1,0 +1,205 @@
+"""Nemotron-Parse vision side: RADIO-interface backbone + the exact neck.
+
+Parity: reference components/models/nemotron_parse/model.py:366-410
+(RadioWithNeck). The reference pulls the C-RADIOv2-H backbone from the hub
+via ``AutoModel.from_config(..., trust_remote_code=True)`` — an external
+dependency, not reference code — and owns only the NECK: 1×1 conv
+(1280→1024) + LN, a (1,4)-stride horizontal pooling conv (no bias) + LN,
+and a summary projection (3840→1024) + LN whose output is appended as one
+extra encoder token.
+
+Here the neck is implemented exactly (convs become the equivalent linears:
+a 1×1 Conv1d is a per-token matmul; the (1,4)/stride-(1,4) Conv2d is a
+linear over 4 horizontally-adjacent tokens). The backbone honours the same
+boundary the reference draws: either the caller feeds precomputed RADIO
+outputs (``features`` [B, N, 1280] + ``summary`` [B, 3840]), or the
+in-tree ViT stand-in below computes them (patch embed + learned positions +
+pre-LN blocks + summary register tokens) so the family trains
+self-contained on a zero-egress TPU host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.llama.model import ACT_FNS, _dense_init
+from automodel_tpu.ops.attention import sdpa
+from automodel_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class RadioBackboneConfig:
+    """In-tree ViT stand-in dims default to C-RADIOv2-H's interface
+    (feature width 1280, summary width 3840 = 3 register tokens)."""
+
+    patch_size: int = 16
+    hidden_size: int = 1280
+    summary_width: int = 3840
+    num_layers: int = 4  # the hub RADIO-H has 32; the stand-in is trainable at any depth
+    num_heads: int = 16
+    mlp_ratio: int = 4
+    num_channels: int = 3
+    ln_eps: float = 1e-6
+    max_grid: int = 128  # learned pos table edge (2048/16)
+    neck_width: int = 1024  # = decoder d_model (reference last_hidden_state)
+
+    @property
+    def num_summary_tokens(self) -> int:
+        return self.summary_width // self.hidden_size
+
+    @property
+    def patch_dim(self) -> int:
+        return self.num_channels * self.patch_size**2
+
+    @classmethod
+    def from_hf(cls, hf: Any) -> "RadioBackboneConfig":
+        get = lambda k, d=None: (
+            hf.get(k, d) if isinstance(hf, dict) else getattr(hf, k, d)
+        )
+        return cls(
+            patch_size=get("patch_size", 16),
+            hidden_size=get("backbone_hidden_size", 1280),
+            summary_width=get("summary_width", 3840),
+            num_layers=get("backbone_num_layers", 4),
+            num_heads=get("backbone_num_heads", 16),
+        )
+
+
+NECK_POOL = 4  # the (1, 4)-stride horizontal conv
+
+
+def init_backbone_params(cfg: RadioBackboneConfig, backend: BackendConfig, key) -> dict:
+    pd = backend.param_jnp_dtype
+    D, L = cfg.hidden_size, cfg.num_layers
+    I = cfg.mlp_ratio * D
+    ks = jax.random.split(key, 8)
+
+    def stack(k, shape):
+        return _dense_init(k, (L, *shape), pd, in_axis=1)
+
+    def zeros(*shape):
+        return jnp.zeros(shape, pd)
+
+    return {
+        "patch_embed": {
+            "kernel": _dense_init(ks[0], (cfg.patch_dim, D), pd),
+            "bias": zeros(D),
+        },
+        "pos_emb": {
+            "weight": (jax.random.normal(ks[1], (cfg.max_grid, cfg.max_grid, D))
+                       * 0.02).astype(pd)
+        },
+        "summary_tokens": (
+            jax.random.normal(ks[2], (cfg.num_summary_tokens, D)) * 0.02
+        ).astype(pd),
+        "blocks": {
+            "norm0": {"scale": jnp.ones((L, D), pd), "bias": zeros(L, D)},
+            "norm1": {"scale": jnp.ones((L, D), pd), "bias": zeros(L, D)},
+            "wqkv": {"kernel": stack(ks[3], (D, 3 * D)), "bias": zeros(L, 3 * D)},
+            "wo": {"kernel": stack(ks[4], (D, D)), "bias": zeros(L, D)},
+            "fc0": {"kernel": stack(ks[5], (D, I)), "bias": zeros(L, I)},
+            "fc1": {"kernel": stack(ks[6], (I, D)), "bias": zeros(L, D)},
+        },
+    }
+
+
+def init_neck_params(cfg: RadioBackboneConfig, backend: BackendConfig, key) -> dict:
+    pd = backend.param_jnp_dtype
+    W = cfg.neck_width
+    ks = jax.random.split(key, 3)
+    ln = lambda: {"scale": jnp.ones((W,), pd), "bias": jnp.zeros((W,), pd)}
+    return {
+        "conv1": {
+            "kernel": _dense_init(ks[0], (cfg.hidden_size, W), pd),
+            "bias": jnp.zeros((W,), pd),
+        },
+        "layer_norm1": ln(),
+        "conv2": {"kernel": _dense_init(ks[1], (NECK_POOL * W, W), pd)},
+        "layer_norm2": ln(),
+        "sum_proj": {
+            "kernel": _dense_init(ks[2], (cfg.summary_width, W), pd),
+            "bias": jnp.zeros((W,), pd),
+        },
+        "layer_norm3": ln(),
+    }
+
+
+def backbone_forward(
+    cfg: RadioBackboneConfig,
+    backend: BackendConfig,
+    params: dict,
+    pixel_patches: jnp.ndarray,  # [B, N, patch_dim] pre-patchified
+    grid_hw: tuple,  # static (h, w), h*w == N
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (features [B, N, 1280], summary [B, 3840]) — the RADIO output
+    interface the neck consumes."""
+    cd = backend.compute_jnp_dtype
+    B, N, _ = pixel_patches.shape
+    h, w = grid_hw
+    D = cfg.hidden_size
+    S = cfg.num_summary_tokens
+    act = ACT_FNS["gelu"]
+    eps = cfg.ln_eps
+    NH, HD = cfg.num_heads, D // cfg.num_heads
+
+    x = pixel_patches.astype(cd) @ params["patch_embed"]["kernel"].astype(cd)
+    x = x + params["patch_embed"]["bias"].astype(cd)
+    pos = params["pos_emb"]["weight"][:h, :w].reshape(-1, D).astype(cd)
+    x = x + pos[None]
+    toks = jnp.broadcast_to(params["summary_tokens"].astype(cd)[None], (B, S, D))
+    x = jnp.concatenate([toks, x], axis=1)  # summary registers lead
+    T = S + N
+
+    def layer_fn(hcarry, lp):
+        y = layer_norm(hcarry, lp["norm0"]["scale"], lp["norm0"]["bias"], eps)
+        qkv = y @ lp["wqkv"]["kernel"].astype(cd) + lp["wqkv"]["bias"].astype(cd)
+        q, k, v = jnp.split(qkv.reshape(B, T, 3 * NH, HD), 3, axis=2)
+        attn = sdpa(q, k, v, causal=False)
+        hcarry = hcarry + (
+            attn.reshape(B, T, D) @ lp["wo"]["kernel"].astype(cd)
+            + lp["wo"]["bias"].astype(cd)
+        )
+        y = layer_norm(hcarry, lp["norm1"]["scale"], lp["norm1"]["bias"], eps)
+        y = act(y @ lp["fc0"]["kernel"].astype(cd) + lp["fc0"]["bias"].astype(cd))
+        hcarry = hcarry + (
+            y @ lp["fc1"]["kernel"].astype(cd) + lp["fc1"]["bias"].astype(cd)
+        )
+        return hcarry, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["blocks"])
+    summary = x[:, :S].reshape(B, S * D)
+    return x[:, S:], summary
+
+
+def neck_forward(
+    cfg: RadioBackboneConfig,
+    params: dict,
+    features: jnp.ndarray,  # [B, N, 1280]
+    summary: jnp.ndarray,  # [B, 3840]
+    grid_hw: tuple,  # static (h, w)
+) -> jnp.ndarray:
+    """→ encoder states [B, h·(w/4) + 1, 1024] (reference RadioWithNeck
+    forward: conv1+LN → horizontal 4× pooling conv+LN → projected summary
+    appended as the LAST token)."""
+    eps = 1e-6  # reference hard-codes 1e-06 on all three neck LNs
+    B = features.shape[0]
+    h, w = grid_hw
+    if w % NECK_POOL:
+        raise ValueError(f"grid width {w} must divide by {NECK_POOL} (neck conv2)")
+    cd = features.dtype
+    x = features @ params["conv1"]["kernel"].astype(cd) + params["conv1"]["bias"].astype(cd)
+    x = layer_norm(x, params["layer_norm1"]["scale"], params["layer_norm1"]["bias"], eps)
+    # Conv2d(1024,1024,(1,4),stride (1,4),no bias) over [B,d,h,w] ≡ linear
+    # over each group of 4 horizontally-adjacent tokens
+    x = x.reshape(B, h, w // NECK_POOL, NECK_POOL * cfg.neck_width)
+    x = x @ params["conv2"]["kernel"].astype(cd)
+    x = x.reshape(B, h * (w // NECK_POOL), cfg.neck_width)
+    x = layer_norm(x, params["layer_norm2"]["scale"], params["layer_norm2"]["bias"], eps)
+    s = summary @ params["sum_proj"]["kernel"].astype(cd) + params["sum_proj"]["bias"].astype(cd)
+    s = layer_norm(s, params["layer_norm3"]["scale"], params["layer_norm3"]["bias"], eps)
+    return jnp.concatenate([x, s[:, None, :]], axis=1)

@@ -192,6 +192,21 @@ def _nemotron_v3_builder(hf_config: Any, backend: BackendConfig):
     return NemotronV3ForCausalLM(cfg, backend), NemotronV3StateDictAdapter(cfg)
 
 
+@register_architecture("NemotronParseForConditionalGeneration")
+def _nemotron_parse_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.nemotron_parse import (
+        NemotronParseConfig,
+        NemotronParseForConditionalGeneration,
+        NemotronParseStateDictAdapter,
+    )
+
+    cfg = NemotronParseConfig.from_hf(hf_config)
+    return (
+        NemotronParseForConditionalGeneration(cfg, backend),
+        NemotronParseStateDictAdapter(cfg),
+    )
+
+
 @register_architecture(
     "Qwen3OmniMoeForConditionalGeneration",
     "Qwen3OmniMoeThinkerForConditionalGeneration",
@@ -208,6 +223,18 @@ def _qwen3_omni_builder(hf_config: Any, backend: BackendConfig):
         Qwen3OmniMoeThinkerForCausalLM(cfg, backend),
         Qwen3OmniMoeStateDictAdapter(cfg),
     )
+
+
+@register_architecture("KimiVLForConditionalGeneration")
+def _kimi_vl_builder(hf_config: Any, backend: BackendConfig):
+    from automodel_tpu.models.kimi_vl import (
+        KimiVLConfig,
+        KimiVLForConditionalGeneration,
+        KimiVLStateDictAdapter,
+    )
+
+    cfg = KimiVLConfig.from_hf(hf_config)
+    return KimiVLForConditionalGeneration(cfg, backend), KimiVLStateDictAdapter(cfg)
 
 
 @register_architecture("KimiK25VLForConditionalGeneration", "KimiVLForConditionalGeneration_K25")

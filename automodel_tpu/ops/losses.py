@@ -213,11 +213,39 @@ def kd_loss(
     return jnp.where(valid, kl, 0.0).sum(), valid.sum()
 
 
+def nemotron_parse_cross_entropy(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    coordinate_weight: float = 10.0,
+    class_token_start_idx: int = 50000,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Coordinate-weighted CE (reference NemotronParseLoss,
+    models/nemotron_parse/nemotron_parse_loss.py:21-122): tokens with label
+    id >= class_token_start_idx (bbox coordinate/class tokens in the OCR
+    vocab) get their per-token loss multiplied by coordinate_weight; the sum
+    is normalized by the UNWEIGHTED valid-token count (the reference divides
+    by valid_tokens / num_label_tokens, both plain counts). Returns
+    (weighted sum, n_valid) in the framework's standard loss contract."""
+    v = logits.shape[-1]
+    flat = logits.reshape(-1, v).astype(jnp.float32)
+    lb = labels.reshape(-1)
+    valid = lb != IGNORE_INDEX
+    safe = jnp.where(valid, lb, 0)
+    lse = jax.nn.logsumexp(flat, axis=-1)
+    picked = jnp.take_along_axis(flat, safe[:, None], axis=-1)[:, 0]
+    per_tok = jnp.where(valid, lse - picked, 0.0)
+    w = jnp.where(lb >= class_token_start_idx, coordinate_weight, 1.0).astype(
+        jnp.float32
+    )
+    return (per_tok * w).sum(), valid.sum()
+
+
 LOSS_REGISTRY = {
     "masked_ce": masked_cross_entropy,
     "chunked_ce": chunked_cross_entropy,
     "fused_linear_ce": fused_linear_cross_entropy,
     "kd": kd_loss,
+    "nemotron_parse": nemotron_parse_cross_entropy,
 }
 
 

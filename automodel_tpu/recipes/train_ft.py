@@ -125,10 +125,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         opt_state = jax.jit(self.optimizer.init)(trainable)
         self.state = TrainState.create(trainable, opt_state)
 
-        # loss + steps
+        # loss + steps; a family may declare its own default loss (reference
+        # nemotron_parse is the only family shipping one — its coordinate-
+        # weighted CE) which explicit YAML settings override
         lcfg = dict(cfg.get("loss_fn", {}) or {})
         lcfg.pop("_target_", None)
-        loss_name = lcfg.pop("name", "masked_ce")
+        family_loss = getattr(self.model, "loss_name", None)
+        loss_name = lcfg.pop("name", family_loss or "masked_ce")
+        if family_loss is not None and loss_name == family_loss:
+            lcfg = {**self.model.loss_kwargs(), **lcfg}
         self.loss_fn = make_causal_lm_loss(
             self.model, loss=loss_name, constrain=self.auto.constrain, **lcfg
         )
