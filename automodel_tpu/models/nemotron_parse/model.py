@@ -99,9 +99,14 @@ class NemotronParseConfig:
         dget = lambda k, d=None: (
             dec.get(k, d) if isinstance(dec, dict) else getattr(dec, k, d)
         )
-        import dataclasses as _dc
+        def first(*vals, default):
+            # token ids can legitimately be 0 — `or`-chaining would drop them
+            for v in vals:
+                if v is not None:
+                    return v
+            return default
 
-        vision = _dc.replace(
+        vision = dataclasses.replace(
             RadioBackboneConfig.from_hf(get("encoder") or {}),
             neck_width=dget("d_model", 1024),
         )
@@ -112,13 +117,19 @@ class NemotronParseConfig:
             num_layers=dget("decoder_layers", 12),
             num_heads=dget("decoder_attention_heads", 16),
             intermediate_size=dget("decoder_ffn_dim", 4096),
-            max_positions=get("max_sequence_length", None)
-            or dget("max_sequence_length", 9000),
+            max_positions=first(
+                get("max_sequence_length"), dget("max_sequence_length"),
+                default=9000,
+            ),
             image_size=tuple(get("image_size") or (2048, 1648)),
             scale_embedding=bool(dget("scale_embedding", False)),
-            pad_token_id=get("pad_token_id", None) or dget("pad_token_id", 1),
-            decoder_start_token_id=get("decoder_start_token_id", None)
-            or dget("decoder_start_token_id", 2),
+            pad_token_id=first(
+                get("pad_token_id"), dget("pad_token_id"), default=1
+            ),
+            decoder_start_token_id=first(
+                get("decoder_start_token_id"), dget("decoder_start_token_id"),
+                default=2,
+            ),
             class_token_start_idx=get("class_token_start_idx", 50000),
         )
 
@@ -340,6 +351,13 @@ class NemotronParseForConditionalGeneration:
             # fall back to the config's image_size grid
             encode_kw["pixel_patches"] = pixel_values
             encode_kw.setdefault("grid_hw", self.config.default_grid_hw)
+        # the generic recipe path also forwards decoder-side kwargs the
+        # encoder has no use for (position_ids/segment_ids from the
+        # collators) — keep only what encode() understands
+        encode_kw = {
+            k: v for k, v in encode_kw.items()
+            if k in ("pixel_patches", "grid_hw", "radio_features", "radio_summary")
+        }
         if encoder_states is None:
             encoder_states = self.encode(params, **encode_kw)
         if input_ids is None:

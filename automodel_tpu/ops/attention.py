@@ -282,14 +282,6 @@ def attention(
         raise ValueError(
             f"Unknown attention backend {backend!r}; available: {sorted(ATTENTION_BACKENDS)}"
         )
-    if backend == "ring" and kwargs.get("sinks") is not None:
-        # composition hole (documented): the ring blockwise kernels have no
-        # sink column; sinks models (gpt-oss) are short-context, so CP is
-        # rejected loudly rather than silently dropping the sinks
-        raise NotImplementedError(
-            "attention sinks are not supported on the ring (context-"
-            "parallel) backend yet; use attn='sdpa' or 'flash'"
-        )
     if backend == "flash":
         kwargs["platform"] = platform
     return fn(q, k, v, **kwargs)
@@ -357,15 +349,11 @@ def windowed_attention(
             block_q=block_q, block_kv=block_kv, platform=platform,
         )
     if backend == "ring":
-        if sinks is not None:
-            raise NotImplementedError(
-                "attention sinks are not supported on the ring (context-"
-                "parallel) backend yet; use attn='sdpa' or 'flash'"
-            )
         return ATTENTION_BACKENDS["ring"](
             q, k, v,
             causal=causal, scale=scale, segment_ids=segment_ids,
             logits_soft_cap=logits_soft_cap, sliding_window=dynamic_window,
+            sinks=sinks,
         )
     if backend == "flash":
         _fallback_loudly("not running on TPU")

@@ -108,6 +108,7 @@ def ring_attention_shard(
     segment_ids: Optional[jnp.ndarray] = None,
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
+    sinks: Optional[jnp.ndarray] = None,
     zigzag: bool = False,
     platform: Optional[str] = None,
 ) -> jnp.ndarray:
@@ -117,7 +118,15 @@ def ring_attention_shard(
     On TPU (or under AUTOMODEL_RING_INTERPRET=1) each ring step runs the
     Pallas blockwise kernels from ops.ring_flash — O(S_loc·block) memory;
     otherwise (and for logits_soft_cap, which the kernel path doesn't carry)
-    the XLA formulation below materializes per-step S_loc² logits."""
+    the XLA formulation below materializes per-step S_loc² logits.
+
+    ``sinks`` (gpt-oss, [N] per-head logits): a sink is one extra virtual
+    key with value 0, so it never needs to ride the ring — the merged
+    (out, lse) pair absorbs it AFTER the last step: lse' = logaddexp(lse,
+    sink) and out' = out·exp(lse − lse'). The saved lse' makes the existing
+    blockwise backward exact (p = exp(s − lse') are the extended-softmax
+    probabilities), with d_sink = −Σ p_sink·Δ falling out of the same
+    flash identity the kernels use."""
     from automodel_tpu.ops.platform_check import is_tpu_platform
 
     interpret = _ring_interpret_requested()
@@ -126,19 +135,19 @@ def ring_attention_shard(
             q, k, v,
             axis_name=axis_name, causal=causal, scale=scale,
             segment_ids=segment_ids, sliding_window=sliding_window,
-            zigzag=zigzag, interpret=interpret,
+            sinks=sinks, zigzag=zigzag, interpret=interpret,
         )
     return _ring_attention_shard_xla(
         q, k, v,
         axis_name=axis_name, causal=causal, scale=scale,
         segment_ids=segment_ids, logits_soft_cap=logits_soft_cap,
-        sliding_window=sliding_window, zigzag=zigzag,
+        sliding_window=sliding_window, sinks=sinks, zigzag=zigzag,
     )
 
 
 def _ring_flash_shard(
     q, k, v, *, axis_name, causal, scale, segment_ids, sliding_window,
-    zigzag, interpret,
+    zigzag, interpret, sinks=None,
 ):
     from automodel_tpu.ops.ring_flash import (
         NEG_INF,
@@ -168,7 +177,7 @@ def _ring_flash_shard(
 
     # NOTE: the custom_vjp fwd/bwd must not close over tracers (axis_index);
     # rank/positions are recomputed inside each impl.
-    def _fwd_impl(q, k, v, seg):
+    def _fwd_impl(q, k, v, seg, sk):
         my_rank = jax.lax.axis_index(axis_name)
         q_pos = pos_of(my_rank)
         out = jnp.zeros((b, s_loc, n, h), jnp.float32)
@@ -188,18 +197,24 @@ def _ring_flash_shard(
             out, lse = merge_partials(out, lse, o_t.astype(jnp.float32), lse_t)
             if step < cp - 1:
                 k_blk, v_blk, seg_blk = rotate(k_blk, v_blk, seg_blk)
+        if sk is not None:
+            # fold the sink in post-merge: one zero-value virtual key
+            s_b = sk.astype(jnp.float32)[None, :, None]  # [1, n, 1]
+            lse_ext = jnp.logaddexp(lse, s_b)  # extended lse (dead rows → s)
+            out = out * jnp.exp(lse - lse_ext).transpose(0, 2, 1)[..., None]
+            lse = lse_ext
         return out.astype(q.dtype), lse
 
     @jax.custom_vjp
-    def ring(q, k, v, seg):
-        return _fwd_impl(q, k, v, seg)[0]
+    def ring(q, k, v, seg, sk):
+        return _fwd_impl(q, k, v, seg, sk)[0]
 
-    def ring_fwd(q, k, v, seg):
-        out, lse = _fwd_impl(q, k, v, seg)
-        return out, (q, k, v, seg, out, lse)
+    def ring_fwd(q, k, v, seg, sk):
+        out, lse = _fwd_impl(q, k, v, seg, sk)
+        return out, (q, k, v, seg, sk, out, lse)
 
     def ring_bwd(res, dout):
-        q, k, v, seg, out, lse = res
+        q, k, v, seg, sk, out, lse = res
         my_rank = jax.lax.axis_index(axis_name)
         q_pos = pos_of(my_rank)
         do32 = dout.astype(jnp.float32)
@@ -232,10 +247,19 @@ def _ring_flash_shard(
         import numpy as np
 
         ct_seg = np.zeros(seg.shape, jax.dtypes.float0)
-        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), ct_seg
+        ct_sk = None
+        if sk is not None:
+            # sink column of the flash backward: dp_sink = dO·v_sink = 0, so
+            # ds_sink = p_sink·(0 − Δ); summed over its (b, s) broadcast
+            p_sink = jnp.exp(sk.astype(jnp.float32)[None, :, None] - lse)
+            ct_sk = (-(p_sink * delta).sum(axis=(0, 2))).astype(sk.dtype)
+        return (
+            dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            ct_seg, ct_sk,
+        )
 
     ring.defvjp(ring_fwd, ring_bwd)
-    return ring(q, k, v, seg0)
+    return ring(q, k, v, seg0, sinks)
 
 
 def _ring_attention_shard_xla(
@@ -249,6 +273,7 @@ def _ring_attention_shard_xla(
     segment_ids: Optional[jnp.ndarray] = None,
     logits_soft_cap: Optional[float] = None,
     sliding_window: Optional[int] = None,
+    sinks: Optional[jnp.ndarray] = None,
     zigzag: bool = False,
 ) -> jnp.ndarray:
     """Reference XLA ring (materializes per-step S_loc² logits)."""
@@ -316,6 +341,11 @@ def _ring_attention_shard_xla(
         return o_new, m_new, l_new, k_nxt, v_nxt, seg_nxt
 
     o, m, l, *_ = jax.lax.fori_loop(0, cp, body, (o, m, l, k, v, seg))
+    if sinks is not None:
+        # the sink is one zero-value virtual key: it only grows the softmax
+        # denominator, so fold it into l post-hoc (this path is plain
+        # differentiable XLA — autodiff carries d_sinks)
+        l = l + jnp.exp(sinks.astype(jnp.float32)[None, :, None] - m)
     l_t = l.transpose(0, 2, 1)[..., None]  # [b,s,n,1]
     out = jnp.where(l_t > 0, o / jnp.maximum(l_t, 1e-30), 0.0)
     return out.astype(q.dtype)
@@ -343,10 +373,16 @@ def make_ring_attention(mesh_ctx, zigzag: bool = False):
         segment_ids: Optional[jnp.ndarray] = None,
         logits_soft_cap: Optional[float] = None,
         sliding_window: Optional[int] = None,
+        sinks: Optional[jnp.ndarray] = None,
         **_ignored,
     ):
         has_seg = segment_ids is not None
-        in_specs = (qkv_spec, qkv_spec, qkv_spec) + ((seg_spec,) if has_seg else ())
+        has_sinks = sinks is not None
+        in_specs = (qkv_spec, qkv_spec, qkv_spec)
+        if has_seg:
+            in_specs += (seg_spec,)
+        if has_sinks:
+            in_specs += (P(tp_ax),)  # per-head logits follow the head shard
         inner = functools.partial(
             ring_attention_shard,
             axis_name="cp",
@@ -359,16 +395,23 @@ def make_ring_attention(mesh_ctx, zigzag: bool = False):
         )
 
         def fn(*args):
+            q_, k_, v_, *rest = args
+            rest = list(rest)
+            kw = {}
             if has_seg:
-                q_, k_, v_, s_ = args
-                return inner(q_, k_, v_, segment_ids=s_)
-            q_, k_, v_ = args
-            return inner(q_, k_, v_)
+                kw["segment_ids"] = rest.pop(0)
+            if has_sinks:
+                kw["sinks"] = rest.pop(0)
+            return inner(q_, k_, v_, **kw)
 
         mapped = shard_map(
             fn, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec, check_vma=False
         )
-        args = (q, k, v) + ((segment_ids,) if has_seg else ())
+        args = (q, k, v)
+        if has_seg:
+            args += (segment_ids,)
+        if has_sinks:
+            args += (sinks,)
         return mapped(*args)
 
     return ring

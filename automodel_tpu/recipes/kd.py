@@ -88,20 +88,21 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
             temperature,
         )
         if self.peft_config is not None:
-            # KD + LoRA (reference recipes/llm/kd.py supports PEFT): wrap the
-            # KD loss exactly like train_ft wraps the CE loss — adapters are
-            # the trainables (super().setup() already built state over them),
-            # student base rides bound_params, teacher stays frozen inside
-            # make_kd_loss's stop_gradient
-            if getattr(self, "_qlora_cfg", None) is not None:
-                raise NotImplementedError("KD+QLoRA composition not supported")
+            # KD + LoRA/QLoRA (reference recipes/llm/kd.py supports PEFT):
+            # wrap the KD loss exactly like train_ft wraps the CE loss —
+            # adapters are the trainables (super().setup() already built
+            # state over them), the student base rides bound_params (NF4
+            # codes under QLoRA, dequantized per layer or via the saved
+            # base_transform), teacher stays frozen inside make_kd_loss's
+            # stop_gradient
             from automodel_tpu.peft import make_lora_loss_fn
 
             self.loss_fn = make_lora_loss_fn(
                 self.loss_fn,
-                self.auto.params,
+                self._lora_base_tree,
                 self.peft_config,
                 graft_patterns=getattr(self.model, "lora_graft_patterns", ()),
+                base_transform=self._lora_base_transform,
                 dropout_seed=cfg.get("seed", 42),
             )
         post_step = (

@@ -178,6 +178,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 from automodel_tpu.quantization import nf4_dequantize_tree
 
                 base_transform = nf4_dequantize_tree
+            # subclasses that REPLACE the loss (kd.py) re-wrap with the same
+            # frozen base — after this point the full-precision tree may be
+            # gone (QLoRA sets auto.params = None above)
+            self._lora_base_tree = base_tree
+            self._lora_base_transform = base_transform
             self.loss_fn = make_lora_loss_fn(
                 self.loss_fn, base_tree, self.peft_config,
                 graft_patterns=getattr(self.model, "lora_graft_patterns", ()),
