@@ -4,16 +4,28 @@ Parity: reference `NeMoAutoTokenizer` (_transformers/auto_tokenizer.py:151)
 — a thin AutoTokenizer builder that guarantees the invariants the data
 pipeline relies on (a pad token exists; padding side is right for
 training), so datasets never need tokenizer-specific special-casing.
-The mistral-common adapter (tokenization_mistral_common.py, 2k LoC) is
-out of scope until a mistral-common dependency exists in-image.
+Mistral-family checkpoints shipping tekken.json / tokenizer.model.v3 route
+to the mistral-common adapter (tokenization_mistral_common.py), whose chat
+template is mistral-common's own encode_chat_completion.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
+
+_MISTRAL_FILES = ("tekken.json", "tokenizer.model.v3")
+
+
+def _looks_mistral_common(path: str) -> bool:
+    if os.path.basename(path) in _MISTRAL_FILES:
+        return True
+    return os.path.isdir(path) and any(
+        os.path.exists(os.path.join(path, f)) for f in _MISTRAL_FILES
+    )
 
 
 def build_tokenizer(
@@ -21,9 +33,27 @@ def build_tokenizer(
     use_fast: bool = True,
     trust_remote_code: bool = False,
     padding_side: str = "right",
+    use_mistral_common: Optional[bool] = None,
     **kwargs: Any,
 ):
-    """AutoTokenizer with training-safe defaults (pad token guaranteed)."""
+    """AutoTokenizer with training-safe defaults (pad token guaranteed).
+
+    ``use_mistral_common``: force (True) or suppress (False) the mistral-
+    common adapter; None auto-detects tekken.json / tokenizer.model.v3 in a
+    local checkout (reference AutoTokenizer picks the backend the same way,
+    _transformers/auto_tokenizer.py)."""
+    if use_mistral_common or (
+        use_mistral_common is None
+        and _looks_mistral_common(pretrained_model_name_or_path)
+    ):
+        from automodel_tpu.data.tokenization_mistral_common import (
+            MistralCommonTokenizer,
+        )
+
+        return MistralCommonTokenizer.from_pretrained(
+            pretrained_model_name_or_path, padding_side=padding_side,
+            **kwargs,  # model_max_length/truncation_side; unknown → loud
+        )
     from transformers import AutoTokenizer
 
     tok = AutoTokenizer.from_pretrained(

@@ -354,10 +354,10 @@ class NemotronParseForConditionalGeneration:
         # the generic recipe path also forwards decoder-side kwargs the
         # encoder has no use for (position_ids/segment_ids from the
         # collators) — keep only what encode() understands
-        encode_kw = {
-            k: v for k, v in encode_kw.items()
-            if k in ("pixel_patches", "grid_hw", "radio_features", "radio_summary")
-        }
+        import inspect
+
+        accepted = set(inspect.signature(self.encode).parameters) - {"params"}
+        encode_kw = {k: v for k, v in encode_kw.items() if k in accepted}
         if encoder_states is None:
             encoder_states = self.encode(params, **encode_kw)
         if input_ids is None:

@@ -1,0 +1,205 @@
+"""Mistral-common tokenizer adapter (reference
+_transformers/tokenization/tokenization_mistral_common.py): the adapter is
+driven hermetically through a fake backend implementing the small
+mistral-common interface (the package is not in this image — the reference
+treats it as an optional extra the same way), covering the surfaces the
+data pipeline uses: encode/decode round-trip, special-token policy,
+__call__ with padding/truncation/attention masks, collator-style pad, and
+apply_chat_template delegating to encode_chat_completion."""
+
+import numpy as np
+import pytest
+
+from automodel_tpu.data.tokenization_mistral_common import (
+    MistralCommonTokenizer,
+)
+
+
+class _FakeBase:
+    """Byte-level toy tokenizer with mistral-common's base interface:
+    ids 0..3 are control (<unk>/<s>/</s>/<pad>), bytes map to 4+b."""
+
+    bos_id, eos_id, unk_id, pad_id = 1, 2, 0, -1
+    num_special_tokens = 4
+
+    @property
+    def n_words(self) -> int:
+        return 4 + 256
+
+    def encode(self, s, bos=False, eos=False):
+        ids = [4 + b for b in s.encode("utf-8")]
+        if bos:
+            ids = [self.bos_id] + ids
+        if eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids):
+        return bytes(i - 4 for i in ids if i >= 4).decode("utf-8", "ignore")
+
+    def id_to_piece(self, i):
+        return ["<unk>", "<s>", "</s>", "<pad>"][i] if i < 4 else chr(i - 4)
+
+    def vocab(self):
+        return [self.id_to_piece(i) for i in range(self.n_words)]
+
+
+class _FakeInstruct:
+    tokenizer = _FakeBase()
+
+
+class _Enc:
+    def __init__(self, tokens, text):
+        self.tokens, self.text = tokens, text
+
+
+class _FakeBackend:
+    """encode_chat_completion renders [INST]...[/INST] like mistral-common
+    (shape only — the point is that the adapter DELEGATES, not templates)."""
+
+    instruct_tokenizer = _FakeInstruct()
+
+    def encode_chat_completion(self, request):
+        base = self.instruct_tokenizer.tokenizer
+        parts = []
+        for m in request.messages:
+            role, content = m["role"], m["content"]
+            parts.append(f"[{role.upper()}]{content}")
+        text = "".join(parts)
+        return _Enc([base.bos_id] + base.encode(text), text)
+
+
+class _FakeRequest:
+    def __init__(self, **kw):
+        self.messages = kw["messages"]
+
+
+@pytest.fixture()
+def tok(monkeypatch):
+    import automodel_tpu.data.tokenization_mistral_common as M
+
+    # dict-messages → request object without the real pydantic model
+    monkeypatch.setattr(
+        M, "_build_chat_request",
+        lambda messages, tools=None, continue_final_message=False: _FakeRequest(
+            messages=list(messages)
+        ),
+    )
+    return MistralCommonTokenizer(_FakeBackend())
+
+
+def test_encode_decode_round_trip(tok):
+    ids = tok.encode("hello", add_special_tokens=True)
+    assert ids[0] == tok.bos_token_id
+    assert tok.decode(ids, skip_special_tokens=True) == "hello"
+    assert tok.decode(ids)  # with specials still decodes
+    assert tok.batch_decode([ids, ids], skip_special_tokens=True) == ["hello", "hello"]
+
+
+def test_special_token_policy(tok):
+    # pad_id < 0 in the file → training-safe eos fallback
+    assert tok.pad_token_id == tok.eos_token_id
+    tok.pad_token_id = 3
+    assert tok.pad_token == "<pad>"
+    assert set([tok.bos_token_id, tok.eos_token_id]) <= set(tok.all_special_ids)
+    assert tok.vocab_size == 260 and len(tok) == 260
+    assert tok.convert_tokens_to_ids("a") == 4 + ord("a")
+    assert tok.convert_ids_to_tokens([4 + ord("a")]) == ["a"]
+
+
+def test_call_padding_truncation(tok):
+    out = tok(["ab", "abcdef"], padding=True, return_tensors="np")
+    assert out["input_ids"].shape == out["attention_mask"].shape
+    assert out["input_ids"].shape[1] == 7  # bos + 6
+    assert out["attention_mask"][0].sum() == 3  # bos + 2 chars
+    # right padding by default → zeros at the end
+    assert out["attention_mask"][0][-1] == 0
+
+    out = tok("abcdef", truncation=True, max_length=3)
+    assert len(out["input_ids"]) == 3
+
+    tok.padding_side = "left"
+    out = tok(["ab", "abcdef"], padding=True)
+    assert out["attention_mask"][0][0] == 0  # pads lead
+
+
+def test_pad_collator_multiple_of(tok):
+    out = tok.pad(
+        [{"input_ids": [5, 6]}, {"input_ids": [5, 6, 7]}],
+        pad_to_multiple_of=4, return_tensors="np",
+    )
+    assert out["input_ids"].shape == (2, 4)
+    assert (out["attention_mask"].sum(1) == np.array([2, 3])).all()
+
+
+def test_apply_chat_template_delegates(tok):
+    conv = [
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "yo"},
+    ]
+    text = tok.apply_chat_template(conv, tokenize=False)
+    assert text == "[USER]hi[ASSISTANT]yo"  # backend's rendering, not ours
+    ids = tok.apply_chat_template(conv)
+    assert ids[0] == tok.bos_token_id
+    # SFT conversations end with assistant: the adapter prefix-encodes +
+    # closes the turn with EOS (mistral templates end assistant turns so)
+    assert ids[-1] == tok.eos_token_id
+    # explicit continue_final_message keeps the turn open for prefill
+    open_ids = tok.apply_chat_template(conv, continue_final_message=True)
+    assert open_ids == ids[:-1]
+    assert tok.decode(ids, skip_special_tokens=True) == text
+
+    # batched + dict form
+    out = tok.apply_chat_template([conv, conv], return_dict=True, return_tensors="np")
+    assert out["input_ids"].shape[0] == 2
+
+    with pytest.raises(ValueError):
+        tok.apply_chat_template(
+            conv, add_generation_prompt=True
+        )  # ends with assistant → loud
+
+
+def test_chat_dataset_label_building(tok):
+    """The adapter's primary consumer: data/chat.py tokenize_conversation
+    builds label masks by encoding conversation prefixes — every prefix
+    ending in an assistant turn must encode (closed with EOS), and the
+    assistant spans get labels."""
+    from automodel_tpu.data.chat import tokenize_conversation
+
+    conv = [
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "yo"},
+        {"role": "user", "content": "more?"},
+        {"role": "assistant", "content": "sure"},
+    ]
+    out = tokenize_conversation(tok, conv)
+    ids, labels = np.asarray(out["input_ids"]), np.asarray(out["labels"])
+    assert ids.shape == labels.shape
+    assert (labels != -100).sum() > 0  # assistant tokens are supervised
+    assert (labels == -100).sum() > 0  # user tokens are masked
+
+
+def test_build_tokenizer_detects_mistral_files(tmp_path, monkeypatch):
+    """tekken.json in a checkpoint dir routes build_tokenizer to the
+    adapter (loader itself is import-gated on mistral-common)."""
+    import automodel_tpu.data.tokenization_mistral_common as M
+    from automodel_tpu.data.tokenizer import build_tokenizer
+
+    (tmp_path / "tekken.json").write_text("{}")
+    monkeypatch.setattr(M, "load_mistral_tokenizer", lambda p: _FakeBackend())
+    tok = build_tokenizer(str(tmp_path))
+    assert isinstance(tok, MistralCommonTokenizer)
+
+    # and save_pretrained copies the tokenizer file
+    dest = tmp_path / "out"
+    (saved,) = tok.save_pretrained(str(dest))
+    assert saved.endswith("tekken.json")
+
+
+def test_import_gate_is_loud():
+    from automodel_tpu.data.tokenization_mistral_common import (
+        load_mistral_tokenizer,
+    )
+
+    with pytest.raises(ImportError, match="mistral-common"):
+        load_mistral_tokenizer("/nonexistent/tekken.json")
