@@ -194,7 +194,7 @@ class MistralCommonTokenizer:
         return [vocab.get(t, self.unk_token_id) for t in tokens]
 
     def convert_ids_to_tokens(self, ids, skip_special_tokens: bool = False):
-        special = {self.bos_token_id, self.eos_token_id}
+        special = set(self._all_special_ids())  # same set decode() strips
         if isinstance(ids, int):
             return self._id_to_piece(ids)
         out = []
@@ -281,6 +281,11 @@ class MistralCommonTokenizer:
         out = {"input_ids": list(ids), "attention_mask": list(masks)}
         if return_tensors == "np":
             out = {k: np.asarray(v, np.int64) for k, v in out.items()}
+        # unknown feature keys pass through untouched (HF tokenizer.pad
+        # semantics — collators pad labels themselves)
+        for k, v in encoded_inputs.items():
+            if k not in out and k != "attention_mask":
+                out[k] = v
         return out
 
     # -- __call__ ------------------------------------------------------------

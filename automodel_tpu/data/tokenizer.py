@@ -42,10 +42,24 @@ def build_tokenizer(
     common adapter; None auto-detects tekken.json / tokenizer.model.v3 in a
     local checkout (reference AutoTokenizer picks the backend the same way,
     _transformers/auto_tokenizer.py)."""
-    if use_mistral_common or (
-        use_mistral_common is None
-        and _looks_mistral_common(pretrained_model_name_or_path)
+    route_mistral = use_mistral_common
+    if route_mistral is None and _looks_mistral_common(
+        pretrained_model_name_or_path
     ):
+        # auto-detect must not regress checkpoints that also ship a normal
+        # tokenizer.json: only route when mistral-common is importable
+        # (explicit use_mistral_common=True stays loud if it is missing)
+        try:
+            import mistral_common  # noqa: F401
+
+            route_mistral = True
+        except ImportError:
+            logger.info(
+                "checkpoint ships a mistral-common tokenizer file but the "
+                "package is not installed; falling back to AutoTokenizer"
+            )
+            route_mistral = False
+    if route_mistral:
         from automodel_tpu.data.tokenization_mistral_common import (
             MistralCommonTokenizer,
         )

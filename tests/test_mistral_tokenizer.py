@@ -181,12 +181,23 @@ def test_chat_dataset_label_building(tok):
 
 def test_build_tokenizer_detects_mistral_files(tmp_path, monkeypatch):
     """tekken.json in a checkpoint dir routes build_tokenizer to the
-    adapter (loader itself is import-gated on mistral-common)."""
+    adapter when mistral-common is importable; auto-detect must FALL BACK
+    to AutoTokenizer when it is not (a mistral HF snapshot also ships a
+    normal tokenizer.json — hard-failing would regress it)."""
+    import sys
+
     import automodel_tpu.data.tokenization_mistral_common as M
     from automodel_tpu.data.tokenizer import build_tokenizer
 
     (tmp_path / "tekken.json").write_text("{}")
     monkeypatch.setattr(M, "load_mistral_tokenizer", lambda p: _FakeBackend())
+
+    # explicit opt-in always routes (loader monkeypatched = "installed")
+    tok = build_tokenizer(str(tmp_path), use_mistral_common=True)
+    assert isinstance(tok, MistralCommonTokenizer)
+
+    # auto-detect with the package importable routes too
+    monkeypatch.setitem(sys.modules, "mistral_common", object())
     tok = build_tokenizer(str(tmp_path))
     assert isinstance(tok, MistralCommonTokenizer)
 
@@ -194,6 +205,19 @@ def test_build_tokenizer_detects_mistral_files(tmp_path, monkeypatch):
     dest = tmp_path / "out"
     (saved,) = tok.save_pretrained(str(dest))
     assert saved.endswith("tekken.json")
+
+
+def test_build_tokenizer_auto_detect_falls_back(tmp_path, monkeypatch):
+    """No mistral-common in the environment → auto-detect does NOT route to
+    the adapter (this image genuinely lacks the package, so this exercises
+    the real fallback: AutoTokenizer is asked instead and raises its own
+    error for this empty dir, not the adapter's ImportError)."""
+    from automodel_tpu.data.tokenizer import build_tokenizer
+
+    (tmp_path / "tekken.json").write_text("{}")
+    with pytest.raises(Exception) as ei:
+        build_tokenizer(str(tmp_path))
+    assert "mistral-common" not in str(ei.value)
 
 
 def test_import_gate_is_loud():

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# One-shot chip evidence capture for a round (VERDICT r4 #1): run the
+# moment the TPU tunnel is up. Produces BENCH_chip.json + PROFILE_MOE_chip.txt
+# in the repo root without overwriting driver-owned BENCH_r*.json files.
+#
+#   bash tools/chip_suite.sh              # full: bench (both MoE backends
+#                                         # raced, QLoRA + GPT-OSS legs) + profile
+#   BENCH_TPU_PROBE_S=30 bash ...         # fail fast if the tunnel is down
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "[chip_suite] probing TPU (timeout ${BENCH_TPU_PROBE_S:-300}s)..." >&2
+python - <<'EOF' || { echo "[chip_suite] no TPU; aborting" >&2; exit 1; }
+import os, subprocess, sys
+r = subprocess.run([sys.executable, "-c",
+                    "import jax,sys; sys.exit(0 if jax.devices()[0].platform=='tpu' else 1)"],
+                   timeout=float(os.environ.get("BENCH_TPU_PROBE_S", "300")))
+sys.exit(r.returncode)
+EOF
+
+echo "[chip_suite] bench (dense LoRA + 8B QLoRA + MoE ragged_fused-vs-ragged race)" >&2
+if ! python bench.py 2> >(tee bench_stderr.log >&2) | tee BENCH_chip.json; then
+    echo "[chip_suite] bench.py FAILED — BENCH_chip.json is not valid evidence" >&2
+    exit 1
+fi
+
+echo "[chip_suite] MoE profile" >&2
+python tools/profile_moe.py 2>&1 | tee PROFILE_MOE_chip.txt \
+    || echo "[chip_suite] profile_moe failed (bench evidence still valid)" >&2
+
+echo "[chip_suite] done — BENCH_chip.json / PROFILE_MOE_chip.txt" >&2
