@@ -279,13 +279,14 @@ class MistralCommonTokenizer:
             target = -(-target // pad_to_multiple_of) * pad_to_multiple_of
         ids, masks = zip(*(self._pad_one(s, target, padding_side) for s in seqs))
         out = {"input_ids": list(ids), "attention_mask": list(masks)}
-        if return_tensors == "np":
-            out = {k: np.asarray(v, np.int64) for k, v in out.items()}
-        # unknown feature keys pass through untouched (HF tokenizer.pad
-        # semantics — collators pad labels themselves)
+        # unknown feature keys pass through (HF tokenizer.pad semantics —
+        # collators pad labels themselves) BEFORE tensorization so every
+        # key converts uniformly (ragged extras raise, exactly like HF)
         for k, v in encoded_inputs.items():
             if k not in out and k != "attention_mask":
                 out[k] = v
+        if return_tensors == "np":
+            out = {k: np.asarray(v) for k, v in out.items()}
         return out
 
     # -- __call__ ------------------------------------------------------------

@@ -36,7 +36,9 @@ def _load_dit_component(sub: str, cfg: Optional[dict] = None):
     from automodel_tpu.diffusion.dit import DiTConfig, DiTModel
 
     if not cfg:
-        for name in ("config.json", "dit_config.json"):
+        # dit_config.json first: it is the explicit DiT marker; a component
+        # dir may also carry an unrelated config.json
+        for name in ("dit_config.json", "config.json"):
             p = os.path.join(sub, name)
             if os.path.exists(p):
                 with open(p) as f:
@@ -152,12 +154,23 @@ class AutoDiffusionPipeline:
             if name.startswith("_") or entry is None:
                 continue
             sub = os.path.join(path, name)
-            if not os.path.isdir(sub):
-                continue
             cls_name = entry[1] if isinstance(entry, (list, tuple)) else str(entry)
-            has_weights = any(
-                fn.endswith(".safetensors") for fn in os.listdir(sub)
-            )
+            if not os.path.isdir(sub):
+                raise FileNotFoundError(
+                    f"model_index.json names component {name!r} ({cls_name}) "
+                    f"but {sub!r} does not exist"
+                )
+            files = os.listdir(sub)
+            has_weights = any(fn.endswith(".safetensors") for fn in files)
+            torch_weights = [
+                fn for fn in files if fn.endswith((".bin", ".pt", ".pth"))
+            ]
+            if not has_weights and torch_weights:
+                raise NotImplementedError(
+                    f"component {name!r} ({cls_name}) ships torch pickle "
+                    f"weights {torch_weights} — only safetensors are "
+                    "ingested (re-save the pipeline with safetensors)"
+                )
             cfg_file = os.path.join(sub, "config.json")
             if not has_weights:
                 for cand in ("scheduler_config.json", "config.json",

@@ -42,7 +42,7 @@ class BackendConfig:
     # point at a different backend than the mesh (e.g. CPU mesh + visible
     # TPU). None → fall back to the default-device heuristic.
     platform: Optional[str] = None
-    experts: str = "gspmd"  # gspmd | ragged | dense | a2a (moe.experts backends)
+    experts: str = "gspmd"  # gspmd | ragged | ragged_fused | dense | a2a | a2a_fused
     fake_balanced_gate: bool = False  # deterministic routing for benchmarks
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"

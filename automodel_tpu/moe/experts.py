@@ -323,6 +323,32 @@ def ragged_experts(
     return out.astype(x.dtype)
 
 
+def _fused_act_of(cfg: MoEConfig, act_name: str, fp8: bool):
+    """(act_kind, limit) for the fused expert-MLP kernel, or a loud error
+    when the config is outside what the kernel implements (same envelope as
+    ragged_fused: silu-gated swiglu / swiglu_oai, no fp8 QDQ in-kernel)."""
+    if fp8:
+        raise NotImplementedError(
+            "fused expert MLP does not implement the fp8 QDQ path — drop "
+            "fp8_experts or use the unfused backend"
+        )
+    if not cfg.gated:
+        raise NotImplementedError(
+            "fused expert MLP supports gated swiglu experts only"
+        )
+    if cfg.activation not in ("swiglu", "swiglu_oai") or (
+        cfg.activation == "swiglu" and act_name != "silu"
+    ):
+        raise NotImplementedError(
+            f"fused expert MLP implements silu-gated swiglu and swiglu_oai, "
+            f"not activation={cfg.activation!r} with base act {act_name!r}"
+        )
+    return (
+        "swiglu_oai" if cfg.activation == "swiglu_oai" else "swiglu",
+        cfg.activation_limit,
+    )
+
+
 def a2a_experts(
     x: jnp.ndarray,  # [B, S, D]
     gate_out: GateOutput,
@@ -332,6 +358,7 @@ def a2a_experts(
     ctx,  # parallel.mesh.MeshContext | None
     platform: str | None = None,
     fp8: bool = False,
+    fused_act=None,
 ) -> jnp.ndarray:
     """Dropless token-exchange EP dispatch (reference DeepEP dispatcher,
     token_dispatcher.py:339 + fused_a2a.py:102 → shard_map + lax.all_to_all).
@@ -349,6 +376,11 @@ def a2a_experts(
         platform = ctx.platform
     if ctx is None or ctx.ep_size == 1:
         # single-slice: the ragged path is already dropless
+        if fused_act is not None:
+            return ragged_fused_experts(
+                x.reshape(-1, D), gate_out, weights, cfg, act2,
+                platform=platform,
+            ).reshape(B, S, D)
         return ragged_experts(
             x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform,
             fp8=fp8,
@@ -392,14 +424,21 @@ def a2a_experts(
         _a2a_body,
         ep=ep, ep_axis=A.EP, E=E, E_loc=E_loc, C=C, D=D, K=K,
         act2=act2, gated=cfg.gated, tp_axis=A.TP, platform=platform, fp8=fp8,
+        fused_act=fused_act,
     )
     idx = gate_out.topk_idx.reshape(B, S, K)
     cw = gate_out.topk_weights.reshape(B, S, K)
+    # check_vma=False (same stance as the ring in parallel/cp.py): the
+    # region runs Pallas kernels whose interpret-mode discharge cannot
+    # carry mixed vma (jax limitation), and custom-VJP cotangent psums are
+    # then placed by the spec-based shard_map transpose. The in-kernel
+    # _match_vma/_out_sds plumbing stays for vma-checked callers (pp).
     return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(tok_spec, tok_spec, tok_spec, {k: w_specs[k] for k in wd}),
         out_specs=tok_spec,
+        check_vma=False,
     )(x, idx, cw, wd)
 
 
@@ -425,7 +464,8 @@ def _a2a_weights(weights: dict, cfg: MoEConfig) -> dict:
 
 
 def _a2a_body(xb, idxb, cwb, wd, *, ep, ep_axis, E, E_loc, C, D, K, act2,
-              gated=True, tp_axis=None, platform=None, fp8=False):
+              gated=True, tp_axis=None, platform=None, fp8=False,
+              fused_act=None):
     """The per-device token-exchange block. Requires `ep_axis` (and, when
     ``tp_axis`` is set, that axis too) to be MANUAL in the calling context —
     either a2a_experts' own shard_map, or a pipeline region already manual
@@ -476,32 +516,58 @@ def _a2a_body(xb, idxb, cwb, wd, *, ep, ep_axis, E, E_loc, C, D, K, act2,
 
     w_g = wd["gw"].astype(xs2.dtype)
     w_d = wd["dw"].astype(xs2.dtype)
-    if fp8:
-        xs2 = fp8_qdq_tensor(xs2)
-        w_g, w_d = fp8_qdq_blockwise(w_g), fp8_qdq_blockwise(w_d)
-    g = ragged_dot(xs2, w_g, gsz, platform=platform)
-    if "gb" in wd:
-        g = g + wd["gb"].astype(g.dtype)[sid]
-    if gated:
+    if fused_act is not None:
+        # one-kernel local expert MLP (ops/fused_expert_mlp): the [rows, 2I]
+        # gate_up output and the [rows, I] activation never touch HBM —
+        # the same win the single-chip ragged_fused backend gets, on the
+        # post-exchange rows. The down bias stays OUTSIDE the kernel when
+        # tp shards the experts (it must land on one tp shard only).
+        act_kind, limit = fused_act
+        from automodel_tpu.ops.fused_expert_mlp import fused_expert_mlp
+
         w_u = wd["uw"].astype(xs2.dtype)
-        if fp8:
-            w_u = fp8_qdq_blockwise(w_u)
-        u = ragged_dot(xs2, w_u, gsz, platform=platform)
-        if "ub" in wd:
-            u = u + wd["ub"].astype(u.dtype)[sid]
-    else:  # non-gated (relu2): one projection, act2 ignores its 2nd operand
-        u = g
-    h_mid = act2(g, u)
-    if fp8:
-        h_mid = fp8_qdq_tensor(h_mid)
-    y = ragged_dot(h_mid, w_d, gsz, platform=platform)
-    if "db" in wd:
-        if tp_axis is not None:  # partial over tp: bias on one tp shard only
+        gb = wd["gb"].astype(xs2.dtype) if "gb" in wd else None
+        ub = wd["ub"].astype(xs2.dtype) if "ub" in wd else None
+        db = wd.get("db")
+        db_in_kernel = db if tp_axis is None else None
+        y = fused_expert_mlp(
+            xs2, w_g, w_u, w_d, gsz,
+            gb, ub,
+            None if db_in_kernel is None else db_in_kernel.astype(xs2.dtype),
+            act_kind, limit, platform, None,
+        )
+        if db is not None and tp_axis is not None:
             y = y + jnp.where(
-                jax.lax.axis_index(tp_axis) == 0, wd["db"].astype(y.dtype)[sid], 0.0
+                jax.lax.axis_index(tp_axis) == 0, db.astype(y.dtype)[sid], 0.0
             )
-        else:
-            y = y + wd["db"].astype(y.dtype)[sid]
+    else:
+        if fp8:
+            xs2 = fp8_qdq_tensor(xs2)
+            w_g, w_d = fp8_qdq_blockwise(w_g), fp8_qdq_blockwise(w_d)
+        g = ragged_dot(xs2, w_g, gsz, platform=platform)
+        if "gb" in wd:
+            g = g + wd["gb"].astype(g.dtype)[sid]
+        if gated:
+            w_u = wd["uw"].astype(xs2.dtype)
+            if fp8:
+                w_u = fp8_qdq_blockwise(w_u)
+            u = ragged_dot(xs2, w_u, gsz, platform=platform)
+            if "ub" in wd:
+                u = u + wd["ub"].astype(u.dtype)[sid]
+        else:  # non-gated (relu2): one projection, act2 ignores its 2nd operand
+            u = g
+        h_mid = act2(g, u)
+        if fp8:
+            h_mid = fp8_qdq_tensor(h_mid)
+        y = ragged_dot(h_mid, w_d, gsz, platform=platform)
+        if "db" in wd:
+            if tp_axis is not None:  # partial over tp: bias on one shard only
+                y = y + jnp.where(
+                    jax.lax.axis_index(tp_axis) == 0,
+                    wd["db"].astype(y.dtype)[sid], 0.0,
+                )
+            else:
+                y = y + wd["db"].astype(y.dtype)[sid]
     # permutations invert as forward GATHERS (out[p[i]] = y[i] is exactly
     # y[argsort(p)]), and every gather here carries a gather-only custom VJP
     # — the EP backward contains no XLA scatter (VERDICT r4 weak #3; jax
@@ -536,6 +602,7 @@ def a2a_experts_manual(
     ep_axis: str = "ep",
     platform: str | None = None,
     fp8: bool = False,
+    fused_act=None,
 ) -> jnp.ndarray:
     """a2a dispatch for contexts where `ep` is ALREADY a manual axis (the
     pp×ep pipeline region). tp must not shard the expert weights here
@@ -559,6 +626,7 @@ def a2a_experts_manual(
         x, idx, cw, wd,
         ep=ep, ep_axis=ep_axis, E=E, E_loc=E_loc, C=C, D=D, K=K,
         act2=act2, gated=cfg.gated, tp_axis=None, platform=platform, fp8=fp8,
+        fused_act=fused_act,
     )
 
 
@@ -617,6 +685,18 @@ def _run_a2a(x, gate_out, weights, cfg, act2, *, ctx=None,
                        fp8=fp8)
 
 
+def _run_a2a_fused(x, gate_out, weights, cfg, act2, *, ctx=None,
+                   constrain=_noop_constrain, platform=None, fp8=False,
+                   act_name="silu"):
+    """a2a token exchange + the one-kernel local expert MLP: EP training
+    gets the same per-layer HBM savings as the single-chip ragged_fused
+    backend (reference capability: DeepEP dispatch feeding TE's fused
+    epilogues)."""
+    fused_act = _fused_act_of(cfg, act_name, fp8)
+    return a2a_experts(x, gate_out, weights, cfg, act2, ctx, platform=platform,
+                       fp8=fp8, fused_act=fused_act)
+
+
 def ragged_fused_experts(
     x: jnp.ndarray,  # [T, D]
     gate_out: GateOutput,
@@ -632,18 +712,7 @@ def ragged_fused_experts(
     dispatch/combine; backward recomputes through the two-gmm composition."""
     from automodel_tpu.ops.fused_expert_mlp import fused_expert_mlp
 
-    if not cfg.gated:
-        raise NotImplementedError(
-            "experts='ragged_fused' supports gated swiglu experts only"
-        )
-    if cfg.activation not in ("swiglu", "swiglu_oai") or (
-        cfg.activation == "swiglu" and act_name != "silu"
-    ):
-        raise NotImplementedError(
-            f"experts='ragged_fused' implements silu-gated swiglu and "
-            f"swiglu_oai in-kernel, not activation={cfg.activation!r} with "
-            f"base act {act_name!r}"
-        )
+    act_kind, limit = _fused_act_of(cfg, act_name, fp8=False)
     T, D = x.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
     flat_expert = gate_out.topk_idx.reshape(-1)
@@ -660,8 +729,6 @@ def ragged_fused_experts(
         gb, ub = gb.astype(xs.dtype), ub.astype(xs.dtype)
     if "down_bias" in weights:
         db = weights["down_bias"].astype(xs.dtype)
-    act_kind = "swiglu_oai" if cfg.activation == "swiglu_oai" else "swiglu"
-    limit = cfg.activation_limit
     ys = fused_expert_mlp(
         xs, gw.astype(xs.dtype), uw.astype(xs.dtype),
         weights["down"].astype(xs.dtype), group_sizes,
@@ -674,8 +741,10 @@ def ragged_fused_experts(
 def _run_ragged_fused(x, gate_out, weights, cfg, act2, *, ctx=None,
                       constrain=_noop_constrain, platform=None, fp8=False,
                       act_name="silu"):
-    if fp8:
-        _warn_fp8_unsupported("ragged_fused")
+    # validate the full envelope incl. fp8 (raise, matching a2a_fused — a
+    # config must not abort on one mesh topology and silently drop
+    # quantization on another)
+    _fused_act_of(cfg, act_name, fp8)
     B, S, D = x.shape
     return ragged_fused_experts(
         x.reshape(-1, D), gate_out, weights, cfg, act2, platform=platform,
@@ -689,4 +758,5 @@ EXPERT_BACKENDS = {
     "gspmd": _run_gspmd,
     "ragged": _run_ragged,
     "a2a": _run_a2a,
+    "a2a_fused": _run_a2a_fused,
 }

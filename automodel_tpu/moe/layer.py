@@ -92,7 +92,7 @@ def moe_block(
     # mesh-aware backends (a2a) need the real Mesh for their shard_map
     # region; make_constrain attaches it to the constrain callback
     ctx = getattr(constrain, "mesh_ctx", None)
-    if experts_backend == "a2a" and ctx is None:
+    if experts_backend in ("a2a", "a2a_fused") and ctx is None:
         logger.warning(
             "experts='a2a' but the constrain callback carries no mesh_ctx "
             "(use parallel.plans.make_constrain, or a custom wrapper must "

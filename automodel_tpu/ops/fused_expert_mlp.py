@@ -152,6 +152,12 @@ def _fwd(lhs, gate, up, down, group_sizes, gb, ub, db, act_kind, limit,
     wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)
     W = Mp // tm + G
 
+    # inside a check_vma shard_map region (the a2a_fused EP path) the
+    # pallas_call output aval must carry the manual-axes vma explicitly
+    from automodel_tpu.ops.grouped_matmul import _out_sds
+
+    out_sds = _out_sds((Mp, Dp), lhs.dtype, lhs, wgu, wd)
+
     out = pl.pallas_call(
         functools.partial(
             _kernel, tm=tm, n_ic=n_ic, act_kind=act_kind, limit=limit, W=W,
@@ -166,7 +172,7 @@ def _fwd(lhs, gate, up, down, group_sizes, gb, ub, db, act_kind, limit,
             ),
             scratch_shapes=[pltpu.VMEM((tm, Dp), jnp.float32)],
         ),
-        out_shape=jax.ShapeDtypeStruct((Mp, Dp), lhs.dtype),
+        out_shape=out_sds,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
@@ -235,6 +241,8 @@ def _vjp_fwd(lhs, gate, up, down, group_sizes, gb, ub, db,
 
 
 def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
+    from automodel_tpu.ops.grouped_matmul import _match_vma
+
     lhs, gate, up, down, group_sizes, gb, ub, db = res
 
     def f(args):
@@ -244,7 +252,11 @@ def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
 
     _, vjp = jax.vjp(f, (lhs, gate, up, down, gb, ub, db))
     (dl, dg, du, dd, dgb, dub, ddb), = vjp(dy)
-    return dl, dg, du, dd, None, dgb, dub, ddb
+    mv = lambda ct, p: None if ct is None else _match_vma(ct, p)
+    return (
+        mv(dl, lhs), mv(dg, gate), mv(du, up), mv(dd, down), None,
+        mv(dgb, gb), mv(dub, ub), mv(ddb, db),
+    )
 
 
 fused_expert_mlp.defvjp(_vjp_fwd, _vjp_bwd)

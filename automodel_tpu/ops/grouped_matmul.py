@@ -50,6 +50,17 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _out_sds(shape, dtype, *operands):
+    """ShapeDtypeStruct carrying the union of the operands' vma — inside a
+    check_vma shard_map region (the a2a/a2a_fused EP paths) a pallas_call
+    must state how its output varies over the manual axes."""
+    vmas = [getattr(jax.typeof(o), "vma", None) for o in operands]
+    if any(vmas):
+        vma = frozenset().union(*[v for v in vmas if v])
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _plan(group_sizes: jnp.ndarray, m_padded: int, tm: int, num_groups: int):
     """Work-unit schedule: for each of W = m_padded/tm + G grid steps, the
     (group, m-tile, row-window) it computes. All jnp — `group_sizes` is a
@@ -135,7 +146,7 @@ def _gmm(lhs: jnp.ndarray, rhs: jnp.ndarray, group_sizes: jnp.ndarray,
             ],
             out_specs=pl.BlockSpec((tm, tn), lambda n, w, wg, wt, ws, we: (wt[w], n)),
         ),
-        out_shape=jax.ShapeDtypeStruct((Mp, Np), out_dtype),
+        out_shape=_out_sds((Mp, Np), out_dtype, lhs, rhs),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
@@ -192,7 +203,7 @@ def _tgmm(lhs: jnp.ndarray, dout: jnp.ndarray, group_sizes: jnp.ndarray,
                 (1, tk, tn), lambda k, n, w, wg, wt, ws, we: (wg[w], k, n)
             ),
         ),
-        out_shape=jax.ShapeDtypeStruct((G, Kp, Np), jnp.float32),
+        out_shape=_out_sds((G, Kp, Np), jnp.float32, lhs, dout),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         ),
@@ -212,11 +223,29 @@ def _grouped_matmul_fwd(lhs, rhs, group_sizes, interpret):
     return _gmm(lhs, rhs, group_sizes, interpret=interpret), (lhs, rhs, group_sizes)
 
 
+def _match_vma(ct, primal):
+    """Inside a check_vma shard_map region a custom-VJP cotangent must vary
+    exactly as its primal does. A cotangent naturally varies over the UNION
+    of the incoming gradient's and the other operand's axes; any axis the
+    primal does not vary over means the primal was (conceptually) broadcast
+    there — whose AD transpose is the psum this inserts (the replicated-
+    weight gradient reduction shard_map's own transpose would have done)."""
+    want = getattr(jax.typeof(primal), "vma", None)
+    have = getattr(jax.typeof(ct), "vma", None)
+    if want is not None and have is not None and have - want:
+        ct = jax.lax.psum(ct, tuple(sorted(have - want)))
+    return ct
+
+
 def _grouped_matmul_bwd(interpret, res, dout):
     lhs, rhs, group_sizes = res
     dlhs = _gmm(dout, rhs.swapaxes(1, 2), group_sizes, interpret=interpret)
     drhs = _tgmm(lhs, dout, group_sizes, interpret=interpret)
-    return dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype), None
+    return (
+        _match_vma(dlhs.astype(lhs.dtype), lhs),
+        _match_vma(drhs.astype(rhs.dtype), rhs),
+        None,
+    )
 
 
 _grouped_matmul.defvjp(_grouped_matmul_fwd, _grouped_matmul_bwd)

@@ -320,6 +320,58 @@ def test_a2a_backward_is_scatter_free(devices8):
     assert not float_scatters, float_scatters[:4]
 
 
+def test_a2a_fused_matches_a2a(devices8, monkeypatch):
+    """experts='a2a_fused' (token exchange + one-kernel local expert MLP,
+    interpret mode): numerics AND grads match the unfused a2a path on an
+    ep=4 × tp=2 mesh, with gpt-oss-style biased interleaved swiglu_oai
+    experts — the fused kernel's bias path inside the manual region."""
+    monkeypatch.setenv("AUTOMODEL_GMM_INTERPRET", "1")
+    cfg = MoEConfig(
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+        activation="swiglu_oai", interleaved_gate_up=True,
+        expert_mlp_bias=True,
+    )
+    p, x, ps, xs, ctx, constrain = _a2a_setup(devices8, cfg)
+    rng = np.random.default_rng(5)
+    for name in ("gate_up_bias", "down_bias"):
+        b = jnp.asarray(
+            rng.standard_normal(p["experts"][name].shape) * 0.1, jnp.float32
+        )
+        p["experts"][name] = b
+        ps["experts"][name] = jax.device_put(b, ps["experts"][name].sharding)
+
+    def loss(p_, x_, backend):
+        out, _ = moe_block(
+            x_, p_, cfg, jax.nn.silu, experts_backend=backend,
+            constrain=constrain,
+        )
+        return (out.astype(jnp.float32) ** 2).mean(), out
+
+    (l_ref, o_ref), g_ref = jax.jit(
+        jax.value_and_grad(lambda p_: loss(p_, xs, "a2a"), has_aux=True)
+    )(ps)
+    (l_f, o_f), g_f = jax.jit(
+        jax.value_and_grad(lambda p_: loss(p_, xs, "a2a_fused"), has_aux=True)
+    )(ps)
+    np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_ref),
+                               rtol=2e-4, atol=2e-5)
+    flat_ref = jax.tree.leaves_with_path(g_ref)
+    flat = dict(jax.tree.leaves_with_path(g_f))
+    for path, ref_leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(flat[path]), np.asarray(ref_leaf),
+            rtol=5e-4, atol=1e-5, err_msg=str(path),
+        )
+
+    # non-gated experts reject loudly (kernel envelope)
+    cfg_ng = MoEConfig(num_experts=8, num_experts_per_tok=2,
+                       moe_intermediate_size=32, activation="relu2")
+    with pytest.raises(NotImplementedError, match="gated"):
+        from automodel_tpu.moe.experts import _fused_act_of
+
+        _fused_act_of(cfg_ng, "silu", False)
+
+
 def test_a2a_bounded_capacity_drops_gracefully(devices8):
     """a2a_capacity_factor < worst case: over-capacity picks contribute zero
     (never NaN/garbage)."""
