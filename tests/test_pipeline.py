@@ -249,3 +249,30 @@ def test_moe_pp_a2a_manual_matches(devices8):
             np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-3,
             err_msg=str(path),
         )
+
+
+def test_moe_pp_a2a_fused_matches_unfused(devices8, monkeypatch):
+    """experts='a2a_fused' inside the pp x ep manual region (the fused
+    local expert MLP on the token-exchange path) matches the unfused a2a
+    pipeline forward — with the PALLAS KERNEL actually running (interpret
+    mode), so vma/grid problems of a pallas_call nested in the pp-manual
+    shard_map surface here, not on the first real-TPU PP run."""
+    monkeypatch.setenv("AUTOMODEL_GMM_INTERPRET", "1")
+    import automodel_tpu.parallel.pp as ppm
+
+    ctx = build_mesh(MeshConfig(pp=2, ep=2, dp_shard=4), devices=devices8)
+    ids = jnp.asarray(
+        np.random.default_rng(9).integers(0, 128, size=(4, 32)), jnp.int32
+    )
+    outs = {}
+    for exp in ("a2a", "a2a_fused"):
+        ppm._logged_a2a_pp = False
+        auto = auto_model.from_config(
+            MOE_HF, ctx, {**FP32, "experts": exp, "pp_microbatches": 2}, seed=0
+        )
+        out, _ = jax.jit(lambda p, i: auto.model(p, i))(auto.params, ids)
+        assert not ppm._logged_a2a_pp, f"{exp} silently downgraded under PP"
+        outs[exp] = np.asarray(out)
+    np.testing.assert_allclose(
+        outs["a2a_fused"], outs["a2a"], atol=2e-5, rtol=1e-5
+    )
