@@ -175,8 +175,13 @@ class MistralCommonTokenizer:
             )
         else:
             ids = list(text)
-        if truncation in TRUNC_KEEP and max_length is not None:
-            ids = self._truncate(ids, max_length)
+        if truncation in TRUNC_KEEP:
+            # HF fallback: truncation=True without max_length truncates to
+            # model_max_length (silently never truncating lost batches to
+            # shape overflows)
+            limit = max_length if max_length is not None else self.model_max_length
+            if limit < int(1e30):
+                ids = self._truncate(ids, int(limit))
         return ids
 
     def tokenize(self, text: str, **kwargs) -> list:
@@ -244,9 +249,10 @@ class MistralCommonTokenizer:
             return ids[-max_length:]
         return ids[:max_length]
 
-    def _pad_one(self, ids: list, target: int, padding_side: Optional[str]):
+    def _pad_one(self, ids: list, target: int, padding_side: Optional[str],
+                 mask: Optional[list] = None):
         n = target - len(ids)
-        mask = [1] * len(ids)
+        mask = [1] * len(ids) if mask is None else list(mask)
         if n <= 0:
             return ids, mask
         pad = [self.pad_token_id] * n
@@ -271,13 +277,22 @@ class MistralCommonTokenizer:
                 k: [d[k] for d in encoded_inputs] for k in encoded_inputs[0]
             }
         seqs = [list(s) for s in encoded_inputs["input_ids"]]
+        # a caller-provided attention_mask (pre-padded features) EXTENDS
+        # with zeros rather than being rebuilt as all-ones (HF semantics)
+        given_masks = encoded_inputs.get("attention_mask")
         if padding == "max_length" and max_length is not None:
             target = max_length
         else:
             target = max(len(s) for s in seqs)
         if pad_to_multiple_of:
             target = -(-target // pad_to_multiple_of) * pad_to_multiple_of
-        ids, masks = zip(*(self._pad_one(s, target, padding_side) for s in seqs))
+        ids, masks = zip(*(
+            self._pad_one(
+                s, target, padding_side,
+                mask=None if given_masks is None else given_masks[i],
+            )
+            for i, s in enumerate(seqs)
+        ))
         out = {"input_ids": list(ids), "attention_mask": list(masks)}
         # unknown feature keys pass through (HF tokenizer.pad semantics —
         # collators pad labels themselves) BEFORE tensorization so every

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from automodel_tpu.models.common.config import BackendConfig
+from automodel_tpu.models.llama.model import ACT_FNS, _proj as _llama_proj
 from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import layer_norm
 
@@ -43,6 +44,8 @@ class GPT2Config:
     num_heads: int = 12
     layer_norm_eps: float = 1e-5
     tie_embeddings: bool = True
+    n_inner: Optional[int] = None  # HF n_inner; None → 4·hidden
+    act: str = "gelu_pytorch_tanh"  # HF gelu_new ≡ tanh approximation
 
     @property
     def head_dim(self) -> int:
@@ -62,7 +65,7 @@ class GPT2Config:
 
     @property
     def intermediate_size(self) -> int:
-        return 4 * self.hidden_size
+        return self.n_inner or 4 * self.hidden_size
 
     @classmethod
     def from_hf(cls, hf: Any) -> "GPT2Config":
@@ -70,6 +73,14 @@ class GPT2Config:
             hf.get(k, d) if isinstance(hf, dict) else getattr(hf, k, d)
         )
         n_pos = get("n_positions", None) or get("n_ctx", None) or 2048
+        hf_act = get("activation_function", "gelu_new")
+        act = {
+            "gelu_new": "gelu_pytorch_tanh",
+            "gelu_pytorch_tanh": "gelu_pytorch_tanh",
+            "gelu": "gelu",
+        }.get(hf_act)
+        if act is None:
+            raise ValueError(f"unsupported gpt2 activation_function {hf_act!r}")
         return cls(
             vocab_size=get("vocab_size", 50257),
             n_positions=n_pos,
@@ -78,6 +89,8 @@ class GPT2Config:
             num_heads=get("n_head", None) or get("num_attention_heads", 12),
             layer_norm_eps=get("layer_norm_epsilon", 1e-5),
             tie_embeddings=bool(get("tie_word_embeddings", True)),
+            n_inner=get("n_inner", None),
+            act=act,
         )
 
 
@@ -117,11 +130,10 @@ def init_params(cfg: GPT2Config, backend: BackendConfig, key: jax.Array) -> dict
 
 
 def _proj(x: jnp.ndarray, p: dict) -> jnp.ndarray:
-    y = x @ p["kernel"].astype(x.dtype)
-    y = y + p["bias"].astype(x.dtype)
-    if "lora_A" in p:
-        y = y + (x @ p["lora_A"].astype(x.dtype)) @ p["lora_B"].astype(x.dtype)
-    return y
+    # the shared llama projection: bias + activation-side LoRA incl. the
+    # grafted adapter DROPOUT seeds and NF4-packed kernels — reimplementing
+    # it here silently dropped LoRA dropout
+    return _llama_proj(x, p)
 
 
 def decoder_layer(
@@ -147,8 +159,7 @@ def decoder_layer(
     h = h + _proj(attn_out.reshape(B, S, D), lp["attn"]["o_proj"])
     h = constrain(h, ("batch", "seq", None))
     x = layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], cfg.layer_norm_eps)
-    # HF gpt2 ACT2FN["gelu_new"] is the tanh approximation
-    mlp = _proj(jax.nn.gelu(_proj(x, lp["mlp"]["fc"]), approximate=True), lp["mlp"]["proj"])
+    mlp = _proj(ACT_FNS[cfg.act](_proj(x, lp["mlp"]["fc"])), lp["mlp"]["proj"])
     h = h + mlp
     return constrain(h, ("batch", "seq", None))
 

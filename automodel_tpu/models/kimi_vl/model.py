@@ -68,6 +68,19 @@ class KimiVLForConditionalGeneration(KimiK25VLForConditionalGeneration):
         constrain=None,
         **kw: Any,
     ):
+        if (
+            pixel_values is not None
+            and grid_hws is None
+            and not self.config.training_image_grid_thw
+        ):
+            # raise with THIS family's config key (the inherited K2.5
+            # message names training_image_grid_thw, which KimiVLConfig
+            # does not read)
+            raise ValueError(
+                "pixel_values given without grid_hws; pass the static "
+                "(h, w) grids per call or set training_image_grid_hws in "
+                "the config"
+            )
         grid_thw = (
             None if grid_hws is None else tuple((1, h, w) for h, w in grid_hws)
         )

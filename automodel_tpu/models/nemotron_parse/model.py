@@ -206,6 +206,13 @@ def decoder_forward(
 ) -> jnp.ndarray:
     cd = backend.compute_jnp_dtype
     B, S = input_ids.shape
+    if S > cfg.max_positions:
+        # learned positions have no extrapolation; an OOB gather would
+        # silently clamp to the last row (same guard as gpt2)
+        raise ValueError(
+            f"decoder sequence length {S} exceeds max_sequence_length "
+            f"{cfg.max_positions}"
+        )
     D, NH, HD = cfg.hidden_size, cfg.num_heads, cfg.head_dim
     eps = cfg.ln_eps
     act = ACT_FNS["gelu"]  # mBART activation_function="gelu" (exact erf)

@@ -116,6 +116,25 @@ class NemotronParseStateDictAdapter:
             path = tuple(getattr(k, "key", k) for k in p)
             yield path, _BB + "/".join(str(s) for s in path)
 
+    def _default_backbone_init(self):
+        """Fresh stand-in ViT leaves (fp32, fixed seed) for checkpoints that
+        carry no in-tree backbone — the generic from_pretrained path calls
+        iter_from_hf with only a tensor getter, and the assembled tree must
+        still be COMPLETE (a missing vision/backbone subtree would KeyError
+        on the first pixel forward)."""
+        import jax
+
+        from automodel_tpu.models.common.config import BackendConfig
+        from automodel_tpu.models.nemotron_parse.vision import (
+            init_backbone_params,
+        )
+
+        return init_backbone_params(
+            self.config.vision,
+            BackendConfig(param_dtype="float32"),
+            jax.random.PRNGKey(0),
+        )
+
     # -- load ---------------------------------------------------------------
     def iter_from_hf(
         self, get_tensor: Callable[[str], np.ndarray], backbone_init: Any = None
@@ -136,19 +155,24 @@ class NemotronParseStateDictAdapter:
                     for i in range(L)
                 ]
             ))
-        if backbone_init is not None:
-            missing = 0
-            for path, key in self._backbone_paths(backbone_init):
-                try:
-                    yield (("vision", "backbone", *path), get_tensor(key))
-                except KeyError:
-                    missing += 1
-            if missing:
-                logger.warning(
-                    "checkpoint has no in-tree backbone weights (%d leaves; a "
-                    "hub RADIO checkpoint keeps its own encoder.model_encoder "
-                    "layout) — the stand-in ViT stays at its init", missing,
-                )
+        if backbone_init is None:
+            backbone_init = self._default_backbone_init()
+        missing = 0
+        for path, key in self._backbone_paths(backbone_init):
+            try:
+                yield (("vision", "backbone", *path), get_tensor(key))
+            except KeyError:
+                node = backbone_init
+                for k in path:
+                    node = node[k]
+                missing += 1
+                yield (("vision", "backbone", *path), np.asarray(node))
+        if missing:
+            logger.warning(
+                "checkpoint has no in-tree backbone weights (%d leaves; a "
+                "hub RADIO checkpoint keeps its own encoder.model_encoder "
+                "layout) — the stand-in ViT stays at its init", missing,
+            )
 
     def from_hf(
         self, get_tensor: Callable[[str], np.ndarray], backbone_init: Any = None

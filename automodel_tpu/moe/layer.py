@@ -94,10 +94,11 @@ def moe_block(
     ctx = getattr(constrain, "mesh_ctx", None)
     if experts_backend in ("a2a", "a2a_fused") and ctx is None:
         logger.warning(
-            "experts='a2a' but the constrain callback carries no mesh_ctx "
+            "experts=%r but the constrain callback carries no mesh_ctx "
             "(use parallel.plans.make_constrain, or a custom wrapper must "
             "preserve the attribute); falling back to the single-slice "
-            "ragged path — NO expert-parallel token exchange will happen."
+            "ragged path — NO expert-parallel token exchange will happen.",
+            experts_backend,
         )
     # a callable backend (e.g. the pipeline's ep-manual a2a binding) uses the
     # registry's uniform signature directly

@@ -411,7 +411,7 @@ def main() -> None:
                 ),
                 "moe_tflops_per_chip": round(moe_tflops, 1),
                 "moe_experts_backend": moe_backend,
-                "moe_mfu_by_backend": moe_tried,
+                "moe_mfu_pct_by_backend": moe_tried,
             }
         )
     )

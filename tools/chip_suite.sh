@@ -10,13 +10,12 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 
 echo "[chip_suite] probing TPU (timeout ${BENCH_TPU_PROBE_S:-300}s)..." >&2
-python - <<'EOF' || { echo "[chip_suite] no TPU; aborting" >&2; exit 1; }
-import os, subprocess, sys
-r = subprocess.run([sys.executable, "-c",
-                    "import jax,sys; sys.exit(0 if jax.devices()[0].platform=='tpu' else 1)"],
-                   timeout=float(os.environ.get("BENCH_TPU_PROBE_S", "300")))
-sys.exit(r.returncode)
-EOF
+# reuse bench.py's probe — one implementation of the subprocess trick
+python -c '
+import os, sys
+from bench import _probe_tpu
+sys.exit(0 if _probe_tpu(float(os.environ.get("BENCH_TPU_PROBE_S", "300"))) == "tpu" else 1)
+' || { echo "[chip_suite] no TPU; aborting" >&2; exit 1; }
 
 echo "[chip_suite] bench (dense LoRA + 8B QLoRA + MoE ragged_fused-vs-ragged race)" >&2
 if ! python bench.py 2> >(tee bench_stderr.log >&2) | tee BENCH_chip.json; then
