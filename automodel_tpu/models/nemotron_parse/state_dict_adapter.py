@@ -116,12 +116,7 @@ class NemotronParseStateDictAdapter:
             path = tuple(getattr(k, "key", k) for k in p)
             yield path, _BB + "/".join(str(s) for s in path)
 
-    def _default_backbone_init(self):
-        """Fresh stand-in ViT leaves (fp32, fixed seed) for checkpoints that
-        carry no in-tree backbone — the generic from_pretrained path calls
-        iter_from_hf with only a tensor getter, and the assembled tree must
-        still be COMPLETE (a missing vision/backbone subtree would KeyError
-        on the first pixel forward)."""
+    def _backbone_init_fn(self):
         import jax
 
         from automodel_tpu.models.common.config import BackendConfig
@@ -129,11 +124,19 @@ class NemotronParseStateDictAdapter:
             init_backbone_params,
         )
 
-        return init_backbone_params(
+        return lambda: init_backbone_params(
             self.config.vision,
             BackendConfig(param_dtype="float32"),
             jax.random.PRNGKey(0),
         )
+
+    def _default_backbone_shapes(self):
+        """Shape skeleton of the stand-in ViT — enumerates the backbone tree
+        paths without materializing ~GBs of fp32 leaves (real leaves are only
+        built when the checkpoint carries no in-tree backbone at all)."""
+        import jax
+
+        return jax.eval_shape(self._backbone_init_fn())
 
     # -- load ---------------------------------------------------------------
     def iter_from_hf(
@@ -155,24 +158,44 @@ class NemotronParseStateDictAdapter:
                     for i in range(L)
                 ]
             ))
-        if backbone_init is None:
-            backbone_init = self._default_backbone_init()
-        missing = 0
-        for path, key in self._backbone_paths(backbone_init):
+        skeleton = (
+            backbone_init if backbone_init is not None
+            else self._default_backbone_shapes()
+        )
+        paths = list(self._backbone_paths(skeleton))
+        loaded, missing = {}, []
+        for path, key in paths:
             try:
-                yield (("vision", "backbone", *path), get_tensor(key))
+                loaded[path] = get_tensor(key)
             except KeyError:
-                node = backbone_init
-                for k in path:
-                    node = node[k]
-                missing += 1
-                yield (("vision", "backbone", *path), np.asarray(node))
-        if missing:
+                missing.append(key)
+        if missing and loaded:
+            # a checkpoint that matches the in-tree layout for SOME leaves is
+            # a broken/renamed checkpoint, not a hub-RADIO one — mixing its
+            # weights with fixed-seed init would produce silently-garbage
+            # vision features
+            raise KeyError(
+                f"checkpoint matches the in-tree backbone layout for "
+                f"{len(loaded)}/{len(paths)} leaves but is missing "
+                f"{missing[:5]}{'…' if len(missing) > 5 else ''} — refusing "
+                f"to mix loaded weights with stand-in init"
+            )
+        if missing:  # no in-tree backbone at all (e.g. hub RADIO layout)
+            if backbone_init is None:
+                backbone_init = self._backbone_init_fn()()
             logger.warning(
                 "checkpoint has no in-tree backbone weights (%d leaves; a "
                 "hub RADIO checkpoint keeps its own encoder.model_encoder "
-                "layout) — the stand-in ViT stays at its init", missing,
+                "layout) — the stand-in ViT stays at its init", len(missing),
             )
+            for path, _ in paths:
+                node = backbone_init
+                for k in path:
+                    node = node[k]
+                yield (("vision", "backbone", *path), np.asarray(node))
+        else:
+            for path, _ in paths:
+                yield (("vision", "backbone", *path), loaded[path])
 
     def from_hf(
         self, get_tensor: Callable[[str], np.ndarray], backbone_init: Any = None

@@ -59,7 +59,7 @@ def _kernel(wg, wt, ws, we, lhs_ref, wgu_ref, wd_ref, *rest,
         preferred_element_type=jnp.float32,
     )  # [tm, 2*ic_size]
     if has_bias:
-        gu = gu + gub_ref[0, 0].astype(jnp.float32)
+        gu = gu + gub_ref[0, 0, 0].astype(jnp.float32)
         # gpt-oss-style expert biases: once added, masked rows are no longer
         # zero (act(bias)·Wd ≠ 0) — re-mask mid before the down contraction
         # and gate the down bias on the same row window (each work unit adds
@@ -67,7 +67,7 @@ def _kernel(wg, wt, ws, we, lhs_ref, wgu_ref, wd_ref, *rest,
         @pl.when(ic == 0)
         def _():
             acc[...] += jnp.where(
-                lmask, db_ref[0].astype(jnp.float32), 0.0
+                lmask, db_ref[0, 0].astype(jnp.float32), 0.0
             )
     half = gu.shape[-1] // 2
     g, u = gu[:, :half], gu[:, half:]
@@ -138,15 +138,20 @@ def _fwd(lhs, gate, up, down, group_sizes, gb, ub, db, act_kind, limit,
         db = jnp.zeros((G, D), lhs.dtype) if db is None else db
         gb = jnp.pad(gb, ((0, 0), (0, Ip - I)))
         ub = jnp.pad(ub, ((0, 0), (0, Ip - I)))
+        # the unit axis before the lane dim keeps Mosaic's sublane tiling
+        # rule satisfied (block dim == array dim == 1); without it a block
+        # of 1 over the G (resp. n_ic) sublane axis fails lowering
         gub = jnp.concatenate(
             [gb.reshape(G, n_ic, ic), ub.reshape(G, n_ic, ic)], axis=-1
-        )  # [G, n_ic, 2ic] — same chunk interleave as wgu
-        operands += [gub, jnp.pad(db, ((0, 0), (0, Dp - D)))]
+        ).reshape(G, n_ic, 1, 2 * ic)  # same chunk interleave as wgu
+        operands += [
+            gub, jnp.pad(db, ((0, 0), (0, Dp - D))).reshape(G, 1, Dp)
+        ]
         in_specs += [
             pl.BlockSpec(
-                (1, 1, 2 * ic), lambda w, i, wg, wt, ws, we: (wg[w], i, 0)
+                (1, 1, 1, 2 * ic), lambda w, i, wg, wt, ws, we: (wg[w], i, 0, 0)
             ),
-            pl.BlockSpec((1, Dp), lambda w, i, wg, wt, ws, we: (wg[w], 0)),
+            pl.BlockSpec((1, 1, Dp), lambda w, i, wg, wt, ws, we: (wg[w], 0, 0)),
         ]
 
     wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)

@@ -84,6 +84,10 @@ def chunked_cross_entropy(
     flat_logits = flat_logits.reshape(num_chunks, t // num_chunks, v)
     flat_labels = flat_labels.reshape(num_chunks, t // num_chunks)
 
+    # checkpoint the body: scan's AD otherwise STACKS each chunk's fp32
+    # softmax residuals across iterations — a [chunks, chunk_t, V] buffer
+    # that exceeds the unchunked working set it was meant to avoid
+    @jax.checkpoint
     def body(carry, chunk):
         lg, lb = chunk
         s, n = _ce_sum(lg, lb)
@@ -114,6 +118,12 @@ def fused_linear_cross_entropy(
     flat_h = flat_h.reshape(num_chunks, t // num_chunks, d)
     flat_labels = flat_labels.reshape(num_chunks, t // num_chunks)
 
+    # checkpoint the body, else scan's AD stacks every chunk's fp32 logits
+    # as residuals — f32[chunks, chunk_t, V] (4GB at the MoE bench shape,
+    # the round-5 OOM) — exactly the buffer this function exists to avoid.
+    # The backward recomputes h @ lm_head per chunk (cut-cross-entropy's
+    # trade: one extra [chunk, D]x[D, V] matmul per chunk).
+    @jax.checkpoint
     def body(carry, chunk):
         h, lb = chunk
         logits = h @ lm_head_kernel

@@ -1,11 +1,17 @@
 """Apportion MoE step time between dispatch (sort/gather), grouped matmuls,
-combine (scatter), attention, and the rest — on the real chip at bench shapes.
+combine, attention, and the rest — on the real chip at bench shapes.
+
+Round 5: shapes track the CURRENT bench fingerprint (bench.py _moe_hf — the
+GPT-OSS-style model: D=1024, per-expert I=1024, E=32 top-4, swiglu_oai with
+interleaved gate_up + expert biases, head_dim 64), and the fused expert MLP
+(`ragged_fused`) is profiled head-to-head against the two-gmm `ragged` path,
+with and without biases.
 
 Each stage is timed as a jitted `lax.scan` loop whose op inputs DEPEND ON THE
 CARRY (else XLA's while-loop LICM hoists the op out and the timing is a lie)
-and whose output feeds the next carry (else DCE). The ~1s tunnel RPC latency
-amortizes over reps; one tiny device_get syncs. Writes PROFILE_MOE_r04.md
-(the committed artifact VERDICT r3 #1 asks for).
+and whose output feeds the next carry (else DCE). The ~120ms tunnel RPC
+latency cancels in the slope between a short and a 4x-longer loop; one tiny
+device_get syncs. Writes PROFILE_MOE_r05.md.
 
 Run: python tools/profile_moe.py  (on the axon TPU).
 """
@@ -23,9 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 # bench fingerprint (bench.py _moe_hf, BENCH_MOE_BATCH=4, seq=4096)
-D = 1536
-I = 768  # moe_intermediate
-E = 16
+D = 1024
+I = 1024  # per-expert intermediate (gpt-oss layout)
+E = 32
 K = 4
 T = 4 * 4096  # tokens per step
 TK = T * K
@@ -65,7 +71,7 @@ def timed(name, fn, c0, *args, flops=0.0, bytes_moved=0.0, reps=REPS):
     run(loop_l)
     t2 = time.perf_counter()
     dt = ((t2 - t1) - (t1 - t0)) / (3 * reps)
-    line = f"{name:<36} {dt*1e3:8.2f} ms"
+    line = f"{name:<40} {dt*1e3:8.2f} ms"
     if flops:
         line += f"  {flops/dt/1e12:7.1f} TFLOP/s"
     if bytes_moved:
@@ -92,13 +98,14 @@ def main():
     x = jnp.asarray(rng.normal(size=(T, D)), cd)
     gu_w = jnp.asarray(rng.normal(size=(E, D, 2 * I)) * 0.02, cd)
     dn_w = jnp.asarray(rng.normal(size=(E, I, D)) * 0.02, cd)
+    gu_b = jnp.asarray(rng.normal(size=(E, 2 * I)) * 0.02, cd)
+    dn_b = jnp.asarray(rng.normal(size=(E, D)) * 0.02, cd)
     topk_idx = jnp.asarray((rng.permutation(TK).reshape(T, K) % E).astype(np.int32))
     topk_w = jnp.full((T, K), 1.0 / K, cd)
 
     order_np = jnp.argsort(topk_idx.reshape(-1))
     token_of = order_np // K
     gsizes = jnp.bincount(topk_idx.reshape(-1), length=E).astype(jnp.int32)
-    inv = jnp.argsort(order_np)
     xs0 = x[token_of]
     lines = []
 
@@ -108,12 +115,6 @@ def main():
         return c + order[:T].astype(cd)[:, None] * eps
 
     lines.append(timed("argsort T*K", f_sort, x, topk_idx)[1])
-
-    def f_bincount(c, idx):
-        gs = jnp.bincount(idx.reshape(-1) + _ipert(c), length=E)
-        return c + gs[0].astype(cd) * eps
-
-    lines.append(timed("bincount", f_bincount, x, topk_idx)[1])
 
     def f_gather(c, tok):
         xs = c[tok + _ipert(c)]
@@ -128,7 +129,7 @@ def main():
 
     def f_gmm1(c, w, gs):
         out = ragged_dot(c, w, gs, platform="tpu")  # carry IS the lhs
-        return c + out[:, :D] * eps
+        return c + out.sum(-1, keepdims=True) * eps
 
     lines.append(
         timed("gmm1 [TK,D]@[E,D,2I]", f_gmm1, xs0, gu_w, gsizes,
@@ -139,85 +140,107 @@ def main():
 
     def f_gmm2(c, w, gs):
         out = ragged_dot(c, w, gs, platform="tpu")
-        return c + out[:, :I] * eps
+        return c + out.sum(-1, keepdims=True) * eps
 
     lines.append(
         timed("gmm2 [TK,I]@[E,I,D]", f_gmm2, h0, dn_w, gsizes,
               flops=2 * TK * I * D)[1]
     )
 
-    ys0 = jnp.asarray(rng.normal(size=(TK, D)), cd)
-    wflat = topk_w.reshape(-1)[order_np]
+    # ---- fused expert MLP kernel vs the two-gmm composition ---------------
+    from automodel_tpu.ops.fused_expert_mlp import fused_expert_mlp
 
-    def f_scatter(c, tok, w):
-        out = jnp.zeros((T, D), jnp.float32)
-        out = out.at[tok + _ipert(c)].add(
-            c.astype(jnp.float32) * w[:, None].astype(jnp.float32)
-        )
-        return c + jnp.tile(out.astype(cd), (K, 1)) * eps
+    gw0, uw0 = gu_w[:, :, ::2], gu_w[:, :, 1::2]  # any fixed split works here
+    gb0, ub0 = gu_b[:, ::2], gu_b[:, 1::2]
+    mlp_flops = 2 * TK * D * 2 * I + 2 * TK * I * D
 
-    lines.append(
-        timed("scatter-add combine (fp32)", f_scatter, ys0, token_of, wflat,
-              bytes_moved=TK * D * 4 * 2 + TK * D * 2)[1]
-    )
-
-    def f_unsort_combine(c, inv, w):
-        yu = c[inv + _ipert(c)].reshape(T, K, D)
-        wu = w[inv].reshape(T, K)
-        out = jnp.einsum("tkd,tk->td", yu.astype(jnp.float32), wu.astype(jnp.float32))
-        return c + jnp.tile(out.astype(cd), (K, 1)) * eps
+    def f_fused(c, gw, uw, dw, gs):
+        out = fused_expert_mlp(c, gw, uw, dw, gs, None, None, None,
+                               "swiglu_oai", None, "tpu", None)
+        return c + out * eps
 
     lines.append(
-        timed("ALT combine: unsort+reshape sum", f_unsort_combine, ys0, inv,
-              wflat, bytes_moved=2 * TK * D * 2)[1]
+        timed("fused MLP kernel (no bias)", f_fused, xs0, gw0, uw0, dn_w,
+              gsizes, flops=mlp_flops)[1]
     )
 
-    # ---- full expert paths (fwd and train) --------------------------------
+    def f_fused_b(c, gw, uw, dw, gb, ub, db, gs):
+        out = fused_expert_mlp(c, gw, uw, dw, gs, gb, ub, db,
+                               "swiglu_oai", None, "tpu", None)
+        return c + out * eps
+
+    lines.append(
+        timed("fused MLP kernel (biased)", f_fused_b, xs0, gw0, uw0, dn_w,
+              gb0, ub0, dn_b, gsizes, flops=mlp_flops)[1]
+    )
+
+    # ---- full expert paths (fwd and train), bench config ------------------
     from automodel_tpu.moe.config import MoEConfig
-    from automodel_tpu.moe.experts import ragged_experts
+    from automodel_tpu.moe.experts import ragged_experts, ragged_fused_experts
     from automodel_tpu.moe.gate import GateOutput
+    from automodel_tpu.moe.layer import make_act2
 
-    cfg = MoEConfig(num_experts=E, num_experts_per_tok=K, moe_intermediate_size=I)
-    act2 = lambda g, u: jax.nn.silu(g) * u
-    moe_flops = 2 * TK * D * 2 * I + 2 * TK * I * D
-
-    def f_ragged_fwd(c, idx, tw, gu, dn):
-        gout = GateOutput(
-            topk_idx=idx + _ipert(c), topk_weights=tw,
-            expert_counts=gsizes, aux_loss=jnp.zeros((), jnp.float32),
-        )
-        w = {"gate_up": gu, "down": dn}
-        return ragged_experts(c, gout, w, cfg, act2, platform="tpu") * eps + c
-
-    lines.append(
-        timed("ragged_experts FWD", f_ragged_fwd, x, topk_idx, topk_w, gu_w,
-              dn_w, flops=moe_flops)[1]
+    cfg = MoEConfig(
+        num_experts=E, num_experts_per_tok=K, moe_intermediate_size=I,
+        activation="swiglu_oai", interleaved_gate_up=True,
     )
+    act2 = make_act2(cfg, jax.nn.silu)
 
-    def f_ragged_train(c, idx, tw, gu, dn):
-        gout = GateOutput(
-            topk_idx=idx + _ipert(c), topk_weights=tw,
+    def gate_of(c, idx):
+        return GateOutput(
+            topk_idx=idx + _ipert(c), topk_weights=topk_w,
             expert_counts=gsizes, aux_loss=jnp.zeros((), jnp.float32),
         )
 
-        def loss(args):
-            x_, gu_, dn_ = args
-            w = {"gate_up": gu_, "down": dn_}
-            y = ragged_experts(x_, gout, w, cfg, act2, platform="tpu")
-            return jnp.sum(y.astype(jnp.float32) ** 2) * 1e-6
-
-        g = jax.grad(loss)((c, gu, dn))
-        return c + g[0] * eps
+    def f_ragged_fwd(c, idx, gu, dn, gub, dnb):
+        w = {"gate_up": gu, "down": dn, "gate_up_bias": gub, "down_bias": dnb}
+        return ragged_experts(c, gate_of(c, idx), w, cfg, act2,
+                              platform="tpu") * eps + c
 
     lines.append(
-        timed("ragged_experts FWD+BWD", f_ragged_train, x, topk_idx, topk_w,
-              gu_w, dn_w, flops=3 * moe_flops)[1]
+        timed("ragged_experts FWD (biased)", f_ragged_fwd, x, topk_idx, gu_w,
+              dn_w, gu_b, dn_b, flops=mlp_flops)[1]
     )
 
-    # ---- attention at bench shape (flash) ---------------------------------
+    def f_fusedpath_fwd(c, idx, gu, dn, gub, dnb):
+        w = {"gate_up": gu, "down": dn, "gate_up_bias": gub, "down_bias": dnb}
+        return ragged_fused_experts(c, gate_of(c, idx), w, cfg, act2,
+                                    platform="tpu") * eps + c
+
+    lines.append(
+        timed("ragged_FUSED_experts FWD (biased)", f_fusedpath_fwd, x,
+              topk_idx, gu_w, dn_w, gu_b, dn_b, flops=mlp_flops)[1]
+    )
+
+    def train_of(expert_fn):
+        def f(c, idx, gu, dn, gub, dnb):
+            gout = gate_of(c, idx)
+
+            def loss(args):
+                x_, gu_, dn_, gub_, dnb_ = args
+                w = {"gate_up": gu_, "down": dn_, "gate_up_bias": gub_,
+                     "down_bias": dnb_}
+                y = expert_fn(x_, gout, w, cfg, act2, platform="tpu")
+                return jnp.sum(y.astype(jnp.float32) ** 2) * 1e-6
+
+            g = jax.grad(loss)((c, gu, dn, gub, dnb))
+            return c + g[0] * eps
+
+        return f
+
+    lines.append(
+        timed("ragged_experts FWD+BWD (biased)", train_of(ragged_experts), x,
+              topk_idx, gu_w, dn_w, gu_b, dn_b, flops=3 * mlp_flops)[1]
+    )
+    lines.append(
+        timed("ragged_FUSED FWD+BWD (biased)", train_of(ragged_fused_experts),
+              x, topk_idx, gu_w, dn_w, gu_b, dn_b, flops=3 * mlp_flops)[1]
+    )
+
+    # ---- attention at bench shape (flash, gpt-oss heads) ------------------
     from automodel_tpu.ops.attention import flash
 
-    B, S, N, NKV, H = 4, 4096, 12, 4, 128
+    B, S, N, NKV, H = 4, 4096, 16, 4, 64
     k = jnp.asarray(rng.normal(size=(B, S, NKV, H)), cd)
     v = jnp.asarray(rng.normal(size=(B, S, NKV, H)), cd)
     q0 = jnp.asarray(rng.normal(size=(B, S, N, H)), cd)
@@ -240,13 +263,14 @@ def main():
     lines.append(timed("flash attention fwd+bwd", f_attn_train, q0, k, v,
                        flops=3 * att_flops)[1])
 
-    with open("PROFILE_MOE_r04.md", "w") as f:
-        f.write("# MoE hot-path profile (round 4)\n\n")
+    with open("PROFILE_MOE_r05.md", "w") as f:
+        f.write("# MoE hot-path profile (round 5)\n\n")
         f.write(f"Device: {dev.device_kind}; shapes: T={T}, K={K}, E={E}, "
-                f"D={D}, I={I} (bench fingerprint, BENCH_MOE_BATCH=4 seq=4096)\n\n```\n")
+                f"D={D}, I={I} (bench GPT-OSS fingerprint, BENCH_MOE_BATCH=4 "
+                f"seq=4096, swiglu_oai + expert biases)\n\n```\n")
         f.write("\n".join(lines))
         f.write("\n```\n")
-    print("wrote PROFILE_MOE_r04.md", flush=True)
+    print("wrote PROFILE_MOE_r05.md", flush=True)
 
 
 if __name__ == "__main__":
