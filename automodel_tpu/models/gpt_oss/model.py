@@ -65,7 +65,10 @@ class GptOssConfig(TransformerConfig):
             score_func="softmax",
             softmax_before_topk=False,  # softmax over the picked logits
             router_linear_bias=True,
-            interleaved_gate_up=True,
+            # HF stores gate_up interleaved; the ADAPTER de-interleaves at
+            # the checkpoint boundary (state_dict_adapter._deint) so the
+            # hot path never strided-slices the stacked expert tensor
+            interleaved_gate_up=False,
             expert_mlp_bias=True,
             activation="swiglu_oai",
             aux_loss_coeff=get("router_aux_loss_coef", 0.0) or 0.0,

@@ -21,12 +21,34 @@ def _t(x: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(x.T)
 
 
+def _deint(x: np.ndarray) -> np.ndarray:
+    """HF's gate_up interleaves [g0,u0,g1,u1,…] on the last axis; natively
+    the halves are stored CONTIGUOUS [g…|u…]. Strided ::2 slices in the
+    per-step hot path leak an interleave-friendly layout onto the stacked
+    expert param and its grad, and every fp32 consumer of that grad (Adam,
+    grad-norm) then pays a full-size relayout copy — de-interleaving once
+    at the checkpoint boundary keeps the hot path contiguous."""
+    return np.ascontiguousarray(
+        np.concatenate([x[..., 0::2], x[..., 1::2]], axis=-1)
+    )
+
+
+def _reint(x: np.ndarray) -> np.ndarray:
+    half = x.shape[-1] // 2
+    out = np.empty_like(x)
+    out[..., 0::2] = x[..., :half]
+    out[..., 1::2] = x[..., half:]
+    return out
+
+
 class GptOssStateDictAdapter:
     def __init__(self, config: GptOssConfig):
         self.config = config
 
-    def _plans(self) -> list[tuple[tuple[str, ...], str, bool]]:
-        """(native path under layers-stack, hf key template, transpose)."""
+    def _plans(self) -> list[tuple[tuple[str, ...], str, Any]]:
+        """(native path under layers-stack, hf key template, transform):
+        transform False → identity, True → transpose, (fwd, inv) pair →
+        custom load/save transforms (gate_up de-interleave)."""
         plans = [
             (("attn", "q_proj", "kernel"), "model.layers.{i}.self_attn.q_proj.weight", True),
             (("attn", "q_proj", "bias"), "model.layers.{i}.self_attn.q_proj.bias", False),
@@ -41,8 +63,8 @@ class GptOssStateDictAdapter:
             (("post_attn_norm", "scale"), "model.layers.{i}.post_attention_layernorm.weight", False),
             (("moe", "router", "weight"), "model.layers.{i}.mlp.router.weight", True),
             (("moe", "router", "linear_bias"), "model.layers.{i}.mlp.router.bias", False),
-            (("moe", "experts", "gate_up"), "model.layers.{i}.mlp.experts.gate_up_proj", False),
-            (("moe", "experts", "gate_up_bias"), "model.layers.{i}.mlp.experts.gate_up_proj_bias", False),
+            (("moe", "experts", "gate_up"), "model.layers.{i}.mlp.experts.gate_up_proj", (_deint, _reint)),
+            (("moe", "experts", "gate_up_bias"), "model.layers.{i}.mlp.experts.gate_up_proj_bias", (_deint, _reint)),
             (("moe", "experts", "down"), "model.layers.{i}.mlp.experts.down_proj", False),
             (("moe", "experts", "down_bias"), "model.layers.{i}.mlp.experts.down_proj_bias", False),
         ]
@@ -59,11 +81,12 @@ class GptOssStateDictAdapter:
         if not c.tie_embeddings:
             yield ("lm_head", "kernel"), _t(get_tensor("lm_head.weight"))
         for path, tmpl, tr in self._plans():
+            fwd = tr[0] if isinstance(tr, tuple) else (_t if tr else None)
             yield ("layers", *path), LazyStacked(
                 [
                     (
-                        lambda i=i, t=tmpl, tr=tr: (
-                            _t(get_tensor(t.format(i=i))) if tr else get_tensor(t.format(i=i))
+                        lambda i=i, t=tmpl, f=fwd: (
+                            f(get_tensor(t.format(i=i))) if f else get_tensor(t.format(i=i))
                         )
                     )
                     for i in range(c.num_layers)
@@ -82,9 +105,10 @@ class GptOssStateDictAdapter:
         if not c.tie_embeddings:
             yield "lm_head.weight", _t(np.asarray(params["lm_head"]["kernel"]))
         for path, tmpl, tr in self._plans():
+            inv = tr[1] if isinstance(tr, tuple) else (_t if tr else None)
             node = params["layers"]
             for kk in path:
                 node = node[kk]
             for i in range(c.num_layers):
                 arr = np.asarray(node[i])
-                yield tmpl.format(i=i), (_t(arr) if tr else arr)
+                yield tmpl.format(i=i), (inv(arr) if inv else arr)

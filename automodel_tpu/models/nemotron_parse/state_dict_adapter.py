@@ -163,20 +163,26 @@ class NemotronParseStateDictAdapter:
             else self._default_backbone_shapes()
         )
         paths = list(self._backbone_paths(skeleton))
-        loaded, missing = {}, []
+        n_loaded, missing = 0, []
+        # loaded leaves stream through immediately (no buffering — the
+        # stand-in ViT is ~GBs); a PARTIAL match raises after the loop,
+        # aborting the consumer's assembly before any forward can run
         for path, key in paths:
             try:
-                loaded[path] = get_tensor(key)
+                t = get_tensor(key)
             except KeyError:
                 missing.append(key)
-        if missing and loaded:
+                continue
+            n_loaded += 1
+            yield (("vision", "backbone", *path), t)
+        if missing and n_loaded:
             # a checkpoint that matches the in-tree layout for SOME leaves is
             # a broken/renamed checkpoint, not a hub-RADIO one — mixing its
             # weights with fixed-seed init would produce silently-garbage
             # vision features
             raise KeyError(
                 f"checkpoint matches the in-tree backbone layout for "
-                f"{len(loaded)}/{len(paths)} leaves but is missing "
+                f"{n_loaded}/{len(paths)} leaves but is missing "
                 f"{missing[:5]}{'…' if len(missing) > 5 else ''} — refusing "
                 f"to mix loaded weights with stand-in init"
             )
@@ -193,9 +199,6 @@ class NemotronParseStateDictAdapter:
                 for k in path:
                     node = node[k]
                 yield (("vision", "backbone", *path), np.asarray(node))
-        else:
-            for path, _ in paths:
-                yield (("vision", "backbone", *path), loaded[path])
 
     def from_hf(
         self, get_tensor: Callable[[str], np.ndarray], backbone_init: Any = None

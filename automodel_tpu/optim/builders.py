@@ -20,13 +20,75 @@ from __future__ import annotations
 
 from typing import Any, Sequence
 
+import jax
+import jax.numpy as jnp
 import optax
 
 from automodel_tpu.optim.scheduler import build_lr_schedule
 
+
+def scale_by_adam_fp32_moments(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
+) -> optax.GradientTransformation:
+    """optax adam scaling with moments INITIALIZED (hence kept) in fp32.
+
+    optax's scale_by_adam inits mu/nu in the param dtype and its update
+    inherits the wider of (moment, grad) dtypes. With bf16 params AND bf16
+    grads (the single-microbatch fast path, training/train_step.py) the
+    moments would stay bf16, where the (1-b2)·g² increment rounds below
+    nu's half-ulp and the second moment freezes. fp32-initialized moments
+    promote every update to fp32 (torch AdamW parity) while reusing
+    optax's update expression verbatim — XLA fuses that formulation into
+    the donated moment buffers without materializing full-size fp32 grad
+    intermediates (hand-rolled variants measured +2-3GB of HLO temps on
+    the MoE bench's stacked expert grads)."""
+    base = optax.scale_by_adam(b1=b1, b2=b2, eps=eps)
+
+    def init(params):
+        s = base.init(params)
+        f32 = lambda t: jax.tree.map(
+            lambda x: x.astype(jnp.float32) if jnp.issubdtype(
+                x.dtype, jnp.floating
+            ) else x, t
+        )
+        return s._replace(mu=f32(s.mu), nu=f32(s.nu))
+
+    return optax.GradientTransformation(init, base.update)
+
+
+def global_norm_fp32(tree: Any) -> jnp.ndarray:
+    """Global L2 norm with fp32 accumulation regardless of leaf dtype —
+    bf16 partial sums saturate after a few hundred equal-magnitude terms.
+    The convert fuses into the reduction (no materialized fp32 copies).
+    Shared by the grad-norm metric (training/train_step.py) and the clip."""
+    return jnp.sqrt(
+        sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree.leaves(tree)
+        )
+    )
+
+
+def clip_by_global_norm_fp32(max_norm: float) -> optax.GradientTransformation:
+    """Global-norm clip built on global_norm_fp32 — optax's own
+    clip_by_global_norm sums squares in the LEAF dtype."""
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+        norm = global_norm_fp32(updates)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+        return jax.tree.map(lambda g: (g * scale.astype(g.dtype)), updates), state
+
+    return optax.GradientTransformation(init, update)
+
+
 _SCALERS = {
-    "adamw": lambda betas, eps: optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps),
-    "adam": lambda betas, eps: optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps),
+    "adamw": None,  # dispatched on moments_dtype in build_optimizer
+    "adam": None,
     "lion": lambda betas, eps: optax.scale_by_lion(b1=betas[0], b2=betas[1]),
     "sgd": lambda betas, eps: optax.trace(decay=betas[0]),
     "adafactor": None,  # handled specially
@@ -41,8 +103,15 @@ def build_optimizer(
     eps: float = 1e-8,
     grad_clip_norm: float | None = None,
     lr_schedule: Any | None = None,
+    moments_dtype: str | None = None,
     **sched_kwargs: Any,
 ) -> optax.GradientTransformation:
+    """``moments_dtype``: None/'float32' (default) keeps Adam moments fp32
+    regardless of grad dtype (torch AdamW parity — bf16 moments freeze nu,
+    see scale_by_adam_fp32_moments). 'param' stores them in the param/grad
+    dtype — HALVES optimizer memory; meant for memory-capacity-bound
+    benchmarking (bench.py documents this concession), not long training
+    runs."""
     # YAML 1.1 parses dotless scientific notation (`lr: 1e-2`) as a string;
     # coerce here so config-file values behave like `1.0e-2`
     lr, weight_decay, eps = float(lr), float(weight_decay), float(eps)
@@ -56,7 +125,7 @@ def build_optimizer(
     )
     parts: list[optax.GradientTransformation] = []
     if grad_clip_norm:
-        parts.append(optax.clip_by_global_norm(grad_clip_norm))
+        parts.append(clip_by_global_norm_fp32(grad_clip_norm))
     if name == "adafactor":
         parts.append(optax.adafactor(learning_rate=schedule, weight_decay_rate=weight_decay or None))
         return optax.chain(*parts)
@@ -76,7 +145,20 @@ def build_optimizer(
         return optax.chain(*parts)
     if name not in _SCALERS:
         raise ValueError(f"Unknown optimizer {name!r}; available: {sorted(_SCALERS)}")
-    parts.append(_SCALERS[name](tuple(betas), eps))
+    if name in ("adamw", "adam"):
+        if moments_dtype in (None, "float32"):
+            parts.append(
+                scale_by_adam_fp32_moments(b1=betas[0], b2=betas[1], eps=eps)
+            )
+        elif moments_dtype == "param":
+            parts.append(optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps))
+        else:
+            raise ValueError(
+                f"moments_dtype must be None, 'float32' or 'param'; got "
+                f"{moments_dtype!r}"
+            )
+    else:
+        parts.append(_SCALERS[name](tuple(betas), eps))
     if weight_decay and name in ("adamw", "lion"):
         parts.append(optax.add_decayed_weights(weight_decay))
     parts.append(optax.scale_by_learning_rate(schedule))

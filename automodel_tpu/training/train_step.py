@@ -91,28 +91,52 @@ def build_train_step(
         return val, grads
 
     def step_fn(state: TrainState, batch: dict, bound=None) -> tuple[TrainState, dict]:
-        grads0 = jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params)
-        carry0 = (grads0, jnp.float32(0.0), jnp.int32(0))
-
-        def body(carry, mb_and_i):
-            mb, mb_i = mb_and_i
-            g_acc, l_acc, n_acc = carry
-            (loss_sum, (n, extras)), grads = mb_value_and_grad(
-                state.params, mb, bound, state.step, mb_i
-            )
-            g_acc = jax.tree.map(
-                lambda a, g: a + g.astype(jnp.float32), g_acc, grads
-            )
-            return (g_acc, l_acc + loss_sum, n_acc + n), extras
-
         n_mb = jax.tree.leaves(batch)[0].shape[0]
-        (grads, loss_sum, n_tokens), extras_stacked = jax.lax.scan(
-            body, carry0, (batch, jnp.arange(n_mb, dtype=jnp.int32))
-        )
-        extras_sum = jax.tree.map(lambda x: x.sum(axis=0), extras_stacked)
+        if n_mb == 1:
+            # no-accumulation fast path: the fp32 zeros+add accumulator would
+            # DOUBLE every grad buffer (bf16→fp32) and drag ~3 full-size
+            # layout copies through global-norm/scale (measured 2.5GB each on
+            # the MoE bench fingerprint's stacked expert grads). Grads stay in
+            # param dtype; moment fp32-ness is the OPTIMIZER's contract
+            # (optim/builders.scale_by_adam_fp32_moments — optax's own adam
+            # would inherit bf16 from these grads and freeze nu).
+            mb = jax.tree.map(lambda x: x[0], batch)
+            (loss_sum, (n_tokens, extras)), grads = mb_value_and_grad(
+                state.params, mb, bound, state.step,
+                jnp.int32(0),
+            )
+            extras_sum = extras
+        else:
+            grads0 = jax.tree.map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params
+            )
+            carry0 = (grads0, jnp.float32(0.0), jnp.int32(0))
+
+            def body(carry, mb_and_i):
+                mb, mb_i = mb_and_i
+                g_acc, l_acc, n_acc = carry
+                (loss_sum, (n, extras)), grads = mb_value_and_grad(
+                    state.params, mb, bound, state.step, mb_i
+                )
+                g_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), g_acc, grads
+                )
+                return (g_acc, l_acc + loss_sum, n_acc + n), extras
+
+            (grads, loss_sum, n_tokens), extras_stacked = jax.lax.scan(
+                body, carry0, (batch, jnp.arange(n_mb, dtype=jnp.int32))
+            )
+            extras_sum = jax.tree.map(lambda x: x.sum(axis=0), extras_stacked)
         denom = jnp.maximum(n_tokens, 1).astype(jnp.float32)
-        grads = jax.tree.map(lambda g: g / denom, grads)
-        grad_norm = optax.global_norm(grads)
+        # divide in fp32 even for bf16 grads (a bf16-rounded token count is
+        # off by up to 0.4%); the convert/divide/convert fuses — no
+        # materialized fp32 copy
+        grads = jax.tree.map(
+            lambda g: (g.astype(jnp.float32) / denom).astype(g.dtype), grads
+        )
+        from automodel_tpu.optim.builders import global_norm_fp32
+
+        grad_norm = global_norm_fp32(grads)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         # keep params in their original dtype (apply_updates may upcast)

@@ -49,7 +49,10 @@ def test_logits_parity_with_hf():
 
     hf_cfg, hf_model = _hf_tiny()
     cfg = GptOssConfig.from_hf(hf_cfg)
-    assert cfg.moe.interleaved_gate_up and cfg.moe.expert_mlp_bias
+    # the ADAPTER de-interleaves HF's [g0,u0,g1,u1,…] at the checkpoint
+    # boundary; natively the halves are contiguous (hot path never strided-
+    # slices the stacked expert tensor — see state_dict_adapter._deint)
+    assert not cfg.moe.interleaved_gate_up and cfg.moe.expert_mlp_bias
     assert cfg.moe.router_linear_bias and not cfg.moe.softmax_before_topk
     assert cfg.layer_types == ("sliding_attention", "full_attention")
     cfg = dataclasses.replace(cfg, moe=dataclasses.replace(cfg.moe, capacity_factor=8.0))

@@ -151,8 +151,11 @@ def test_gpt_oss_adapter_loads_mxfp4_checkpoint(tmp_path):
     r = HFCheckpointReader(tmp_path)
     loaded = adapter.from_hf(r.get_tensor)
     r.close()
+    from automodel_tpu.models.gpt_oss.state_dict_adapter import _deint
+
     gate_up = np.asarray(loaded["layers"]["moe"]["experts"]["gate_up"], np.float32)
-    ref = originals["model.layers.0.mlp.experts.gate_up_proj"]
+    # the adapter de-interleaves HF's gate_up at the boundary
+    ref = _deint(originals["model.layers.0.mlp.experts.gate_up_proj"])
     assert gate_up.shape[1:] == ref.shape  # [L=1, ...] stacking on top
     scale = max(np.abs(ref).max(), 1e-6)
     assert np.max(np.abs(gate_up[0] - ref)) / scale < 0.2

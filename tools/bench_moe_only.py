@@ -33,14 +33,7 @@ def main() -> None:
     results = {}
     for experts in candidates:
         try:
-            backend = {
-                "attn": "flash",
-                "param_dtype": "bfloat16",
-                "compute_dtype": "bfloat16",
-                "remat": "full_save_dispatch" if experts == "ragged_fused" else "full",
-                "fake_balanced_gate": True,
-                "experts": experts,
-            }
+            backend = bench._moe_backend(experts)
             tps, fpt = bench._run(
                 bench._moe_hf(), backend,
                 int(os.environ.get("BENCH_MOE_BATCH", 4)), seq, 8, ctx,

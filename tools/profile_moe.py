@@ -2,10 +2,11 @@
 combine, attention, and the rest — on the real chip at bench shapes.
 
 Round 5: shapes track the CURRENT bench fingerprint (bench.py _moe_hf — the
-GPT-OSS-style model: D=1024, per-expert I=1024, E=32 top-4, swiglu_oai with
+GPT-OSS-style model: D=I=1536 per expert, E=32 top-4, swiglu_oai with
 interleaved gate_up + expert biases, head_dim 64), and the fused expert MLP
 (`ragged_fused`) is profiled head-to-head against the two-gmm `ragged` path,
-with and without biases.
+with and without biases. Edit the D/I/E constants below if the bench
+fingerprint moves again — the written artifact names the shapes it measured.
 
 Each stage is timed as a jitted `lax.scan` loop whose op inputs DEPEND ON THE
 CARRY (else XLA's while-loop LICM hoists the op out and the timing is a lie)
@@ -29,8 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 
 # bench fingerprint (bench.py _moe_hf, BENCH_MOE_BATCH=4, seq=4096)
-D = 1024
-I = 1024  # per-expert intermediate (gpt-oss layout)
+D = 1536
+I = 1536  # per-expert intermediate (gpt-oss layout, I=D)
 E = 32
 K = 4
 T = 4 * 4096  # tokens per step
@@ -180,9 +181,12 @@ def main():
     from automodel_tpu.moe.gate import GateOutput
     from automodel_tpu.moe.layer import make_act2
 
+    # interleaved_gate_up=False matches production: the gpt-oss adapter
+    # de-interleaves at the checkpoint boundary, so the hot path splits
+    # contiguous halves (strided ::2 splits leak relayout copies)
     cfg = MoEConfig(
         num_experts=E, num_experts_per_tok=K, moe_intermediate_size=I,
-        activation="swiglu_oai", interleaved_gate_up=True,
+        activation="swiglu_oai", interleaved_gate_up=False,
     )
     act2 = make_act2(cfg, jax.nn.silu)
 
