@@ -390,7 +390,7 @@ def main() -> None:
         try:
             backend = _moe_backend(experts)
             tps, fpt = _run(
-                _moe_hf(), backend, int(os.environ.get("BENCH_MOE_BATCH", 4)),
+                _moe_hf(), backend, int(os.environ.get("BENCH_MOE_BATCH", 6)),
                 seq, steps, ctx,
             )
             mfu = calculate_mfu(tps, fpt, peak)

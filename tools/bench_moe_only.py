@@ -36,7 +36,7 @@ def main() -> None:
             backend = bench._moe_backend(experts)
             tps, fpt = bench._run(
                 bench._moe_hf(), backend,
-                int(os.environ.get("BENCH_MOE_BATCH", 4)), seq, 8, ctx,
+                int(os.environ.get("BENCH_MOE_BATCH", 6)), seq, 8, ctx,
             )
             mfu = calculate_mfu(tps, fpt, peak)
             results[experts] = {
