@@ -99,13 +99,14 @@ def _pick_tiles(k: int, n: int, itemsize: int) -> tuple[int, int]:
     return 128, 128
 
 
-def _gmm_kernel(wg, wt, ws, we, lhs_ref, rhs_ref, out_ref, *, tm, tn):
+def _gmm_kernel(wg, wt, ws, we, lhs_ref, rhs_ref, out_ref, *, tm, tn,
+                transpose_rhs=False):
     w = pl.program_id(1)
     t = wt[w]
     acc = jax.lax.dot_general(
         lhs_ref[...],
         rhs_ref[0],
-        (((1,), (0,)), ((), ())),
+        (((1,), (1,) if transpose_rhs else (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     rows = t * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, tn), 0)
@@ -119,30 +120,48 @@ def _gmm_kernel(wg, wt, ws, we, lhs_ref, rhs_ref, out_ref, *, tm, tn):
 
 
 def _gmm(lhs: jnp.ndarray, rhs: jnp.ndarray, group_sizes: jnp.ndarray,
-         interpret: bool = False) -> jnp.ndarray:
-    """lhs [M, K] (rows sorted by group) @ rhs [G, K, N] → [M, N]."""
+         interpret: bool = False, transpose_rhs: bool = False) -> jnp.ndarray:
+    """lhs [M, K] (rows sorted by group) @ rhs [G, K, N] → [M, N].
+
+    ``transpose_rhs``: rhs is [G, N, K] and contracts on its LAST dim —
+    the backward's dlhs = dout @ W^T without materializing a transposed
+    copy of the stacked weights (rhs.swapaxes(1, 2) costs a full relayout
+    write per call)."""
     M, K = lhs.shape
-    G, _, N = rhs.shape
+    if transpose_rhs:
+        G, N, _ = rhs.shape
+    else:
+        G, _, N = rhs.shape
     out_dtype = lhs.dtype
     tm, tn = _pick_tiles(_round_up(K, 128), _round_up(N, 128), lhs.dtype.itemsize)
     Mp, Kp, Np = _round_up(M, tm), _round_up(K, 128), _round_up(N, tn)
     if (Mp, Kp) != (M, K):
         lhs = jnp.pad(lhs, ((0, Mp - M), (0, Kp - K)))
-    if (Kp, Np) != (K, N):
+    if transpose_rhs:
+        if (Kp, Np) != (K, N):
+            rhs = jnp.pad(rhs, ((0, 0), (0, Np - N), (0, Kp - K)))
+    elif (Kp, Np) != (K, N):
         rhs = jnp.pad(rhs, ((0, 0), (0, Kp - K), (0, Np - N)))
 
     wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)
     W = Mp // tm + G
     grid = (Np // tn, W)
 
+    rhs_spec = (
+        pl.BlockSpec((1, tn, Kp), lambda n, w, wg, wt, ws, we: (wg[w], n, 0))
+        if transpose_rhs
+        else pl.BlockSpec((1, Kp, tn), lambda n, w, wg, wt, ws, we: (wg[w], 0, n))
+    )
     out = pl.pallas_call(
-        functools.partial(_gmm_kernel, tm=tm, tn=tn),
+        functools.partial(
+            _gmm_kernel, tm=tm, tn=tn, transpose_rhs=transpose_rhs
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((tm, Kp), lambda n, w, wg, wt, ws, we: (wt[w], 0)),
-                pl.BlockSpec((1, Kp, tn), lambda n, w, wg, wt, ws, we: (wg[w], 0, n)),
+                rhs_spec,
             ],
             out_specs=pl.BlockSpec((tm, tn), lambda n, w, wg, wt, ws, we: (wt[w], n)),
         ),
@@ -214,13 +233,18 @@ def _tgmm(lhs: jnp.ndarray, dout: jnp.ndarray, group_sizes: jnp.ndarray,
     return out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _grouped_matmul(lhs, rhs, group_sizes, interpret=False):
-    return _gmm(lhs, rhs, group_sizes, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _grouped_matmul(lhs, rhs, group_sizes, interpret=False, transpose_rhs=False):
+    return _gmm(lhs, rhs, group_sizes, interpret=interpret,
+                transpose_rhs=transpose_rhs)
 
 
-def _grouped_matmul_fwd(lhs, rhs, group_sizes, interpret):
-    return _gmm(lhs, rhs, group_sizes, interpret=interpret), (lhs, rhs, group_sizes)
+def _grouped_matmul_fwd(lhs, rhs, group_sizes, interpret, transpose_rhs):
+    return (
+        _gmm(lhs, rhs, group_sizes, interpret=interpret,
+             transpose_rhs=transpose_rhs),
+        (lhs, rhs, group_sizes),
+    )
 
 
 def _match_vma(ct, primal):
@@ -237,10 +261,17 @@ def _match_vma(ct, primal):
     return ct
 
 
-def _grouped_matmul_bwd(interpret, res, dout):
+def _grouped_matmul_bwd(interpret, transpose_rhs, res, dout):
     lhs, rhs, group_sizes = res
-    dlhs = _gmm(dout, rhs.swapaxes(1, 2), group_sizes, interpret=interpret)
-    drhs = _tgmm(lhs, dout, group_sizes, interpret=interpret)
+    # dlhs contracts rhs on the axis OPPOSITE the forward's — both cases run
+    # straight off the stored layout (no rhs.swapaxes materialization)
+    dlhs = _gmm(dout, rhs, group_sizes, interpret=interpret,
+                transpose_rhs=not transpose_rhs)
+    if transpose_rhs:
+        # y = lhs @ rhs^T → drhs[g, n, k] = Σ_m dout[m, n] · lhs[m, k]
+        drhs = _tgmm(dout, lhs, group_sizes, interpret=interpret)
+    else:
+        drhs = _tgmm(lhs, dout, group_sizes, interpret=interpret)
     return (
         _match_vma(dlhs.astype(lhs.dtype), lhs),
         _match_vma(drhs.astype(rhs.dtype), rhs),
@@ -258,6 +289,7 @@ def ragged_dot(
     *,
     interpret: bool | None = None,
     platform: str | None = None,
+    transpose_rhs: bool = False,
 ) -> jnp.ndarray:
     """Drop-in for `jax.lax.ragged_dot`: Pallas gmm on TPU (or under
     AUTOMODEL_GMM_INTERPRET=1 anywhere), XLA's ragged_dot elsewhere.
@@ -272,5 +304,7 @@ def ragged_dot(
     if interpret is None:
         interpret = _interpret_requested()
     if not (interpret or _pallas_eligible(platform)):
+        if transpose_rhs:
+            rhs = rhs.swapaxes(1, 2)
         return jax.lax.ragged_dot(lhs, rhs, group_sizes)
-    return _grouped_matmul(lhs, rhs, group_sizes, interpret)
+    return _grouped_matmul(lhs, rhs, group_sizes, interpret, transpose_rhs)
