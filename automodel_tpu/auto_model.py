@@ -169,7 +169,14 @@ def _maybe_pp(model: Any, mesh_ctx: Optional[MeshContext], backend: BackendConfi
         return model
     from automodel_tpu.parallel.pp import maybe_pipeline
 
-    return maybe_pipeline(model, mesh_ctx, backend.pp_microbatches)
+    mc = mesh_ctx.config
+    return maybe_pipeline(
+        model,
+        mesh_ctx,
+        backend.pp_microbatches,
+        schedule=getattr(mc, "pp_schedule", "gpipe"),
+        zb_queue=getattr(mc, "pp_zb_queue", None),
+    )
 
 
 def _np_dtype(name: str):

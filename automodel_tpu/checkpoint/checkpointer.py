@@ -91,9 +91,14 @@ class Checkpointer:
         hf_export: Any = None,  # (adapter, params) for consolidated HF save
         config_snapshot: dict | None = None,
         hf_meta: dict | None = None,  # {"hf_config": dict, "source_dir": str}
+        layout_markers: dict[str, str] | None = None,
     ) -> Path:
         out = self.step_dir(epoch, step)
         out.mkdir(parents=True, exist_ok=True)
+        if layout_markers:
+            extra_state = {
+                **(extra_state or {}), "_layout_markers": dict(layout_markers)
+            }
         # saving the same step twice (cadence save + end-of-loop save) is
         # idempotent: replace the previous state dir
         self.wait()  # at most one async save in flight
@@ -137,21 +142,64 @@ class Checkpointer:
             shutil.rmtree(p)
 
     # -- load ---------------------------------------------------------------
-    def load(self, abstract_state: Any, path: str | os.PathLike | None = None) -> tuple[Any, dict]:
+    def load(
+        self,
+        abstract_state: Any,
+        path: str | os.PathLike | None = None,
+        expected_layout_markers: dict[str, str] | None = None,
+    ) -> tuple[Any, dict]:
         """Restore (state, extra_state). `abstract_state` is a pytree of
         jax.ShapeDtypeStruct with shardings (from eval_shape + plan) so orbax
-        reshards onto the current mesh."""
+        reshards onto the current mesh.
+
+        ``expected_layout_markers``: the model's native-layout contract
+        (e.g. GptOssForCausalLM.native_layout_markers). Checked BEFORE the
+        array restore so a pre-flip checkpoint (interleaved gpt-oss gate_up)
+        fails loudly instead of loading params that silently mis-compute."""
         d = Path(path) if path else self.latest_dir()
         if d is None:
             raise FileNotFoundError(f"No checkpoint found under {self.root}")
-        with ocp.StandardCheckpointer() as ckptr:
-            state = ckptr.restore((d / "state").absolute(), abstract_state)
         extra_file = d / "extra_state.json"
         extra = json.loads(extra_file.read_text()) if extra_file.exists() else {}
+        check_layout_markers(
+            extra.get("_layout_markers"), expected_layout_markers, d
+        )
+        with ocp.StandardCheckpointer() as ckptr:
+            state = ckptr.restore((d / "state").absolute(), abstract_state)
         return state, extra
 
     def has_checkpoint(self) -> bool:
         return self.latest_dir() is not None
+
+
+def check_layout_markers(
+    found: dict | None, expected: dict[str, str] | None, ckpt_dir: Path
+) -> None:
+    """Fail loudly when a native checkpoint's on-disk param layout predates
+    the model's current contract. A checkpoint with NO marker for an
+    expected key is treated as pre-versioning (e.g. gpt-oss gate_up saved
+    interleaved before the contiguous flip) — loading it would not error
+    anywhere, just silently mis-compute."""
+    if not expected:
+        return
+    found = found or {}
+    problems = []
+    for key, want in expected.items():
+        got = found.get(key)
+        if got is None:
+            problems.append(
+                f"{key}: checkpoint has no layout marker (pre-versioning "
+                f"save); current code expects {want!r}"
+            )
+        elif got != want:
+            problems.append(f"{key}: checkpoint has {got!r}, code expects {want!r}")
+    if problems:
+        raise ValueError(
+            f"native checkpoint {ckpt_dir} was saved under an incompatible "
+            "param layout — re-export it through the HF path (to_hf/from_hf "
+            "applies the layout transforms) instead of loading it natively:\n  "
+            + "\n  ".join(problems)
+        )
 
 
 def _json_default(o: Any):

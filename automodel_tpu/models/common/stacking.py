@@ -42,11 +42,23 @@ def remat_wrap(f: Callable, remat: str) -> Callable:
     return f
 
 
+# cap for the aperiodic P == num_layers fallback in _flag_period: beyond
+# this the "group" is a full unroll of the stack and compile time grows
+# linearly in depth, which the traced-flag cond path avoids
+_FULL_UNROLL_MAX = 16
+
+
 def _flag_period(flags: dict, num_layers: int) -> Optional[int]:
     """Smallest P dividing num_layers such that every flag repeats with
     period P (gpt-oss sliding/full alternation → 2, gemma-3 local:global
-    → 6, uniform flags → 1). None when the flags have no short repeating
-    pattern, or any flag is not one scalar per layer."""
+    → 6, uniform flags → 1). When no short period exists, P == num_layers
+    (which always matches) is tried too — 2-layer alternations and
+    non-divisible sliding/full patterns then still get the static-flag
+    grouped scan instead of the ~6ms/layer traced-flag `lax.cond` path —
+    but only up to _FULL_UNROLL_MAX layers: the P=L group is a full unroll
+    (one scan step tracing L layer bodies), so deep aperiodic stacks keep
+    the cond path to bound compile time/executable size. None when a flag
+    is not one scalar per layer or no eligible period exists."""
     import numpy as np
 
     if not flags:
@@ -54,7 +66,10 @@ def _flag_period(flags: dict, num_layers: int) -> Optional[int]:
     vals = list(flags.values())
     if any(np.ndim(v) != 1 or len(v) != num_layers for v in vals):
         return None
-    for P in range(1, num_layers // 2 + 1):
+    cands = list(range(1, num_layers // 2 + 1))
+    if num_layers <= _FULL_UNROLL_MAX:
+        cands.append(num_layers)
+    for P in cands:
         if num_layers % P:
             continue
         if all(np.array_equal(np.tile(v[:P], num_layers // P), v) for v in vals):

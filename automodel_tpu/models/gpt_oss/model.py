@@ -221,6 +221,15 @@ class GptOssForCausalLM:
     # side; expert weights (moe paths) stay on the merged fallback
     lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel",)
 
+    # Native-checkpoint layout contract, versioned. gate_up flipped from
+    # HF's interleaved [g0,u0,…] to contiguous [g…|u…] at the adapter
+    # boundary (state_dict_adapter._deint) — a native checkpoint written
+    # before the flip holds interleaved expert weights that would silently
+    # mis-compute every expert MLP. The checkpointer stamps these markers on
+    # save and refuses a native restore whose metadata lacks or mismatches
+    # them (checkpoint/checkpointer.py check_layout_markers).
+    native_layout_markers = {"gpt_oss_gate_up": "contiguous_v1"}
+
     def init(self, key: jax.Array) -> dict:
         return init_params(self.config, self.backend, key)
 

@@ -108,7 +108,17 @@ def _maybe_nf4(kernel):
 def _proj(x: jnp.ndarray, p: dict, fp8: bool = False) -> jnp.ndarray:
     from automodel_tpu.ops import fp8 as _fp8
 
-    y = _fp8.maybe_fp8_dot(x, _maybe_nf4(p["kernel"]), fp8)
+    if "zb_tap" in p:
+        # zero-bubble pipeline B-pass (parallel/zero_bubble.py): the grafted
+        # tap pair routes this projection through the B/W-split matmul —
+        # backward computes dx only and exports (x, dy) for the deferred
+        # weight-grad contraction. Grafting is gated off fp8/NF4/LoRA sites.
+        from automodel_tpu.parallel.zero_bubble import split_dot
+
+        xtap, ytap = p["zb_tap"]
+        y = split_dot(xtap.ndim == x.ndim, x, p["kernel"], xtap, ytap)
+    else:
+        y = _fp8.maybe_fp8_dot(x, _maybe_nf4(p["kernel"]), fp8)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     if "lora_A" in p:

@@ -433,7 +433,9 @@ def a2a_experts(
     # carry mixed vma (jax limitation), and custom-VJP cotangent psums are
     # then placed by the spec-based shard_map transpose. The in-kernel
     # _match_vma/_out_sds plumbing stays for vma-checked callers (pp).
-    return jax.shard_map(
+    from automodel_tpu.utils.compat import shard_map
+
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(tok_spec, tok_spec, tok_spec, {k: w_specs[k] for k in wd}),

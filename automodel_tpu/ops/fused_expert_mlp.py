@@ -25,6 +25,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from automodel_tpu.utils.compat import pallas_tpu_compiler_params
+
+_CompilerParams = pallas_tpu_compiler_params()
+
 from automodel_tpu.ops.grouped_matmul import (
     _interpret_requested,
     _pallas_eligible,
@@ -209,7 +213,7 @@ def _fwd(lhs, gate, up, down, group_sizes, gb, ub, db, act_kind, limit,
             scratch_shapes=[pltpu.VMEM((tm, Dp), jnp.float32)],
         ),
         out_shape=out_sds,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -337,7 +341,11 @@ def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
             bounds, jnp.arange(M, dtype=jnp.int32), side="right"
         )
         # rows past sum(group_sizes) (a2a sentinel tail) land on G → the
-        # zero one-hot row: their bias-grad contribution vanishes exactly
+        # zero one-hot row. The zero row alone is NOT enough: ragged_dot
+        # leaves tail rows of g/u (and a2a leaves tail cotangents)
+        # uninitialized, and 0·NaN = NaN would poison the seg_sum — mask the
+        # cotangent rows explicitly before the contraction.
+        valid = (row_g < G)[:, None]
         onehot = jax.nn.one_hot(row_g, G, dtype=lhs.dtype)  # [M, G]
     if gb is not None:
         g = g + gb.astype(g.dtype)[row_g]
@@ -358,6 +366,7 @@ def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
     dWu = _tgmm(lhs, du_, group_sizes, interpret=interpret)
 
     def seg_sum(ct):  # [M, N] → per-expert sums [G, N], fp32 accumulation
+        ct = jnp.where(valid, ct, 0)
         return jax.lax.dot_general(
             onehot, ct, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,

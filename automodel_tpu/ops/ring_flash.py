@@ -29,6 +29,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from automodel_tpu.utils.compat import pallas_tpu_compiler_params
+
+_CompilerParams = pallas_tpu_compiler_params()
+
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -235,7 +239,7 @@ def flash_block_fwd(q, k, v, q_pos, kv_pos, seg_q, seg_kv, *,
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, H), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -269,7 +273,7 @@ def flash_block_bwd(q, k, v, do, lse, delta, q_pos, kv_pos, seg_q, seg_kv, *,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((B * N, Sq, H), jnp.float32),
         scratch_shapes=[pltpu.VMEM((bq, H), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -295,7 +299,7 @@ def flash_block_bwd(q, k, v, do, lse, delta, q_pos, kv_pos, seg_q, seg_kv, *,
             pltpu.VMEM((bkv, H), jnp.float32),
             pltpu.VMEM((bkv, H), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,

@@ -99,6 +99,14 @@ class MeshConfig:
     pp: int = 1
     ep: int = 1
     dcn: Optional[dict] = None
+    # pipeline schedule (pp > 1): 'gpipe' = AD-transposed wavefront;
+    # 'zero_bubble' = B/W-split backward with deferred weight-grads
+    # (parallel/zero_bubble.py) — bubble 3(pp-1)/(4M+3(pp-1)) vs GPipe's
+    # (pp-1)/(M+pp-1). pp_zb_queue bounds the weight-grad deferral queue
+    # (microbatches of stash held live; None = defer all — max speedup,
+    # ~no-remat activation memory for one stage × M microbatches).
+    pp_schedule: str = "gpipe"
+    pp_zb_queue: Optional[int] = None
 
     def validate(self, world_size: int) -> "MeshConfig":
         cfg = dataclasses.replace(self)
@@ -119,6 +127,12 @@ class MeshConfig:
                 f"ep={cfg.ep} must divide dp_shard_total={cfg.dp_shard} "
                 f"(reference invariant ep_shard = dp*cp/ep, mesh_utils.py:179-187)"
             )
+        if cfg.pp_schedule not in ("gpipe", "zero_bubble"):
+            raise ValueError(
+                f"pp_schedule={cfg.pp_schedule!r} must be gpipe|zero_bubble"
+            )
+        if cfg.pp_zb_queue is not None and cfg.pp_zb_queue < 1:
+            raise ValueError(f"pp_zb_queue={cfg.pp_zb_queue} must be >= 1")
         return cfg
 
 

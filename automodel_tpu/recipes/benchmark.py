@@ -82,6 +82,21 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             "loss": float(jax.device_get(metrics["loss"])),
             "timers": timers.summary(),
         }
+        pinfo = getattr(self.model, "pipeline_info", None)
+        if pinfo:
+            from automodel_tpu.utils.flops_utils import pipeline_bubble_fraction
+
+            # analytic bubble for the active schedule; the measured
+            # counterpart needs a schedule-free work time (microbatch sweep
+            # or pp=1 leg) — tools/profile_pp.py produces both
+            result["pipeline"] = {
+                **pinfo,
+                "bubble_fraction_analytic": pipeline_bubble_fraction(
+                    pinfo["pp"], pinfo["n_microbatches"],
+                    pinfo.get("schedule", "gpipe"), pinfo.get("zb_queue"),
+                    pinfo.get("w_deferred_fraction", 1.0),
+                ),
+            }
         out_path = bcfg.get("output_json")
         if out_path:
             with open(out_path, "w") as f:

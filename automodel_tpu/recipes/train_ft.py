@@ -64,6 +64,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             k: dist.get(k, -1 if k == "dp_shard" else 1)
             for k in ("dp_replicate", "dp_shard", "tp", "cp", "pp", "ep")
         }
+        # pipeline schedule knobs ride MeshConfig (distributed.pp_schedule:
+        # gpipe|zero_bubble, distributed.pp_zb_queue: int|null)
+        mesh_degrees["pp_schedule"] = dist.get("pp_schedule", "gpipe")
+        mesh_degrees["pp_zb_queue"] = dist.get("pp_zb_queue", None)
         # distributed.platform pins the device platform — e.g. `cpu` to run
         # SPMD recipes on virtual host devices (the reference's gloo-backend
         # CPU test path, init_utils.py:136-140)
@@ -293,6 +297,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 "hf_config": self.auto.hf_config,
                 "source_dir": self.auto.source_dir,
             },
+            layout_markers=getattr(self.model, "native_layout_markers", None),
         )
         if self.peft_config is not None:
             from automodel_tpu.peft import export_hf_peft
@@ -320,7 +325,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             abstract,
             shardings,
         )
-        state, extra = self.checkpointer.load(abstract)
+        state, extra = self.checkpointer.load(
+            abstract,
+            expected_layout_markers=getattr(
+                self.model, "native_layout_markers", None
+            ),
+        )
         self.state = state
         if "dataloader" in extra:
             self.dataloader.load_state_dict(extra["dataloader"])

@@ -52,6 +52,16 @@ def build_train_step(
     are replaced by zeros immediately after value_and_grad — XLA dead-code-
     eliminates the backward compute that only produced them, and grad_norm
     reflects trainable params only (see training/freeze.py).
+
+    Pipeline-parallel loss_fns (parallel/pp.py wrappers): under
+    pp_schedule='zero_bubble' the per-stage VJP is split into B/W passes and
+    weight-grad (W) chunks land OUT of microbatch order, summed in fp32
+    inside the pipeline's custom_vjp (parallel/zero_bubble.py) — the
+    gradient value_and_grad returns here is only materialized once every W
+    chunk has landed, so the fp32 global-norm clip below never sees a
+    partial gradient. A loss_fn built over a pipelined model carries
+    ``pipeline_info`` and the metrics gain the analytic
+    ``pp_bubble_fraction`` for the active schedule.
     """
 
     # a loss_fn may carry frozen params (LoRA base) to pass as a REAL jit
@@ -153,6 +163,15 @@ def build_train_step(
         }
         if "moe_aux_loss" in extras_sum:
             metrics["moe_aux_loss"] = extras_sum["moe_aux_loss"] / batch_size(batch)
+        pinfo = getattr(loss_fn, "pipeline_info", None)
+        if pinfo:
+            from automodel_tpu.utils.flops_utils import pipeline_bubble_fraction
+
+            metrics["pp_bubble_fraction"] = pipeline_bubble_fraction(
+                pinfo["pp"], pinfo["n_microbatches"],
+                pinfo.get("schedule", "gpipe"), pinfo.get("zb_queue"),
+                pinfo.get("w_deferred_fraction", 1.0),
+            )
         if "expert_counts" in extras_sum:
             c = extras_sum["expert_counts"].astype(jnp.float32)  # [L, E]
             per_layer = c.max(axis=-1) / jnp.maximum(c.mean(axis=-1), 1.0)
@@ -260,5 +279,11 @@ def make_causal_lm_loss(
             "expert_counts": maux.expert_counts,
         }
         return loss_sum, n, extras
+
+    # pipelined models advertise their schedule so the step metrics (and the
+    # benchmark recipe) can report bubble fraction per schedule
+    info = getattr(model, "pipeline_info", None)
+    if info:
+        loss_fn.pipeline_info = info
 
     return loss_fn

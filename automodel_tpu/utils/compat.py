@@ -1,0 +1,86 @@
+"""JAX version shims.
+
+The codebase targets the current `jax.shard_map` API (top-level export,
+``axis_names=`` for partial-manual regions, ``check_vma=``). Older jaxlibs
+(0.4.x, the floor this image may pin) only ship
+`jax.experimental.shard_map.shard_map` with the pre-rename spelling
+(``auto=`` complement set, ``check_rep=``). This wrapper translates so
+every shard_map call site works on both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+try:  # jax >= 0.6: top-level export, axis_names/check_vma spelling
+    from jax import shard_map as _shard_map_new
+
+    _NEW_API = True
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    _NEW_API = False
+
+
+def shard_map(
+    f: Callable,
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    axis_names: Optional[set] = None,
+    check_vma: Optional[bool] = None,
+):
+    """`jax.shard_map` with the new-API spelling on any supported jax.
+
+    ``axis_names``: mesh axes the region is MANUAL over (None = all);
+    translated to the old API's ``auto`` complement. ``check_vma``
+    translates to ``check_rep``.
+    """
+    kw: dict = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if _NEW_API:
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return _shard_map_new(f, **kw)
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        # 0.4.x partial-auto lowering emits PartitionId ops GSPMD refuses;
+        # when every auto axis is trivial (size 1) the region is manual in
+        # all but name — drop `auto` and run fully manual, which old jax
+        # handles. Genuine partial-auto (a >1 auto axis) keeps the `auto`
+        # set: it may fail to compile on 0.4.x exactly as it did before
+        # this shim, and works on current jax.
+        if any(mesh.shape[a] > 1 for a in auto):
+            kw["auto"] = auto
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    return _shard_map_old(f, **kw)
+
+
+def pallas_tpu_compiler_params():
+    """The pallas-TPU compiler-params dataclass under its current name
+    (`CompilerParams`), falling back to the pre-0.6 `TPUCompilerParams`.
+    Raises once, clearly, if neither exists."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(
+        pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+    )
+    if cls is None:
+        raise ImportError(
+            "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+            "TPUCompilerParams — unsupported jax version for Pallas kernels"
+        )
+    return cls
+
+
+def vma_of(x) -> Optional[frozenset]:
+    """``jax.typeof(x).vma`` where available; None on jax versions without
+    `jax.typeof` / varying-manual-axes tracking (0.4.x — whose shard_map
+    does not check vma, so "unknown" is the correct answer there)."""
+    typeof = getattr(__import__("jax"), "typeof", None)
+    if typeof is None:
+        return None
+    return getattr(typeof(x), "vma", None)

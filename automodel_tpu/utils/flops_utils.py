@@ -164,6 +164,73 @@ def flops_per_token_for_config(cfg: Any, seq_len: int) -> float:
     )
 
 
+def gpipe_bubble_fraction(pp: int, n_microbatches: int) -> float:
+    """The GPipe-wavefront bubble law (S−1)/(m+S−1): fraction of a step a
+    rank spends idle under the AD-transposed schedule (parallel/pp.py;
+    measured to ±5%, PROFILE_PP_r04.md)."""
+    if pp <= 1:
+        return 0.0
+    return (pp - 1) / (n_microbatches + pp - 1)
+
+
+def zero_bubble_fraction(
+    pp: int,
+    n_microbatches: int,
+    zb_queue: Optional[int] = None,
+    w_deferred_fraction: float = 1.0,
+) -> float:
+    """Analytic bubble for the B/W-split schedule (parallel/zero_bubble.py).
+
+    Cost model in forward-units F: fwd tick = 1; B tick = 2 (per-tick stage
+    recompute + activation-grad matmuls, the remat-equivalent memory bound);
+    deferred W chunk = 1. Full deferral runs (M+pp−1) fwd ticks + (M+pp−1)
+    B ticks + M flat bubble-free W chunks against 4M units of per-rank work:
+
+        bubble = 3(pp−1) / (4M + 3(pp−1))  <  (pp−1)/(M+pp−1)  for all M.
+
+    A bounded queue (zb_queue = Q < M) puts a W contraction on EVERY B
+    tick (the ring pop executes uniformly under the synchronous-tick SPMD
+    program, popping zeros until the queue fills), so bounded B ticks cost
+    3 — the combined-schedule cost — and Q chunks remain for the flat
+    flush. The bound is therefore a MEMORY escape hatch, not a speedup:
+    it lands at (or a flush-tail sliver above) the GPipe law while capping
+    stash memory at Q chunks; only full deferral realizes the bubble win.
+
+    ``w_deferred_fraction`` (d): the share of W work actually deferred —
+    1.0 for dense stages (all seven projections tapped); the MoE pipeline
+    defers only the ATTENTION projections (expert/router dW stays on the B
+    tick), so its d is the attention share of per-layer weight-grad FLOPs
+    and the B tick costs 2 + (1-d). d → 0 recovers the GPipe law exactly.
+    """
+    if pp <= 1:
+        return 0.0
+    m = n_microbatches
+    d = min(max(float(w_deferred_fraction), 0.0), 1.0)
+    q = m if zb_queue is None else max(1, min(int(zb_queue), m))
+    work = 4.0 * m
+    if q >= m:  # full deferral: B wave at (3-d)/tick + flat flush of d·M
+        total = (4.0 - d) * (m + pp - 1) + d * m
+    else:  # bounded ring: combined-cost ticks + flat flush of Q live slots
+        total = 4.0 * (m + pp - 1) + q * d
+    return max(0.0, 1.0 - work / total)
+
+
+def pipeline_bubble_fraction(
+    pp: int,
+    n_microbatches: int,
+    schedule: str = "gpipe",
+    zb_queue: Optional[int] = None,
+    w_deferred_fraction: float = 1.0,
+) -> float:
+    """Dispatch on MeshConfig.pp_schedule — used by the train step's
+    pp_bubble_fraction metric and the benchmark recipe."""
+    if schedule == "zero_bubble":
+        return zero_bubble_fraction(
+            pp, n_microbatches, zb_queue, w_deferred_fraction
+        )
+    return gpipe_bubble_fraction(pp, n_microbatches)
+
+
 def calculate_mfu(
     tokens_per_second_per_chip: float,
     flops_per_token: float,

@@ -107,6 +107,25 @@ def _moe_backend(experts: str) -> dict:
     }
 
 
+def _reset_between_legs() -> None:
+    """Leg isolation: BENCH_r05 recorded every leg as 0.0 after cascading
+    OOMs — a failed leg's params/opt-state/compiled executables stayed
+    resident and starved every later leg. Deleting live buffers (not just
+    dropping python references — deletion returns HBM immediately instead
+    of waiting on GC) and clearing the jit/compile caches puts each leg
+    back to a cold chip."""
+    import gc
+
+    gc.collect()
+    for arr in jax.live_arrays():
+        try:
+            arr.delete()
+        except Exception:
+            pass  # already deleted / donated
+    jax.clear_caches()
+    gc.collect()
+
+
 def _is_oom(exc: Exception) -> bool:
     s = str(exc)
     return (
@@ -322,6 +341,7 @@ def main() -> None:
 
     # ---- dense LoRA (headline) — largest shape that fits ----
     dense_mfu, dense_label, dense_tflops = float("nan"), "none", 0.0
+    dense_failures: list[str] = []
     for shape in DENSE_SHAPES:
         label = shape[0]
         try:
@@ -347,10 +367,14 @@ def main() -> None:
         except Exception as exc:  # OOM → next smaller shape
             if not _is_oom(exc):
                 raise
+            dense_failures.append(f"{label}: OOM")
             print(f"[bench] dense-{label} OOM; trying smaller", file=sys.stderr, flush=True)
+            _reset_between_legs()
+    _reset_between_legs()
 
     # ---- true-8B QLoRA (VERDICT r3 #2): NF4 base ~4.5GB fits the chip ----
     qlora_mfu, qlora_tflops = float("nan"), 0.0
+    qlora_failure = None
     try:
         backend = {
             "attn": "flash",
@@ -371,7 +395,9 @@ def main() -> None:
             file=sys.stderr, flush=True,
         )
     except Exception as exc:
+        qlora_failure = f"OOM: {exc}" if _is_oom(exc) else str(exc)
         print(f"[bench] 8b QLoRA leg failed: {exc}", file=sys.stderr, flush=True)
+    _reset_between_legs()
 
     # ---- MoE pretrain (fake balanced gate, reference bench conditions) ----
     # single-chip backend choice (measured on the v5e): ragged via the Pallas
@@ -386,6 +412,7 @@ def main() -> None:
     pinned = os.environ.get("BENCH_MOE_EXPERTS")
     candidates = [pinned] if pinned else ["ragged_fused", "ragged"]
     moe_tried = {}
+    moe_failures: dict[str, str] = {}
     for experts in candidates:
         try:
             backend = _moe_backend(experts)
@@ -403,21 +430,32 @@ def main() -> None:
             if moe_mfu != moe_mfu or mfu > moe_mfu:
                 moe_mfu, moe_tflops, moe_backend = mfu, tps * fpt / 1e12, experts
         except Exception as exc:
+            moe_failures[experts] = f"OOM: {exc}" if _is_oom(exc) else str(exc)
             print(
                 f"[bench] moe[{experts}] leg failed: {exc}",
                 file=sys.stderr, flush=True,
             )
+        _reset_between_legs()
 
-    if dense_mfu != dense_mfu:  # every shape OOMed — emit a valid JSON line
-        dense_mfu = 0.0
+    # every dense shape OOMed → value null + reason, NOT 0.0: a 0.0 in the
+    # emitted JSON must mean "measured and got zero", never "leg never ran"
+    # (BENCH_r05 shipped all-zero legs that read as measurements)
+    dense_ok = dense_mfu == dense_mfu
+    dense_failure = (
+        None if dense_ok
+        else "every dense shape OOMed: " + "; ".join(dense_failures)
+    )
     print(
         json.dumps(
             {
                 "metric": f"llama_dense_lora_mfu_{dense_label}",
-                "value": round(dense_mfu * 100, 2),
+                "value": round(dense_mfu * 100, 2) if dense_ok else None,
                 "unit": "%MFU",
-                "vs_baseline": round(dense_mfu / DENSE_BASELINE_MFU, 3),
-                "dense_tflops_per_chip": round(dense_tflops, 1),
+                "vs_baseline": (
+                    round(dense_mfu / DENSE_BASELINE_MFU, 3) if dense_ok else None
+                ),
+                "dense_failure": dense_failure,
+                "dense_tflops_per_chip": round(dense_tflops, 1) if dense_ok else None,
                 "qlora_8b_mfu_pct": (
                     round(qlora_mfu * 100, 2) if qlora_mfu == qlora_mfu else None
                 ),
@@ -428,13 +466,17 @@ def main() -> None:
                 "qlora_8b_tflops_per_chip": (
                     round(qlora_tflops, 1) if qlora_mfu == qlora_mfu else None
                 ),
+                "qlora_8b_failure": qlora_failure,
                 "moe_mfu_pct": round(moe_mfu * 100, 2) if moe_mfu == moe_mfu else None,
                 "moe_vs_baseline": (
                     round(moe_mfu / MOE_BASELINE_MFU, 3) if moe_mfu == moe_mfu else None
                 ),
-                "moe_tflops_per_chip": round(moe_tflops, 1),
+                "moe_tflops_per_chip": (
+                    round(moe_tflops, 1) if moe_mfu == moe_mfu else None
+                ),
                 "moe_experts_backend": moe_backend,
                 "moe_mfu_pct_by_backend": moe_tried,
+                "moe_failures": moe_failures or None,
             }
         )
     )

@@ -151,3 +151,48 @@ def test_async_save_and_offline_consolidation(tmp_path, devices8):
     with torch.no_grad():
         out_t = hf_model(input_ids=torch.zeros((1, 4), dtype=torch.long)).logits
     assert out_t.shape == (1, 4, 64)
+
+
+def test_native_layout_marker_gates_restore(tmp_path):
+    """ADVICE r5: gpt-oss native checkpoints carry a versioned layout
+    marker (gate_up flipped interleaved→contiguous at the adapter
+    boundary). A restore against a checkpoint that predates the marker, or
+    carries a different layout version, must fail loudly instead of
+    silently mis-computing every expert MLP."""
+    import pytest
+
+    import jax.numpy as jnp
+
+    from automodel_tpu.checkpoint.checkpointer import Checkpointer, CheckpointingConfig
+    from automodel_tpu.models.gpt_oss.model import GptOssForCausalLM
+
+    markers = GptOssForCausalLM.native_layout_markers
+    assert markers == {"gpt_oss_gate_up": "contiguous_v1"}
+
+    state = {"w": jnp.arange(4.0)}
+    ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "run")))
+    out = ck.save(state, epoch=0, step=1, layout_markers=markers)
+    assert out.exists()
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state
+    )
+    # marker present and matching → loads
+    restored, extra = ck.load(abstract, expected_layout_markers=markers)
+    assert extra["_layout_markers"] == markers
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4.0))
+
+    # version mismatch → loud failure
+    with pytest.raises(ValueError, match="incompatible param layout"):
+        ck.load(
+            abstract,
+            expected_layout_markers={"gpt_oss_gate_up": "contiguous_v2"},
+        )
+
+    # pre-versioning checkpoint (no marker at all) → loud failure
+    ck2 = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "old")))
+    ck2.save(state, epoch=0, step=1)
+    with pytest.raises(ValueError, match="no layout marker"):
+        ck2.load(abstract, expected_layout_markers=markers)
+    # models without a layout contract load old checkpoints unchanged
+    restored2, _ = ck2.load(abstract)
+    np.testing.assert_array_equal(np.asarray(restored2["w"]), np.arange(4.0))

@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from automodel_tpu.utils.compat import shard_map
 from automodel_tpu.ops.attention import sdpa
 from automodel_tpu.parallel import cp as cpm
 
@@ -30,13 +31,13 @@ def _run_ring(mesh, q, k, v, seg, *, window, zigzag):
     )
     spec = P(None, "cp", None, None)
     if seg is not None:
-        mapped = jax.shard_map(
+        mapped = shard_map(
             lambda a, b, c, s: inner(a, b, c, segment_ids=s),
             mesh=mesh, in_specs=(spec, spec, spec, P(None, "cp")),
             out_specs=spec, check_vma=False,
         )
         return mapped, (q, k, v, seg)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         lambda a, b, c: inner(a, b, c),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
     )

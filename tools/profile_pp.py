@@ -1,21 +1,25 @@
-"""Measure the SPMD pipeline's bubble empirically (VERDICT r3 #6: retire the
-1F1B question with data, not essay).
+"""Measure the SPMD pipeline bubble per SCHEDULE (gpipe vs zero_bubble).
 
-Method: pp=4 over 4 REAL XLA devices (virtual CPU devices execute in
-parallel threads, so wall-clock sees the schedule), a compute-heavy dense
-stack, FIXED global batch, microbatch count M swept. Theory for the
-GPipe wavefront (fwd + AD-transposed bwd, globally synchronous ticks):
+Round 4 (PROFILE_PP_r04.md) established that the AD-transposed GPipe
+wavefront sits on the (pp-1)/(M+pp-1) law to within 5% and recorded
+zero-bubble B/W splitting as the remaining schedule-level headroom. This
+round implements it (parallel/zero_bubble.py); this tool measures both
+schedules over a microbatch sweep and writes PROFILE_PP_r06.md.
 
-    t(M) = T_work · (1 + (pp-1)/M)        [bubble = (pp-1)/(M+pp-1)]
+Method: pp stages over real XLA host devices (one per core so wall-clock
+sees the schedule — with fewer cores than ranks the OS time-slices idle
+ranks away and the bubble becomes invisible), fixed global batch, M swept.
+T_work/overhead are fit from the gpipe leg exactly as in r04:
 
-A least-squares fit of t against (1 + (pp-1)/M) separates T_work from
-per-tick overhead; the residual trend vs theory IS the measured idle gap.
-1F1B has the SAME bubble term — its payoff is capping in-flight microbatch
-memory at pp (here provided by remat over the tick body); interleaved
-virtual stages shrink the bubble to (pp-1)/(v·M) at the cost of v× more
-ppermute hops. Writes PROFILE_PP_r04.md.
+    t_gpipe(M) = T_work · (1 + (pp-1)/M) + c
+
+and the measured bubble of EITHER schedule at M is then
+1 − (T_work + c)/t(M)  (training/timers.measured_bubble_fraction), compared
+against the analytic laws in utils/flops_utils (gpipe_bubble_fraction /
+zero_bubble_fraction).
 
 Run: env -u PALLAS_AXON_POOL_IPS -u JAX_PLATFORMS python tools/profile_pp.py
+Knobs: PROFILE_PP_STAGES (default 2 = host cores), PROFILE_PP_REPS.
 """
 
 from __future__ import annotations
@@ -24,28 +28,40 @@ import os
 import sys
 import time
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+PP = int(os.environ.get("PROFILE_PP_STAGES", 2))
+os.environ.setdefault(
+    "XLA_FLAGS", f"--xla_force_host_platform_device_count={PP}"
+)
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
-import jax.numpy as jnp
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
 from automodel_tpu import auto_model
 from automodel_tpu.data.loader import place_batch
 from automodel_tpu.optim.builders import build_optimizer
 from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+from automodel_tpu.training.timers import measured_bubble_fraction
 from automodel_tpu.training.train_state import TrainState
 from automodel_tpu.training.train_step import build_train_step, make_causal_lm_loss
+from automodel_tpu.utils.flops_utils import (
+    gpipe_bubble_fraction,
+    zero_bubble_fraction,
+)
 
-PP = 4
 GLOBAL_BATCH = 16
 SEQ = 128
+REPS = int(os.environ.get("PROFILE_PP_REPS", 5))
+MS = [4, 8, 16]
 
 
-def step_time(M: int, reps: int = 6) -> float:
+def step_time(M: int, schedule: str) -> float:
     ctx = build_mesh(
-        MeshConfig(pp=PP, dp_shard=1), devices=jax.devices("cpu")[:PP]
+        MeshConfig(pp=PP, dp_shard=1, pp_schedule=schedule),
+        devices=jax.devices("cpu")[:PP],
     )
     hf = {
         "architectures": ["LlamaForCausalLM"],
@@ -59,9 +75,10 @@ def step_time(M: int, reps: int = 6) -> float:
         "head_dim": 32,
         "tie_word_embeddings": False,
     }
-    backend = {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32",
-               "remat": "full"}
-    backend = dict(backend, pp_microbatches=M)
+    backend = {
+        "attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32",
+        "remat": "full", "pp_microbatches": M,
+    }
     auto = auto_model.from_config(hf, ctx, backend, seed=0)
     loss_fn = make_causal_lm_loss(auto.model, loss="masked_ce", constrain=auto.constrain)
     opt = build_optimizer(name="adamw", lr=1e-4)
@@ -72,70 +89,120 @@ def step_time(M: int, reps: int = 6) -> float:
     state, m = step(state, b)
     jax.block_until_ready(m["loss"])
     t0 = time.perf_counter()
-    for _ in range(reps):
+    for _ in range(REPS):
         state, m = step(state, b)
     jax.block_until_ready(m["loss"])
-    return (time.perf_counter() - t0) / reps
+    return (time.perf_counter() - t0) / REPS
 
 
 def main() -> None:
-    Ms = [2, 4, 8, 16]
-    ts = []
-    for M in Ms:
-        t = step_time(M)
-        ts.append(t)
-        print(f"M={M:>2}: {t*1e3:8.1f} ms/step", flush=True)
+    t = {s: [] for s in ("gpipe", "zero_bubble")}
+    for schedule in t:
+        for M in MS:
+            dt = step_time(M, schedule)
+            t[schedule].append(dt)
+            print(f"{schedule:>12} M={M:>2}: {dt*1e3:8.1f} ms/step", flush=True)
 
-    # fit t = T_work * (1 + (pp-1)/M) + c  (c = fixed per-step overhead)
-    X = np.stack([1 + (PP - 1) / np.asarray(Ms, float), np.ones(len(Ms))], 1)
-    coef, *_ = np.linalg.lstsq(X, np.asarray(ts), rcond=None)
+    # T_work / c from the gpipe leg (the r04 fit); on a noisy/small host the
+    # 2-param fit can come out non-physical — measured-bubble rows are only
+    # emitted when it doesn't, the schedule RATIO rows below are always
+    X = np.stack([1 + (PP - 1) / np.asarray(MS, float), np.ones(len(MS))], 1)
+    coef, *_ = np.linalg.lstsq(X, np.asarray(t["gpipe"]), rcond=None)
     T_work, c = coef
-    pred = X @ coef
-    lines = [f"M={m:>2}: measured {t*1e3:7.1f} ms, GPipe-theory "
-             f"{p*1e3:7.1f} ms, bubble {(PP-1)/(m+PP-1):.1%}"
-             for m, t, p in zip(Ms, ts, pred)]
-    rel_err = float(np.max(np.abs(pred - ts) / ts))
-    # measured idle beyond theory at the practical operating point M>=4*pp
     t_ideal = T_work + c
-    idle_16 = (ts[-1] - t_ideal) / ts[-1]
+    rel_err = float(
+        np.max(np.abs(X @ coef - t["gpipe"]) / np.asarray(t["gpipe"]))
+    )
+    fit_ok = T_work > 0 and t_ideal > 0
 
-    with open("PROFILE_PP_r04.md", "w") as f:
-        f.write(f"""# Pipeline schedule profile (round 4)
+    rows = []
+    for i, M in enumerate(MS):
+        ratio = t["zero_bubble"][i] / t["gpipe"][i]
+        # tick-model total-cost ratio (F=1, B=2, W=1 units)
+        model_ratio = (3.0 * (M + PP - 1) + M) / (4.0 * (M + PP - 1))
+        row = (
+            f"M={M:>2}: gpipe {t['gpipe'][i]*1e3:7.1f} ms | zero_bubble "
+            f"{t['zero_bubble'][i]*1e3:7.1f} ms | ratio {ratio:5.3f} "
+            f"(tick model {model_ratio:5.3f})"
+        )
+        if fit_ok:
+            row += (
+                f" | bubble meas {measured_bubble_fraction(t['gpipe'][i], t_ideal):5.1%}"
+                f"/{measured_bubble_fraction(t['zero_bubble'][i], t_ideal):5.1%}"
+                f" vs law {gpipe_bubble_fraction(PP, M):5.1%}"
+                f"/{zero_bubble_fraction(PP, M):5.1%}"
+            )
+        rows.append(row)
+    analytic = []
+    for M in MS:
+        gbf, zbf = gpipe_bubble_fraction(PP, M), zero_bubble_fraction(PP, M)
+        ratio = f"   (x{gbf / zbf:.2f} smaller)" if zbf > 0 else ""
+        analytic.append(
+            f"m={M:>2}:  GPipe law {gbf:6.2%}   zero-bubble {zbf:6.2%}{ratio}"
+        )
 
-VERDICT r3 #6 asked for DATA on the GPipe-wavefront-vs-1F1B question
-(parallel/pp.py:28-41). Setup: pp={PP} over 4 XLA devices (host threads
-execute stages concurrently, so wall-clock sees the schedule), 8-layer
-dense stack, GLOBAL batch fixed at {GLOBAL_BATCH}x{SEQ}, microbatch count
-swept; remat=full (the 1F1B-equivalent memory bound). 6-rep means.
+    with open("PROFILE_PP_r06.md", "w") as f:
+        f.write(f"""# Pipeline schedule profile (round 6): zero-bubble B/W split
+
+Round 4 measured the GPipe wavefront on its (pp-1)/(M+pp-1) law within 5%
+and named zero-bubble W-deferral the one schedule-level optimization left.
+This round ships it (`parallel/zero_bubble.py`, `pp_schedule=zero_bubble`):
+the stage backward splits into B (activation grads, on the ppermute
+wavefront) and W (weight grads, exported as split_dot tap cotangents and
+contracted as flat bubble-free work after the B wave drains).
+
+## Analytic schedule model (tick costs: F=1, B=2 incl. recompute, W=1)
+
+Per-rank idle is 3(pp-1) tick-equivalents under both schedules, but the
+zero-bubble denominator grows by the flat W phase:
+
+    GPipe:        bubble = (pp-1)/(M+pp-1)
+    zero-bubble:  bubble = 3(pp-1)/(4M+3(pp-1))   < GPipe for every M
+
+At pp={PP}, for the acceptance sweep m ∈ {{4, 8, 16}}:
 
 ```
-""" + "\n".join(lines) + f"""
+""" + "\n".join(analytic) + f"""
 ```
 
-Least-squares fit of t = T_work*(1 + (pp-1)/M) + c:
-T_work = {T_work*1e3:.1f} ms, fixed overhead c = {c*1e3:.1f} ms,
-max relative deviation from the GPipe bubble model: {rel_err:.1%}.
+Bounded deferral (`pp_zb_queue=Q<M`) is the memory escape hatch, not a
+speedup: every B tick then carries a W contraction (combined-schedule
+cost) and the bubble returns to ~the GPipe law while stash memory caps at
+Q microbatches (utils/flops_utils.zero_bubble_fraction).
 
-Conclusions:
-- The measured step times follow the (pp-1)/M bubble law to within
-  {rel_err:.1%} — the AD-generated backward wavefront introduces NO extra
-  idle gap beyond the schedule-inherent bubble (the fwd and bwd waves abut:
-  the transpose of the last ppermute starts the backward sweep on the tick
-  after the forward drains).
-- At the documented operating point M >= 4*pp the residual idle is
-  {idle_16:.1%} of the step — 1F1B proper would not recover it, because
-  1F1B's bubble term is IDENTICAL ((pp-1) warmup + (pp-1) drain); its
-  payoff is the pp-bounded in-flight activation memory, which remat over
-  the tick body already provides here (measured: this sweep runs remat=full
-  at every M without memory growth in M).
-- What WOULD shrink the bubble is interleaved virtual stages
-  (bubble -> (pp-1)/(v*M)) at v x ppermute traffic, or zero-bubble B/W
-  splitting. Both only matter when M cannot reach 4*pp (global-batch
-  bound). Decision recorded: keep the GPipe wavefront + remat, require
-  M >= 4*pp (bubble <= {(PP-1)/(4*PP+PP-1):.0%}), revisit interleaving only
-  if a production config cannot raise M.
+## Measured
+
+pp={PP} over {PP} XLA host devices, one per core; 8-layer dense stack,
+global batch {GLOBAL_BATCH}x{SEQ}, remat=full, {REPS}-rep means.
+
+t_gpipe 2-param fit (r04 method): T_work = {T_work*1e3:.1f} ms,
+overhead c = {c*1e3:.1f} ms, max deviation {rel_err:.1%}
+({"physical — per-M measured bubble emitted" if fit_ok else
+  "NON-physical on this host (per-tick overhead dominates the tiny "
+  "per-tick compute at this scale) — only the schedule ratio rows below "
+  "are meaningful"}).
+
+```
+""" + "\n".join(rows) + """
+```
+
+Honest read of the measured leg: this container exposes only as many cores
+as stages at pp=2, where the tick-model gap between the schedules is just
+1.5-3% of the step — below the host's noise floor — and the zero-bubble
+implementation carries real per-tick constants the model ignores (per-layer
+dynamic_slice of the closed-over kernels in the B pass, the stash-ring
+dynamic updates, and the W-flush einsum hitting a different CPU kernel than
+the scan matmuls). Wall-clock here does NOT resolve the law gap; the
+recorded acceptance evidence is the analytic model above (whose GPipe half
+r04 validated on-law within 5% at pp=4) plus the parity tests. Re-sweep on
+a host with >= 4 cores at pp=4, where the law gap is 3x larger, before
+quoting a measured speedup.
+
+grads parity: `tests/test_pipeline.py` asserts zero_bubble loss/grads match
+gpipe within fp32-accum tolerance on dense and MoE (incl. the aux-free
+gate-bias update path), with full and bounded deferral queues.
 """)
-    print("wrote PROFILE_PP_r04.md", flush=True)
+    print("wrote PROFILE_PP_r06.md", flush=True)
 
 
 if __name__ == "__main__":
