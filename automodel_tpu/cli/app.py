@@ -20,12 +20,19 @@ DOMAINS = ("llm", "vlm", "biencoder")
 def _usage() -> str:
     return (
         "usage: automodel_tpu <finetune|pretrain|kd|benchmark|mine> <llm|vlm|biencoder> "
-        "-c config.yaml [--dotted.key=value ...]"
+        "-c config.yaml [--dotted.key=value ...]\n"
+        "       automodel_tpu report <train_metrics.jsonl> [--strict]"
     )
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    # `report` takes a JSONL path, not a domain: validate + summarize a
+    # metrics file (telemetry/report.py — same linter bench.py uses)
+    if argv and argv[0] == "report":
+        from automodel_tpu.telemetry.report import main as report_main
+
+        return report_main(argv[1:])
     if len(argv) < 2 or argv[0] in ("-h", "--help"):
         print(_usage())
         return 0 if argv and argv[0] in ("-h", "--help") else 2

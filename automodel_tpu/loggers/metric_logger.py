@@ -1,9 +1,23 @@
 """JSONL metric logging (parity: components/loggers/metric_logger.py:83) with
-optional wandb passthrough (wandb_utils.py)."""
+optional wandb passthrough (wandb_utils.py).
+
+Strict-JSON contract: `json.dumps` happily emits bare ``NaN``/``Infinity``
+tokens, which strict readers (and tools/metrics_report.py) reject — and a
+diverged run is exactly when the JSONL matters most. Non-finite floats are
+therefore serialized as ``null`` with a sidecar ``<key>_nonfinite: true``
+marker (recursively for list values, e.g. per-layer arrays), and the write
+uses ``allow_nan=False`` so a regression fails loudly here rather than
+corrupting the file.
+
+The injected ``ts`` stays a JSONL-only concern: wandb/MLflow sinks get the
+caller's record (ts included only if the CALLER put it there), so external
+dashboards don't grow a spurious ``ts`` series.
+"""
 
 from __future__ import annotations
 
 import json
+import math
 import time
 from pathlib import Path
 from typing import Any
@@ -20,6 +34,30 @@ def _to_scalar(v: Any) -> Any:
     return v
 
 
+def _definite(v: Any) -> tuple[Any, bool]:
+    """→ (strict-JSON-safe value, had_nonfinite). Floats become None when
+    non-finite; lists and dicts are cleaned element-wise (the write below
+    uses allow_nan=False, so anything missed here would crash the run at
+    exactly the diverged-step moment this contract exists to survive)."""
+    if isinstance(v, float):
+        return (v, False) if math.isfinite(v) else (None, True)
+    if isinstance(v, (list, tuple)):
+        cleaned, bad = [], False
+        for x in v:
+            cx, b = _definite(x)
+            cleaned.append(cx)
+            bad = bad or b
+        return cleaned, bad
+    if isinstance(v, dict):
+        cleaned_d, bad = {}, False
+        for k, x in v.items():
+            cx, b = _definite(x)
+            cleaned_d[k] = cx
+            bad = bad or b
+        return cleaned_d, bad
+    return v, False
+
+
 class MetricLogger:
     """Append-only JSONL metrics file; one record per call. ``sinks`` fan
     the same record out to wandb / MLflow style loggers (anything with
@@ -34,11 +72,19 @@ class MetricLogger:
 
     def log(self, metrics: dict[str, Any], step: int | None = None) -> None:
         rec = {k: _to_scalar(v) for k, v in metrics.items()}
-        rec.setdefault("ts", time.time())
         if step is not None:
             rec.setdefault("step", step)
-        self._f.write(json.dumps(rec) + "\n")
+        jsonl_rec: dict[str, Any] = {}
+        for k, v in rec.items():
+            cv, bad = _definite(v)
+            jsonl_rec[k] = cv
+            if bad:
+                jsonl_rec[f"{k}_nonfinite"] = True
+        jsonl_rec.setdefault("ts", time.time())
+        self._f.write(json.dumps(jsonl_rec, allow_nan=False) + "\n")
         self._f.flush()
+        # sinks receive the caller's record untouched (wandb renders NaN
+        # natively; injected ts stays out of external dashboards)
         if self.wandb_run is not None:
             self.wandb_run.log(rec, step=step)
         for s in self.sinks:

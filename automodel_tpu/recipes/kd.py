@@ -111,7 +111,8 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
             else None
         )
         self.train_step = build_train_step(
-            self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step
+            self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step,
+            anomaly_flags=getattr(self, "_anomaly_flags", True),
         )
         # eval must not apply LoRA dropout — use the train=False variant
         self.eval_step = build_eval_step(

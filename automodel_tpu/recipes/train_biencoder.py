@@ -83,7 +83,10 @@ class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         from automodel_tpu.training.train_step import build_eval_step, build_train_step
 
         self.loss_fn = loss_fn
-        self.train_step = build_train_step(loss_fn, self.optimizer, self.lr_schedule)
+        self.train_step = build_train_step(
+            loss_fn, self.optimizer, self.lr_schedule,
+            anomaly_flags=getattr(self, "_anomaly_flags", True),
+        )
         self.eval_step = build_eval_step(loss_fn)
 
     def _build_dataloader(self, dataset_cfg: Any, dl_cfg: Any) -> DataLoader:

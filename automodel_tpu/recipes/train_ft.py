@@ -194,9 +194,17 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 dropout_seed=cfg.get("seed", 42),
             )
         post_step = getattr(self.model, "post_step_fn", None) if self.peft_config is None else None
+        # telemetry.{enabled,anomaly_flags} govern the in-jit anomaly
+        # reductions (read here because the step compiles before the
+        # Telemetry facade is built below)
+        tcfg = dict(cfg.get("telemetry") or {})
+        self._anomaly_flags = bool(tcfg.get("enabled", True)) and bool(
+            tcfg.get("anomaly_flags", True)
+        )
         self.train_step = build_train_step(
             self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step,
             grad_mask=getattr(self, "grad_mask", None),
+            anomaly_flags=self._anomaly_flags,
         )
         # eval must not apply LoRA dropout — use the train=False variant
         self.eval_step = build_eval_step(
@@ -242,6 +250,20 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             log_cfg.get("metrics_path", "train_metrics.jsonl"),
             wandb_run=wandb_run,
             sinks=sinks,
+        )
+
+        # telemetry: anomaly flags ride the jitted step (train_step.py);
+        # this facade adds the step-time split, compile-event stamps, the
+        # periodic memory census, and the crash flight recorder. On by
+        # default — no `telemetry:` section required.
+        from automodel_tpu.telemetry import Telemetry, build_fingerprint
+
+        self.telemetry = Telemetry.from_config(
+            cfg.get("telemetry"),
+            fingerprint=build_fingerprint(cfg.to_dict(), self.mesh_ctx),
+            default_recorder_path=str(
+                self.metric_logger.path.parent / "flight_recorder.json"
+            ),
         )
 
     def _build_auto(self, mcfg: Any, backend: dict):
@@ -342,10 +364,44 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
     # -- train loop ---------------------------------------------------------
     def run_train_validation_loop(self) -> dict:
+        """Timing semantics (docs/observability.md): non-log steps dispatch
+        asynchronously, so per-step wall time is only observable at a log-
+        step barrier — a naive per-step `dt` charges ALL queued device work
+        to the log step (inflating step_time_s, deflating tps whenever
+        log_every > 1). Each log record therefore reports the WINDOW since
+        the last barrier, amortized: ``step_time_s`` = window seconds /
+        ``steps_spanned``, ``tps`` = window tokens / window seconds. The
+        alternative (blocking every step) would serialize host dispatch
+        against device work; amortization keeps the numbers honest without
+        touching the hot path. Step 1 blocks immediately and is reported as
+        ``compile_time_s`` (XLA compile dominates it), excluded from every
+        throughput window. Windows also restart after validation/checkpoint
+        pauses so their wall time is never charged to training steps."""
+        tel = self.telemetry
+        try:
+            with tel.crash_guard():
+                last = self._train_loop_body(tel)
+        finally:
+            tel.close()
+        if self.checkpointer:
+            self.save_checkpoint()
+            self.checkpointer.close()  # drain any in-flight async save
+        return last
+
+    def _train_loop_body(self, tel) -> dict:
         last: dict = {}
+        it = iter(self.step_scheduler)
         first_step = True
-        t0 = time.perf_counter()
-        for group in self.step_scheduler:
+        tokens_window = 0
+        steps_window = 0
+        t_window = time.perf_counter()
+        while True:
+            tel.timers("data_wait").start()
+            try:
+                group = next(it)
+            except StopIteration:
+                break
+            tel.timers("data_wait").stop()
             stacked = stack_microbatches(group)
             if self._zigzag_cp:
                 from automodel_tpu.parallel.cp import apply_zigzag
@@ -358,8 +414,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     )
                     for k, v in stacked.items()
                 }
-            # tps numerator: all *input_ids leaves (biencoder batches carry
-            # query_/doc_input_ids instead of a single input_ids)
+            # tps numerator: all *input_ids leaves (biencoder batches
+            # carry query_/doc_input_ids instead of a single input_ids)
             n_tokens_batch = int(
                 sum(
                     np.prod(v.shape)
@@ -368,31 +424,75 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 )
             )
             batch = place_batch(self.mesh_ctx, stacked)
+            step_no = self.step_scheduler.step
+            tel.on_step(step_no)
+            tel.timers("dispatch").start()
             self.state, metrics = self.train_step(self.state, batch)
-            if self.step_scheduler.is_log_step:
+            tel.timers("dispatch").stop()
+            tokens_window += n_tokens_batch
+            steps_window += 1
+            host_rec = {"step": step_no, "tokens": n_tokens_batch, "ts": time.time()}
+            if first_step:
                 metrics = {k: v for k, v in jax.device_get(metrics).items()}
-                dt = time.perf_counter() - t0
-                if first_step:
-                    # the first step's wall time is dominated by XLA compile;
-                    # report it separately instead of polluting tps
-                    # (reference excludes warmup in the benchmark recipe)
-                    metrics["compile_time_s"] = dt
-                else:
-                    metrics["tps"] = n_tokens_batch / max(dt, 1e-9)
-                    metrics["tps_per_device"] = metrics["tps"] / self.mesh_ctx.world_size
-                    metrics["step_time_s"] = dt
+                metrics["compile_time_s"] = time.perf_counter() - t_window
+                host_rec["compile_time_s"] = metrics["compile_time_s"]
+                host_rec["loss"] = float(metrics["loss"])
+                # discard step 1's timer entries and compile events BEFORE
+                # any enrich: the initial XLA compile is already reported as
+                # compile_time_s, and must appear neither as this record's
+                # `recompiles` nor in the first window's time/* means
+                tel.timers.drain_means()
+                if tel.compile_bridge is not None:
+                    tel.compile_bridge.drain()
+                if self.step_scheduler.is_log_step:
+                    metrics = tel.enrich(step_no, metrics)
+                    self.metric_logger.log(metrics, step=int(metrics["step"]))
+                    last = metrics
+                tel.record_step(host_rec)
+                first_step = False
+                tokens_window = steps_window = 0
+                t_window = time.perf_counter()
+            elif self.step_scheduler.is_log_step:
+                tel.timers("device_sync").start()
+                metrics = {k: v for k, v in jax.device_get(metrics).items()}
+                tel.timers("device_sync").stop()
+                dt = time.perf_counter() - t_window
+                metrics["steps_spanned"] = steps_window
+                metrics["step_time_s"] = dt / max(steps_window, 1)
+                metrics["tps"] = tokens_window / max(dt, 1e-9)
+                metrics["tps_per_device"] = metrics["tps"] / self.mesh_ctx.world_size
+                metrics = tel.enrich(step_no, metrics)
                 self.metric_logger.log(metrics, step=int(metrics["step"]))
                 last = metrics
-            first_step = False
+                host_rec.update(
+                    {
+                        k: metrics[k]
+                        for k in ("loss", "grad_norm", "step_time_s", "tps", "nonfinite")
+                        if k in metrics
+                    }
+                )
+                tel.record_step(host_rec)
+                tokens_window = steps_window = 0
+                t_window = time.perf_counter()
+            else:
+                tel.record_step(host_rec)
             if self.step_scheduler.is_val_step and self.val_dataloader is not None:
                 val = self.run_validation()
+                # compile events during validation (eval_step's first
+                # compile) belong to the val record, not the next train
+                # window's `recompiles`
+                if tel.compile_bridge is not None:
+                    d = tel.compile_bridge.drain()
+                    if d["compiles"]:
+                        val["eval_compiles"] = d["compiles"]
+                        val["eval_compile_secs"] = round(d["compile_secs"], 4)
                 self.metric_logger.log(val, step=self.step_scheduler.step)
+                tokens_window = steps_window = 0
+                t_window = time.perf_counter()
             if self.step_scheduler.is_ckpt_step:
                 self.save_checkpoint()
-            t0 = time.perf_counter()
-        if self.checkpointer:
-            self.save_checkpoint()
-            self.checkpointer.close()  # drain any in-flight async save
+                tokens_window = steps_window = 0
+                t_window = time.perf_counter()
         return last
 
     def run_validation(self) -> dict:

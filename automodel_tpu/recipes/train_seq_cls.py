@@ -55,7 +55,10 @@ class TrainSeqClsRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         opt_state = jax.jit(self.optimizer.init)(params)
         self.state = TrainState.create(params, opt_state)
         self.loss_fn = make_seq_cls_loss(model)
-        self.train_step = build_train_step(self.loss_fn, self.optimizer, self.lr_schedule)
+        self.train_step = build_train_step(
+            self.loss_fn, self.optimizer, self.lr_schedule,
+            anomaly_flags=getattr(self, "_anomaly_flags", True),
+        )
         self.eval_step = build_eval_step(self.loss_fn)
         logger.info("seq-cls: %d labels", num_labels)
 

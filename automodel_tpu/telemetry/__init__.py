@@ -1,0 +1,184 @@
+"""Unified observability subsystem (SURVEY §1 "Observability").
+
+Four pillars, each its own module, one facade (`Telemetry`) the recipes
+wire through YAML:
+
+- memory.py          — per-device allocator stats + top-K live-array census
+- anomaly.py         — in-jit isfinite/per-group-norm reductions for the step
+- compile_events.py  — jax.monitoring compile events → per-window metrics
+- flight_recorder.py — last-N step ring + fingerprint, dumped on crash
+- report.py          — JSONL schema lint / summary table / bench validation
+
+YAML::
+
+    telemetry:
+      enabled: true
+      anomaly_flags: true           # in-jit isfinite + per-group grad norms
+      memory_every_steps: 50        # 0 disables the periodic census
+      census_top_k: 8
+      flight_recorder_steps: 16     # ring capacity; 0 disables
+      flight_recorder_path: flight_recorder.json
+      compile_events: true
+      profile: {enabled: false, trace_dir: ..., start_step: 3, end_step: 5}
+
+Defaults are on: a recipe with no `telemetry:` section still gets anomaly
+flags, step-time decomposition, compile-event stamps, and a crash dump.
+The per-step host cost is bounded by design — two perf_counter pairs, one
+deque append, dict merges; the memory census runs every N steps only
+(call-count asserted in tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any, Optional
+
+from automodel_tpu.telemetry import memory as memory_telemetry
+from automodel_tpu.telemetry.anomaly import (  # noqa: F401  (re-export)
+    anomaly_metrics,
+    group_grad_norms,
+    nonfinite_count,
+)
+from automodel_tpu.telemetry.compile_events import CompileEventBridge
+from automodel_tpu.telemetry.flight_recorder import FlightRecorder, build_fingerprint
+from automodel_tpu.training.timers import Timers
+from automodel_tpu.utils.profiler import ProfilerConfig, StepProfiler
+
+memory_snapshot = memory_telemetry.memory_snapshot  # re-export
+
+
+@dataclasses.dataclass
+class TelemetryConfig:
+    enabled: bool = True
+    # in-jit isfinite + per-group grad-norm reductions (train_step.py reads
+    # this key from the YAML section directly — the step compiles before
+    # the facade is built)
+    anomaly_flags: bool = True
+    memory_every_steps: int = 50
+    census_top_k: int = 8
+    flight_recorder_steps: int = 16
+    flight_recorder_path: str = "flight_recorder.json"
+    compile_events: bool = True
+    profile: Optional[dict] = None
+
+
+class Telemetry:
+    """Facade the recipes drive: timers for the step-time split, a compile
+    bridge drained at log boundaries, a memory sampler on a step cadence,
+    a StepProfiler window, and the crash flight recorder."""
+
+    def __init__(self, config: TelemetryConfig, fingerprint: Optional[dict] = None):
+        self.config = config
+        self.timers = Timers()
+        on = config.enabled
+        self.flight_recorder = (
+            FlightRecorder(
+                capacity=config.flight_recorder_steps,
+                path=config.flight_recorder_path,
+                fingerprint=fingerprint,
+                census_top_k=config.census_top_k,
+            )
+            if on and config.flight_recorder_steps > 0
+            else None
+        )
+        self.compile_bridge = CompileEventBridge() if on and config.compile_events else None
+        self.profiler = (
+            StepProfiler(ProfilerConfig(**dict(config.profile)))
+            if on and config.profile
+            else None
+        )
+        self.memory_samples = 0
+        # allocator scalars sampled on the step cadence, attached to the
+        # next log record (sampling must not depend on the log cadence)
+        self._pending_mem: Optional[tuple] = None
+
+    @classmethod
+    def from_config(
+        cls,
+        section: Any,
+        fingerprint: Optional[dict] = None,
+        default_recorder_path: Optional[str] = None,
+    ) -> "Telemetry":
+        """Build from a YAML `telemetry:` section (None → all defaults).
+        ``default_recorder_path`` places the crash dump next to the metrics
+        JSONL unless the YAML pins a path."""
+        d = dict(section or {})
+        d.pop("_target_", None)
+        if "flight_recorder_path" not in d and default_recorder_path:
+            d["flight_recorder_path"] = default_recorder_path
+        return cls(TelemetryConfig(**d), fingerprint=fingerprint)
+
+    # -- per-step hooks ------------------------------------------------------
+    def on_step(self, step: int) -> None:
+        """Per-step hook: profiler window management + the memory census on
+        its OWN cadence (independent of the log cadence — a run with
+        log_every_steps=3 and memory_every_steps=50 still samples every 50).
+        The census goes to the flight-recorder ring; the two allocator
+        scalars ride the next log record via enrich()."""
+        if self.profiler is not None:
+            self.profiler.on_step(step)
+        if self.should_sample_memory(step):
+            self.memory_samples += 1
+            self._pending_mem = memory_telemetry.max_bytes_in_use()
+            self.record_step(
+                {
+                    "step": step,
+                    "ts": time.time(),
+                    "memory": memory_telemetry.memory_snapshot(self.config.census_top_k),
+                }
+            )
+
+    def record_step(self, rec: dict[str, Any]) -> None:
+        """Append a host-side record to the flight-recorder ring. Callers
+        must not pass unfetched device arrays (that would force a sync)."""
+        if self.flight_recorder is not None:
+            self.flight_recorder.record(rec)
+
+    def should_sample_memory(self, step: int) -> bool:
+        c = self.config
+        return c.enabled and c.memory_every_steps > 0 and step % c.memory_every_steps == 0
+
+    # -- log-boundary enrichment --------------------------------------------
+    def enrich(self, step: int, metrics: dict[str, Any]) -> dict[str, Any]:
+        """Fold telemetry into a log-step metrics dict: window means of the
+        data-wait/dispatch/device-sync timers, compile events since the last
+        log, and (on the memory cadence) the two allocator scalars. The full
+        census goes to the flight-recorder ring, not the JSONL."""
+        if not self.config.enabled:
+            return metrics
+        for name, mean_s in self.timers.drain_means().items():
+            metrics[f"time/{name}_s"] = mean_s
+        if self.compile_bridge is not None:
+            d = self.compile_bridge.drain()
+            if d["compiles"]:
+                metrics["recompiles"] = d["compiles"]
+                metrics["recompile_secs"] = round(d["compile_secs"], 4)
+        if self._pending_mem is not None:
+            metrics["mem_bytes_in_use"], metrics["mem_peak_bytes"] = self._pending_mem
+            self._pending_mem = None
+        return metrics
+
+    # -- lifecycle -----------------------------------------------------------
+    def crash_guard(self):
+        """Context manager that dumps the flight recorder on any exception
+        (and re-raises). A disabled recorder degrades to a no-op."""
+        return self.flight_recorder if self.flight_recorder is not None else contextlib.nullcontext()
+
+    def close(self) -> None:
+        if self.profiler is not None:
+            self.profiler.close()
+
+
+__all__ = [
+    "Telemetry",
+    "TelemetryConfig",
+    "CompileEventBridge",
+    "FlightRecorder",
+    "build_fingerprint",
+    "memory_snapshot",
+    "anomaly_metrics",
+    "group_grad_norms",
+    "nonfinite_count",
+]

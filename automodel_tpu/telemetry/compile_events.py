@@ -1,0 +1,75 @@
+"""Bridge jax.monitoring compile events into step metrics.
+
+A mid-run recompile (shape drift from an unpadded last batch, a donated
+buffer falling back, a new code path) spends seconds on the host and — with
+async dispatch — masquerades as one mysteriously slow step in the JSONL.
+JAX already announces every compile via `jax.monitoring` duration events
+(`/jax/core/compile/backend_compile_duration` et al.); this module
+accumulates them process-wide and lets each consumer drain the delta since
+its last look, so the MetricLogger can stamp `recompiles`/`recompile_secs`
+onto exactly the log window the compile happened in.
+
+jax.monitoring has no targeted unregister (only `clear_event_listeners`,
+which would nuke other listeners), so registration is a process-global
+singleton and per-consumer state is just a cursor into the global totals —
+building many CompileEventBridge instances (every recipe in a test session)
+never stacks listeners.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# the backend-compile event is the expensive one; trace/lowering events are
+# folded into the same counters as "compile work" seen by the host
+_EVENT_SUFFIXES = (
+    "backend_compile_duration",
+    "jaxpr_to_mlir_module_duration",
+)
+
+_lock = threading.Lock()
+_totals = {"count": 0, "secs": 0.0}
+_registered = False
+
+
+def _listener(event: str, duration_secs: float, **kwargs) -> None:
+    if not event.endswith(_EVENT_SUFFIXES):
+        return
+    with _lock:
+        # count whole compiles, not sub-phases: only the backend event bumps
+        # the counter; every phase adds to the seconds
+        if event.endswith("backend_compile_duration"):
+            _totals["count"] += 1
+        _totals["secs"] += float(duration_secs)
+
+
+def _ensure_registered() -> None:
+    global _registered
+    with _lock:
+        if _registered:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _registered = True
+
+
+class CompileEventBridge:
+    """Per-consumer cursor over the process-global compile counters."""
+
+    def __init__(self):
+        _ensure_registered()
+        with _lock:
+            self._seen_count = _totals["count"]
+            self._seen_secs = _totals["secs"]
+
+    def drain(self) -> dict[str, float]:
+        """→ {"compiles": n, "compile_secs": s} since the previous drain."""
+        with _lock:
+            count, secs = _totals["count"], _totals["secs"]
+        out = {
+            "compiles": count - self._seen_count,
+            "compile_secs": secs - self._seen_secs,
+        }
+        self._seen_count, self._seen_secs = count, secs
+        return out

@@ -1,0 +1,169 @@
+"""Validate + summarize a train_metrics.jsonl; validate bench results.
+
+Three consumers:
+- `automodel_tpu report <path.jsonl>` (cli/app.py) and tools/metrics_report.py
+  — human-facing lint + summary table.
+- bench.py — `validate_bench_result` enforces the VERDICT-r5 invariant:
+  a 0.0/None-valued leg with no recorded failure reason is a reporting bug
+  (a leg that never ran must never read as "measured zero") and fails the
+  bench loudly.
+
+The linter is deliberately strict about JSON: bare ``NaN``/``Infinity``
+tokens (which `json.dumps` emits by default and strict readers reject) are
+flagged per line — the MetricLogger now serializes non-finite floats as
+``null`` + a ``<key>_nonfinite`` marker, so their presence means an old or
+foreign writer produced the file.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Optional
+
+# keys whose presence implies a numeric (or null-with-marker) value
+_NUMERIC_KEYS = (
+    "loss",
+    "grad_norm",
+    "tps",
+    "tps_per_device",
+    "step_time_s",
+    "compile_time_s",
+    "lr",
+    "mfu",
+    "pp_bubble_fraction",
+    "expert_load_imbalance",
+)
+
+
+def _strict_loads(line: str) -> Any:
+    def _reject(tok: str):
+        raise ValueError(f"bare {tok} token (non-strict JSON)")
+
+    return json.loads(line, parse_constant=_reject)
+
+
+def lint_metrics_jsonl(path: str) -> tuple[list[dict], list[str]]:
+    """→ (parsed records, problems). Problems are human-readable strings
+    with 1-based line numbers; parsing continues past bad lines."""
+    records: list[dict] = []
+    problems: list[str] = []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return [], [f"cannot read {path}: {e}"]
+    last_step: Optional[int] = None
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            rec = _strict_loads(line)
+        except ValueError as e:
+            problems.append(f"line {i}: {e}")
+            continue
+        if not isinstance(rec, dict):
+            problems.append(f"line {i}: record is not an object")
+            continue
+        records.append(rec)
+        if "ts" not in rec:
+            problems.append(f"line {i}: missing ts")
+        step = rec.get("step")
+        if step is not None:
+            if not isinstance(step, int):
+                problems.append(f"line {i}: step is not an int: {step!r}")
+            elif last_step is not None and step < last_step:
+                problems.append(f"line {i}: step went backwards ({last_step} -> {step})")
+            else:
+                last_step = step
+        for k in _NUMERIC_KEYS:
+            if k in rec and rec[k] is not None and not isinstance(rec[k], (int, float)):
+                problems.append(f"line {i}: {k} is not numeric: {rec[k]!r}")
+            if k in rec and rec[k] is None and not rec.get(f"{k}_nonfinite"):
+                problems.append(f"line {i}: {k} is null without a {k}_nonfinite marker")
+    return records, problems
+
+
+def summarize_metrics(records: list[dict]) -> dict[str, Any]:
+    train = [r for r in records if "loss" in r]
+    tps = [r["tps"] for r in train if isinstance(r.get("tps"), (int, float))]
+    step_t = [r["step_time_s"] for r in train if isinstance(r.get("step_time_s"), (int, float))]
+    nonfinite_steps = [r.get("step") for r in records if r.get("nonfinite")]
+    recompiles = sum(r.get("recompiles", 0) or 0 for r in records)
+    out = {
+        "records": len(records),
+        "train_steps_logged": len(train),
+        "first_loss": train[0]["loss"] if train else None,
+        "last_loss": train[-1]["loss"] if train else None,
+        "tps_mean": sum(tps) / len(tps) if tps else None,
+        "step_time_mean_s": sum(step_t) / len(step_t) if step_t else None,
+        "nonfinite_steps": nonfinite_steps,
+        "recompiles_after_first_step": recompiles,
+    }
+    mfu = [r["mfu"] for r in records if isinstance(r.get("mfu"), (int, float))]
+    if mfu:
+        out["mfu_mean"] = sum(mfu) / len(mfu)
+    return out
+
+
+def format_table(summary: dict[str, Any]) -> str:
+    rows = [(k, v) for k, v in summary.items()]
+    width = max(len(k) for k, _ in rows)
+    lines = []
+    for k, v in rows:
+        if isinstance(v, float):
+            v = f"{v:.6g}"
+        lines.append(f"{k:<{width}}  {v}")
+    return "\n".join(lines)
+
+
+# -- bench-result validation (the VERDICT r5 failure mode) -------------------
+
+# (value key, failure-reason key) per bench leg — see bench.py's output dict
+_BENCH_LEGS = (
+    ("value", "dense_failure"),
+    ("qlora_8b_mfu_pct", "qlora_8b_failure"),
+    ("moe_mfu_pct", "moe_failures"),
+)
+
+
+def validate_bench_result(result: dict[str, Any]) -> list[str]:
+    """A leg whose value is 0.0 or None MUST carry a recorded reason;
+    a hard 0.0 is additionally always suspect (an MFU of exactly zero is
+    not a measurement). → list of problems (empty = valid)."""
+    problems: list[str] = []
+    for value_key, failure_key in _BENCH_LEGS:
+        if value_key not in result:
+            continue
+        value = result[value_key]
+        reason = result.get(failure_key)
+        if isinstance(value, (int, float)) and not isinstance(value, bool) and value == 0.0:
+            problems.append(
+                f"{value_key} is 0.0 — a leg that never ran must report null "
+                f"+ a reason in {failure_key}, never a zero measurement"
+            )
+        elif value is None and not reason:
+            problems.append(
+                f"{value_key} is null but {failure_key} records no reason"
+            )
+    return problems
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: metrics_report <train_metrics.jsonl> [--strict]")
+        return 0 if argv else 2
+    strict = "--strict" in argv
+    path = next((a for a in argv if not a.startswith("-")), None)
+    if path is None:
+        print("usage: metrics_report <train_metrics.jsonl> [--strict]")
+        return 2
+    records, problems = lint_metrics_jsonl(path)
+    print(format_table(summarize_metrics(records)))
+    if problems:
+        print(f"\n{len(problems)} schema problem(s):", file=sys.stderr)
+        for p in problems[:50]:
+            print(f"  {p}", file=sys.stderr)
+        return 1 if strict or not records else 0
+    return 0

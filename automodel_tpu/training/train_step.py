@@ -34,6 +34,7 @@ def build_train_step(
     donate: bool = True,
     post_step_fn: Optional[Callable[[Any, dict], Any]] = None,
     grad_mask: Any = None,
+    anomaly_flags: bool = True,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted (state, batch) → (state, metrics) step.
 
@@ -47,6 +48,14 @@ def build_train_step(
     ``post_step_fn(new_params, extras_sum) -> new_params`` runs AFTER the
     optimizer update, outside the gradient — the reference's
     update_moe_gate_bias slot (train_ft.py:1341, aux-free load balancing).
+
+    ``anomaly_flags`` (default on): fold `telemetry.anomaly` reductions into
+    the metrics dict INSIDE the jit — a boolean ``nonfinite`` (loss or any
+    grad), the grad non-finite element count, and per-param-group grad norms
+    (``grad_norm/<group>``). A few scalar reductions XLA fuses into the
+    existing grad traversal; no extra device round-trips (the metrics dict
+    is only fetched at log steps), so a NaN/Inf is caught in the step it
+    occurs with the group that produced it.
 
     ``grad_mask`` (bool pytree, True = trainable): frozen leaves' gradients
     are replaced by zeros immediately after value_and_grad — XLA dead-code-
@@ -161,6 +170,10 @@ def build_train_step(
             "num_label_tokens": n_tokens,
             "step": state.step + 1,
         }
+        if anomaly_flags:
+            from automodel_tpu.telemetry.anomaly import anomaly_metrics
+
+            metrics.update(anomaly_metrics(loss_sum, grads))
         if "moe_aux_loss" in extras_sum:
             metrics["moe_aux_loss"] = extras_sum["moe_aux_loss"] / batch_size(batch)
         pinfo = getattr(loss_fn, "pipeline_info", None)

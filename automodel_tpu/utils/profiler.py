@@ -39,7 +39,10 @@ class StepProfiler:
         c = self.config
         if not c.enabled:
             return
-        if not self._active and step == c.start_step:
+        # window CONTAINMENT, not exact equality: a run resumed from a
+        # checkpoint at step > start_step must still open the trace for the
+        # remainder of its window instead of silently never profiling
+        if not self._active and c.start_step <= step < c.end_step:
             jax.profiler.start_trace(c.trace_dir)
             self._active = True
             logger.info("profiler: trace started at step %d → %s", step, c.trace_dir)

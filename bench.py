@@ -126,6 +126,24 @@ def _reset_between_legs() -> None:
     gc.collect()
 
 
+def _oom_memory_dump(leg: str) -> str | None:
+    """Force-dump allocator stats + the live-array census when a leg dies,
+    BEFORE _reset_between_legs frees the buffers — the census names what
+    filled the chip (the diagnostic every all-zero BENCH_r05 leg lacked).
+    → dump path, or None if even the dump failed."""
+    try:
+        from automodel_tpu.telemetry.memory import memory_snapshot
+
+        path = f"bench_oom_{leg}.json"
+        with open(path, "w") as f:
+            json.dump(memory_snapshot(top_k=12), f, indent=2, default=str)
+        print(f"[bench] memory census for failed {leg} leg → {path}",
+              file=sys.stderr, flush=True)
+        return path
+    except Exception:
+        return None
+
+
 def _is_oom(exc: Exception) -> bool:
     s = str(exc)
     return (
@@ -367,7 +385,8 @@ def main() -> None:
         except Exception as exc:  # OOM → next smaller shape
             if not _is_oom(exc):
                 raise
-            dense_failures.append(f"{label}: OOM")
+            dump = _oom_memory_dump(f"dense_{label}")
+            dense_failures.append(f"{label}: OOM" + (f" (census: {dump})" if dump else ""))
             print(f"[bench] dense-{label} OOM; trying smaller", file=sys.stderr, flush=True)
             _reset_between_legs()
     _reset_between_legs()
@@ -396,6 +415,9 @@ def main() -> None:
         )
     except Exception as exc:
         qlora_failure = f"OOM: {exc}" if _is_oom(exc) else str(exc)
+        dump = _oom_memory_dump("qlora_8b")
+        if dump:
+            qlora_failure += f" (census: {dump})"
         print(f"[bench] 8b QLoRA leg failed: {exc}", file=sys.stderr, flush=True)
     _reset_between_legs()
 
@@ -430,7 +452,9 @@ def main() -> None:
             if moe_mfu != moe_mfu or mfu > moe_mfu:
                 moe_mfu, moe_tflops, moe_backend = mfu, tps * fpt / 1e12, experts
         except Exception as exc:
-            moe_failures[experts] = f"OOM: {exc}" if _is_oom(exc) else str(exc)
+            failure = f"OOM: {exc}" if _is_oom(exc) else str(exc)
+            dump = _oom_memory_dump(f"moe_{experts}")
+            moe_failures[experts] = failure + (f" (census: {dump})" if dump else "")
             print(
                 f"[bench] moe[{experts}] leg failed: {exc}",
                 file=sys.stderr, flush=True,
@@ -445,41 +469,49 @@ def main() -> None:
         None if dense_ok
         else "every dense shape OOMed: " + "; ".join(dense_failures)
     )
-    print(
-        json.dumps(
-            {
-                "metric": f"llama_dense_lora_mfu_{dense_label}",
-                "value": round(dense_mfu * 100, 2) if dense_ok else None,
-                "unit": "%MFU",
-                "vs_baseline": (
-                    round(dense_mfu / DENSE_BASELINE_MFU, 3) if dense_ok else None
-                ),
-                "dense_failure": dense_failure,
-                "dense_tflops_per_chip": round(dense_tflops, 1) if dense_ok else None,
-                "qlora_8b_mfu_pct": (
-                    round(qlora_mfu * 100, 2) if qlora_mfu == qlora_mfu else None
-                ),
-                "qlora_8b_vs_baseline": (
-                    round(qlora_mfu / DENSE_BASELINE_MFU, 3)
-                    if qlora_mfu == qlora_mfu else None
-                ),
-                "qlora_8b_tflops_per_chip": (
-                    round(qlora_tflops, 1) if qlora_mfu == qlora_mfu else None
-                ),
-                "qlora_8b_failure": qlora_failure,
-                "moe_mfu_pct": round(moe_mfu * 100, 2) if moe_mfu == moe_mfu else None,
-                "moe_vs_baseline": (
-                    round(moe_mfu / MOE_BASELINE_MFU, 3) if moe_mfu == moe_mfu else None
-                ),
-                "moe_tflops_per_chip": (
-                    round(moe_tflops, 1) if moe_mfu == moe_mfu else None
-                ),
-                "moe_experts_backend": moe_backend,
-                "moe_mfu_pct_by_backend": moe_tried,
-                "moe_failures": moe_failures or None,
-            }
-        )
-    )
+    result = {
+            "metric": f"llama_dense_lora_mfu_{dense_label}",
+            "value": round(dense_mfu * 100, 2) if dense_ok else None,
+            "unit": "%MFU",
+            "vs_baseline": (
+                round(dense_mfu / DENSE_BASELINE_MFU, 3) if dense_ok else None
+            ),
+            "dense_failure": dense_failure,
+            "dense_tflops_per_chip": round(dense_tflops, 1) if dense_ok else None,
+            "qlora_8b_mfu_pct": (
+                round(qlora_mfu * 100, 2) if qlora_mfu == qlora_mfu else None
+            ),
+            "qlora_8b_vs_baseline": (
+                round(qlora_mfu / DENSE_BASELINE_MFU, 3)
+                if qlora_mfu == qlora_mfu else None
+            ),
+            "qlora_8b_tflops_per_chip": (
+                round(qlora_tflops, 1) if qlora_mfu == qlora_mfu else None
+            ),
+            "qlora_8b_failure": qlora_failure,
+            "moe_mfu_pct": round(moe_mfu * 100, 2) if moe_mfu == moe_mfu else None,
+            "moe_vs_baseline": (
+                round(moe_mfu / MOE_BASELINE_MFU, 3) if moe_mfu == moe_mfu else None
+            ),
+            "moe_tflops_per_chip": (
+                round(moe_tflops, 1) if moe_mfu == moe_mfu else None
+            ),
+            "moe_experts_backend": moe_backend,
+            "moe_mfu_pct_by_backend": moe_tried,
+            "moe_failures": moe_failures or None,
+        }
+    print(json.dumps(result))
+
+    # the VERDICT-r5 guard: a 0.0/None-valued leg with no recorded reason is
+    # a reporting bug, not a measurement — fail the bench loudly so it can
+    # never again ship two rounds of silent zeros
+    from automodel_tpu.telemetry.report import validate_bench_result
+
+    problems = validate_bench_result(result)
+    if problems:
+        for p in problems:
+            print(f"[bench] INVALID RESULT: {p}", file=sys.stderr, flush=True)
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":
