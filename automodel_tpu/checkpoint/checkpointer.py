@@ -10,20 +10,48 @@ TPU-native: orbax handles sharded async array IO (the DCP equivalent);
 consolidated HF safetensors goes through checkpoint/hf_io.py. Restoring
 reshards automatically to the current mesh — orbax restores to the target
 shardings we pass, so elastic re-layout (reference: DCP resharding) is free.
+
+Resilience contract (resilience/manifest.py): every save COMMITS by writing
+``MANIFEST.json`` last (for async saves, when the upload drains at the next
+``wait()``/``close()``), listing every file with size + checksum. Only
+committed dirs count for auto-resume and pruning; ``load()`` verifies and
+walks back past corrupt dirs (bounded by ``max_restore_fallbacks``) instead
+of crashing a restarted run on a damaged newest checkpoint. Orbax calls ride
+the retrying-I/O decorator so transient storage errors back off instead of
+killing the run.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import shutil
+import time
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from automodel_tpu.resilience.fault_injection import active_injector
+from automodel_tpu.resilience.manifest import (
+    classify_step_dirs,
+    has_manifest,
+    step_dir_key as _dir_key,
+    verify_manifest,
+    write_manifest,
+)
+from automodel_tpu.resilience.retry import retry_io
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointIntegrityError(Exception):
+    """No loadable checkpoint: every candidate (within the walk-back bound)
+    failed manifest verification, or an explicitly named dir is damaged."""
 
 
 @dataclasses.dataclass
@@ -38,6 +66,25 @@ class CheckpointingConfig:
     # the background; the next save (or close()) waits for it — reference
     # async staging, checkpointing.py:84-97,519-540
     is_async: bool = False
+    # auto-resume walk-back bound: how many older committed checkpoints
+    # load() may fall back to when newer ones fail verification
+    max_restore_fallbacks: int = 3
+    # False = size-only manifests: keeps the commit marker + truncation
+    # detection but skips the commit-time checksum read-back of the whole
+    # tree (a full disk-bandwidth pass — material at multi-TB scale)
+    manifest_checksums: bool = True
+
+
+@retry_io(op="orbax_save", max_attempts=3)
+def _orbax_save_sync(path: Path, state: Any) -> None:
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state)
+
+
+@retry_io(op="orbax_restore", max_attempts=3)
+def _orbax_restore(path: Path, abstract_state: Any) -> Any:
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, abstract_state)
 
 
 class Checkpointer:
@@ -45,18 +92,71 @@ class Checkpointer:
         self.config = config
         self.root = Path(config.checkpoint_dir)
         self._async: Optional[ocp.AsyncCheckpointer] = None
+        # (dir, epoch, step, layout_markers) whose manifest commits when the
+        # in-flight async save drains
+        self._pending_commit: Optional[tuple[Path, int, int, Optional[dict]]] = None
+        # recipes point this at telemetry.record_step so integrity events
+        # (fallbacks, failed verifications) land in the flight recorder
+        self.event_hook: Optional[Callable[[dict], None]] = None
         if config.is_async:
             self._async = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
 
+    def _event(self, rec: dict) -> None:
+        if self.event_hook is not None:
+            try:
+                self.event_hook(rec)
+            except Exception:  # telemetry must never break checkpointing
+                pass
+
     def wait(self) -> None:
         """Block until any in-flight async save finishes (the reference gates
-        the next optimizer step on staging, train_ft.py:1336)."""
+        the next optimizer step on staging, train_ft.py:1336), then COMMIT it
+        by writing its manifest — a crash before this point leaves the dir
+        uncommitted and auto-resume ignores it. A drain that RAISES discards
+        the pending commit: a later close() (the recipe's finally) must not
+        write a manifest over a partial upload — its checksums would match
+        the partial bytes and verification could never catch it. The failure
+        does NOT propagate when a save was pending: the dir stays
+        uncommitted (resume skips it), the event lands in the flight
+        recorder, and the next cadence save tries again — a flaky remote
+        store costs one checkpoint, not the whole run."""
+        pending, self._pending_commit = self._pending_commit, None
         if self._async is not None:
-            self._async.wait_until_finished()
+            try:
+                self._async.wait_until_finished()
+            except Exception as e:
+                if pending is None:
+                    raise  # no save in flight: this is not a drain failure
+                logger.error(
+                    "async checkpoint save to %s FAILED (%r); dir left "
+                    "uncommitted — resume will skip it, next cadence save "
+                    "retries", pending[0], e,
+                )
+                self._event({
+                    "event": "async_save_failed", "dir": str(pending[0]),
+                    "error": repr(e), "ts": time.time(),
+                })
+                return
+        if pending is not None:
+            self._commit(*pending)
+
+    def _commit(
+        self, out: Path, epoch: int, step: int, layout_markers: Optional[dict]
+    ) -> None:
+        # the commit marker is the last storage touchpoint on the save path;
+        # retried like every other one (write_manifest is tmp+rename, so a
+        # retry after a transient EIO mid-checksum-read-back is idempotent)
+        retry_io(op="manifest_commit", max_attempts=3)(write_manifest)(
+            out, epoch=epoch, step=step, layout_markers=layout_markers,
+            checksums=self.config.manifest_checksums,
+        )
+        inj = active_injector()
+        if inj is not None:
+            inj.after_checkpoint_save(out)
 
     def close(self) -> None:
+        self.wait()
         if self._async is not None:
-            self._async.wait_until_finished()
             self._async.close()
             self._async = None
 
@@ -64,22 +164,57 @@ class Checkpointer:
     def step_dir(self, epoch: int, step: int) -> Path:
         return self.root / f"epoch_{epoch}_step_{step}"
 
+    def _candidate_dirs(self, include_legacy_tail: bool = False) -> list[Path]:
+        """Committed checkpoint dirs, newest first by (epoch, step).
+
+        Committed = manifest present — a single stat per dir, because this
+        runs on every save (via _prune) and a per-file size sweep over
+        thousands of orbax array files on a FUSE mount would stall the step
+        boundary. Contents are verified (sizes AND checksums) at load time
+        by _verify_for_load, which walks back past any dir that fails. The
+        committed/legacy/unfinished classification (and the manifest-era
+        rule deciding which a bare completed-``state/`` dir is) lives in
+        ``manifest.classify_step_dirs``, shared with ``verify-ckpt``.
+
+        ``include_legacy_tail`` (walk-back only): in a manifest-era tree,
+        append the completed-``state/`` no-manifest dirs AFTER every
+        manifest dir — a valid legacy checkpoint is a better last resort
+        than crashing when every manifest-era dir fails verification."""
+        manifest_era, classified = classify_step_dirs(self.root)
+        cands = [
+            p for p, kind in classified
+            if kind == "committed" or (kind == "legacy_state" and not manifest_era)
+        ]
+        legacy_tail = [
+            p for p, kind in classified
+            if kind == "legacy_state" and manifest_era
+        ]
+        cands.sort(key=_dir_key, reverse=True)
+        if include_legacy_tail:
+            cands.extend(sorted(legacy_tail, key=_dir_key, reverse=True))
+        return cands
+
+    def latest_committed_dir(self) -> Path | None:
+        """Newest checkpoint committed into THIS run's tree — no
+        ``restore_from`` bootstrap fallback. The preemption path uses this
+        to decide requeue-eligibility: a run that committed nothing must
+        exit as a real failure, or the launcher would requeue it to
+        re-bootstrap and be preempted again at zero net progress."""
+        cands = self._candidate_dirs()
+        return cands[0] if cands else None
+
     def latest_dir(self) -> Path | None:
+        """Newest committed run-local checkpoint; ``restore_from`` is only
+        the BOOTSTRAP source, used when the run's own tree is empty. (If it
+        pinned every resume, a preempted-and-requeued run would restart
+        from the original base checkpoint forever — zero net progress under
+        recurring preemption.)"""
+        cands = self._candidate_dirs()
+        if cands:
+            return cands[0]
         if self.config.restore_from:
             return Path(self.config.restore_from)
-        if not self.root.exists():
-            return None
-        # only COMMITTED checkpoints count: orbax writes to a tmp-suffixed
-        # dir and renames to `state` on completion, so a crash mid-async-save
-        # leaves no `state/` and auto-resume falls back to the previous step
-        cands = [
-            p
-            for p in self.root.iterdir()
-            if p.is_dir() and p.name.startswith("epoch_") and (p / "state").exists()
-        ]
-        if not cands:
-            return None
-        return max(cands, key=lambda p: int(p.name.rsplit("_", 1)[1]))
+        return None
 
     # -- save ---------------------------------------------------------------
     def save(
@@ -102,15 +237,18 @@ class Checkpointer:
         # saving the same step twice (cadence save + end-of-loop save) is
         # idempotent: replace the previous state dir
         self.wait()  # at most one async save in flight
+        # UNCOMMIT first: a stale manifest must not vouch for the dir while
+        # its contents are being rewritten underneath it
+        manifest = out / "MANIFEST.json"
+        if manifest.exists():
+            manifest.unlink()
         if (out / "state").exists():
             shutil.rmtree(out / "state")
-        if self._async is not None:
-            self._async.save(
-                (out / "state").absolute(), args=ocp.args.StandardSave(state)
-            )
-        else:
-            with ocp.StandardCheckpointer() as ckptr:
-                ckptr.save((out / "state").absolute(), state)
+        # a kill mid-async-save strands `state.orbax-checkpoint-tmp-*`;
+        # reclaim it here so the re-save doesn't carry dead bytes (the
+        # manifest writer independently refuses to list such dirs)
+        for stale in out.glob("*.orbax-checkpoint-tmp-*"):
+            shutil.rmtree(stale, ignore_errors=True)
         if extra_state:
             (out / "extra_state.json").write_text(json.dumps(extra_state, default=_json_default))
         if config_snapshot:
@@ -127,19 +265,62 @@ class Checkpointer:
             # save_hf_checkpoint flushes shard files as they fill.
             save_hf_checkpoint(out / "hf", adapter.to_hf(params))
             write_hf_addons(out / "hf", **(hf_meta or {}))
-        self._prune()
+        if self._async is not None:
+            # dispatch (blocking device→host staging) retried like the sync
+            # path; the OSError-typed filter never retries orbax state
+            # errors, only transient storage failures
+            retry_io(op="orbax_async_dispatch", max_attempts=3)(self._async.save)(
+                (out / "state").absolute(), args=ocp.args.StandardSave(state)
+            )
+            self._pending_commit = (out, epoch, step, layout_markers)
+        else:
+            _orbax_save_sync((out / "state").absolute(), state)
+            self._commit(out, epoch, step, layout_markers)
+        self._prune(protect={out.resolve()})
         return out
 
-    def _prune(self) -> None:
+    def _prune(self, protect: set[Path] | None = None) -> None:
+        """Delete committed checkpoints beyond ``keep_last_k`` (by (epoch,
+        step), oldest first). Only COMMITTED dirs count toward k — an
+        uncommitted crash leftover must not silently consume a keep slot —
+        and neither the dir named by ``restore_from`` (the resume source of
+        a running job) nor the in-flight save target is ever deleted.
+
+        Uncommitted leftovers strictly OLDER than the newest committed
+        checkpoint are garbage (a kill mid-save can leave a multi-GB
+        partial tree per incident — on spot capacity that fills the volume)
+        and are deleted too — but ONLY dirs without a completed ``state/``
+        (a kill mid-upload leaves ``state.orbax-checkpoint-tmp-*``, never
+        ``state/``). A dir WITH ``state/`` and no manifest is
+        indistinguishable from a valid legacy (pre-manifest) checkpoint,
+        and sweeping those would destroy every legacy restore point the
+        moment the first manifest-era save lands. A newer-or-equal
+        uncommitted dir is left alone (it may be the save currently in
+        flight)."""
         k = self.config.keep_last_k
         if k <= 0 or not self.root.exists():
             return
-        cands = sorted(
-            (p for p in self.root.iterdir() if p.is_dir() and p.name.startswith("epoch_")),
-            key=lambda p: int(p.name.rsplit("_", 1)[1]),
-        )
-        for p in cands[:-k]:
+        protect = set(protect or ())
+        if self.config.restore_from:
+            protect.add(Path(self.config.restore_from).resolve())
+        if self._pending_commit is not None:
+            protect.add(self._pending_commit[0].resolve())
+        committed = self._candidate_dirs()  # newest first
+        for p in committed[k:]:
+            if p.resolve() in protect:
+                continue
             shutil.rmtree(p)
+        if not committed:
+            return
+        newest_key = _dir_key(committed[0])
+        keep = {p.resolve() for p in committed}
+        for p in self.root.iterdir():
+            key = _dir_key(p)
+            if key is None or not p.is_dir() or p.resolve() in keep | protect:
+                continue
+            if key < newest_key and not (p / "state").exists():
+                logger.warning("pruning stale uncommitted checkpoint dir %s", p)
+                shutil.rmtree(p)
 
     # -- load ---------------------------------------------------------------
     def load(
@@ -147,6 +328,7 @@ class Checkpointer:
         abstract_state: Any,
         path: str | os.PathLike | None = None,
         expected_layout_markers: dict[str, str] | None = None,
+        before_step: int | None = None,
     ) -> tuple[Any, dict]:
         """Restore (state, extra_state). `abstract_state` is a pytree of
         jax.ShapeDtypeStruct with shardings (from eval_shape + plan) so orbax
@@ -155,18 +337,118 @@ class Checkpointer:
         ``expected_layout_markers``: the model's native-layout contract
         (e.g. GptOssForCausalLM.native_layout_markers). Checked BEFORE the
         array restore so a pre-flip checkpoint (interleaved gpt-oss gate_up)
-        fails loudly instead of loading params that silently mis-compute."""
-        d = Path(path) if path else self.latest_dir()
-        if d is None:
-            raise FileNotFoundError(f"No checkpoint found under {self.root}")
+        fails loudly instead of loading params that silently mis-compute.
+
+        An explicitly named dir (``path`` arg, or ``restore_from`` when the
+        run-local tree is empty — the bootstrap case) is fully verified and
+        FAILS on damage — the user asked for that checkpoint, silently
+        substituting another would be worse. Auto-resume walks back through
+        committed run-local dirs (newest first, at most
+        ``max_restore_fallbacks`` extra candidates), loudly logging each
+        rejected dir into the flight recorder.
+
+        ``before_step`` (auto-resume only) restricts candidates to
+        checkpoints saved STRICTLY BEFORE that optimizer step — the
+        non-finite rollback policy uses it because a cadence save at (or
+        after) the diverged step already contains the poisoned params."""
+        if path is not None:
+            d = self._verify_for_load(Path(path))
+        else:
+            try:
+                d = self._pick_verified_latest(before_step=before_step)
+            except FileNotFoundError:
+                # no run-local committed checkpoint at all → bootstrap
+                # (restore_from is by definition older than any run step,
+                # so it also satisfies before_step)
+                if not self.config.restore_from:
+                    raise
+                d = self._verify_for_load(Path(self.config.restore_from))
         extra_file = d / "extra_state.json"
         extra = json.loads(extra_file.read_text()) if extra_file.exists() else {}
         check_layout_markers(
             extra.get("_layout_markers"), expected_layout_markers, d
         )
-        with ocp.StandardCheckpointer() as ckptr:
-            state = ckptr.restore((d / "state").absolute(), abstract_state)
+        state = _orbax_restore((d / "state").absolute(), abstract_state)
         return state, extra
+
+    def _verify_for_load(self, d: Path) -> Path:
+        if not d.exists():
+            raise FileNotFoundError(f"No checkpoint found at {d}")
+        if not has_manifest(d):
+            # pre-manifest tree: nothing to verify against — load with a
+            # warning rather than stranding older runs
+            logger.warning(
+                "checkpoint %s has no MANIFEST.json (pre-manifest save) — "
+                "loading unverified", d,
+            )
+            return d
+        ok, problems = verify_manifest(d, check_checksums=True)
+        if ok:
+            return d
+        raise CheckpointIntegrityError(
+            f"checkpoint {d} fails integrity verification:\n  "
+            + "\n  ".join(problems)
+        )
+
+    def _pick_verified_latest(self, before_step: int | None = None) -> Path:
+        cands = self._candidate_dirs(include_legacy_tail=True)
+        if before_step is not None:
+            cands = [p for p in cands if _dir_key(p)[1] < before_step]
+        if not cands:
+            raise FileNotFoundError(
+                f"No checkpoint found under {self.root}"
+                + (f" before step {before_step}" if before_step is not None else "")
+            )
+        budget = 1 + max(self.config.max_restore_fallbacks, 0)
+        rejected: list[str] = []
+        for i, d in enumerate(cands[:budget]):
+            try:
+                chosen = self._verify_for_load(d)
+            except CheckpointIntegrityError as e:
+                quarantined = self._quarantine(d)
+                logger.error(
+                    "checkpoint %s FAILED verification — quarantined as %s, "
+                    "falling back to the previous committed checkpoint (%s)",
+                    d, quarantined, e,
+                )
+                self._event(
+                    {
+                        "event": "checkpoint_fallback",
+                        "rejected": str(d),
+                        "quarantined_as": str(quarantined),
+                        "problems": str(e),
+                    }
+                )
+                rejected.append(f"{d}: {e}")
+                continue
+            if i > 0:
+                logger.warning(
+                    "resuming from OLDER checkpoint %s after %d newer dir(s) "
+                    "failed verification — some steps will be retrained",
+                    chosen, i,
+                )
+            return chosen
+        raise CheckpointIntegrityError(
+            f"no loadable checkpoint under {self.root} within "
+            f"{budget} candidate(s):\n  " + "\n  ".join(rejected)
+        )
+
+    def _quarantine(self, d: Path) -> Path:
+        """Rename a committed-but-corrupt dir out of the ``epoch_E_step_S``
+        namespace (data kept for forensics). Without this, corrupt dirs
+        would keep occupying ``keep_last_k`` slots FOREVER — pruning counts
+        them as committed and would delete the newer GOOD post-resume saves
+        instead, until every restore candidate is corrupt."""
+        target = d.with_name(d.name + ".corrupt")
+        n = 0
+        while target.exists():
+            n += 1
+            target = d.with_name(f"{d.name}.corrupt{n}")
+        try:
+            d.rename(target)
+        except OSError:  # quarantine is best-effort; the walk-back proceeds
+            return d
+        return target
 
     def has_checkpoint(self) -> bool:
         return self.latest_dir() is not None

@@ -26,6 +26,8 @@ from typing import Any, Iterable, Iterator
 import ml_dtypes
 import numpy as np
 
+from automodel_tpu.resilience.retry import retry_io
+
 SAFETENSORS_INDEX = "model.safetensors.index.json"
 MAX_SHARD_BYTES = 5 * 1024**3
 
@@ -49,8 +51,11 @@ _ST_TO_NP = {
 _NP_TO_ST = {v: k for k, v in _ST_TO_NP.items()}
 
 
+@retry_io(op="safetensors_read_header", max_attempts=3)
 def _read_header(path: Path) -> tuple[dict, int]:
-    """(header dict, data section offset)."""
+    """(header dict, data section offset). Retried: remote mounts (GCS
+    fuse, NFS) surface transient EIO/ESTALE here; a malformed header is a
+    ValueError and propagates immediately."""
     with open(path, "rb") as f:
         (n,) = struct.unpack("<Q", f.read(8))
         header = json.loads(f.read(n))
@@ -181,6 +186,7 @@ class HFCheckpointReader:
         self._files.clear()
 
 
+@retry_io(op="safetensors_write", max_attempts=3)
 def _write_safetensors(path: Path, tensors: dict[str, np.ndarray]) -> None:
     header: dict[str, Any] = {}
     offset = 0

@@ -21,7 +21,29 @@ def _usage() -> str:
     return (
         "usage: automodel_tpu <finetune|pretrain|kd|benchmark|mine> <llm|vlm|biencoder> "
         "-c config.yaml [--dotted.key=value ...]\n"
-        "       automodel_tpu report <train_metrics.jsonl> [--strict]"
+        "       automodel_tpu report <train_metrics.jsonl> [--strict]\n"
+        "       automodel_tpu verify-ckpt <ckpt_dir> [--no-checksums] [--json]"
+    )
+
+
+def _crash_is_preemption_collateral(cfg) -> bool:
+    """Multi-host requeue wiring (resilience/preemption.py): when ONE host
+    of a multi-host job is preempted it exits the requeue code, but its
+    peers die of broken collectives with ordinary exceptions. The preempted
+    host drops a marker into the shared checkpoint root at SIGTERM time; a
+    crash here while that marker is FRESH is preemption collateral and must
+    requeue too, or the launcher burns its backoff budget on spot churn."""
+    from automodel_tpu.checkpoint.checkpointer import CheckpointingConfig
+    from automodel_tpu.resilience import peer_preemption_fresh
+
+    ccfg = dict(cfg.get("checkpoint", {}) or {})
+    if not ccfg.get("enabled", False):
+        return False
+    # default from the dataclass, not a re-typed literal: the trainer writes
+    # the marker into CheckpointingConfig.checkpoint_dir, and the two paths
+    # must never drift apart
+    return peer_preemption_fresh(
+        ccfg.get("checkpoint_dir", CheckpointingConfig.checkpoint_dir)
     )
 
 
@@ -33,6 +55,12 @@ def main(argv: list[str] | None = None) -> int:
         from automodel_tpu.telemetry.report import main as report_main
 
         return report_main(argv[1:])
+    # `verify-ckpt` audits a checkpoint tree's manifests (integrity + layout
+    # markers) without loading arrays — checkpoint/verify.py
+    if argv and argv[0] == "verify-ckpt":
+        from automodel_tpu.checkpoint.verify import main as verify_main
+
+        return verify_main(argv[1:])
     if len(argv) < 2 or argv[0] in ("-h", "--help"):
         print(_usage())
         return 0 if argv and argv[0] in ("-h", "--help") else 2
@@ -102,7 +130,29 @@ def main(argv: list[str] | None = None) -> int:
                 raise
             module = None
         if module is not None:
-            module.main(cfg)
+            from automodel_tpu.resilience import REQUEUE_EXIT_CODE, TrainingPreempted
+
+            try:
+                module.main(cfg)
+            except TrainingPreempted as e:
+                print(f"preempted: {e}", file=sys.stderr)
+                if e.checkpoint_dir is None:
+                    # nothing committed to resume from: requeueing would loop
+                    # at zero progress forever — fail loudly instead so the
+                    # launcher/operator sees a real failure
+                    return 1
+                # the emergency checkpoint is committed; exit with the
+                # requeue code the launchers translate into a restart
+                return REQUEUE_EXIT_CODE
+            except Exception as e:
+                if _crash_is_preemption_collateral(cfg):
+                    print(
+                        "crash while a peer host's preemption marker is "
+                        f"fresh — requeueing as preemption collateral: {e!r}",
+                        file=sys.stderr,
+                    )
+                    return REQUEUE_EXIT_CODE
+                raise
             return 0
     print(f"{command} {domain} is not implemented yet")
     return 3

@@ -19,8 +19,8 @@ kind: Job
 metadata:
   name: {name}
 spec:
-  backoffLimit: 0
-  completions: {num_hosts}
+  backoffLimit: {backoff_limit}
+{pod_failure_policy}  completions: {num_hosts}
   parallelism: {num_hosts}
   completionMode: Indexed
   template:
@@ -45,6 +45,64 @@ spec:
 {extra_env}
 """
 
+# requeue wiring (resilience/preemption.py): a container exiting with
+# {requeue_exit_code} means "preempted; emergency checkpoint committed" —
+# Ignore recreates the pod WITHOUT consuming backoffLimit, so spot
+# preemptions requeue forever while any real crash still FailJobs
+# immediately (restartPolicy must stay Never for podFailurePolicy).
+# Rules match in order; the DisruptionTarget rule comes FIRST so a
+# preemption/eviction kill that never reaches the trainer's exit-75 path —
+# an emergency save outliving the grace window ends in SIGKILL (137), and a
+# node-level eviction may record no container exit at all — still requeues
+# instead of tripping the catch-all FailJob.
+POD_FAILURE_POLICY = """\
+  podFailurePolicy:
+    rules:
+      - action: Ignore
+        onPodConditions:
+          - type: DisruptionTarget
+            status: "True"
+      - action: Ignore
+        onExitCodes:
+          containerName: train
+          operator: In
+          values: [{requeue_exit_code}]
+      - action: FailJob
+        onExitCodes:
+          containerName: train
+          operator: NotIn
+          values: [{requeue_exit_code}]
+"""
+
+# Multi-host: when one host is preempted (exits 75, Ignored above) its
+# peers die from broken collectives with ORDINARY non-zero exits and no
+# DisruptionTarget condition — indistinguishable, by exit code, from a
+# real crash. Two layers disarm that: (1) the preempted trainer drops a
+# marker into the SHARED checkpoint root at SIGTERM time, and a peer
+# whose run then crashes while the marker is fresh exits 75 itself
+# (cli/app.py _crash_is_preemption_collateral) — Ignored above; (2) the
+# marker is best-effort (an object-store checkpoint root can't host it),
+# so the catch-all FailJob is still dropped and residual peer deaths
+# Count against a backoffLimit sized to absorb several preemption events
+# per host. A genuinely crashing job still exhausts that budget quickly;
+# podFailurePolicy itself has no cross-pod state to do better with.
+POD_FAILURE_POLICY_MULTIHOST = """\
+  podFailurePolicy:
+    rules:
+      - action: Ignore
+        onPodConditions:
+          - type: DisruptionTarget
+            status: "True"
+      - action: Ignore
+        onExitCodes:
+          containerName: train
+          operator: In
+          values: [{requeue_exit_code}]
+"""
+
+# preemption-collateral retry budget per host (multi-host requeue only)
+BACKOFF_PER_HOST = 4
+
 
 @dataclasses.dataclass
 class K8sConfig:
@@ -56,6 +114,9 @@ class K8sConfig:
     chips_per_host: int = 4
     env: Optional[dict] = None
     manifest_dir: str = "k8s"
+    # the exit code itself is deliberately not configurable: the trainer
+    # always exits resilience.REQUEUE_EXIT_CODE on preemption
+    requeue_on_preemption: bool = True
 
 
 def render_manifest(
@@ -71,9 +132,25 @@ def render_manifest(
     extra_env = ""
     for k, v in (cfg.env or {}).items():
         extra_env += f'            - name: {k}\n              value: "{v}"\n'
+    from automodel_tpu.resilience.preemption import REQUEUE_EXIT_CODE
+
     ov = "".join(f', "{o}"' for o in (overrides or []))
+    backoff_limit = 0  # no requeue, or single host: any real crash fails fast
+    pod_failure_policy = ""
+    if cfg.requeue_on_preemption:
+        if cfg.num_hosts > 1:
+            pod_failure_policy = POD_FAILURE_POLICY_MULTIHOST.format(
+                requeue_exit_code=REQUEUE_EXIT_CODE
+            )
+            backoff_limit = BACKOFF_PER_HOST * cfg.num_hosts
+        else:
+            pod_failure_policy = POD_FAILURE_POLICY.format(
+                requeue_exit_code=REQUEUE_EXIT_CODE
+            )
     return MANIFEST_TEMPLATE.format(
         overrides=ov,
+        pod_failure_policy=pod_failure_policy,
+        backoff_limit=backoff_limit,
         name=cfg.name,
         image=cfg.image,
         accelerator=cfg.accelerator,

@@ -28,7 +28,32 @@ export JAX_NUM_PROCESSES=$SLURM_NTASKS
 srun --kill-on-bad-exit=1 bash -c '
 export JAX_PROCESS_ID=$SLURM_PROCID
 {container_prefix}python -m automodel_tpu.cli.app {command} {domain} -c {config_path} {overrides}
+rc=$?
+{marker_line}exit $rc
 '
+rc=$?
+{requeue_block}exit $rc
+"""
+
+# exit {requeue_exit_code} (REQUEUE_EXIT_CODE, resilience/preemption.py)
+# means "preempted; emergency checkpoint committed — run me again": requeue
+# this job instead of failing it. Auto-resume picks up the newest
+# manifest-verified checkpoint on restart.
+#
+# Multi-node wrinkle: with --kill-on-bad-exit=1, srun reports the HIGHEST
+# task exit code — the first task to exit 75 triggers a SIGKILL of its
+# peers (exit 137), which masks the 75. Each task therefore drops a marker
+# file on the (shared) submit directory when it exits 75, and the epilogue
+# requeues on rc==75 OR the marker.
+MARKER_LINE = (
+    'if [ $rc -eq {requeue_exit_code} ]; '
+    'then touch ".preempted_$SLURM_JOB_ID"; fi\n'
+)
+REQUEUE_BLOCK = """if [ $rc -eq {requeue_exit_code} ] || [ -f ".preempted_$SLURM_JOB_ID" ]; then
+  echo "preempted: requeueing $SLURM_JOB_ID"
+  rm -f ".preempted_$SLURM_JOB_ID"
+  scontrol requeue $SLURM_JOB_ID
+fi
 """
 
 
@@ -54,6 +79,13 @@ class SlurmConfig:
     env: dict = dataclasses.field(default_factory=dict)
     extra_directives: Sequence[str] = ()
     job_dir: str = "slurm_jobs"
+    # preemption-aware requeue (resilience/): a task exiting with
+    # REQUEUE_EXIT_CODE gets `scontrol requeue`d; requires the job to be
+    # requeueable, so the --requeue directive is emitted alongside. The
+    # code itself is NOT configurable here — the trainer always exits
+    # resilience.REQUEUE_EXIT_CODE, and a knob that only changed the
+    # launcher side would silently break every requeue.
+    requeue_on_preemption: bool = True
 
 
 def render_sbatch(
@@ -64,6 +96,14 @@ def render_sbatch(
         directives.append(f"#SBATCH --account={cfg.account}")
     if cfg.partition:
         directives.append(f"#SBATCH --partition={cfg.partition}")
+    from automodel_tpu.resilience.preemption import REQUEUE_EXIT_CODE
+
+    requeue_block = marker_line = ""
+    if cfg.requeue_on_preemption:
+        directives.append("#SBATCH --requeue")
+        directives.append("#SBATCH --open-mode=append")
+        requeue_block = REQUEUE_BLOCK.format(requeue_exit_code=REQUEUE_EXIT_CODE)
+        marker_line = MARKER_LINE.format(requeue_exit_code=REQUEUE_EXIT_CODE)
     container_prefix = ""
     if cfg.container_image:
         mounts = ",".join(str(m) for m in cfg.container_mounts)
@@ -84,6 +124,8 @@ def render_sbatch(
         domain=domain,
         config_path=config_path,
         overrides=" ".join(overrides),
+        requeue_block=requeue_block,
+        marker_line=marker_line,
     )
 
 

@@ -18,11 +18,86 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import time
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: degrade to the lock-free conservative path
+    fcntl = None
 from pathlib import Path
 from typing import Any
 
 import numpy as np
+
+from automodel_tpu.resilience.retry import retry_io
+
+
+def _append_attempt(path, data: bytes, state: dict) -> None:
+    """One attempt of the idempotent append (separated out so the retry
+    closure in _append_line — and the tests — can drive it directly).
+
+    The append offset is captured ONCE per logical append (first attempt
+    to get this far): without that, an attempt whose write lands durably
+    but whose flush raises a deferred EIO would leave a clean trailing
+    newline, and a naive retry would append the record a second time.
+    ``a+`` mode recreates the file if it was unlinked/rotated mid-run
+    (O_APPEND writes land at EOF).
+
+    Multi-writer safety (several hosts logging to one shared-FS path, as a
+    multi-node slurm launch does): the ONLY bytes ever truncated are a
+    prefix of OUR OWN record — an earlier attempt's durable write being
+    retried. A dangling no-newline tail found at the first attempt could
+    be our crashed predecessor's partial record or another live writer's
+    in-flight bytes, and the two are indistinguishable even under flock
+    (NFS flock can be a per-host no-op), so it is SEALED with a newline
+    instead of truncated: the fragment becomes its own lint-flagged line
+    (telemetry/report.py parses past it), our record stays parseable, and
+    nobody's data is deleted. Bytes that land after our captured offset
+    between attempts get the same treatment — the offset moves forward and
+    we accept a possible duplicate of ours rather than delete theirs. The
+    flock, where it works, additionally keeps whole records from
+    interleaving; nothing below depends on it for safety."""
+    with open(path, "a+b") as f:
+        if fcntl is not None:
+            try:
+                fcntl.flock(f, fcntl.LOCK_EX)
+            except OSError:
+                pass  # filesystem without flock: safe regardless, see above
+        end = f.seek(0, os.SEEK_END)
+        if "start" not in state:
+            if end:
+                f.seek(end - 1)
+                if f.read(1) != b"\n":
+                    f.write(b"\n")  # seal a crashed writer's fragment
+                    end += 1
+            state["start"] = end
+        # the file may have shrunk between attempts (rotation): never
+        # truncate PAST the current end, which would zero-fill
+        start = min(state["start"], end)
+        if start < end:
+            # bytes landed after our captured offset: OURS iff a prefix of
+            # this record (an earlier attempt's durable write)
+            f.seek(start)
+            tail = f.read(end - start)
+            if data.startswith(tail):
+                f.truncate(start)
+            else:
+                if not tail.endswith(b"\n"):  # crashed writer's fragment
+                    f.write(b"\n")
+                state["start"] = f.seek(0, os.SEEK_END)
+        f.write(data)
+        f.flush()
+
+
+def _append_line(path, line: str) -> None:
+    """Retried JSONL append — all I/O (including offset probing) sits
+    inside the retried body, so transient stat/open failures back off like
+    any other error; the shared ``state`` makes retries idempotent."""
+    state: dict = {}
+    retry_io(op="metrics_flush", max_attempts=3, base_delay_s=0.1, max_delay_s=1.0)(
+        lambda: _append_attempt(path, line.encode(), state)
+    )()
 
 
 def _to_scalar(v: Any) -> Any:
@@ -66,7 +141,7 @@ class MetricLogger:
     def __init__(self, path: str, wandb_run: Any = None, sinks: Any = None):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self._f = open(self.path, "a")
+        self.path.touch()  # the file exists even before the first record
         self.wandb_run = wandb_run
         self.sinks = list(sinks or [])
 
@@ -81,8 +156,7 @@ class MetricLogger:
             if bad:
                 jsonl_rec[f"{k}_nonfinite"] = True
         jsonl_rec.setdefault("ts", time.time())
-        self._f.write(json.dumps(jsonl_rec, allow_nan=False) + "\n")
-        self._f.flush()
+        _append_line(self.path, json.dumps(jsonl_rec, allow_nan=False) + "\n")
         # sinks receive the caller's record untouched (wandb renders NaN
         # natively; injected ts stays out of external dashboards)
         if self.wandb_run is not None:
@@ -91,7 +165,6 @@ class MetricLogger:
             s.log(rec, step=step)
 
     def close(self) -> None:
-        self._f.close()
         for s in self.sinks:
             close = getattr(s, "close", None)
             if close:

@@ -22,7 +22,7 @@ from automodel_tpu import auto_model
 from automodel_tpu.config.loader import ConfigNode
 from automodel_tpu.ops import losses as L
 from automodel_tpu.recipes.train_ft import TrainFinetuneRecipeForNextTokenPrediction
-from automodel_tpu.training.train_step import build_eval_step, build_train_step
+from automodel_tpu.training.train_step import build_eval_step
 
 logger = logging.getLogger(__name__)
 
@@ -110,10 +110,9 @@ class KDRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
             if self.peft_config is None
             else None
         )
-        self.train_step = build_train_step(
-            self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step,
-            anomaly_flags=getattr(self, "_anomaly_flags", True),
-        )
+        # _make_train_step folds in the anomaly flags, the non-finite
+        # policy, and the fault-injection arm alongside the KD loss
+        self.train_step = self._make_train_step(self.loss_fn, post_step_fn=post_step)
         # eval must not apply LoRA dropout — use the train=False variant
         self.eval_step = build_eval_step(
             getattr(self.loss_fn, "eval_loss_fn", self.loss_fn)

@@ -80,13 +80,10 @@ class TrainBiencoderRecipe(TrainFinetuneRecipeForNextTokenPrediction):
             )
             return contrastive_loss(q, d, temperature=temperature)
 
-        from automodel_tpu.training.train_step import build_eval_step, build_train_step
+        from automodel_tpu.training.train_step import build_eval_step
 
         self.loss_fn = loss_fn
-        self.train_step = build_train_step(
-            loss_fn, self.optimizer, self.lr_schedule,
-            anomaly_flags=getattr(self, "_anomaly_flags", True),
-        )
+        self.train_step = self._make_train_step(loss_fn)
         self.eval_step = build_eval_step(loss_fn)
 
     def _build_dataloader(self, dataset_cfg: Any, dl_cfg: Any) -> DataLoader:

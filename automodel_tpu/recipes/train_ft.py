@@ -37,6 +37,8 @@ from automodel_tpu.loggers.metric_logger import MetricLogger
 from automodel_tpu.optim.builders import build_optimizer
 from automodel_tpu.optim.scheduler import build_lr_schedule
 from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+from automodel_tpu.resilience import NonFiniteError, Resilience, TrainingPreempted
+from automodel_tpu.resilience.manifest import step_dir_key
 from automodel_tpu.training.rng import StatefulRNG
 from automodel_tpu.training.step_scheduler import StepScheduler
 from automodel_tpu.training.train_state import TrainState
@@ -47,6 +49,16 @@ from automodel_tpu.training.train_step import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+class _RollbackRequested(Exception):
+    """Internal control flow: the non-finite policy asked for a restore of
+    the last verified checkpoint (caught inside the crash guard, so it never
+    reaches the flight recorder as a crash)."""
+
+    def __init__(self, fail_step: int):
+        super().__init__(f"rollback requested at step {fail_step}")
+        self.fail_step = fail_step
 
 
 class TrainFinetuneRecipeForNextTokenPrediction:
@@ -201,10 +213,28 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self._anomaly_flags = bool(tcfg.get("enabled", True)) and bool(
             tcfg.get("anomaly_flags", True)
         )
-        self.train_step = build_train_step(
-            self.loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step,
+        # resilience: preemption handling + non-finite-step policy + fault
+        # injection (resilience/). Built before the step because the `skip`
+        # policy and the nan-grads injection live INSIDE the jit.
+        self.resilience = Resilience.from_config(
+            cfg.get("fault_tolerance"), cfg.get("fault_injection")
+        )
+        if (
+            self.resilience.config.enabled
+            and self.resilience.on_nonfinite == "raise"
+            and not self._anomaly_flags
+        ):
+            # skip/rollback force the in-jit flag themselves; the default
+            # raise policy respects the anomaly_flags opt-out — but that
+            # leaves non-finite steps undetected, which deserves a shout
+            logger.warning(
+                "telemetry.anomaly_flags is disabled: fault_tolerance."
+                "on_nonfinite=raise cannot detect non-finite steps — "
+                "divergence will train through silently"
+            )
+        self.train_step = self._make_train_step(
+            self.loss_fn, post_step_fn=post_step,
             grad_mask=getattr(self, "grad_mask", None),
-            anomaly_flags=self._anomaly_flags,
         )
         # eval must not apply LoRA dropout — use the train=False variant
         self.eval_step = build_eval_step(
@@ -219,21 +249,23 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 cfg.get("validation_dataset"), cfg.get("validation_dataloader", cfg.get("dataloader", {}))
             )
 
-        # step scheduler
+        # step scheduler + signal wiring: with resilience enabled (default),
+        # SIGTERM means PREEMPTION — the handler flips the preempted flag and
+        # asks the scheduler to stop at the next step boundary, after which
+        # the loop saves an emergency checkpoint and exits with the requeue
+        # code. With resilience disabled, the scheduler's own (chaining)
+        # graceful-shutdown handler is installed as before.
         scfg = dict(cfg.get("step_scheduler", {}) or {})
         self.step_scheduler = StepScheduler(dataloader=self.dataloader, **scfg)
-        self.step_scheduler.install_signal_handler()
-
-        # checkpointing
-        ccfg = dict(cfg.get("checkpoint", {}) or {})
-        self.checkpointer = Checkpointer(CheckpointingConfig(**ccfg)) if ccfg.get(
-            "enabled", False
-        ) else None
-        if self.checkpointer and self.checkpointer.has_checkpoint():
-            self._restore()
+        if self.resilience.preemption is not None:
+            self.resilience.preemption.on_preempt = self.step_scheduler.request_shutdown
+            self.resilience.install()
+        else:
+            self.step_scheduler.install_signal_handler()
 
         # metrics (JSONL + optional wandb/MLflow fan-out,
-        # reference train_ft.py:844-853)
+        # reference train_ft.py:844-853) — built BEFORE the checkpointer so
+        # the startup auto-resume can stamp its resume marker
         log_cfg = cfg.get("logging", ConfigNode())
         wandb_run, sinks = None, []
         if log_cfg.get("wandb") is not None:
@@ -264,6 +296,33 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             default_recorder_path=str(
                 self.metric_logger.path.parent / "flight_recorder.json"
             ),
+        )
+
+        # checkpointing — AFTER telemetry, so the event hook is live for the
+        # startup auto-resume: a walk-back past a corrupt newest checkpoint
+        # during _restore() must reach the flight recorder
+        ccfg = dict(cfg.get("checkpoint", {}) or {})
+        self.checkpointer = Checkpointer(CheckpointingConfig(**ccfg)) if ccfg.get(
+            "enabled", False
+        ) else None
+        if self.checkpointer is not None:
+            self.checkpointer.event_hook = self.telemetry.record_step
+            # multi-host: at SIGTERM time drop a marker into the shared
+            # checkpoint root so peer hosts dying of broken collectives
+            # exit with the requeue code too (cli/app.py checks it)
+            self.resilience.arm_peer_marker(self.checkpointer.root)
+        if self.checkpointer and self.checkpointer.has_checkpoint():
+            self._restore()
+
+    def _make_train_step(self, loss_fn, post_step_fn=None, grad_mask=None):
+        """Single construction point for the jitted step so every recipe
+        subclass that swaps the loss (KD, biencoder, seq-cls) inherits the
+        anomaly flags, the non-finite policy, and the fault-injection arm."""
+        return build_train_step(
+            loss_fn, self.optimizer, self.lr_schedule, post_step_fn=post_step_fn,
+            grad_mask=grad_mask, anomaly_flags=self._anomaly_flags,
+            on_nonfinite=self.resilience.on_nonfinite,
+            nan_grads_at_step=self.resilience.nan_grads_at_step,
         )
 
     def _build_auto(self, mcfg: Any, backend: dict):
@@ -332,7 +391,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             )
         logger.info("saved checkpoint at step %d", self.step_scheduler.step)
 
-    def _restore(self) -> None:
+    def _restore(self, before_step: Optional[int] = None) -> None:
         # Abstract target WITH shardings so orbax restores every array —
         # params AND optimizer moments — directly onto its current-mesh shard
         # (adam state is 2x model size; restoring it replicated would OOM).
@@ -352,6 +411,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             expected_layout_markers=getattr(
                 self.model, "native_layout_markers", None
             ),
+            before_step=before_step,
         )
         self.state = state
         if "dataloader" in extra:
@@ -361,6 +421,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         if "rng" in extra:
             self.rng.load_state_dict(extra["rng"])
         logger.info("restored checkpoint at step %d", int(self.state.step))
+        # stamp the resume into the JSONL: step numbers may legitimately go
+        # backwards after this (walk-back / rollback retraining), and the
+        # report linter only excuses a rewind that follows such a marker
+        if getattr(self, "metric_logger", None) is not None:
+            self.metric_logger.log(
+                {"event": "resume", "resumed_from_step": int(self.state.step)}
+            )
 
     # -- train loop ---------------------------------------------------------
     def run_train_validation_loop(self) -> dict:
@@ -376,22 +443,167 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         touching the hot path. Step 1 blocks immediately and is reported as
         ``compile_time_s`` (XLA compile dominates it), excluded from every
         throughput window. Windows also restart after validation/checkpoint
-        pauses so their wall time is never charged to training steps."""
-        tel = self.telemetry
+        pauses so their wall time is never charged to training steps.
+
+        Resilience semantics (docs/fault_tolerance.md): a preemption signal
+        drains the loop at the next step boundary, then the end-of-loop save
+        below becomes the EMERGENCY checkpoint — committed (manifest written,
+        async save drained) before ``TrainingPreempted`` unwinds to the CLI,
+        which exits with the requeue code. A non-finite step is detected one
+        step late (the flag is fetched from the PREVIOUS step's metrics
+        after dispatching the current one, so detection never stalls async
+        dispatch) and handled per ``fault_tolerance.on_nonfinite``; rollback
+        restores the last verified checkpoint and fast-forwards the
+        dataloader past the offending window."""
+        tel, res = self.telemetry, self.resilience
         try:
-            with tel.crash_guard():
-                last = self._train_loop_body(tel)
+            try:
+                with tel.crash_guard():
+                    last = self._train_loop_with_rollback(tel)
+            finally:
+                tel.close()
+            if self.checkpointer:
+                if not res.preempted or res.config.emergency_checkpoint:
+                    # drain + commit any in-flight cadence save FIRST, then
+                    # skip the save when it already covers this optimizer
+                    # step: save() begins by UNCOMMITTING the target dir, so
+                    # re-saving would destroy the newest good checkpoint and
+                    # restart a multi-GB upload inside the preemption grace
+                    # window. Compare STEP numbers, not full dir paths —
+                    # StepScheduler increments epoch before the loop exits,
+                    # so a cadence save at epoch_E_step_S must still match
+                    # when the scheduler now reads epoch E+1 (step is a
+                    # global counter; same step == same param state).
+                    self.checkpointer.wait()
+                    latest = self.checkpointer.latest_committed_dir()
+                    if (
+                        latest is None
+                        or step_dir_key(latest)[1] != self.step_scheduler.step
+                    ):
+                        self.save_checkpoint()
         finally:
-            tel.close()
-        if self.checkpointer:
-            self.save_checkpoint()
-            self.checkpointer.close()  # drain any in-flight async save
+            # ALWAYS drain + COMMIT any in-flight async save — even when the
+            # loop died (e.g. NonFiniteError): a finished upload without its
+            # manifest would be discarded as an uncommitted leftover on
+            # restart. Signal handlers are restored only AFTER the emergency
+            # save: a second SIGTERM during the save must keep hitting the
+            # chaining handler, not the default terminate.
+            if self.checkpointer:
+                self.checkpointer.close()
+            res.close()
+            self.step_scheduler.restore_signal_handlers()
+        if res.preempted:
+            # run-LOCAL committed dir only: latest_dir()'s restore_from
+            # bootstrap fallback must not make a nothing-committed run look
+            # requeue-eligible — that loops at zero net progress. Without a
+            # checkpoint_dir, TrainingPreempted maps to a REAL failure exit.
+            out = (
+                self.checkpointer.latest_committed_dir()
+                if self.checkpointer
+                else None
+            )
+            raise TrainingPreempted(
+                self.step_scheduler.step, str(out) if out else None
+            )
         return last
 
-    def _train_loop_body(self, tel) -> dict:
+    def _train_loop_with_rollback(self, tel) -> dict:
+        while True:
+            try:
+                return self._train_loop_body(tel, restarted=self.resilience.rollbacks > 0)
+            except _RollbackRequested as rb:
+                self._rollback(rb.fail_step)
+
+    def _rollback(self, fail_step: int) -> None:
+        """on_nonfinite=rollback: restore the last VERIFIED checkpoint (the
+        walk-back in Checkpointer.load) and fast-forward the dataloader past
+        the offending window so the retrained steps see fresh data."""
+        if not (self.checkpointer and self.checkpointer.has_checkpoint()):
+            raise NonFiniteError(
+                f"non-finite step {fail_step}: rollback requested but no "
+                "checkpoint is available (enable checkpointing or use "
+                "on_nonfinite: skip)"
+            )
+        self.telemetry.record_step(
+            {"event": "rollback", "fail_step": fail_step, "ts": time.time()}
+        )
+        # quiesce any in-flight async save before reading the tree back
+        self.checkpointer.wait()
+        # strictly-before: a cadence save at the diverged step (saved in the
+        # same iteration, before the lagged detection fired) holds the
+        # poisoned params — never roll back INTO the blast radius
+        self._restore(before_step=fail_step)
+        ckpt_step = self.step_scheduler.step
+        dl = self.dataloader
+        ga = self.step_scheduler.grad_acc_steps
+        nb = len(dl)
+        # replay the scheduler's consumption, not steps*grad_acc: an epoch
+        # whose length doesn't divide grad_acc discards its tail batches
+        # (step_scheduler.__iter__ drops the partial group), so a window
+        # spanning an epoch boundary consumes more batches than it yields
+        # steps — undercounting would land the loader back INSIDE the
+        # offending group and retrain the same bad batch every rollback
+        epoch, pos = dl.epoch, dl.batch_in_epoch
+        steps_left = max(fail_step - ckpt_step, 0)
+        while steps_left and nb >= ga:
+            in_epoch = (nb - pos) // ga
+            if steps_left <= in_epoch:
+                pos += steps_left * ga
+                steps_left = 0
+            else:
+                steps_left -= in_epoch
+                epoch += 1
+                pos = 0
+        dl.epoch, dl.batch_in_epoch = epoch, pos
+        # keep the scheduler's epoch budget in sync: the skipped window may
+        # contain epoch boundaries the scheduler will now never observe
+        self.step_scheduler.epoch = epoch
+        logger.warning(
+            "rollback #%d: restored step %d, fast-forwarded dataloader to "
+            "epoch %d batch %d, past the non-finite window ending at step %d",
+            self.resilience.rollbacks, ckpt_step, epoch, pos, fail_step,
+        )
+
+    def _check_prev_nonfinite(self, res) -> None:
+        """Fold the PREVIOUS step's non-finite flag into the policy. The
+        flag is a scalar from an already-executed step, so fetching it does
+        not block on the step just dispatched."""
+        pending = self._pending_flag
+        self._pending_flag = None
+        if pending is None:
+            return
+        step_no, flag = pending
+        if flag is None or not bool(jax.device_get(flag)):
+            res.observe_step_flag(step_no, False)
+            return
+        action = res.observe_step_flag(step_no, True)
+        self.telemetry.record_step(
+            {
+                "event": "nonfinite_step",
+                "step": step_no,
+                "policy": res.on_nonfinite,
+                "action": action or "continue",
+                "ts": time.time(),
+            }
+        )
+        if action == "raise":
+            raise NonFiniteError(
+                f"non-finite loss/gradients at step {step_no} "
+                f"(policy: {res.on_nonfinite}) — see the flight recorder for "
+                "the per-group grad norms of the offending step"
+            )
+        if action == "rollback":
+            raise _RollbackRequested(step_no)
+
+    def _train_loop_body(self, tel, restarted: bool = False) -> dict:
         last: dict = {}
+        res = self.resilience
+        # (step, device flag) of the step whose non-finite check is pending
+        self._pending_flag: Optional[tuple] = None
         it = iter(self.step_scheduler)
-        first_step = True
+        # after a rollback restart the step is already compiled — don't
+        # re-report the first step as compile_time_s
+        first_step = not restarted
         tokens_window = 0
         steps_window = 0
         t_window = time.perf_counter()
@@ -429,6 +641,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             tel.timers("dispatch").start()
             self.state, metrics = self.train_step(self.state, batch)
             tel.timers("dispatch").stop()
+            if res.injector is not None:
+                res.injector.maybe_die(step_no)
+            if res.config.enabled and "nonfinite" in metrics:
+                # check the PREVIOUS step's flag now that this one is in
+                # flight (lagged detection, no dispatch stall), then queue
+                # this step's flag
+                self._check_prev_nonfinite(res)
+                self._pending_flag = (step_no, metrics["nonfinite"])
             tokens_window += n_tokens_batch
             steps_window += 1
             host_rec = {"step": step_no, "tokens": n_tokens_batch, "ts": time.time()}
@@ -461,6 +681,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 metrics["step_time_s"] = dt / max(steps_window, 1)
                 metrics["tps"] = tokens_window / max(dt, 1e-9)
                 metrics["tps_per_device"] = metrics["tps"] / self.mesh_ctx.world_size
+                if res.skipped_steps:
+                    metrics["skipped_steps_total"] = res.skipped_steps
+                if res.rollbacks:
+                    metrics["rollbacks_total"] = res.rollbacks
                 metrics = tel.enrich(step_no, metrics)
                 self.metric_logger.log(metrics, step=int(metrics["step"]))
                 last = metrics
@@ -477,6 +701,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             else:
                 tel.record_step(host_rec)
             if self.step_scheduler.is_val_step and self.val_dataloader is not None:
+                # same early resolution as the ckpt block below: under
+                # lag-1 detection a diverged step N would otherwise run a
+                # full eval pass on NaN params and log a garbage val record
+                # before the policy fires at N+1 (validation is a device
+                # barrier anyway, so the early fetch costs nothing extra)
+                if res.config.enabled:
+                    self._check_prev_nonfinite(res)
                 val = self.run_validation()
                 # compile events during validation (eval_step's first
                 # compile) belong to the val record, not the next train
@@ -490,9 +721,23 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 tokens_window = steps_window = 0
                 t_window = time.perf_counter()
             if self.step_scheduler.is_ckpt_step:
+                # resolve THIS step's flag before persisting: a cadence save
+                # at the diverged step would commit the poisoned params as
+                # the newest checkpoint (integrity checks can't see NaN) and
+                # crash-loop the restarted run. The save is a device barrier
+                # anyway, so the early fetch costs nothing extra.
+                if res.config.enabled:
+                    self._check_prev_nonfinite(res)
                 self.save_checkpoint()
                 tokens_window = steps_window = 0
                 t_window = time.perf_counter()
+        # a non-finite flag from the final step must still be enforced
+        if res.config.enabled:
+            self._check_prev_nonfinite(res)
+        if res.skipped_steps:
+            last["skipped_steps_total"] = res.skipped_steps
+        if res.rollbacks:
+            last["rollbacks_total"] = res.rollbacks
         return last
 
     def run_validation(self) -> dict:

@@ -23,7 +23,7 @@ from automodel_tpu.models.llama.seq_cls import (
 )
 from automodel_tpu.recipes.train_ft import TrainFinetuneRecipeForNextTokenPrediction
 from automodel_tpu.training.train_state import TrainState
-from automodel_tpu.training.train_step import build_eval_step, build_train_step
+from automodel_tpu.training.train_step import build_eval_step
 
 logger = logging.getLogger(__name__)
 
@@ -55,10 +55,7 @@ class TrainSeqClsRecipe(TrainFinetuneRecipeForNextTokenPrediction):
         opt_state = jax.jit(self.optimizer.init)(params)
         self.state = TrainState.create(params, opt_state)
         self.loss_fn = make_seq_cls_loss(model)
-        self.train_step = build_train_step(
-            self.loss_fn, self.optimizer, self.lr_schedule,
-            anomaly_flags=getattr(self, "_anomaly_flags", True),
-        )
+        self.train_step = self._make_train_step(self.loss_fn)
         self.eval_step = build_eval_step(self.loss_fn)
         logger.info("seq-cls: %d labels", num_labels)
 

@@ -1,0 +1,230 @@
+"""Resilience subsystem: keep goodput up when the world misbehaves.
+
+A TPU-native AutoModel framework lives on preemptible capacity — spot VMs
+SIGTERM with a short grace window, remote storage flakes, and one NaN step
+can waste a day of compute. Large-scale practice (CheckFreq, FAST'21;
+MegaScale, NSDI'24) says the answer is frequent low-overhead checkpoints
+plus automatic detect/recover, not clean shutdowns. Five pillars, one per
+module:
+
+- preemption.py      — SIGTERM → ``preempted`` flag (distinct from graceful
+  shutdown) → emergency checkpoint at the next step boundary → exit
+  REQUEUE_EXIT_CODE, which the Slurm/k8s launchers turn into a requeue
+- manifest.py        — MANIFEST.json commit marker + integrity record;
+  ``Checkpointer`` only trusts manifest-verified dirs and walks back past
+  corrupt ones on load
+- retry.py           — bounded-exponential-backoff decorator around every
+  storage touchpoint (safetensors, orbax, metric flushes)
+- the non-finite-step policy — ``fault_tolerance.on_nonfinite:
+  raise|skip|rollback`` consuming the telemetry anomaly flags (PR 2):
+  ``skip`` discards the update inside the jitted step, ``rollback``
+  restores the last verified checkpoint and fast-forwards the dataloader
+- fault_injection.py — config/env-driven faults (die at step k, NaN the
+  grads, corrupt a checkpoint file, fail the first M I/O attempts) so the
+  recovery paths are testable end-to-end on CPU
+
+YAML::
+
+    fault_tolerance:
+      enabled: true
+      preemption_signals: [SIGTERM]
+      emergency_checkpoint: true
+      on_nonfinite: raise            # raise | skip | rollback
+      max_consecutive_nonfinite: 3   # skip: raise after N in a row
+      max_rollbacks: 2               # rollback: then raise
+    fault_injection: {}              # tests only; see fault_injection.py
+
+Defaults are on: a recipe with no ``fault_tolerance:`` section still gets
+preemption handling, manifest-committed checkpoints, retrying I/O, and the
+``raise`` non-finite policy (a diverged step fails fast with the flight
+recorder naming the param group, instead of burning a day of NaN steps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Optional, Sequence
+
+from automodel_tpu.resilience.fault_injection import (  # noqa: F401
+    FaultInjectionConfig,
+    FaultInjector,
+    InjectedFault,
+    activate_from_config,
+    active_injector,
+    corrupt_file,
+)
+from automodel_tpu.resilience.manifest import (  # noqa: F401
+    MANIFEST_NAME,
+    classify_step_dirs,
+    has_manifest,
+    verify_manifest,
+    write_manifest,
+)
+from automodel_tpu.resilience.preemption import (  # noqa: F401
+    DEFAULT_PREEMPTION_SIGNALS,
+    PEER_PREEMPTION_MARKER,
+    REQUEUE_EXIT_CODE,
+    NonFiniteError,
+    PreemptionHandler,
+    TrainingPreempted,
+    peer_preemption_fresh,
+    write_peer_preemption_marker,
+)
+from automodel_tpu.resilience.retry import RetriesExhausted, retry_io  # noqa: F401
+
+logger = logging.getLogger(__name__)
+
+NONFINITE_POLICIES = ("raise", "skip", "rollback")
+
+
+@dataclasses.dataclass
+class FaultToleranceConfig:
+    enabled: bool = True
+    preemption_signals: Sequence[str] = DEFAULT_PREEMPTION_SIGNALS
+    emergency_checkpoint: bool = True
+    on_nonfinite: str = "raise"  # raise | skip | rollback
+    max_consecutive_nonfinite: int = 3
+    max_rollbacks: int = 2
+
+    def __post_init__(self) -> None:
+        if self.on_nonfinite not in NONFINITE_POLICIES:
+            raise ValueError(
+                f"fault_tolerance.on_nonfinite must be one of "
+                f"{NONFINITE_POLICIES}, got {self.on_nonfinite!r}"
+            )
+
+
+class Resilience:
+    """Facade the recipes drive: the installed preemption handler, the
+    non-finite policy bookkeeping (consecutive/total skip counters, rollback
+    budget), and the active fault injector."""
+
+    def __init__(
+        self,
+        config: FaultToleranceConfig,
+        injector: Optional[FaultInjector] = None,
+    ):
+        self.config = config
+        self.injector = injector
+        self.preemption = (
+            PreemptionHandler(config.preemption_signals) if config.enabled else None
+        )
+        self.skipped_steps = 0
+        self.rollbacks = 0
+        self._consecutive_nonfinite = 0
+
+    @classmethod
+    def from_config(
+        cls, section: Any, fault_injection_section: Any = None
+    ) -> "Resilience":
+        d = dict(section or {})
+        d.pop("_target_", None)
+        injector = activate_from_config(fault_injection_section)
+        return cls(FaultToleranceConfig(**d), injector=injector)
+
+    # -- lifecycle ----------------------------------------------------------
+    def install(self) -> "Resilience":
+        if self.preemption is not None:
+            self.preemption.install()
+        return self
+
+    def arm_peer_marker(self, checkpoint_root: Any) -> None:
+        """Multi-host requeue wiring: at SIGTERM time, drop a marker into
+        the SHARED checkpoint root so peer hosts that later die of broken
+        collectives (this host stops participating once it exits) can
+        classify their crash as preemption collateral and exit with the
+        requeue code too — see preemption.write_peer_preemption_marker.
+        CHAINS with any on_preempt already installed (the recipe points it
+        at the step scheduler's request_shutdown); the marker goes first —
+        it is the one action another host depends on."""
+        if self.preemption is None:
+            return
+        root, prior = checkpoint_root, self.preemption.on_preempt
+
+        def _on_preempt() -> None:
+            write_peer_preemption_marker(root)
+            if prior is not None:
+                prior()
+
+        self.preemption.on_preempt = _on_preempt
+
+    def close(self) -> None:
+        if self.preemption is not None:
+            self.preemption.restore()
+
+    @property
+    def preempted(self) -> bool:
+        return self.preemption is not None and self.preemption.preempted
+
+    # -- non-finite-step policy ---------------------------------------------
+    @property
+    def on_nonfinite(self) -> str:
+        return self.config.on_nonfinite if self.config.enabled else "raise"
+
+    @property
+    def nan_grads_at_step(self) -> Optional[int]:
+        return self.injector.nan_grads_at_step if self.injector is not None else None
+
+    def observe_step_flag(self, step: int, is_nonfinite: bool) -> Optional[str]:
+        """Fold one step's non-finite flag into the policy. Returns the
+        action the loop must take: None (continue), ``"rollback"``, or
+        ``"raise"`` (the caller raises NonFiniteError)."""
+        if not is_nonfinite:
+            self._consecutive_nonfinite = 0
+            return None
+        self._consecutive_nonfinite += 1
+        policy = self.on_nonfinite
+        if policy == "raise" or not self.config.enabled:
+            return "raise"
+        if policy == "skip":
+            self.skipped_steps += 1
+            if self._consecutive_nonfinite >= self.config.max_consecutive_nonfinite:
+                logger.error(
+                    "on_nonfinite=skip: %d consecutive non-finite steps "
+                    "(budget %d) — raising",
+                    self._consecutive_nonfinite,
+                    self.config.max_consecutive_nonfinite,
+                )
+                return "raise"
+            logger.warning(
+                "on_nonfinite=skip: discarded update at step %d "
+                "(%d skipped total)", step, self.skipped_steps,
+            )
+            return None
+        # rollback
+        if self.rollbacks >= self.config.max_rollbacks:
+            logger.error(
+                "on_nonfinite=rollback: rollback budget (%d) exhausted — raising",
+                self.config.max_rollbacks,
+            )
+            return "raise"
+        self.rollbacks += 1
+        self._consecutive_nonfinite = 0
+        return "rollback"
+
+
+__all__ = [
+    "FaultToleranceConfig",
+    "Resilience",
+    "PreemptionHandler",
+    "TrainingPreempted",
+    "NonFiniteError",
+    "REQUEUE_EXIT_CODE",
+    "PEER_PREEMPTION_MARKER",
+    "write_peer_preemption_marker",
+    "peer_preemption_fresh",
+    "retry_io",
+    "RetriesExhausted",
+    "write_manifest",
+    "verify_manifest",
+    "has_manifest",
+    "classify_step_dirs",
+    "MANIFEST_NAME",
+    "FaultInjectionConfig",
+    "FaultInjector",
+    "InjectedFault",
+    "activate_from_config",
+    "active_injector",
+    "corrupt_file",
+]

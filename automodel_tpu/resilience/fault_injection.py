@@ -1,0 +1,172 @@
+"""Fault-injection harness: config/env-driven failures for resilience tests.
+
+The subsystem this drives (preemption → emergency checkpoint, manifest
+walk-back, retrying I/O, non-finite-step policy) is exactly the code that
+only runs when something goes wrong — so it needs a way to MAKE things go
+wrong, deterministically, on CPU, in tier-1. Four fault classes:
+
+- ``die_at_step``           — kill the process at optimizer step k
+  (``die_mode: hard`` = os._exit, simulating a SIGKILL'd host mid-async-save;
+  ``exception`` = raise, exercising the crash-guard/flight-recorder path)
+- ``nan_grads_at_step``     — poison the gradients at step k INSIDE the
+  jitted step (keyed on the traced ``state.step`` so there is no recompile
+  and no host sync; see train_step.py), firing the anomaly flags and
+  whatever ``on_nonfinite`` policy is configured
+- ``corrupt_ckpt_file``     — after a checkpoint commits, flip bytes in the
+  first file matching this glob (relative to the checkpoint step dir), so
+  the next load must detect the damage and walk back
+- ``fail_io_attempts``/``fail_io_op`` — fail the first M attempts of any
+  retry_io-wrapped op whose name contains ``fail_io_op``, proving the
+  backoff absorbs transient storage errors (or exhausts loudly)
+
+Activation: a ``fault_injection:`` YAML section (recipes call
+``activate_from_config``) or the ``AUTOMODEL_FAULT_INJECTION`` env var
+holding the same dict as JSON (for subprocess tests where no recipe code
+runs before the fault must be armed). Inactive (the default) every hook is
+a cheap None/False check — zero cost in production.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "AUTOMODEL_FAULT_INJECTION"
+# distinctive code so tests can tell an injected hard-death from a real crash
+HARD_DEATH_EXIT_CODE = 113
+
+
+class InjectedFault(RuntimeError):
+    """Raised by ``die_mode: exception`` and by injected I/O failures."""
+
+
+@dataclasses.dataclass
+class FaultInjectionConfig:
+    die_at_step: Optional[int] = None
+    die_mode: str = "hard"  # hard (os._exit, no cleanup) | exception
+    nan_grads_at_step: Optional[int] = None
+    corrupt_ckpt_file: Optional[str] = None  # glob under the step dir
+    fail_io_attempts: int = 0
+    fail_io_op: str = ""  # substring of the retry_io op name; "" = every op
+
+
+class FaultInjector:
+    def __init__(self, config: FaultInjectionConfig):
+        self.config = config
+        self._io_attempts: dict[str, int] = {}
+
+    # -- step-loop hooks ----------------------------------------------------
+    def maybe_die(self, step: int) -> None:
+        c = self.config
+        if c.die_at_step is None or step != c.die_at_step:
+            return
+        if c.die_mode == "exception":
+            raise InjectedFault(f"injected crash at step {step}")
+        logger.error("fault injection: hard death at step %d", step)
+        os._exit(HARD_DEATH_EXIT_CODE)  # no atexit, no finally — like SIGKILL
+
+    @property
+    def nan_grads_at_step(self) -> Optional[int]:
+        return self.config.nan_grads_at_step
+
+    # -- checkpoint hook ----------------------------------------------------
+    def after_checkpoint_save(self, step_dir: Path) -> None:
+        """Corrupt the first file under ``step_dir`` matching the configured
+        glob (called AFTER the manifest commits, so the damage is exactly
+        what integrity verification exists to catch)."""
+        pat = self.config.corrupt_ckpt_file
+        if not pat:
+            return
+        for p in sorted(step_dir.rglob("*")):
+            if p.is_file() and fnmatch.fnmatch(str(p.relative_to(step_dir)), pat):
+                corrupt_file(p)
+                logger.error("fault injection: corrupted %s", p)
+                return
+
+    # -- retry_io hook ------------------------------------------------------
+    def check_io(self, op: str) -> None:
+        c = self.config
+        if c.fail_io_attempts <= 0 or c.fail_io_op not in op:
+            return
+        n = self._io_attempts.get(op, 0)
+        if n < c.fail_io_attempts:
+            self._io_attempts[op] = n + 1
+            raise OSError(f"injected I/O failure {n + 1}/{c.fail_io_attempts} for {op}")
+
+
+def corrupt_file(path: Path | str, offset_fraction: float = 0.5, n_bytes: int = 64) -> None:
+    """Flip ``n_bytes`` in the middle of a file in place (bounded by size)."""
+    path = Path(path)
+    size = path.stat().st_size
+    if size == 0:
+        path.write_bytes(b"\xff")
+        return
+    off = int(size * offset_fraction) % size
+    n = min(n_bytes, size - off)
+    with open(path, "r+b") as f:
+        f.seek(off)
+        chunk = f.read(n)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+# -- process-global activation ----------------------------------------------
+_ACTIVE: Optional[FaultInjector] = None
+_ENV_CHECKED = False
+
+
+def activate(config: FaultInjectionConfig | dict | None) -> Optional[FaultInjector]:
+    """Install (or, with None, clear) the process-global injector."""
+    global _ACTIVE, _ENV_CHECKED
+    _ENV_CHECKED = True  # explicit activation wins over the env var
+    if config is None:
+        _ACTIVE = None
+        return None
+    if isinstance(config, dict):
+        d = {k: v for k, v in config.items() if k != "_target_"}
+        config = FaultInjectionConfig(**d)
+    armed = (
+        config.die_at_step is not None
+        or config.nan_grads_at_step is not None
+        or config.corrupt_ckpt_file
+        or config.fail_io_attempts > 0
+    )
+    if not armed:
+        # an empty `fault_injection: {}` section (the docs' example form)
+        # must not put a do-nothing injector — and its scary ACTIVE
+        # warning — into a production run
+        _ACTIVE = None
+        return None
+    _ACTIVE = FaultInjector(config)
+    logger.warning("fault injection ACTIVE: %s", config)
+    return _ACTIVE
+
+
+def activate_from_config(section: Any) -> Optional[FaultInjector]:
+    """From a YAML ``fault_injection:`` section (None → env var → inactive)."""
+    if section is None:
+        return active_injector()
+    return activate(dict(section))
+
+
+def active_injector() -> Optional[FaultInjector]:
+    """The process-global injector, arming from ``AUTOMODEL_FAULT_INJECTION``
+    (JSON) on first use so subprocess tests need no in-process setup."""
+    global _ACTIVE, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        raw = os.environ.get(ENV_VAR)
+        if raw:
+            try:
+                _ACTIVE = FaultInjector(FaultInjectionConfig(**json.loads(raw)))
+                logger.warning("fault injection ACTIVE from env: %s", raw)
+            except (ValueError, TypeError) as e:
+                raise ValueError(f"bad {ENV_VAR} value {raw!r}") from e
+    return _ACTIVE

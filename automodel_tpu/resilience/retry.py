@@ -1,0 +1,118 @@
+"""Retrying I/O: bounded exponential backoff with jitter.
+
+Parity motive: remote storage (GCS fuse mounts, NFS scratch) flakes under
+load — CheckFreq (Mohan et al., FAST'21) and MegaScale (NSDI'24) both treat
+transient checkpoint/metric I/O failures as expected events to absorb, not
+crashes. One decorator covers every storage touchpoint in the repo: HF
+safetensors read/write (checkpoint/hf_io.py), orbax save/restore
+(checkpoint/checkpointer.py), and metric-sink flushes
+(loggers/metric_logger.py).
+
+Only TYPED retryable exceptions are absorbed (OSError family by default) —
+a ValueError from a corrupt header is a bug or real corruption and must
+propagate immediately, not burn the backoff budget.
+
+The fault-injection harness (resilience/fault_injection.py) hooks in at the
+attempt boundary: when an injector is active, each attempt first consults
+``check_io(op)`` so tests can fail the first M attempts of a named op and
+watch the backoff absorb (or exhaust on) them.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import random
+import time
+from typing import Any, Callable, Iterable, Optional, Type
+
+logger = logging.getLogger(__name__)
+
+# the transient-failure family: filesystem/network hiccups. TimeoutError and
+# InterruptedError are OSError subclasses already; ConnectionError too.
+DEFAULT_RETRYABLE: tuple[Type[BaseException], ...] = (OSError,)
+
+
+class RetriesExhausted(Exception):
+    """All attempts failed; ``__cause__`` is the last underlying error."""
+
+    def __init__(self, op: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"{op}: {attempts} attempt(s) failed; last error: {last!r}"
+        )
+        self.op = op
+        self.attempts = attempts
+        self.last = last
+
+
+def backoff_delays(
+    max_attempts: int,
+    base_delay_s: float,
+    max_delay_s: float,
+    jitter: float,
+    rng: Optional[random.Random] = None,
+) -> Iterable[float]:
+    """The sleep schedule BETWEEN attempts (so it yields max_attempts-1
+    values): base * 2^i capped at max_delay_s, each scaled by a uniform
+    [1-jitter, 1+jitter] factor so a fleet of preempted workers does not
+    hammer the storage service in lockstep."""
+    rng = rng or random
+    for i in range(max(max_attempts - 1, 0)):
+        d = min(base_delay_s * (2.0**i), max_delay_s)
+        if jitter > 0:
+            d *= rng.uniform(1.0 - jitter, 1.0 + jitter)
+        yield max(d, 0.0)
+
+
+def retry_io(
+    op: Optional[str] = None,
+    max_attempts: int = 3,
+    base_delay_s: float = 0.5,
+    max_delay_s: float = 8.0,
+    jitter: float = 0.25,
+    retryable: tuple[Type[BaseException], ...] = DEFAULT_RETRYABLE,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Callable:
+    """Decorator (or ``retry_io(...)(fn)`` wrapper) that retries transient
+    I/O failures with bounded exponential backoff.
+
+    ``op`` names the operation for logs and for the fault injector; defaults
+    to the wrapped function's qualname. ``sleep`` is injectable so tests
+    assert the schedule without waiting on it. After ``max_attempts``
+    failures the LAST exception is re-raised (chained under
+    ``RetriesExhausted``) so callers see the real error class.
+    """
+
+    def decorate(fn: Callable) -> Callable:
+        name = op or getattr(fn, "__qualname__", repr(fn))
+
+        @functools.wraps(fn)
+        def wrapped(*args: Any, **kwargs: Any) -> Any:
+            from automodel_tpu.resilience.fault_injection import active_injector
+
+            delays = list(
+                backoff_delays(max_attempts, base_delay_s, max_delay_s, jitter)
+            )
+            last: Optional[BaseException] = None
+            for attempt in range(max_attempts):
+                try:
+                    inj = active_injector()
+                    if inj is not None:
+                        inj.check_io(name)
+                    return fn(*args, **kwargs)
+                except retryable as e:
+                    last = e
+                    if attempt == max_attempts - 1:
+                        break
+                    d = delays[attempt]
+                    logger.warning(
+                        "%s: attempt %d/%d failed (%r); retrying in %.2fs",
+                        name, attempt + 1, max_attempts, e, d,
+                    )
+                    sleep(d)
+            raise RetriesExhausted(name, max_attempts, last) from last
+
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    return decorate

@@ -54,6 +54,7 @@ def lint_metrics_jsonl(path: str) -> tuple[list[dict], list[str]]:
     except OSError as e:
         return [], [f"cannot read {path}: {e}"]
     last_step: Optional[int] = None
+    pending_resume = None  # True = bare marker; int = resumed_from_step
     for i, line in enumerate(lines, 1):
         if not line.strip():
             continue
@@ -68,13 +69,38 @@ def lint_metrics_jsonl(path: str) -> tuple[list[dict], list[str]]:
         records.append(rec)
         if "ts" not in rec:
             problems.append(f"line {i}: missing ts")
+        if rec.get("event") in ("resume", "rollback"):
+            # one marker excuses ONE rewind (to resumed_from_step+1 when the
+            # marker carries it); a sticky excuse would let genuine
+            # corruption later in a resumed file slip past --strict
+            rf = rec.get("resumed_from_step")
+            pending_resume = rf if isinstance(rf, int) else True
         step = rec.get("step")
         if step is not None:
             if not isinstance(step, int):
                 problems.append(f"line {i}: step is not an int: {step!r}")
-            elif last_step is not None and step < last_step:
-                problems.append(f"line {i}: step went backwards ({last_step} -> {step})")
             else:
+                if last_step is not None and step < last_step:
+                    # the rewound step need only land PAST the restore point
+                    # (`>` not `== +1`: with log_every_steps=N the first
+                    # post-resume record is the next multiple of N)
+                    if pending_resume is True or (
+                        isinstance(pending_resume, int)
+                        and step > pending_resume
+                    ):
+                        # a legitimate rewind: a recorded resume (checkpoint
+                        # walk-back after preemption, on_nonfinite rollback)
+                        # retrains step numbers in the same JSONL. Surfaced
+                        # as resume_points in the summary, not corruption.
+                        rec["_resume_point"] = True
+                    else:
+                        problems.append(
+                            f"line {i}: step went backwards ({last_step} -> "
+                            f"{step}) with no matching resume/rollback marker"
+                        )
+                # the first step record after a marker consumes it, rewind
+                # or not (a forward resume needs no excuse later)
+                pending_resume = None
                 last_step = step
         for k in _NUMERIC_KEYS:
             if k in rec and rec[k] is not None and not isinstance(rec[k], (int, float)):
@@ -100,6 +126,9 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
         "nonfinite_steps": nonfinite_steps,
         "recompiles_after_first_step": recompiles,
     }
+    resumes = [r.get("step") for r in records if r.get("_resume_point")]
+    if resumes:
+        out["resume_points"] = resumes
     mfu = [r["mfu"] for r in records if isinstance(r.get("mfu"), (int, float))]
     if mfu:
         out["mfu_mean"] = sum(mfu) / len(mfu)

@@ -34,13 +34,35 @@ class StepScheduler:
         self.step = 0  # optimizer steps taken
         self.epoch = 0
         self._shutdown = False
+        self._handler = None
 
     # -- graceful shutdown --------------------------------------------------
     def install_signal_handler(self, signals: tuple = (signal.SIGTERM,)) -> None:
-        for sig in signals:
-            signal.signal(sig, self._on_signal)
+        """Install the stop-at-step-boundary handler, CHAINING any handler
+        already installed (cluster agents and libtpu hook the same signals;
+        overwriting them silently disabled their cleanup). The caller owns
+        restoration via ``restore_signal_handlers()`` — the recipe runs it
+        AFTER the end-of-run checkpoint save, because restoring at loop
+        exit would expose that save to a second (now default-disposition)
+        signal. The chaining machinery itself is
+        resilience.PreemptionHandler — one implementation of
+        capture/chain/restore, two consumers."""
+        from automodel_tpu.resilience.preemption import PreemptionHandler
 
-    def _on_signal(self, signum, frame) -> None:
+        if self._handler is None:
+            self._handler = PreemptionHandler(
+                signals=signals, on_preempt=self.request_shutdown,
+                log_message="stopping at the next step boundary (graceful shutdown)",
+            )
+        self._handler.install()
+
+    def restore_signal_handlers(self) -> None:
+        if self._handler is not None:
+            self._handler.restore()
+
+    def request_shutdown(self) -> None:
+        """Programmatic stop at the next step boundary (the preemption
+        handler calls this so SIGTERM drains the loop cleanly)."""
         self._shutdown = True
 
     @property
@@ -69,6 +91,10 @@ class StepScheduler:
                     if self._shutdown:
                         return
             self.epoch += 1
+            # a signal landing in the epoch tail (after the last full
+            # group yielded) must stop HERE, not a full epoch later
+            if self._shutdown:
+                return
 
     # -- cadence ------------------------------------------------------------
     @property

@@ -35,6 +35,8 @@ def build_train_step(
     post_step_fn: Optional[Callable[[Any, dict], Any]] = None,
     grad_mask: Any = None,
     anomaly_flags: bool = True,
+    on_nonfinite: str = "raise",
+    nan_grads_at_step: Optional[int] = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted (state, batch) → (state, metrics) step.
 
@@ -56,6 +58,25 @@ def build_train_step(
     existing grad traversal; no extra device round-trips (the metrics dict
     is only fetched at log steps), so a NaN/Inf is caught in the step it
     occurs with the group that produced it.
+
+    ``on_nonfinite`` (resilience/, fault_tolerance.on_nonfinite): with
+    ``"skip"``, a step whose loss or gradient goes non-finite DISCARDS the
+    update inside the jit — params and opt-state are carried through
+    bit-identical (``jnp.where`` on the already-computed new trees, so
+    there is no control-flow divergence and no recompile) and the metrics
+    gain a ``skipped`` flag the recipe counts. ``"raise"``/``"rollback"``
+    are host-side policies (recipes/train_ft.py). The non-default policies
+    (skip/rollback) force the bare ``nonfinite`` flag even when
+    ``anomaly_flags`` is off; the default ``raise`` policy respects the
+    anomaly_flags opt-out — disabling anomaly flags under ``raise``
+    disables non-finite detection entirely (the recipe warns loudly at
+    setup). The step counter still advances on a skipped step (the LR
+    schedule and cadence predicates stay aligned with the data stream).
+
+    ``nan_grads_at_step`` (fault injection): poison every gradient leaf at
+    the optimizer step with that 1-based number (``state.step + 1``, the
+    number the scheduler and metrics report). Keyed on the TRACED step, so
+    arming it costs one fused select per leaf and no recompile.
 
     ``grad_mask`` (bool pytree, True = trainable): frozen leaves' gradients
     are replaced by zeros immediately after value_and_grad — XLA dead-code-
@@ -153,6 +174,11 @@ def build_train_step(
         grads = jax.tree.map(
             lambda g: (g.astype(jnp.float32) / denom).astype(g.dtype), grads
         )
+        if nan_grads_at_step is not None:
+            poison = jnp.where(
+                state.step + 1 == nan_grads_at_step, jnp.float32(jnp.nan), 0.0
+            )
+            grads = jax.tree.map(lambda g: g + poison.astype(g.dtype), grads)
         from automodel_tpu.optim.builders import global_norm_fp32
 
         grad_norm = global_norm_fp32(grads)
@@ -174,6 +200,28 @@ def build_train_step(
             from automodel_tpu.telemetry.anomaly import anomaly_metrics
 
             metrics.update(anomaly_metrics(loss_sum, grads))
+        elif on_nonfinite != "raise" or nan_grads_at_step is not None:
+            # the host-side policies need the flag even with the full
+            # anomaly reductions disabled
+            from automodel_tpu.telemetry.anomaly import nonfinite_count
+
+            metrics["nonfinite"] = ~jnp.isfinite(loss_sum) | (
+                nonfinite_count(grads) > 0
+            )
+        if on_nonfinite == "skip":
+            bad = metrics["nonfinite"]
+            # carry params AND opt-state through unchanged (bit-identical:
+            # jnp.where with a scalar pred selects whole buffers) — the NaN
+            # never reaches the weights or the Adam moments
+            new_params = jax.tree.map(
+                lambda new, old: jnp.where(bad, old, new), new_params, state.params
+            )
+            new_opt_state = jax.tree.map(
+                lambda new, old: jnp.where(bad, old, new),
+                new_opt_state,
+                state.opt_state,
+            )
+            metrics["skipped"] = bad
         if "moe_aux_loss" in extras_sum:
             metrics["moe_aux_loss"] = extras_sum["moe_aux_loss"] / batch_size(batch)
         pinfo = getattr(loss_fn, "pipeline_info", None)

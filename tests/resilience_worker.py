@@ -1,0 +1,25 @@
+"""Single-process CLI worker for the resilience subprocess tests
+(tests/test_resilience.py): runs `automodel_tpu.cli.app.main` on a tiny
+CPU config so the parent can deliver a REAL SIGTERM and assert the
+emergency-checkpoint + requeue-exit-code contract, and then restart it to
+prove auto-resume picks up the committed emergency checkpoint.
+
+Mirrors multiprocess_worker.py's env dance: the image's sitecustomize
+preregisters an `axon` TPU backend, so the platform must be pinned to cpu
+BEFORE jax initializes."""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = ""  # axon is force-registered; cpu must coexist
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # never touch the tunneled chip
+
+from automodel_tpu.cli.app import main
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

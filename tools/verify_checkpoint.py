@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Audit a checkpoint tree's manifests without loading arrays.
+
+Thin CLI wrapper over automodel_tpu/checkpoint/verify.py (which
+`automodel_tpu verify-ckpt` also uses): MANIFEST.json presence, file list,
+sizes, streamed checksums, layout-marker stamp.
+
+    python tools/verify_checkpoint.py <ckpt_root_or_step_dir> [--no-checksums] [--json]
+
+Exit codes: 0 = all committed dirs verify; 1 = any corrupt/uncommitted;
+2 = usage error.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from automodel_tpu.checkpoint.verify import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
