@@ -21,6 +21,7 @@ def _usage() -> str:
     return (
         "usage: automodel_tpu <finetune|pretrain|kd|benchmark|mine> <llm|vlm|biencoder> "
         "-c config.yaml [--dotted.key=value ...]\n"
+        "       automodel_tpu generate -c config.yaml [--prompt '...'] [--dotted.key=value ...]\n"
         "       automodel_tpu report <train_metrics.jsonl> [--strict]\n"
         "       automodel_tpu verify-ckpt <ckpt_dir> [--no-checksums] [--json]"
     )
@@ -61,6 +62,16 @@ def main(argv: list[str] | None = None) -> int:
         from automodel_tpu.checkpoint.verify import main as verify_main
 
         return verify_main(argv[1:])
+    # `generate` runs the inference engine (generation/engine.py): model +
+    # mesh from the same YAML sections the recipes use, a `generation:`
+    # section for sampling/lengths, `--prompt` via the dotted overrides
+    if argv and argv[0] == "generate":
+        from automodel_tpu.generation.engine import main as generate_main
+        from automodel_tpu.parallel.mesh import initialize_distributed
+
+        cfg = parse_args_and_load_config(argv[1:])
+        initialize_distributed()
+        return generate_main(cfg)
     if len(argv) < 2 or argv[0] in ("-h", "--help"):
         print(_usage())
         return 0 if argv and argv[0] in ("-h", "--help") else 2

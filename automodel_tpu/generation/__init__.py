@@ -1,0 +1,29 @@
+"""TPU-native generation subsystem.
+
+The standard TPU-inference formulation (Pope et al., "Efficiently Scaling
+Transformer Inference"): a pjit-sharded contiguous KV cache written with
+``dynamic_update_slice``, ONE jitted prefill program (reusing the packed
+segment-ids attention path over the whole padded prompt batch) and ONE
+jitted single-token decode program (a ``lax.while_loop`` that feeds each
+sampled token back through the model with its KV cache).
+
+    kv_cache.py   mesh-sharded cache pytree: full layout + ring-buffer
+                  layout for homogeneous sliding-window models
+    sampling.py   greedy / temperature / top-k / top-p (threaded PRNG)
+    loop.py       jitted prefill + while_loop decode with stop tokens
+    engine.py     GenerationEngine facade over from_pretrained + MeshContext
+                  (slot-based batched decoding) + the CLI entry point
+"""
+
+from automodel_tpu.generation.engine import GenerationConfig, GenerationEngine
+from automodel_tpu.generation.kv_cache import KVCache, init_cache
+from automodel_tpu.generation.sampling import SamplingConfig, sample
+
+__all__ = [
+    "GenerationConfig",
+    "GenerationEngine",
+    "KVCache",
+    "SamplingConfig",
+    "init_cache",
+    "sample",
+]

@@ -1,0 +1,238 @@
+"""Mesh-sharded KV cache pytree.
+
+Layout (Pope et al. §3.2, the contiguous-cache formulation): per-layer keys
+and values stacked on a leading layer axis — ``[L, B, C, N_kv, H]`` — so the
+decode step threads the cache through the SAME ``lax.scan`` over stacked
+layer params the training forward uses (cache slices ride the scan as
+xs/ys; compile time stays constant in depth). Keys are stored POST-RoPE, so
+decode never re-rotates history.
+
+Two layouts, one code path:
+
+- **full**: capacity = prompt + max_new_tokens; every position owns a slot.
+- **ring**: homogeneous sliding-window models (mistral-style) cap capacity
+  at the window — slot = position % capacity, old tokens are overwritten
+  exactly when the window would mask them anyway.
+
+Validity is governed by per-slot **position tags** (``pos [B, C]``, -1 =
+empty), not by the write itself: padded-prompt junk is written (the scatter
+is dense) but tagged -1, and the attention mask derives from tags —
+``tag >= 0 & tag <= q_pos & (q_pos - tag < window)`` — which makes full,
+ring, and mixed-window-per-layer masking one expression.
+
+Writes are ``dynamic_update_slice``: prefill writes the whole prompt block
+at offset 0 (ring: the last-C tail, rolled into slot order), decode writes
+one token per slot at its own offset (vmapped dus → per-slot scatter).
+
+Ring caveat (documented in docs/generation.md): prompts right-padded past a
+slot's true length write junk into ring slots; junk is never ATTENDED (tag
+-1) but, once the ring has wrapped during PREFILL (S_padded > capacity), a
+pad position p evicts real position p - C that a short slot still needed —
+in the worst case (len <= S_padded - C) a slot's entire in-window history.
+The engine therefore rejects ragged batches whose padded prompt wraps the
+ring (equal-length batches, or ragged ones fitting the window, are exact).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """The cache pytree. ``window`` is static metadata (ring layout when it
+    equals the capacity and the model's layers are homogeneously windowed);
+    everything else is arrays so the whole object jits/shards cleanly."""
+
+    k: jnp.ndarray  # [L, B, C, N_kv, H], post-RoPE
+    v: jnp.ndarray  # [L, B, C, N_kv, H]
+    pos: jnp.ndarray  # [B, C] int32 position tags; -1 = empty slot
+    lengths: jnp.ndarray  # [B] int32 tokens committed per slot
+    window: Optional[int] = dataclasses.field(
+        default=None, metadata={"static": True}
+    )
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def nbytes(self) -> int:
+        """Global logical cache footprint (telemetry census semantics)."""
+        return int(self.k.nbytes + self.v.nbytes + self.pos.nbytes + self.lengths.nbytes)
+
+    def replace(self, **kw) -> "KVCache":
+        return dataclasses.replace(self, **kw)
+
+
+def init_cache(
+    num_layers: int,
+    batch: int,
+    capacity: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+    window: Optional[int] = None,
+) -> KVCache:
+    """Empty cache. ``window`` (homogeneous sliding-window models) caps the
+    useful capacity — callers pass ``capacity=min(window, total_len)`` to get
+    the ring layout; a larger capacity still works, it just wastes HBM."""
+    shape = (num_layers, batch, capacity, num_kv_heads, head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        pos=jnp.full((batch, capacity), -1, jnp.int32),
+        lengths=jnp.zeros((batch,), jnp.int32),
+        window=window,
+    )
+
+
+def place_cache(cache: KVCache, mesh_ctx) -> KVCache:
+    """Shard a host-built cache onto the mesh: batch over the data axes,
+    KV heads over tensor — the Pope et al. decode layout where each TP
+    shard holds its own heads' cache and no cache collective ever runs.
+    Axes that don't divide the cache dims are dropped (replicated) — tiny
+    eval batches on big meshes must not crash generation."""
+    if mesh_ctx is None:
+        return cache
+
+    def usable(dim: int, logical) -> object:
+        import numpy as np
+
+        axes = mesh_ctx.resolve((logical,))
+        names = axes[0] if len(axes) else None
+        if names is None:
+            return None
+        names = names if isinstance(names, tuple) else (names,)
+        deg = int(np.prod([mesh_ctx.mesh.shape[a] for a in names]))
+        return names if deg > 0 and dim % deg == 0 else None
+
+    b_ax = usable(cache.batch, "batch")
+    t_ax = usable(cache.k.shape[3], "tensor")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    kv_s = NamedSharding(mesh_ctx.mesh, P(None, b_ax, None, t_ax, None))
+    host_s = NamedSharding(mesh_ctx.mesh, P(None, None))
+    return cache.replace(
+        k=jax.device_put(cache.k, kv_s),
+        v=jax.device_put(cache.v, kv_s),
+        pos=jax.device_put(cache.pos, host_s),
+        lengths=jax.device_put(cache.lengths, NamedSharding(mesh_ctx.mesh, P(None))),
+    )
+
+
+@dataclasses.dataclass
+class CacheContext:
+    """Per-forward write/attend plan, derived ONCE per model call and closed
+    over by the layer scan (only the k/v slices ride the scan as xs/ys —
+    tags and positions are shared by every layer).
+
+    ``mode``: 'prefill' (attend normally over the incoming block, write it)
+    or 'decode' (write one token per slot, attend the query over the cache).
+    """
+
+    mode: str  # "prefill" | "decode"
+    capacity: int
+    q_pos: jnp.ndarray  # [B] decode query position / [B] prompt lengths
+    pos: jnp.ndarray  # [B, C] tags AFTER this call's write
+    slots: Optional[jnp.ndarray] = None  # [B] decode write slot
+    prompt_len: int = 0  # static padded prompt length (prefill)
+
+    @property
+    def decode(self) -> bool:
+        return self.mode == "decode"
+
+    # -- writes --------------------------------------------------------------
+    def write(
+        self, ck: jnp.ndarray, cv: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Write this layer's new keys/values. ck/cv: [B, C, N_kv, H];
+        k/v: [B, S, N_kv, H] (S = prompt length in prefill, 1 in decode)."""
+        if self.mode == "prefill":
+            S, C = self.prompt_len, self.capacity
+            if S <= C:
+                ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+            else:
+                # ring: only the last C positions survive; position p lands
+                # in slot p % C, which for the contiguous tail [S-C, S) is a
+                # roll — a dense overwrite, no scatter
+                shift = (S - C) % C
+                ck = jnp.roll(k[:, S - C :].astype(ck.dtype), shift, axis=1)
+                cv = jnp.roll(v[:, S - C :].astype(cv.dtype), shift, axis=1)
+            return ck, cv
+        # decode: one token per slot at its own offset
+        write = jax.vmap(
+            lambda cb, nb, s: jax.lax.dynamic_update_slice(cb, nb, (s, 0, 0))
+        )
+        return (
+            write(ck, k.astype(ck.dtype), self.slots),
+            write(cv, v.astype(cv.dtype), self.slots),
+        )
+
+    # -- attend --------------------------------------------------------------
+    def attend_mask(self, sliding_window: Optional[int] = None) -> jnp.ndarray:
+        """[B, C] bool — which cache slots this decode query may attend.
+        Per-layer ``sliding_window`` (mixed full/windowed stacks) narrows the
+        mask; the ring layout needs no extra handling because eviction and
+        window expiry coincide by construction."""
+        tags = self.pos
+        q = self.q_pos[:, None]
+        valid = (tags >= 0) & (tags <= q)
+        if sliding_window is not None:
+            valid = valid & (q - tags < sliding_window)
+        return valid
+
+
+def prefill_ctx(cache: KVCache, prompt_len: int, lengths: jnp.ndarray) -> tuple[KVCache, CacheContext]:
+    """Plan the prompt write: returns the cache with tags/lengths updated
+    (k/v update per layer inside the model) and the shared context."""
+    C = cache.capacity
+    S = int(prompt_len)
+    if S <= C:
+        written = jnp.arange(S, dtype=jnp.int32)  # slot j holds position j
+        tags = jnp.where(
+            written[None, :] < lengths[:, None], written[None, :], -1
+        )
+        pos = jax.lax.dynamic_update_slice(cache.pos, tags.astype(jnp.int32), (0, 0))
+    else:
+        # ring tail [S-C, S): slot j holds position S-C + ((j-(S-C)) % C)
+        j = jnp.arange(C, dtype=jnp.int32)
+        written = S - C + ((j - (S - C)) % C)
+        pos = jnp.where(
+            written[None, :] < lengths[:, None], written[None, :], -1
+        ).astype(jnp.int32)
+    new_cache = cache.replace(pos=pos, lengths=lengths.astype(jnp.int32))
+    ctx = CacheContext(
+        mode="prefill", capacity=C, q_pos=lengths.astype(jnp.int32),
+        pos=pos, prompt_len=S,
+    )
+    return new_cache, ctx
+
+
+def decode_ctx(cache: KVCache) -> tuple[KVCache, CacheContext]:
+    """Plan a single-token step: the new token sits at position lengths[b],
+    slot lengths[b] % C; its tag is set BEFORE attention so the token
+    attends to itself."""
+    C = cache.capacity
+    q_pos = cache.lengths
+    slots = (q_pos % C).astype(jnp.int32)
+    pos = jax.vmap(lambda row, s, p: row.at[s].set(p))(cache.pos, slots, q_pos)
+    new_cache = cache.replace(pos=pos, lengths=cache.lengths + 1)
+    ctx = CacheContext(
+        mode="decode", capacity=C, q_pos=q_pos, pos=pos, slots=slots
+    )
+    return new_cache, ctx

@@ -1,0 +1,127 @@
+"""Jitted prefill + token-at-a-time decode.
+
+Two programs, compiled once each (Pope et al. §3.1's prefill/generate
+split):
+
+- **prefill**: the whole padded prompt batch through the model's ordinary
+  packed segment-ids attention path (pads sit in segment 0, prompts in
+  segment 1; right-padding + causality keeps real tokens clean), writing
+  every layer's post-RoPE K/V into the cache, returning each slot's
+  last-real-token logits.
+- **decode**: a ``lax.while_loop`` feeding each sampled token back through
+  the model with ``cache=(KVCache, CacheContext)`` — one token per slot per
+  iteration, RoPE evaluated at the slot's own position offset
+  (``position_ids = lengths``), stop-token handling with early exit when
+  every slot is done.
+
+Model output convention (the cache-capable families): calling with a cache
+returns ``(primary, new_cache)`` where primary is ``logits`` for dense
+models and ``(logits, aux)`` for MoE — ``_logits_of`` normalizes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.generation import kv_cache
+from automodel_tpu.generation.sampling import SamplingConfig, sample
+
+
+def _logits_of(primary: Any) -> jnp.ndarray:
+    return primary[0] if isinstance(primary, tuple) else primary
+
+
+def build_prefill_fn(apply: Callable) -> Callable:
+    """``apply(params, input_ids, **kw)`` → jitted
+    ``prefill(params, input_ids [B,S], lengths [B], cache)`` →
+    ``(last_logits [B,V] fp32, cache)``."""
+
+    def prefill(params, input_ids, lengths, cache):
+        B, S = input_ids.shape
+        positions = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+        )
+        segment_ids = (positions < lengths[:, None]).astype(jnp.int32)
+        kvc, ctx = kv_cache.prefill_ctx(cache, S, lengths)
+        primary, new_cache = apply(
+            params, input_ids, position_ids=positions, segment_ids=segment_ids,
+            cache=(kvc, ctx),
+        )
+        logits = _logits_of(primary)
+        last = logits[jnp.arange(B), lengths - 1].astype(jnp.float32)
+        return last, new_cache
+
+    return jax.jit(prefill)
+
+
+def build_decode_fn(
+    apply: Callable,
+    sampling: SamplingConfig,
+    max_new_tokens: int,
+    eos_ids: Sequence[int] = (),
+    pad_id: int = 0,
+) -> Callable:
+    """Jitted ``decode(params, cache, first_token [B], key)`` →
+    ``(result dict, cache)``.
+
+    ``first_token`` is the token sampled from the prefill logits (already
+    counted as generated token 0); each loop iteration writes the current
+    token's K/V at its slot's position and samples the next. The loop exits
+    at ``max_new_tokens`` or as soon as every slot has emitted a stop token
+    (``steps`` in the result shows the actual iteration count — the early
+    exit is observable)."""
+    eos_ids = tuple(int(e) for e in eos_ids)
+
+    def is_eos(tok: jnp.ndarray) -> jnp.ndarray:
+        if not eos_ids:
+            return jnp.zeros(tok.shape, bool)
+        m = tok == eos_ids[0]
+        for e in eos_ids[1:]:
+            m = m | (tok == e)
+        return m
+
+    def decode(params, cache, first_token, key):
+        B = first_token.shape[0]
+        tokens = jnp.full((B, max_new_tokens), pad_id, jnp.int32)
+        tokens = tokens.at[:, 0].set(first_token)
+        done0 = is_eos(first_token)
+
+        def cond(carry):
+            _, _, _, done, i, _ = carry
+            return (i < max_new_tokens) & ~jnp.all(done)
+
+        def body(carry):
+            cache, tokens, cur, done, i, n_gen = carry
+            kvc, ctx = kv_cache.decode_ctx(cache)
+            primary, cache = apply(
+                params, cur[:, None], position_ids=ctx.q_pos[:, None],
+                cache=(kvc, ctx),
+            )
+            logits = _logits_of(primary)[:, -1].astype(jnp.float32)
+            nxt = sample(logits, jax.random.fold_in(key, i), sampling)
+            nxt = jnp.where(done, jnp.int32(pad_id), nxt)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt[:, None], (jnp.int32(0), i)
+            )
+            n_gen = n_gen + jnp.where(done, 0, 1).astype(jnp.int32)
+            done = done | is_eos(nxt)
+            return (cache, tokens, nxt, done, i + 1, n_gen)
+
+        carry = (
+            cache, tokens, first_token, done0,
+            jnp.int32(1), jnp.ones((B,), jnp.int32),
+        )
+        cache, tokens, _, done, i, n_gen = jax.lax.while_loop(
+            cond, body, carry
+        )
+        # i starts at 1 (slot 0 holds first_token), so body iterations —
+        # the observable loop-length for the early-exit contract — are i-1
+        return (
+            {"tokens": tokens, "n_generated": n_gen, "steps": i - 1},
+            cache,
+        )
+
+    return jax.jit(decode)

@@ -1,0 +1,78 @@
+"""Token sampling: greedy / temperature / top-k / top-p.
+
+All transforms are jit-traceable with a STATIC config (the frozen dataclass
+hashes), so the decode while_loop compiles one program per sampling recipe.
+The PRNG is threaded explicitly: callers derive a per-host base key via
+``training.rng.sampling_key`` and fold the decode step index in per token —
+multi-host generation never samples identical streams, and the same
+(seed, host, step) always reproduces the same token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """temperature <= 0 means greedy (HF convention do_sample=False);
+    top_k/top_p restrict the support BEFORE renormalization (HF order:
+    temperature → top-k → top-p)."""
+
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+
+    def __post_init__(self):
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k={self.top_k} must be >= 1")
+        if self.top_p is not None and not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p={self.top_p} must be in (0, 1]")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature is None or self.temperature <= 0.0
+
+
+_NEG_INF = jnp.float32(-1e30)
+
+
+def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    k = min(k, logits.shape[-1])
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def _apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of descending-prob tokens
+    whose cumulative probability reaches p (the top token always survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+    # a token is kept iff the cumulative mass BEFORE it is < p
+    keep_sorted = jnp.concatenate(
+        [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < p], axis=-1
+    )
+    # threshold logit = smallest kept logit; everything below it is cut
+    kth = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def sample(
+    logits: jnp.ndarray, key: jax.Array, config: SamplingConfig
+) -> jnp.ndarray:
+    """logits [B, V] → token ids [B] int32."""
+    logits = logits.astype(jnp.float32)
+    if config.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.float32(config.temperature)
+    if config.top_k is not None:
+        logits = _apply_top_k(logits, config.top_k)
+    if config.top_p is not None and config.top_p < 1.0:
+        logits = _apply_top_p(logits, config.top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

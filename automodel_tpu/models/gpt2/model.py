@@ -143,25 +143,42 @@ def decoder_layer(
     lp: dict,
     segment_ids: Optional[jnp.ndarray],
     constrain: Constrain,
-) -> jnp.ndarray:
+    cache: Optional[tuple] = None,
+    cache_ctx: Any = None,
+):
+    """``cache``/``cache_ctx``: generation hook, same contract as the llama
+    attention_block — this layer's (k, v) cache slices plus the shared
+    write/attend plan; returns ``(h, (new_k, new_v))`` when caching."""
     B, S, D = h.shape
     x = layer_norm(h, lp["ln_1"]["scale"], lp["ln_1"]["bias"], cfg.layer_norm_eps)
     q = _proj(x, lp["attn"]["q_proj"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
     k = _proj(x, lp["attn"]["k_proj"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
     v = _proj(x, lp["attn"]["v_proj"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-    attn_out = attention(
-        q, k, v,
-        backend=backend.attn,
-        platform=backend.platform,
-        causal=True,
-        segment_ids=segment_ids,
-    )
+    new_layer_kv = None
+    if cache is not None:
+        new_layer_kv = cache_ctx.write(cache[0], cache[1], k, v)
+    if cache is not None and cache_ctx.decode:
+        from automodel_tpu.ops.attention import sdpa_decode
+
+        attn_out = sdpa_decode(
+            q, new_layer_kv[0], new_layer_kv[1],
+            kv_mask=cache_ctx.attend_mask(None),
+        )
+    else:
+        attn_out = attention(
+            q, k, v,
+            backend=backend.attn,
+            platform=backend.platform,
+            causal=True,
+            segment_ids=segment_ids,
+        )
     h = h + _proj(attn_out.reshape(B, S, D), lp["attn"]["o_proj"])
     h = constrain(h, ("batch", "seq", None))
     x = layer_norm(h, lp["ln_2"]["scale"], lp["ln_2"]["bias"], cfg.layer_norm_eps)
     mlp = _proj(ACT_FNS[cfg.act](_proj(x, lp["mlp"]["fc"])), lp["mlp"]["proj"])
     h = h + mlp
-    return constrain(h, ("batch", "seq", None))
+    h = constrain(h, ("batch", "seq", None))
+    return h if cache is None else (h, new_layer_kv)
 
 
 def forward_hidden(
@@ -172,7 +189,8 @@ def forward_hidden(
     position_ids: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     constrain: Constrain = _noop_constrain,
-) -> jnp.ndarray:
+    cache: Optional[tuple] = None,
+):
     cd = backend.compute_jnp_dtype
     if input_ids.shape[1] > cfg.n_positions:
         # learned wpe has no extrapolation; an OOB gather would silently
@@ -188,21 +206,47 @@ def forward_hidden(
     h = h + params["pos_embed"]["embedding"].astype(cd)[position_ids]
     h = constrain(h, ("batch", "seq", None))
 
-    def layer_fn(carry, lp):
-        return decoder_layer(cfg, backend, carry, lp, segment_ids, constrain), None
+    kvc = ctx = None
+    if cache is not None:
+        kvc, ctx = cache
 
-    from automodel_tpu.models.common.stacking import remat_wrap
+        def layer_fn(carry, xs):
+            lp, layer_kv = xs
+            return decoder_layer(
+                cfg, backend, carry, lp, segment_ids, constrain,
+                cache=layer_kv, cache_ctx=ctx,
+            )
 
-    layer_fn = remat_wrap(layer_fn, backend.remat)
-    if backend.scan_layers:
-        h, _ = jax.lax.scan(layer_fn, h, params["layers"])
     else:
+
+        def layer_fn(carry, lp):
+            return decoder_layer(cfg, backend, carry, lp, segment_ids, constrain), None
+
+        from automodel_tpu.models.common.stacking import remat_wrap
+
+        layer_fn = remat_wrap(layer_fn, backend.remat)
+    new_cache = None
+    if backend.scan_layers:
+        xs = params["layers"] if cache is None else (params["layers"], (kvc.k, kvc.v))
+        h, ys = jax.lax.scan(layer_fn, h, xs)
+        if cache is not None:
+            new_cache = kvc.replace(k=ys[0], v=ys[1])
+    else:
+        new_k, new_v = [], []
         for i in range(cfg.num_layers):
-            h, _ = layer_fn(h, jax.tree.map(lambda x: x[i], params["layers"]))
-    return layer_norm(
+            lp = jax.tree.map(lambda x: x[i], params["layers"])
+            xs = lp if cache is None else (lp, (kvc.k[i], kvc.v[i]))
+            h, lkv = layer_fn(h, xs)
+            if cache is not None:
+                new_k.append(lkv[0])
+                new_v.append(lkv[1])
+        if cache is not None:
+            new_cache = kvc.replace(k=jnp.stack(new_k), v=jnp.stack(new_v))
+    h = layer_norm(
         h, params["final_norm"]["scale"], params["final_norm"]["bias"],
         cfg.layer_norm_eps,
     )
+    return h if cache is None else (h, new_cache)
 
 
 def lm_head_kernel(cfg: GPT2Config, params: dict) -> jnp.ndarray:
@@ -219,12 +263,16 @@ def forward(
     position_ids: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     constrain: Constrain = _noop_constrain,
-) -> jnp.ndarray:
-    h = forward_hidden(
-        cfg, backend, params, input_ids, position_ids, segment_ids, constrain
+    cache: Optional[tuple] = None,
+):
+    out = forward_hidden(
+        cfg, backend, params, input_ids, position_ids, segment_ids, constrain,
+        cache=cache,
     )
+    h, new_cache = out if cache is not None else (out, None)
     logits = h @ lm_head_kernel(cfg, params).astype(h.dtype)
-    return constrain(logits, ("batch", "seq", "vocab"))
+    logits = constrain(logits, ("batch", "seq", "vocab"))
+    return logits if cache is None else (logits, new_cache)
 
 
 SHARDING_RULES: list[tuple[str, tuple]] = [
@@ -280,6 +328,7 @@ class GPT2ForCausalLM:
     backend: BackendConfig = BackendConfig()
 
     lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel", "*/mlp/*/kernel")
+    supports_kv_cache = True
 
     def init(self, key: jax.Array) -> dict:
         return init_params(self.config, self.backend, key)

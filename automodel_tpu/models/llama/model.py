@@ -157,8 +157,19 @@ def attention_block(
     segment_ids: Optional[jnp.ndarray],
     constrain: Constrain,
     sliding_window: Optional[int] = None,
-) -> jnp.ndarray:
-    """Pre-norm attention + residual; shared across dense and MoE families."""
+    cache: Optional[tuple] = None,
+    cache_ctx: Any = None,
+):
+    """Pre-norm attention + residual; shared across dense and MoE families.
+
+    ``cache``/``cache_ctx`` (generation subsystem): ``cache`` is this
+    layer's KV slice ``(k [B,C,Nkv,H], v [B,C,Nkv,H])`` riding the layer
+    scan; ``cache_ctx`` is the shared per-forward write/attend plan
+    (generation.kv_cache.CacheContext). Post-RoPE k/v are written into the
+    cache; prefill then attends normally over the incoming block (the
+    packed segment-ids path), decode attends the single query over the
+    cache under the position-tag mask. With a cache the return value is
+    ``(h, (new_k, new_v))`` instead of ``h``."""
     B, S, D = h.shape
     x = rms_norm(h, lp["input_norm"]["scale"], cfg.rms_eps)
     q = _proj(x, lp["attn"]["q_proj"], backend.fp8)
@@ -174,6 +185,23 @@ def attention_block(
         q = rms_norm(q, lp["attn"]["q_norm"]["scale"], cfg.rms_eps)
         k = rms_norm(k, lp["attn"]["k_norm"]["scale"], cfg.rms_eps)
     q, k = apply_rope(q, k, cos, sin)
+    new_layer_kv = None
+    if cache is not None:
+        ck, cv = cache
+        new_layer_kv = cache_ctx.write(ck, cv, k, v)
+        if cache_ctx.decode:
+            from automodel_tpu.ops.attention import sdpa_decode
+
+            attn_out = sdpa_decode(
+                q, new_layer_kv[0], new_layer_kv[1],
+                kv_mask=cache_ctx.attend_mask(sliding_window),
+                scale=cfg.attn_scale,
+                logits_soft_cap=cfg.attn_soft_cap,
+            )
+            h = h + _proj(
+                attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"], backend.fp8
+            )
+            return constrain(h, ("batch", "seq", None)), new_layer_kv
     attn_out = attention(
         q,
         k,
@@ -192,7 +220,8 @@ def attention_block(
         ),
     )
     h = h + _proj(attn_out.reshape(B, S, cfg.q_dim), lp["attn"]["o_proj"], backend.fp8)
-    return constrain(h, ("batch", "seq", None))
+    h = constrain(h, ("batch", "seq", None))
+    return h if cache is None else (h, new_layer_kv)
 
 
 def decoder_layer(
@@ -205,10 +234,14 @@ def decoder_layer(
     segment_ids: Optional[jnp.ndarray],
     constrain: Constrain,
     sliding_window: Optional[int] = None,
-) -> jnp.ndarray:
-    h = attention_block(
-        cfg, backend, h, lp, cos, sin, segment_ids, constrain, sliding_window
+    cache: Optional[tuple] = None,
+    cache_ctx: Any = None,
+):
+    out = attention_block(
+        cfg, backend, h, lp, cos, sin, segment_ids, constrain, sliding_window,
+        cache=cache, cache_ctx=cache_ctx,
     )
+    h, new_layer_kv = out if cache is not None else (out, None)
     x = rms_norm(h, lp["post_attn_norm"]["scale"], cfg.rms_eps)
     act = ACT_FNS[cfg.act]
     mlp = _proj(
@@ -217,7 +250,8 @@ def decoder_layer(
         lp["mlp"]["down_proj"], backend.fp8,
     )
     h = h + mlp
-    return constrain(h, ("batch", "seq", None))
+    h = constrain(h, ("batch", "seq", None))
+    return h if cache is None else (h, new_layer_kv)
 
 
 def forward_hidden(
@@ -229,11 +263,17 @@ def forward_hidden(
     segment_ids: Optional[jnp.ndarray] = None,
     constrain: Constrain = _noop_constrain,
     inputs_embeds: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
+    cache: Optional[tuple] = None,
+):
     """Embed + decoder stack → final-norm hidden states [B, S, D].
 
     ``inputs_embeds``: VLM hook (same contract as gemma/qwen3_moe) — caller
-    already embedded text tokens and scattered projected image features."""
+    already embedded text tokens and scattered projected image features.
+
+    ``cache``: generation hook — ``(KVCache, CacheContext)`` from
+    generation.kv_cache.prefill_ctx/decode_ctx. The per-layer KV slices
+    ride the layer scan as xs/ys; the return value becomes
+    ``(hidden, new_KVCache)``."""
     cd = backend.compute_jnp_dtype
     if position_ids is None:
         position_ids = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
@@ -247,14 +287,22 @@ def forward_hidden(
     h = constrain(h, ("batch", "seq", None))
     cos, sin = rope_table(position_ids, cfg.rope_dim or cfg.head_dim, cfg.rope)
 
+    kvc = ctx = None
+    if cache is not None:
+        kvc, ctx = cache
+
     def make_layer_fn(sliding_window):
-        def layer_fn(carry, lp):
+        def layer_fn(carry, xs):
+            lp, layer_kv = (xs, None) if cache is None else xs
             out = decoder_layer(
                 cfg, backend, carry, lp, cos, sin, segment_ids, constrain,
-                sliding_window=sliding_window,
+                sliding_window=sliding_window, cache=layer_kv, cache_ctx=ctx,
             )
-            return out, None
+            return out if cache is not None else (out, None)
 
+        if cache is not None:
+            # inference: no backward pass, remat would only re-run compute
+            return layer_fn
         from automodel_tpu.models.common.stacking import remat_wrap
 
         return remat_wrap(layer_fn, backend.remat)
@@ -263,15 +311,29 @@ def forward_hidden(
     # mixed full/windowed layers force per-layer calls; the homogeneous case
     # (every layer same window) keeps the single lax.scan over stacked params.
     homogeneous = cfg.sliding_window is None or cfg.max_window_layers in (0, None)
+    new_cache = None
     if backend.scan_layers and homogeneous:
-        h, _ = jax.lax.scan(
-            make_layer_fn(_layer_sliding_window(cfg, 0)), h, params["layers"]
+        xs = (
+            params["layers"]
+            if cache is None
+            else (params["layers"], (kvc.k, kvc.v))
         )
+        h, ys = jax.lax.scan(make_layer_fn(_layer_sliding_window(cfg, 0)), h, xs)
+        if cache is not None:
+            new_cache = kvc.replace(k=ys[0], v=ys[1])
     else:
+        new_k, new_v = [], []
         for i in range(L):
             lp = jax.tree.map(lambda x: x[i], params["layers"])
-            h, _ = make_layer_fn(_layer_sliding_window(cfg, i))(h, lp)
-    return rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
+            xs = lp if cache is None else (lp, (kvc.k[i], kvc.v[i]))
+            h, lkv = make_layer_fn(_layer_sliding_window(cfg, i))(h, xs)
+            if cache is not None:
+                new_k.append(lkv[0])
+                new_v.append(lkv[1])
+        if cache is not None:
+            new_cache = kvc.replace(k=jnp.stack(new_k), v=jnp.stack(new_v))
+    h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
+    return h if cache is None else (h, new_cache)
 
 
 def lm_head_kernel(cfg: TransformerConfig, params: dict) -> jnp.ndarray:
@@ -288,13 +350,20 @@ def forward(
     position_ids: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     constrain: Constrain = _noop_constrain,
-) -> jnp.ndarray:
-    """Full forward → logits [B, S, V] (compute dtype)."""
-    h = forward_hidden(cfg, backend, params, input_ids, position_ids, segment_ids, constrain)
+    cache: Optional[tuple] = None,
+):
+    """Full forward → logits [B, S, V] (compute dtype); with ``cache``
+    (generation) → ``(logits, new_KVCache)``."""
+    out = forward_hidden(
+        cfg, backend, params, input_ids, position_ids, segment_ids, constrain,
+        cache=cache,
+    )
+    h, new_cache = out if cache is not None else (out, None)
     logits = h @ lm_head_kernel(cfg, params).astype(h.dtype)
     if cfg.logits_soft_cap is not None:
         logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
-    return constrain(logits, ("batch", "seq", "vocab"))
+    logits = constrain(logits, ("batch", "seq", "vocab"))
+    return logits if cache is None else (logits, new_cache)
 
 
 # -- sharding rules ---------------------------------------------------------
@@ -326,6 +395,9 @@ class LlamaForCausalLM:
     the scan (QLoRA without materializing the full-precision stack)."""
 
     supports_packed_nf4 = True
+    # generation: forward/forward_hidden accept cache=(KVCache, CacheContext)
+    # and return (..., new_cache); the GenerationEngine keys off this flag
+    supports_kv_cache = True
 
     config: TransformerConfig
     backend: BackendConfig = BackendConfig()

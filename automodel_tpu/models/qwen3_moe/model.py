@@ -180,14 +180,30 @@ def forward_hidden(
     inputs_embeds: Optional[jnp.ndarray] = None,
     rope_cos_sin: Optional[tuple] = None,
     deepstack: Optional[tuple] = None,
-) -> tuple[jnp.ndarray, MoEModelAux]:
+    cache: Optional[tuple] = None,
+):
     """``inputs_embeds``/``rope_cos_sin``/``deepstack`` are the VLM hooks
     (qwen3_vl_moe): precomputed embeddings with image features scattered in,
     an mrope cos/sin table, and ``(visual_mask [B,S,1], ds [n_deep,B,S,D])``
     visual embeds added to the hidden states after each of the first n_deep
-    layers (HF Qwen3VLMoeTextModel._deepstack_process)."""
+    layers (HF Qwen3VLMoeTextModel._deepstack_process).
+
+    ``cache``: generation hook — ``(KVCache, CacheContext)``; the cache's
+    layer axis covers dense-prefix + MoE layers in order, sliced statically
+    per stack. Return becomes ``((h, aux), new_cache)``. Only the default
+    llama attention block supports it (the VLM attn_block overrides don't
+    decode)."""
     cd = backend.compute_jnp_dtype
     moe = cfg.moe
+    kvc = ctx = None
+    if cache is not None:
+        if deepstack is not None:
+            raise NotImplementedError("KV-cache decode with deepstack (VLM)")
+        if attn_block is not attention_block:
+            raise NotImplementedError(
+                "KV-cache decode requires the default attention block"
+            )
+        kvc, ctx = cache
     if position_ids is None:
         position_ids = jnp.arange(input_ids.shape[1])[None, :].astype(jnp.int32)
         position_ids = jnp.broadcast_to(position_ids, input_ids.shape)
@@ -209,21 +225,48 @@ def forward_hidden(
 
         return remat_wrap(fn, backend.remat)
 
+    nd = moe.num_dense_layers
+    new_k_parts: list = []
+    new_v_parts: list = []
+
+    def attn_and_kv(carry, lp, layer_kv):
+        if layer_kv is None:
+            return attn_block(
+                cfg, backend, carry, lp, cos, sin, segment_ids, constrain
+            ), None
+        return attn_block(
+            cfg, backend, carry, lp, cos, sin, segment_ids, constrain,
+            cache=layer_kv, cache_ctx=ctx,
+        )
+
     if "dense_layers" in params:
-        def dense_fn(carry, lp):
-            hh = attn_block(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
+        def dense_fn(carry, xs):
+            lp, layer_kv = xs if cache is not None else (xs, None)
+            hh, new_kv = attn_and_kv(carry, lp, layer_kv)
             x = rms_norm(hh, lp["post_attn_norm"]["scale"], cfg.rms_eps)
             act = ACT_FNS[cfg.act]
             mlp = (
                 act(x @ lp["mlp"]["gate_proj"]["kernel"].astype(x.dtype))
                 * (x @ lp["mlp"]["up_proj"]["kernel"].astype(x.dtype))
             ) @ lp["mlp"]["down_proj"]["kernel"].astype(x.dtype)
-            return constrain(hh + mlp, ("batch", "seq", None)), None
+            out = constrain(hh + mlp, ("batch", "seq", None))
+            return out, (None if cache is None else new_kv)
 
-        h, _ = jax.lax.scan(maybe_remat(dense_fn), h, params["dense_layers"])
+        dxs = (
+            params["dense_layers"]
+            if cache is None
+            else (params["dense_layers"], (kvc.k[:nd], kvc.v[:nd]))
+        )
+        h, dys = jax.lax.scan(
+            dense_fn if cache is not None else maybe_remat(dense_fn), h, dxs
+        )
+        if cache is not None:
+            new_k_parts.append(dys[0])
+            new_v_parts.append(dys[1])
 
-    def moe_fn(carry, lp):
-        hh = attn_block(cfg, backend, carry, lp, cos, sin, segment_ids, constrain)
+    def moe_fn(carry, xs):
+        lp, layer_kv = xs if cache is not None else (xs, None)
+        hh, new_kv = attn_and_kv(carry, lp, layer_kv)
         x = rms_norm(hh, lp["post_attn_norm"]["scale"], cfg.rms_eps)
         out, aux = moe_block(
             x,
@@ -238,9 +281,10 @@ def forward_hidden(
             act_name=cfg.act,
         )
         hh = hh + out
-        return constrain(hh, ("batch", "seq", None)), aux
+        hh = constrain(hh, ("batch", "seq", None))
+        return hh, (aux if cache is None else (aux, new_kv))
 
-    nm = cfg.num_layers - moe.num_dense_layers
+    nm = cfg.num_layers - nd
     if deepstack is not None:
         # run the first n_deep layers unstacked, adding the deepstack visual
         # embeds at image positions after each, then scan the homogeneous rest
@@ -267,20 +311,48 @@ def forward_hidden(
         counts = jnp.concatenate([jnp.stack(counts_l), auxs.expert_counts])
         aux_losses = jnp.concatenate([jnp.stack(aux_l), auxs.aux_loss])
     elif backend.scan_layers:
-        h, auxs = jax.lax.scan(maybe_remat(moe_fn), h, params["moe_layers"])
+        mxs = (
+            params["moe_layers"]
+            if cache is None
+            else (params["moe_layers"], (kvc.k[nd:], kvc.v[nd:]))
+        )
+        h, ys = jax.lax.scan(
+            moe_fn if cache is not None else maybe_remat(moe_fn), h, mxs
+        )
+        if cache is not None:
+            auxs, (mk, mv) = ys
+            new_k_parts.append(mk)
+            new_v_parts.append(mv)
+        else:
+            auxs = ys
         counts, aux_losses = auxs.expert_counts, auxs.aux_loss
     else:
-        counts_l, aux_l = [], []
+        counts_l, aux_l, mk_l, mv_l = [], [], [], []
         for i in range(nm):
             lp = jax.tree.map(lambda x: x[i], params["moe_layers"])
-            h, aux = moe_fn(h, lp)
+            xs = lp if cache is None else (lp, (kvc.k[nd + i], kvc.v[nd + i]))
+            h, ys = moe_fn(h, xs)
+            aux = ys if cache is None else ys[0]
+            if cache is not None:
+                mk_l.append(ys[1][0])
+                mv_l.append(ys[1][1])
             counts_l.append(aux.expert_counts)
             aux_l.append(aux.aux_loss)
         counts = jnp.stack(counts_l)
         aux_losses = jnp.stack(aux_l)
+        if cache is not None:
+            new_k_parts.append(jnp.stack(mk_l))
+            new_v_parts.append(jnp.stack(mv_l))
 
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
-    return h, MoEModelAux(counts, aux_losses.sum())
+    out = (h, MoEModelAux(counts, aux_losses.sum()))
+    if cache is None:
+        return out
+    new_cache = kvc.replace(
+        k=jnp.concatenate(new_k_parts) if len(new_k_parts) > 1 else new_k_parts[0],
+        v=jnp.concatenate(new_v_parts) if len(new_v_parts) > 1 else new_v_parts[0],
+    )
+    return out, new_cache
 
 
 def forward(
@@ -290,11 +362,14 @@ def forward(
     input_ids: jnp.ndarray,
     attn_block: Any = attention_block,
     rope_dim: Optional[int] = None,
+    cache: Optional[tuple] = None,
     **kw: Any,
-) -> tuple[jnp.ndarray, MoEModelAux]:
-    h, aux = forward_hidden(
-        cfg, backend, params, input_ids, attn_block=attn_block, rope_dim=rope_dim, **kw
+):
+    out = forward_hidden(
+        cfg, backend, params, input_ids, attn_block=attn_block,
+        rope_dim=rope_dim, cache=cache, **kw
     )
+    (h, aux), new_cache = out if cache is not None else (out, None)
     kernel = (
         params["embed"]["embedding"].T
         if cfg.tie_embeddings
@@ -303,7 +378,7 @@ def forward(
     logits = h @ kernel.astype(h.dtype)
     if cfg.logits_soft_cap is not None:
         logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
-    return logits, aux
+    return (logits, aux) if cache is None else ((logits, aux), new_cache)
 
 
 # dense rules match here too ("layers/attn/..." regexes find
@@ -334,6 +409,8 @@ class MoEForCausalLM:
     # LoRA activation-side; mlp/expert weights do raw kernel matmuls and
     # stay on the merged fallback (see peft.lora.graft_lora)
     lora_graft_patterns = ("*/attn/[qkvo]_proj/kernel",)
+    # generation: the MoE decode path (cache over dense-prefix + MoE stacks)
+    supports_kv_cache = True
 
     def init(self, key: jax.Array) -> dict:
         return init_params(self.config, self.backend, key)

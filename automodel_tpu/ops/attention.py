@@ -51,8 +51,14 @@ def sdpa(
     sinks: Optional[jnp.ndarray] = None,
     bidir_groups: Optional[jnp.ndarray] = None,
     attn_bias: Optional[jnp.ndarray] = None,
+    kv_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """XLA scaled dot-product attention. q: [B,S,N,H], k/v: [B,S,Nkv,H].
+
+    ``kv_mask``: [B, Sk] bool — per-key validity, ANDed onto the mask. The
+    KV-cache decode path (generation/) expresses slot validity this way
+    (position-tag masks subsume causality/window there, so decode calls pass
+    causal=False and let the tags do the masking).
 
     ``attn_bias``: additive fp32 bias [B, 1|N, Sq, Sk] applied after scaling
     (DeepSeek-V3.2 sparse top-k mask; TE core_attention_bias equivalent).
@@ -93,6 +99,8 @@ def sdpa(
     if segment_ids is not None:
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         mask = mask & seg
+    if kv_mask is not None:
+        mask = mask & kv_mask[:, None, None, :]
     logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
     if sinks is not None:
         sink_col = jnp.broadcast_to(
@@ -103,6 +111,31 @@ def sdpa(
     else:
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+def sdpa_decode(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    kv_mask: jnp.ndarray,
+    scale: Optional[float] = None,
+    logits_soft_cap: Optional[float] = None,
+    sinks: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Single-token decode attention over a KV cache.
+
+    q: [B, 1, N, H] (the new token), k/v: [B, C, Nkv, H] (the cache),
+    kv_mask: [B, C] valid-slot mask (generation.kv_cache position tags —
+    these already encode causality and any sliding window, so no causal
+    mask is applied here). One fused XLA program: a [B, N, 1, C] logits
+    block is VPU work, so decode never needs (or benefits from) splash —
+    the MXU tile is 128 wide and a 1-row query can't fill it."""
+    return sdpa(
+        q, k, v,
+        causal=False, scale=scale, logits_soft_cap=logits_soft_cap,
+        sinks=sinks, kv_mask=kv_mask,
+    )
 
 
 def _pick_block(pref: int, s: int) -> int:
@@ -229,6 +262,17 @@ def flash(
     attention (splash's LocalMask enforces causality, so even non-causal
     windowed must not route there), and logs loudly when it does."""
     h = q.shape[-1]
+    if q.shape[1] == 1:
+        # single-query decode: the splash MXU tiling pads the query to a
+        # 128-row block — 127/128 of the kernel is wasted — while the XLA
+        # sdpa lowers to one VPU-bound fused program. Not a fallback (no
+        # warning): decode is DESIGNED to never require splash.
+        return sdpa(
+            q, k, v,
+            causal=causal, scale=scale, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap, sliding_window=sliding_window,
+            sinks=sinks,
+        )
     reason = None
     if not _flash_eligible(platform):
         reason = "not running on TPU"

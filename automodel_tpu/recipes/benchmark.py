@@ -133,6 +133,12 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
                     "step times are polluted by %.2fs of compile",
                     d["compiles"], d["compile_secs"],
                 )
+        # decode leg (generation subsystem): time-to-first-token + decode
+        # tokens/sec through the jitted prefill/while-loop-decode programs.
+        # Degrades to null-with-recorded-reason (validate_bench_result
+        # semantics) when the `generation:` section or a cache-capable
+        # model is absent — a leg that never ran must never read as 0.0.
+        result.update(self._generation_leg())
         pinfo = getattr(self.model, "pipeline_info", None)
         if pinfo:
             from automodel_tpu.utils.flops_utils import pipeline_bubble_fraction
@@ -158,6 +164,41 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
         )
         print(json.dumps(result))
         return result
+
+
+    def _generation_leg(self) -> dict:
+        """→ {gen_ttft_s, gen_decode_tps, gen_failure[, gen_tokens,
+        gen_cache_bytes]}. First call compiles (discarded), second call is
+        the measurement. Mock prompts: random token ids, batch/length from
+        `generation.bench_batch` / `generation.bench_prompt_len`."""
+        if self._gen_engine is None:
+            return {
+                "gen_ttft_s": None,
+                "gen_decode_tps": None,
+                "gen_failure": self._gen_skip_reason
+                or "no generation: section in config",
+            }
+        batch = int(self._gen_section.get("bench_batch", 4))
+        prompt_len = int(self._gen_section.get("bench_prompt_len", 64))
+        vocab = int(self.model.config.vocab_size)
+        rng = np.random.default_rng(0)
+        prompts = rng.integers(1, vocab, size=(batch, prompt_len)).tolist()
+        try:
+            self._gen_engine.generate_ids(prompts, params=self.state.params)
+            out = self._gen_engine.generate_ids(prompts, params=self.state.params)
+        except Exception as e:
+            return {
+                "gen_ttft_s": None,
+                "gen_decode_tps": None,
+                "gen_failure": f"{type(e).__name__}: {e}",
+            }
+        return {
+            "gen_ttft_s": round(out["ttft_s"], 6),
+            "gen_decode_tps": round(out["decode_tps"], 2),
+            "gen_tokens": out["gen_tokens"],
+            "gen_cache_bytes": out["cache_bytes"],
+            "gen_failure": None,
+        }
 
 
 def main(cfg: ConfigNode) -> dict:

@@ -298,6 +298,20 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             ),
         )
 
+        # in-training eval generation (generation: YAML section,
+        # docs/generation.md): sample completions at validation boundaries
+        # through the KV-cache inference engine and log them to the JSONL
+        # (gen_samples + ttft_s/decode_tps). Never load-bearing: any skip
+        # reason is recorded and the benchmark recipe's decode leg reports
+        # it as a null-with-reason leg instead of a silent zero.
+        self._gen_engine = None
+        self._gen_prompts = None
+        self._gen_prompt_ids = None
+        self._gen_section: dict = {}
+        self._gen_skip_reason: Optional[str] = None
+        if cfg.get("generation") is not None:
+            self._setup_eval_generation(dict(cfg.get("generation") or {}))
+
         # checkpointing — AFTER telemetry, so the event hook is live for the
         # startup auto-resume: a walk-back past a corrupt newest checkpoint
         # during _restore() must reach the flight recorder
@@ -313,6 +327,91 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             self.resilience.arm_peer_marker(self.checkpointer.root)
         if self.checkpointer and self.checkpointer.has_checkpoint():
             self._restore()
+
+    def _setup_eval_generation(self, gcfg: dict) -> None:
+        from automodel_tpu.generation.engine import (
+            GenerationConfig,
+            GenerationEngine,
+            GenerationUnsupported,
+            resolve_tokenizer,
+        )
+
+        gcfg.pop("_target_", None)
+        self._gen_section = dict(gcfg)
+        if gcfg.pop("enabled", True) is False:
+            self._gen_skip_reason = "generation.enabled: false"
+            return
+        prompts = gcfg.pop("prompts", None)
+        prompt_ids = gcfg.pop("prompt_ids", None)
+        tok_cfg = gcfg.pop("tokenizer", None)
+        if self.peft_config is not None:
+            # the trainable tree is the adapter, not decodable weights;
+            # merged-adapter generation is a follow-up
+            self._gen_skip_reason = (
+                "generation with peft adapters is not supported (merge first)"
+            )
+            logger.warning("generation: %s", self._gen_skip_reason)
+            return
+        # same resolution ladder as the generate CLI; the checkpoint
+        # fallback only matters when text prompts are configured
+        tokenizer = resolve_tokenizer(
+            tok_cfg,
+            self.cfg.model.get("pretrained_model_name_or_path")
+            if prompts is not None
+            else None,
+        )
+        try:
+            self._gen_engine = GenerationEngine(
+                self.auto, GenerationConfig.from_dict(gcfg), tokenizer=tokenizer
+            )
+        except GenerationUnsupported as e:
+            self._gen_skip_reason = str(e)
+            logger.warning("generation: %s", e)
+            return
+        if prompts is not None and tokenizer is None:
+            logger.warning(
+                "generation.prompts given without generation.tokenizer — "
+                "use generation.prompt_ids for tokenizer-less runs"
+            )
+            prompts = None
+        self._gen_prompts = list(prompts) if prompts else None
+        self._gen_prompt_ids = (
+            [[int(t) for t in p] for p in prompt_ids] if prompt_ids else None
+        )
+
+    def _log_eval_generation(self) -> None:
+        """Sample completions with the CURRENT weights and log them. A
+        generation failure is logged and swallowed — eval sampling must
+        never kill a training run."""
+        eng = self._gen_engine
+        if eng is None or (self._gen_prompts is None and self._gen_prompt_ids is None):
+            return
+        try:
+            if self._gen_prompt_ids is not None:
+                out = eng.generate_ids(self._gen_prompt_ids, params=self.state.params)
+                shown = [" ".join(map(str, p)) for p in self._gen_prompt_ids]
+                texts = [" ".join(map(str, t)) for t in out["tokens"]]
+            else:
+                out = eng.generate(self._gen_prompts, params=self.state.params)
+                shown, texts = self._gen_prompts, out["texts"]
+        except Exception as e:
+            logger.warning("eval generation failed: %s", e)
+            return
+        for p, t in zip(shown, texts):
+            logger.info("sample @%d | %s -> %s", self.step_scheduler.step, p, t)
+        self.metric_logger.log(
+            {
+                "event": "generation",
+                "gen_samples": [
+                    {"prompt": p, "completion": t} for p, t in zip(shown, texts)
+                ],
+                "ttft_s": out["ttft_s"],
+                "decode_tps": out["decode_tps"],
+                "gen_tokens": out["gen_tokens"],
+                "gen_cache_bytes": out["cache_bytes"],
+            },
+            step=self.step_scheduler.step,
+        )
 
     def _make_train_step(self, loss_fn, post_step_fn=None, grad_mask=None):
         """Single construction point for the jitted step so every recipe
@@ -700,7 +799,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 t_window = time.perf_counter()
             else:
                 tel.record_step(host_rec)
-            if self.step_scheduler.is_val_step and self.val_dataloader is not None:
+            gen_active = self._gen_engine is not None and (
+                self._gen_prompts is not None or self._gen_prompt_ids is not None
+            )
+            if self.step_scheduler.is_val_step and (
+                self.val_dataloader is not None or gen_active
+            ):
                 # same early resolution as the ckpt block below: under
                 # lag-1 detection a diverged step N would otherwise run a
                 # full eval pass on NaN params and log a garbage val record
@@ -708,16 +812,23 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 # barrier anyway, so the early fetch costs nothing extra)
                 if res.config.enabled:
                     self._check_prev_nonfinite(res)
-                val = self.run_validation()
-                # compile events during validation (eval_step's first
-                # compile) belong to the val record, not the next train
-                # window's `recompiles`
+                if self.val_dataloader is not None:
+                    val = self.run_validation()
+                    # compile events during validation (eval_step's first
+                    # compile) belong to the val record, not the next train
+                    # window's `recompiles`
+                    if tel.compile_bridge is not None:
+                        d = tel.compile_bridge.drain()
+                        if d["compiles"]:
+                            val["eval_compiles"] = d["compiles"]
+                            val["eval_compile_secs"] = round(d["compile_secs"], 4)
+                    self.metric_logger.log(val, step=self.step_scheduler.step)
+                # sample completions with the current weights (generation:
+                # section); compiles + wall time land OUTSIDE the training
+                # windows (the reset below), like validation itself
+                self._log_eval_generation()
                 if tel.compile_bridge is not None:
-                    d = tel.compile_bridge.drain()
-                    if d["compiles"]:
-                        val["eval_compiles"] = d["compiles"]
-                        val["eval_compile_secs"] = round(d["compile_secs"], 4)
-                self.metric_logger.log(val, step=self.step_scheduler.step)
+                    tel.compile_bridge.drain()
                 tokens_window = steps_window = 0
                 t_window = time.perf_counter()
             if self.step_scheduler.is_ckpt_step:

@@ -33,6 +33,11 @@ _NUMERIC_KEYS = (
     "mfu",
     "pp_bubble_fraction",
     "expert_load_imbalance",
+    # generation records (in-training eval sampling + the bench decode leg)
+    "ttft_s",
+    "decode_tps",
+    "gen_tokens",
+    "gen_cache_bytes",
 )
 
 
@@ -132,6 +137,16 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
     mfu = [r["mfu"] for r in records if isinstance(r.get("mfu"), (int, float))]
     if mfu:
         out["mfu_mean"] = sum(mfu) / len(mfu)
+    gens = [r for r in records if r.get("event") == "generation"]
+    if gens:
+        out["generation_records"] = len(gens)
+        tpses = [
+            r["decode_tps"]
+            for r in gens
+            if isinstance(r.get("decode_tps"), (int, float))
+        ]
+        if tpses:
+            out["decode_tps_mean"] = sum(tpses) / len(tpses)
     return out
 
 
@@ -148,11 +163,13 @@ def format_table(summary: dict[str, Any]) -> str:
 
 # -- bench-result validation (the VERDICT r5 failure mode) -------------------
 
-# (value key, failure-reason key) per bench leg — see bench.py's output dict
+# (value key, failure-reason key) per bench leg — bench.py's output dict and
+# the benchmark recipe's generation (decode) leg
 _BENCH_LEGS = (
     ("value", "dense_failure"),
     ("qlora_8b_mfu_pct", "qlora_8b_failure"),
     ("moe_mfu_pct", "moe_failures"),
+    ("gen_decode_tps", "gen_failure"),
 )
 
 

@@ -38,6 +38,29 @@ class StatefulRNG:
         self.numpy.bit_generator.state = state["numpy"]
 
 
+def sampling_key(seed, step=None, host_index: int | None = None):
+    """Per-host deterministic sampling stream (generation subsystem).
+
+    Folds the HOST index into the base key so multi-host generation never
+    samples identical streams (each host sampling the same tokens for its
+    own slots would correlate every host's output), then optionally the
+    decode step. The decode while_loop folds its traced step index itself
+    (``jax.random.fold_in(key, i)``), so callers there pass ``step=None``;
+    ``step`` accepts a traced value too (fold_in is jit-safe).
+
+    ``seed``: int or an existing PRNG key. ``host_index`` defaults to
+    ``jax.process_index()``."""
+    import jax
+
+    key = seed if isinstance(seed, jax.Array) else jax.random.key(int(seed))
+    if host_index is None:
+        host_index = jax.process_index()
+    key = jax.random.fold_in(key, host_index)
+    if step is not None:
+        key = jax.random.fold_in(key, step)
+    return key
+
+
 @contextlib.contextmanager
 def scoped_rng(seed: int):
     """Temporarily seed global python/numpy RNGs (reference ScopedRNG)."""
