@@ -98,6 +98,13 @@ class Checkpointer:
         # recipes point this at telemetry.record_step so integrity events
         # (fallbacks, failed verifications) land in the flight recorder
         self.event_hook: Optional[Callable[[dict], None]] = None
+        # multi-host commit discipline: the recipe points this at the
+        # distributed guard's timed barrier so NO host writes the manifest
+        # until EVERY host's save drained — a straggling or dead peer
+        # otherwise leaves a committed manifest vouching for a tree whose
+        # shards from that host never landed. A timeout here raises
+        # (SyncTimeout): the dir stays uncommitted and resume skips it.
+        self.commit_barrier: Optional[Callable[[str], None]] = None
         if config.is_async:
             self._async = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
 
@@ -143,6 +150,8 @@ class Checkpointer:
     def _commit(
         self, out: Path, epoch: int, step: int, layout_markers: Optional[dict]
     ) -> None:
+        if self.commit_barrier is not None:
+            self.commit_barrier("checkpoint_commit")
         # the commit marker is the last storage touchpoint on the save path;
         # retried like every other one (write_manifest is tmp+rename, so a
         # retry after a transient EIO mid-checksum-read-back is idempotent)

@@ -143,8 +143,16 @@ def main(argv: list[str] | None = None) -> int:
         if module is not None:
             from automodel_tpu.resilience import REQUEUE_EXIT_CODE, TrainingPreempted
 
+            from automodel_tpu.resilience import DesyncError
+
             try:
                 module.main(cfg)
+            except DesyncError as e:
+                # a desynced host is a REAL fault (bad code rev, data-order
+                # bug, SDC) — never excused as preemption collateral, never
+                # requeued into the same desync: fail loudly naming the host
+                print(f"DESYNC: {e}", file=sys.stderr)
+                return 1
             except TrainingPreempted as e:
                 print(f"preempted: {e}", file=sys.stderr)
                 if e.checkpoint_dir is None:

@@ -26,6 +26,7 @@ spec:
   template:
     spec:
       restartPolicy: Never
+      terminationGracePeriodSeconds: {termination_grace_s}
       subdomain: {name}
       nodeSelector:
         cloud.google.com/gke-tpu-accelerator: {accelerator}
@@ -117,6 +118,12 @@ class K8sConfig:
     # the exit code itself is deliberately not configurable: the trainer
     # always exits resilience.REQUEUE_EXIT_CODE on preemption
     requeue_on_preemption: bool = True
+    # how long the kubelet waits between SIGTERM and SIGKILL on pod
+    # deletion/eviction: the emergency-checkpoint window. The hang
+    # watchdog's exit-75 (a wedged host detected mid-run) rides the same
+    # Ignore rules as preemption, so a hung pod recycles without burning
+    # the backoff budget.
+    termination_grace_s: int = 90
 
 
 def render_manifest(
@@ -151,6 +158,7 @@ def render_manifest(
         overrides=ov,
         pod_failure_policy=pod_failure_policy,
         backoff_limit=backoff_limit,
+        termination_grace_s=cfg.termination_grace_s,
         name=cfg.name,
         image=cfg.image,
         accelerator=cfg.accelerator,

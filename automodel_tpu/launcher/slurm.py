@@ -38,7 +38,10 @@ rc=$?
 # exit {requeue_exit_code} (REQUEUE_EXIT_CODE, resilience/preemption.py)
 # means "preempted; emergency checkpoint committed — run me again": requeue
 # this job instead of failing it. Auto-resume picks up the newest
-# manifest-verified checkpoint on restart.
+# manifest-verified checkpoint on restart. The hang watchdog
+# (resilience/watchdog.py) exits with the SAME code when a host wedges and
+# a committed checkpoint exists, so a hung job gets recycled through this
+# exact path instead of burning its reservation to the time limit.
 #
 # Multi-node wrinkle: with --kill-on-bad-exit=1, srun reports the HIGHEST
 # task exit code — the first task to exit 75 triggers a SIGKILL of its
@@ -86,6 +89,13 @@ class SlurmConfig:
     # resilience.REQUEUE_EXIT_CODE, and a knob that only changed the
     # launcher side would silently break every requeue.
     requeue_on_preemption: bool = True
+    # `--signal=TERM@N`: slurm delivers SIGTERM to the JOB STEP's tasks
+    # (the python trainers — NOT `B:`, which would signal only the batch
+    # shell, where no trap forwards it) N seconds before the time limit,
+    # so hitting the wall clock becomes a normal preemption (emergency
+    # checkpoint → exit 75 → requeue) instead of a SIGKILL that loses
+    # everything since the last cadence save. 0 disables the directive.
+    term_grace_s: int = 90
 
 
 def render_sbatch(
@@ -102,6 +112,8 @@ def render_sbatch(
     if cfg.requeue_on_preemption:
         directives.append("#SBATCH --requeue")
         directives.append("#SBATCH --open-mode=append")
+        if cfg.term_grace_s > 0:
+            directives.append(f"#SBATCH --signal=TERM@{cfg.term_grace_s}")
         requeue_block = REQUEUE_BLOCK.format(requeue_exit_code=REQUEUE_EXIT_CODE)
         marker_line = MARKER_LINE.format(requeue_exit_code=REQUEUE_EXIT_CODE)
     container_prefix = ""

@@ -148,6 +148,26 @@ def _pick_block(pref: int, s: int) -> int:
     return 128
 
 
+_SPLASH_SINKS_SUPPORTED: Optional[bool] = None
+
+
+def _splash_supports_sinks() -> bool:
+    """Whether this jax build's splash kernel takes a ``sinks`` argument
+    (one signature inspection, cached)."""
+    global _SPLASH_SINKS_SUPPORTED
+    if _SPLASH_SINKS_SUPPORTED is None:
+        import inspect
+
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sak,
+        )
+
+        _SPLASH_SINKS_SUPPORTED = "sinks" in inspect.signature(
+            sak._splash_attention
+        ).parameters
+    return _SPLASH_SINKS_SUPPORTED
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -220,9 +240,18 @@ def _splash_flash(
         if segment_ids is not None
         else None
     )
-    out = jax.vmap(
-        kernel, in_axes=(0, 0, 0, 0 if seg is not None else None, None)
-    )(qt, kt, vt, seg, sinks)
+    # older jax builds ship a splash kernel without the `sinks` parameter
+    # (_splash_attention has no such arg): passing it positionally breaks
+    # EVERY splash call, sinks or not. Omit the argument when it is None so
+    # sink-less models keep the fused kernel on those builds; an actual
+    # sinks tensor on such a build still fails loudly below (the capability
+    # is genuinely missing — silently dropping the sinks would mis-compute).
+    call = (qt, kt, vt, seg)
+    axes: tuple = (0, 0, 0, 0 if seg is not None else None)
+    if sinks is not None or _splash_supports_sinks():
+        call += (sinks,)
+        axes += (None,)
+    out = jax.vmap(kernel, in_axes=axes)(*call)
     out = out.transpose(0, 2, 1, 3).astype(q.dtype)
     return out[:, :S] if pad else out
 

@@ -334,47 +334,58 @@ def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
     G = gate.shape[0]
     g = ragged_dot(lhs, gate, group_sizes, **kw)
     u = ragged_dot(lhs, up, group_sizes, **kw)
+    # rows past sum(group_sizes) (the a2a sentinel tail) are uninitialized
+    # in every ragged_dot/_tgmm output AND in the a2a cotangents (dy). Zero
+    # one-hot rows and the _tgmm kernel's in-tile lhs mask both rely on
+    # 0·x = 0 with FINITE x — NaN/Inf garbage survives them (0·NaN = NaN),
+    # so every contraction that reduces over rows (seg_sum, and the dout
+    # operand of each _tgmm) gets an explicit zero-mask first. The mask is
+    # one [M, 1] compare broadcast into the selects — backward-only cost.
+    bounds = jnp.cumsum(group_sizes.astype(jnp.int32))
+    valid = (jnp.arange(M, dtype=jnp.int32) < bounds[-1])[:, None]
     has_bias = gb is not None or ub is not None or db is not None
     if has_bias:
-        bounds = jnp.cumsum(group_sizes.astype(jnp.int32))
         row_g = jnp.searchsorted(
             bounds, jnp.arange(M, dtype=jnp.int32), side="right"
         )
-        # rows past sum(group_sizes) (a2a sentinel tail) land on G → the
-        # zero one-hot row. The zero row alone is NOT enough: ragged_dot
-        # leaves tail rows of g/u (and a2a leaves tail cotangents)
-        # uninitialized, and 0·NaN = NaN would poison the seg_sum — mask the
-        # cotangent rows explicitly before the contraction.
-        valid = (row_g < G)[:, None]
+        # tail rows land on row_g == G: clamp the gather index explicitly
+        # and zero the gathered bias under the mask — never rely on XLA's
+        # out-of-bounds clamp semantics for rows whose content is garbage
+        # anyway
+        row_gc = jnp.minimum(row_g, G - 1)
         onehot = jax.nn.one_hot(row_g, G, dtype=lhs.dtype)  # [M, G]
     if gb is not None:
-        g = g + gb.astype(g.dtype)[row_g]
+        g = g + jnp.where(valid, gb.astype(g.dtype)[row_gc], 0)
     if ub is not None:
-        u = u + ub.astype(u.dtype)[row_g]
+        u = u + jnp.where(valid, ub.astype(u.dtype)[row_gc], 0)
 
     mid, act_vjp = jax.vjp(
         lambda g_, u_: _act_fn(g_, u_, act_kind, limit), g, u
     )
+    dy_m = jnp.where(valid, dy, 0)
     dmid = ragged_dot(dy, down, group_sizes, transpose_rhs=True, **kw)
-    dWd = _tgmm(mid, dy, group_sizes, interpret=interpret)
+    dWd = _tgmm(mid, dy_m, group_sizes, interpret=interpret)
     dg_, du_ = act_vjp(dmid)
+    dg_m = jnp.where(valid, dg_, 0)
+    du_m = jnp.where(valid, du_, 0)
+    # dlhs tail rows stay uninitialized — they ARE the sentinel tail, and
+    # the a2a consumer never reads them (ragged_dot precondition)
     dlhs = (
         ragged_dot(dg_, gate, group_sizes, transpose_rhs=True, **kw)
         + ragged_dot(du_, up, group_sizes, transpose_rhs=True, **kw)
     )
-    dWg = _tgmm(lhs, dg_, group_sizes, interpret=interpret)
-    dWu = _tgmm(lhs, du_, group_sizes, interpret=interpret)
+    dWg = _tgmm(lhs, dg_m, group_sizes, interpret=interpret)
+    dWu = _tgmm(lhs, du_m, group_sizes, interpret=interpret)
 
-    def seg_sum(ct):  # [M, N] → per-expert sums [G, N], fp32 accumulation
-        ct = jnp.where(valid, ct, 0)
+    def seg_sum(ct):  # [M, N] (tail pre-masked) → per-expert sums [G, N]
         return jax.lax.dot_general(
             onehot, ct, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dgb = seg_sum(dg_).astype(gb.dtype) if gb is not None else None
-    dub = seg_sum(du_).astype(ub.dtype) if ub is not None else None
-    ddb = seg_sum(dy).astype(db.dtype) if db is not None else None
+    dgb = seg_sum(dg_m).astype(gb.dtype) if gb is not None else None
+    dub = seg_sum(du_m).astype(ub.dtype) if ub is not None else None
+    ddb = seg_sum(dy_m).astype(db.dtype) if db is not None else None
     return (
         mv(dlhs.astype(lhs.dtype), lhs),
         mv(dWg.astype(gate.dtype), gate),

@@ -383,9 +383,22 @@ def initialize_distributed(
         "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
         coordinator_address, num_processes, process_id,
     )
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
+    # timed init (resilience/timed_sync.py): a host that never shows up at
+    # the rendezvous — bad DNS, a pod that crashed before python started —
+    # must surface as a diagnosed SyncTimeout naming the sync point, not an
+    # indefinite block inside the coordinator handshake.
+    # AUTOMODEL_INIT_TIMEOUT_S bounds the wait (default 600s, generous for
+    # slow pod scheduling).
+    from automodel_tpu.resilience.timed_sync import timed_call
+
+    timeout_s = float(env.get("AUTOMODEL_INIT_TIMEOUT_S", "600"))
+    timed_call(
+        lambda: jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        ),
+        name="distributed_init",
+        timeout_s=timeout_s,
     )

@@ -36,8 +36,16 @@ logger = logging.getLogger(__name__)
 
 class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPrediction):
     def run_benchmark(self) -> dict:
-        with self.telemetry.crash_guard():
-            return self._run_benchmark_body()
+        # bench legs hang the same ways training does (wedged collective,
+        # dead tunnel): the watchdog turns a stuck leg into stacks + a
+        # flight-recorder dump instead of a silent stall. Pets ride the
+        # measure loop below.
+        self.guard.start()
+        try:
+            with self.telemetry.crash_guard():
+                return self._run_benchmark_body()
+        finally:
+            self.guard.close()
 
     def _run_benchmark_body(self) -> dict:
         bcfg = dict(self.cfg.get("benchmark", {}) or {})
@@ -83,6 +91,7 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             timers("device").stop()
             dt = timers("step").stop()
             tel.record_step({"bench_step": i, "step_time_s": dt, "ts": time.time()})
+            self.guard.on_step(i)  # heartbeat only (no consensus fold)
             tel_overhead_s += time.perf_counter() - _t
         prof.close()
         self.state = state
@@ -138,7 +147,10 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
         # Degrades to null-with-recorded-reason (validate_bench_result
         # semantics) when the `generation:` section or a cache-capable
         # model is absent — a leg that never ran must never read as 0.0.
-        result.update(self._generation_leg())
+        # the decode leg compiles fresh prefill/decode programs — minutes
+        # at scale, with no pets in between: watchdog eval grace covers it
+        with self.guard.phase("eval"):
+            result.update(self._generation_leg())
         pinfo = getattr(self.model, "pipeline_info", None)
         if pinfo:
             from automodel_tpu.utils.flops_utils import pipeline_bubble_fraction

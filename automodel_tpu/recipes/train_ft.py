@@ -298,6 +298,25 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             ),
         )
 
+        # distributed guard (resilience/guard.py): hang watchdog petted at
+        # every step boundary, cross-host consensus at log/checkpoint/
+        # shutdown boundaries, timed barriers at the multi-host sync
+        # points. On by default; stacks/desync evidence lands next to the
+        # metrics JSONL and in the flight recorder.
+        from automodel_tpu.resilience.guard import DistributedGuard
+
+        self.guard = DistributedGuard.from_config(
+            cfg.get("distributed_guard"),
+            fingerprint=self.telemetry.flight_recorder.fingerprint
+            if self.telemetry.flight_recorder is not None
+            else None,
+            flight_recorder=self.telemetry.flight_recorder,
+            metric_logger=self.metric_logger,
+            default_stacks_path=str(
+                self.metric_logger.path.parent / "watchdog_stacks.txt"
+            ),
+        )
+
         # in-training eval generation (generation: YAML section,
         # docs/generation.md): sample completions at validation boundaries
         # through the KV-cache inference engine and log them to the JSONL
@@ -325,8 +344,38 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             # checkpoint root so peer hosts dying of broken collectives
             # exit with the requeue code too (cli/app.py checks it)
             self.resilience.arm_peer_marker(self.checkpointer.root)
+            # multi-host commit discipline: no host writes the manifest
+            # until every host's save drained (timed — a dead peer turns
+            # the commit into a diagnosed SyncTimeout, dir stays
+            # uncommitted)
+            self.checkpointer.commit_barrier = self.guard.barrier
+        # the guard learns the runtime facts that exist only now: requeue
+        # eligibility (a hang with nothing committed must exit 1, not loop
+        # at zero progress), the shared root for the peer marker, where
+        # desync events go, and the params tree for the jitted checksum
+        self.guard.bind_runtime(
+            requeue_eligible=(
+                (lambda: self.checkpointer.latest_committed_dir() is not None)
+                if self.checkpointer is not None
+                else (lambda: False)
+            ),
+            peer_marker_root=(
+                str(self.checkpointer.root) if self.checkpointer else None
+            ),
+            event_hook=self._guard_event,
+            params_example=self.state.params,
+        )
         if self.checkpointer and self.checkpointer.has_checkpoint():
             self._restore()
+
+    def _guard_event(self, rec: dict) -> None:
+        """Desync evidence goes to BOTH sinks: the flight recorder (for the
+        post-mortem bundle) and the metrics JSONL (for `report`)."""
+        self.telemetry.record_step(rec)
+        try:
+            self.metric_logger.log(dict(rec), step=rec.get("step"))
+        except Exception:  # evidence is best-effort; the abort is not
+            pass
 
     def _setup_eval_generation(self, gcfg: dict) -> None:
         from automodel_tpu.generation.engine import (
@@ -555,6 +604,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         restores the last verified checkpoint and fast-forwards the
         dataloader past the offending window."""
         tel, res = self.telemetry, self.resilience
+        self.guard.start()
         try:
             try:
                 with tel.crash_guard():
@@ -573,13 +623,25 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     # so a cadence save at epoch_E_step_S must still match
                     # when the scheduler now reads epoch E+1 (step is a
                     # global counter; same step == same param state).
-                    self.checkpointer.wait()
-                    latest = self.checkpointer.latest_committed_dir()
-                    if (
-                        latest is None
-                        or step_dir_key(latest)[1] != self.step_scheduler.step
-                    ):
-                        self.save_checkpoint()
+                    with self.guard.phase("checkpoint"):
+                        self.checkpointer.wait()
+                        latest = self.checkpointer.latest_committed_dir()
+                        if (
+                            latest is None
+                            or step_dir_key(latest)[1] != self.step_scheduler.step
+                        ):
+                            # the end-of-loop/emergency save is a commit
+                            # point like any other: hosts must agree before
+                            # the manifest lands
+                            self.guard.pre_commit(
+                                self.step_scheduler.step, self.state.params
+                            )
+                            self.save_checkpoint()
+            # all hosts drain together (timed): a peer that died during its
+            # final save surfaces as a diagnosed SyncTimeout, not a silent
+            # per-host exit skew
+            with self.guard.phase("shutdown"):
+                self.guard.barrier("shutdown")
         finally:
             # ALWAYS drain + COMMIT any in-flight async save — even when the
             # loop died (e.g. NonFiniteError): a finished upload without its
@@ -587,10 +649,17 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             # restart. Signal handlers are restored only AFTER the emergency
             # save: a second SIGTERM during the save must keep hitting the
             # chaining handler, not the default terminate.
-            if self.checkpointer:
-                self.checkpointer.close()
-            res.close()
-            self.step_scheduler.restore_signal_handlers()
+            try:
+                if self.checkpointer:
+                    with self.guard.phase("checkpoint"):
+                        self.checkpointer.close()
+            finally:
+                # even when the final drain raises: a live watchdog thread
+                # in an embedding process (tests, notebooks) would fire
+                # minutes later and os._exit it
+                self.guard.close()
+                res.close()
+                self.step_scheduler.restore_signal_handlers()
         if res.preempted:
             # run-LOCAL committed dir only: latest_dir()'s restore_from
             # bootstrap fallback must not make a nothing-committed run look
@@ -740,8 +809,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             tel.timers("dispatch").start()
             self.state, metrics = self.train_step(self.state, batch)
             tel.timers("dispatch").stop()
+            # step boundary: pet the hang watchdog (two attribute stores)
+            # and fold the batch into the consensus data hash (crc32 over
+            # host-side numpy, only when consensus is live) — nothing here
+            # touches the jitted hot path
+            self.guard.on_step(step_no, stacked)
             if res.injector is not None:
                 res.injector.maybe_die(step_no)
+                res.injector.maybe_straggle(step_no)
+                res.injector.maybe_hang(step_no)
             if res.config.enabled and "nonfinite" in metrics:
                 # check the PREVIOUS step's flag now that this one is in
                 # flight (lagged detection, no dispatch stall), then queue
@@ -765,6 +841,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     tel.compile_bridge.drain()
                 if self.step_scheduler.is_log_step:
                     metrics = tel.enrich(step_no, metrics)
+                    metrics = self.guard.on_log(
+                        step_no, metrics, params=self.state.params
+                    )
                     self.metric_logger.log(metrics, step=int(metrics["step"]))
                     last = metrics
                 tel.record_step(host_rec)
@@ -785,6 +864,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 if res.rollbacks:
                     metrics["rollbacks_total"] = res.rollbacks
                 metrics = tel.enrich(step_no, metrics)
+                # the log step is already a device barrier: liveness +
+                # cross-host consensus + straggler attribution ride it
+                metrics = self.guard.on_log(
+                    step_no, metrics, params=self.state.params
+                )
                 self.metric_logger.log(metrics, step=int(metrics["step"]))
                 last = metrics
                 host_rec.update(
@@ -812,21 +896,25 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 # barrier anyway, so the early fetch costs nothing extra)
                 if res.config.enabled:
                     self._check_prev_nonfinite(res)
-                if self.val_dataloader is not None:
-                    val = self.run_validation()
-                    # compile events during validation (eval_step's first
-                    # compile) belong to the val record, not the next train
-                    # window's `recompiles`
-                    if tel.compile_bridge is not None:
-                        d = tel.compile_bridge.drain()
-                        if d["compiles"]:
-                            val["eval_compiles"] = d["compiles"]
-                            val["eval_compile_secs"] = round(d["compile_secs"], 4)
-                    self.metric_logger.log(val, step=self.step_scheduler.step)
-                # sample completions with the current weights (generation:
-                # section); compiles + wall time land OUTSIDE the training
-                # windows (the reset below), like validation itself
-                self._log_eval_generation()
+                # eval/generation are legitimately slow (fresh compiles,
+                # full passes): the watchdog's eval grace covers them
+                with self.guard.phase("eval"):
+                    if self.val_dataloader is not None:
+                        val = self.run_validation()
+                        # compile events during validation (eval_step's first
+                        # compile) belong to the val record, not the next
+                        # train window's `recompiles`
+                        if tel.compile_bridge is not None:
+                            d = tel.compile_bridge.drain()
+                            if d["compiles"]:
+                                val["eval_compiles"] = d["compiles"]
+                                val["eval_compile_secs"] = round(d["compile_secs"], 4)
+                        self.metric_logger.log(val, step=self.step_scheduler.step)
+                    # sample completions with the current weights
+                    # (generation: section); compiles + wall time land
+                    # OUTSIDE the training windows (the reset below), like
+                    # validation itself
+                    self._log_eval_generation()
                 if tel.compile_bridge is not None:
                     tel.compile_bridge.drain()
                 tokens_window = steps_window = 0
@@ -839,7 +927,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 # anyway, so the early fetch costs nothing extra.
                 if res.config.enabled:
                     self._check_prev_nonfinite(res)
-                self.save_checkpoint()
+                # same resolution point, cross-host edition: every host
+                # must agree on (step, config, data order, params) before
+                # this checkpoint may commit — a desynced checkpoint is as
+                # poisonous as a NaN one and integrity checksums can't see
+                # either
+                self.guard.pre_commit(step_no, self.state.params)
+                with self.guard.phase("checkpoint"):
+                    self.save_checkpoint()
                 tokens_window = steps_window = 0
                 t_window = time.perf_counter()
         # a non-finite flag from the final step must still be enforced

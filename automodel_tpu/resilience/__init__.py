@@ -20,8 +20,24 @@ module:
   ``skip`` discards the update inside the jitted step, ``rollback``
   restores the last verified checkpoint and fast-forwards the dataloader
 - fault_injection.py — config/env-driven faults (die at step k, NaN the
-  grads, corrupt a checkpoint file, fail the first M I/O attempts) so the
-  recovery paths are testable end-to-end on CPU
+  grads, corrupt a checkpoint file, fail the first M I/O attempts, hang
+  the loop, desync a host's data hash, straggle a host) so the recovery
+  paths are testable end-to-end on CPU
+
+Distributed-guard pillars (multi-host SPMD; ``distributed_guard:`` YAML
+section, facade in guard.py):
+
+- watchdog.py        — daemon heartbeat thread petted at every step
+  boundary; adaptive deadline (EMA step time × multiplier, phase grace
+  for compile/checkpoint/eval); on expiry: all-thread stacks +
+  flight-recorder dump + ``hang`` event + requeue exit
+- consensus.py       — cross-host fingerprint agreement (step, config CRC,
+  data rolling hash, param checksum) via ``process_allgather`` at log/
+  checkpoint/shutdown boundaries; names the diverged host and aborts
+  before a desynced checkpoint can commit
+- timed_sync.py      — ``barrier_with_timeout`` / ``timed_call`` so a dead
+  peer at init/commit/shutdown becomes a diagnosed ``SyncTimeout``, plus
+  straggler attribution (``slowest_host``) over per-host step times
 
 YAML::
 
@@ -72,6 +88,22 @@ from automodel_tpu.resilience.preemption import (  # noqa: F401
     write_peer_preemption_marker,
 )
 from automodel_tpu.resilience.retry import RetriesExhausted, retry_io  # noqa: F401
+from automodel_tpu.resilience.consensus import (  # noqa: F401
+    ConsensusConfig,
+    ConsensusGuard,
+    DesyncError,
+    find_divergent,
+)
+from automodel_tpu.resilience.guard import (  # noqa: F401
+    DistributedGuard,
+    DistributedGuardConfig,
+)
+from automodel_tpu.resilience.timed_sync import (  # noqa: F401
+    SyncTimeout,
+    barrier_with_timeout,
+    timed_call,
+)
+from automodel_tpu.resilience.watchdog import Watchdog, WatchdogConfig  # noqa: F401
 
 logger = logging.getLogger(__name__)
 
@@ -227,4 +259,15 @@ __all__ = [
     "activate_from_config",
     "active_injector",
     "corrupt_file",
+    "DistributedGuard",
+    "DistributedGuardConfig",
+    "Watchdog",
+    "WatchdogConfig",
+    "ConsensusGuard",
+    "ConsensusConfig",
+    "DesyncError",
+    "find_divergent",
+    "SyncTimeout",
+    "barrier_with_timeout",
+    "timed_call",
 ]

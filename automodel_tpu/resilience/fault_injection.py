@@ -18,6 +18,14 @@ wrong, deterministically, on CPU, in tier-1. Four fault classes:
 - ``fail_io_attempts``/``fail_io_op`` — fail the first M attempts of any
   retry_io-wrapped op whose name contains ``fail_io_op``, proving the
   backoff absorbs transient storage errors (or exhausts loudly)
+- ``hang_at_step``          — block the training loop at step k (a bounded
+  ``time.sleep``, which releases the GIL exactly like a wedged collective
+  would), driving the hang watchdog's detect → dump → requeue-exit path
+- ``desync_batch_at_step``  — perturb THIS host's rolling data-batch hash
+  at step k (on ``desync_on_host`` only), driving the cross-host consensus
+  check's detect-and-name-the-culprit path
+- ``straggle_host``/``straggle_ms`` — sleep ``straggle_ms`` per step on one
+  host, driving the straggler-attribution metrics (``slowest_host``)
 
 Activation: a ``fault_injection:`` YAML section (recipes call
 ``activate_from_config``) or the ``AUTOMODEL_FAULT_INJECTION`` env var
@@ -55,12 +63,29 @@ class FaultInjectionConfig:
     corrupt_ckpt_file: Optional[str] = None  # glob under the step dir
     fail_io_attempts: int = 0
     fail_io_op: str = ""  # substring of the retry_io op name; "" = every op
+    # distributed-guard faults (watchdog / consensus / straggler)
+    hang_at_step: Optional[int] = None
+    hang_seconds: float = 3600.0  # bounded — the watchdog exits long before
+    desync_batch_at_step: Optional[int] = None
+    desync_on_host: int = 0  # process_index whose data hash is perturbed
+    straggle_host: Optional[int] = None
+    straggle_ms: float = 0.0  # per-step sleep on the straggling host
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
 
 
 class FaultInjector:
     def __init__(self, config: FaultInjectionConfig):
         self.config = config
         self._io_attempts: dict[str, int] = {}
+        self._hung = False
 
     # -- step-loop hooks ----------------------------------------------------
     def maybe_die(self, step: int) -> None:
@@ -75,6 +100,37 @@ class FaultInjector:
     @property
     def nan_grads_at_step(self) -> Optional[int]:
         return self.config.nan_grads_at_step
+
+    def maybe_hang(self, step: int) -> None:
+        """Block the loop like a wedged collective would (sleep releases the
+        GIL, so the watchdog thread stays runnable — same as jax's blocking
+        calls). Fires once; the watchdog is expected to end the process."""
+        c = self.config
+        if c.hang_at_step is None or step != c.hang_at_step or self._hung:
+            return
+        self._hung = True
+        logger.error(
+            "fault injection: hanging at step %d for up to %.0fs",
+            step, c.hang_seconds,
+        )
+        import time
+
+        time.sleep(c.hang_seconds)
+
+    def should_desync(self, step: int) -> bool:
+        c = self.config
+        if c.desync_batch_at_step is None or step != c.desync_batch_at_step:
+            return False
+        return _process_index() == c.desync_on_host
+
+    def maybe_straggle(self, step: int) -> None:
+        c = self.config
+        if c.straggle_host is None or c.straggle_ms <= 0:
+            return
+        if _process_index() == c.straggle_host:
+            import time
+
+            time.sleep(c.straggle_ms / 1000.0)
 
     # -- checkpoint hook ----------------------------------------------------
     def after_checkpoint_save(self, step_dir: Path) -> None:
@@ -137,6 +193,9 @@ def activate(config: FaultInjectionConfig | dict | None) -> Optional[FaultInject
         or config.nan_grads_at_step is not None
         or config.corrupt_ckpt_file
         or config.fail_io_attempts > 0
+        or config.hang_at_step is not None
+        or config.desync_batch_at_step is not None
+        or config.straggle_host is not None
     )
     if not armed:
         # an empty `fault_injection: {}` section (the docs' example form)

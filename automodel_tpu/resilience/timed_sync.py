@@ -1,0 +1,138 @@
+"""Timed collectives: a dead peer becomes a diagnosed timeout, not a hang.
+
+Multi-host SPMD has four host-side sync points where every process must
+show up — distributed init, checkpoint commit, emergency save, shutdown —
+and the default behaviour when one host died (preempted, kernel panic,
+network partition) is that every OTHER host blocks inside the collective
+forever, burning the reservation until an operator notices. The wrappers
+here run the blocking call on a helper thread and bound the wait: on
+expiry they raise :class:`SyncTimeout` naming the sync point, which the
+crash guard turns into a flight-recorder dump and the CLI (via the PR 3
+peer-preemption marker) can classify as preemption collateral.
+
+The helper thread cannot be cancelled — a timed-out collective leaks its
+thread. That is deliberate and safe: every caller of these wrappers is on
+a failure path that ends in process exit, and a leaked daemon thread dies
+with the process. What matters is that the MAIN thread gets control back
+with a diagnosis instead of waiting forever.
+
+Single-process runs short-circuit before any thread is spawned, so the
+wrappers are free when there is nothing to synchronize with.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_SYNC_TIMEOUT_S = 600.0
+
+
+class SyncTimeout(RuntimeError):
+    """A cross-host sync point did not complete within its deadline —
+    almost always a dead or wedged peer. The message names the sync point
+    so the operator (and the flight recorder) knows WHERE the world hung."""
+
+    def __init__(self, name: str, timeout_s: float, detail: str = ""):
+        super().__init__(
+            f"cross-host sync point {name!r} timed out after {timeout_s:.0f}s"
+            + (f" — {detail}" if detail else "")
+            + "; a peer host is likely dead or wedged (check per-host logs / "
+            "the flight recorder of the host that stopped heartbeating)"
+        )
+        self.name = name
+        self.timeout_s = timeout_s
+
+
+def timed_call(
+    fn: Callable[[], Any],
+    *,
+    name: str,
+    timeout_s: float = DEFAULT_SYNC_TIMEOUT_S,
+) -> Any:
+    """Run a blocking (collective) call with a wall-clock bound. Returns the
+    call's result, re-raises its exception, or raises :class:`SyncTimeout`.
+
+    The call runs on a daemon thread so a timeout leaves the main thread in
+    control; the abandoned thread is reaped at process exit (see module
+    docstring for why that is acceptable)."""
+    result: list = []
+    error: list = []
+
+    def _run() -> None:
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            error.append(e)
+
+    t = threading.Thread(target=_run, name=f"timed-{name}", daemon=True)
+    start = time.monotonic()
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise SyncTimeout(
+            name, timeout_s,
+            detail=f"still blocked after {time.monotonic() - start:.0f}s",
+        )
+    if error:
+        raise error[0]
+    return result[0] if result else None
+
+
+def _default_gather(vec: np.ndarray) -> np.ndarray:
+    """allgather a small host-side vector → [num_processes, len(vec)].
+
+    Imported lazily so this module stays importable without a live jax
+    runtime (the launchers import resilience at submit time)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(vec)[None, :]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(vec)))
+
+
+def barrier_with_timeout(
+    name: str,
+    timeout_s: float = DEFAULT_SYNC_TIMEOUT_S,
+    gather_fn: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+) -> int:
+    """Host barrier with a deadline: every process contributes its index and
+    waits for the rest; a missing peer raises :class:`SyncTimeout` instead
+    of blocking forever. Returns the number of processes seen.
+
+    Used at the multi-host sync points (init, checkpoint commit, emergency
+    save, shutdown). Single-process: returns 1 with zero work — no thread,
+    no collective."""
+    import jax
+
+    if gather_fn is None and jax.process_count() == 1:
+        return 1
+    gather = gather_fn or _default_gather
+    vec = np.asarray([jax.process_index()], dtype=np.float64)
+    out = timed_call(lambda: gather(vec), name=name, timeout_s=timeout_s)
+    n = int(np.asarray(out).shape[0])
+    logger.debug("barrier %s: %d host(s)", name, n)
+    return n
+
+
+def slowest_host(step_times_s: Sequence[float]) -> tuple[int, float]:
+    """Straggler attribution over a per-host step-time vector (one allgather
+    row per host): → (slowest host index, max/median ratio). A ratio near
+    1.0 means the pod is balanced; MegaScale-style monitoring flags a host
+    whose ratio stays above ~1.2–2× as the straggler dragging every peer
+    (in synchronous SPMD the pod runs at the speed of its slowest host)."""
+    arr = np.asarray(step_times_s, dtype=np.float64)
+    if arr.size == 0:
+        return 0, 1.0
+    worst = int(np.argmax(arr))
+    med = float(np.median(arr))
+    ratio = float(arr[worst] / med) if med > 0 else 1.0
+    return worst, ratio

@@ -38,6 +38,14 @@ _NUMERIC_KEYS = (
     "decode_tps",
     "gen_tokens",
     "gen_cache_bytes",
+    # distributed guard (watchdog liveness, consensus/straggler attribution)
+    "heartbeat_age_s",
+    "deadline_s",
+    "ema_step_time_s",
+    "slowest_host",
+    "host_step_time_max_s",
+    "host_step_time_median_s",
+    "straggler_ratio",
 )
 
 
@@ -134,6 +142,27 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
     resumes = [r.get("step") for r in records if r.get("_resume_point")]
     if resumes:
         out["resume_points"] = resumes
+    # distributed-guard events: a hang or desync anywhere in the file is
+    # the headline of that run — surface it unconditionally
+    hangs = [r for r in records if r.get("event") == "hang"]
+    if hangs:
+        out["hang_events"] = [
+            {"step": r.get("step"), "heartbeat_age_s": r.get("heartbeat_age_s")}
+            for r in hangs
+        ]
+    desyncs = [r for r in records if r.get("event") == "desync"]
+    if desyncs:
+        out["desync_events"] = [
+            {"step": r.get("step"), "hosts": r.get("desync_hosts")}
+            for r in desyncs
+        ]
+    stragglers = [
+        r["straggler_ratio"]
+        for r in records
+        if isinstance(r.get("straggler_ratio"), (int, float))
+    ]
+    if stragglers:
+        out["straggler_ratio_max"] = max(stragglers)
     mfu = [r["mfu"] for r in records if isinstance(r.get("mfu"), (int, float))]
     if mfu:
         out["mfu_mean"] = sum(mfu) / len(mfu)

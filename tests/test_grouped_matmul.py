@@ -130,3 +130,49 @@ def test_fused_expert_mlp_nan_tail_bias_grads_finite():
         assert bool(jnp.isfinite(g).all()), f"{name} poisoned by NaN tail"
     # the real rows still produce real (nonzero) bias grads
     assert float(jnp.abs(ddb).max()) > 0.0
+
+
+def test_fused_expert_mlp_nan_tail_weight_grads_finite():
+    """The FULL manual backward under a garbage tail (the part the forward-
+    focused PR 1 test never differentiated): dWg/dWu/dWd flow through
+    `_tgmm`, whose in-kernel row mask zeroes only the LHS tile — a NaN tail
+    in the dout operand still poisons the contraction (0·NaN = NaN), and
+    the biased path additionally gathers `gb[row_g]` with the clamped
+    sentinel index. Plants NaNs in the tail inputs and tail cotangents and
+    asserts every weight and bias grad stays finite and nonzero."""
+    from automodel_tpu.ops.fused_expert_mlp import fused_expert_mlp
+
+    rng = np.random.default_rng(11)
+    M, D, I, G = 16, 32, 24, 3
+    n_real = 10  # sum(group_sizes) < M → 6 sentinel tail rows
+    gs = jnp.asarray([4, 3, 3], jnp.int32)
+    lhs = rng.normal(size=(M, D)).astype(np.float32)
+    lhs[n_real:] = np.nan
+    lhs = jnp.asarray(lhs)
+    gate = jnp.asarray(rng.normal(size=(G, D, I)), jnp.float32)
+    up = jnp.asarray(rng.normal(size=(G, D, I)), jnp.float32)
+    down = jnp.asarray(rng.normal(size=(G, I, D)), jnp.float32)
+    gb = jnp.asarray(rng.normal(size=(G, I)), jnp.float32)
+    ub = jnp.asarray(rng.normal(size=(G, I)), jnp.float32)
+    db = jnp.asarray(rng.normal(size=(G, D)), jnp.float32)
+
+    for biased in (True, False):  # the bias-less path masks the tail too
+        def f(gate_, up_, down_, gb_, ub_, db_):
+            return fused_expert_mlp(
+                lhs, gate_, up_, down_, gs, gb_, ub_, db_,
+                "swiglu", None, None, True,
+            )
+
+        args = (gate, up, down) + ((gb, ub, db) if biased else (None, None, None))
+        y, vjp = jax.vjp(f, *args)
+        dy = rng.normal(size=(M, D)).astype(np.float32)
+        dy[n_real:] = np.nan
+        grads = vjp(jnp.asarray(dy))
+        names = ("dWg", "dWu", "dWd", "dgb", "dub", "ddb")
+        for name, g in zip(names, grads):
+            if g is None:
+                continue
+            assert bool(jnp.isfinite(g).all()), (
+                f"{name} poisoned by NaN tail (biased={biased})"
+            )
+            assert float(jnp.abs(g).max()) > 0.0, f"{name} all-zero"

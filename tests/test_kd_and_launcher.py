@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+from capabilities import skip_unless
+
 
 TINY = {
     "architectures": ["LlamaForCausalLM"],
@@ -207,6 +209,7 @@ def test_slurm_render(tmp_path):
     assert "finetune llm -c cfg.yaml" in text
 
 
+@skip_unless("muon")
 def test_muon_optimizer_runs():
     import jax
 

@@ -248,8 +248,8 @@ def test_a2a_grad_parity_with_dense(devices8):
 
     g_ref = jax.grad(lambda p_: loss(p_, x, "dense", lambda a, s: a))(p)
     g_a2a = jax.jit(jax.grad(lambda p_: loss(p_, xs, "a2a", constrain)))(ps)
-    flat_ref = jax.tree.leaves_with_path(g_ref)
-    flat = dict(jax.tree.leaves_with_path(g_a2a))
+    flat_ref = jax.tree_util.tree_leaves_with_path(g_ref)
+    flat = dict(jax.tree_util.tree_leaves_with_path(g_a2a))
     for path, ref_leaf in flat_ref:
         np.testing.assert_allclose(
             np.asarray(flat[path]), np.asarray(ref_leaf),
@@ -355,8 +355,8 @@ def test_a2a_fused_matches_a2a(devices8, monkeypatch):
     )(ps)
     np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_ref),
                                rtol=2e-4, atol=2e-5)
-    flat_ref = jax.tree.leaves_with_path(g_ref)
-    flat = dict(jax.tree.leaves_with_path(g_f))
+    flat_ref = jax.tree_util.tree_leaves_with_path(g_ref)
+    flat = dict(jax.tree_util.tree_leaves_with_path(g_f))
     for path, ref_leaf in flat_ref:
         np.testing.assert_allclose(
             np.asarray(flat[path]), np.asarray(ref_leaf),

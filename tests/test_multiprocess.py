@@ -18,6 +18,8 @@ import sys
 import numpy as np
 import pytest
 
+from capabilities import skip_unless
+
 _WORKER = os.path.join(os.path.dirname(__file__), "multiprocess_worker.py")
 
 
@@ -48,6 +50,7 @@ def _run_single() -> list:
     return json.loads(line[len("LOSSES "):])
 
 
+@skip_unless("multiprocess_cpu")
 def test_two_process_training_matches_single_process():
     port = _free_port()
     procs = []
