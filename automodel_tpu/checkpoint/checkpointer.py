@@ -73,6 +73,13 @@ class CheckpointingConfig:
     # detection but skips the commit-time checksum read-back of the whole
     # tree (a full disk-bandwidth pass — material at multi-TB scale)
     manifest_checksums: bool = True
+    # param-tree signature guard (production resume, reference
+    # base_recipe.py:768-850): every save records the state tree's
+    # (path, shape, dtype) signature; load() refuses a checkpoint whose
+    # signature mismatches the BUILT model instead of letting orbax restore
+    # garbage into a differently-shaped tree (or half-succeed). False only
+    # for deliberate surgery (manual partial restores).
+    check_param_signature: bool = True
 
 
 @retry_io(op="orbax_save", max_attempts=3)
@@ -87,6 +94,54 @@ def _orbax_restore(path: Path, abstract_state: Any) -> Any:
         return ckptr.restore(path, abstract_state)
 
 
+def param_tree_signature(tree: Any) -> dict:
+    """Structural signature of a state pytree: sorted ``path:shape:dtype``
+    entries + a digest. Works on concrete arrays and ShapeDtypeStructs alike
+    (load-side comparison uses the abstract target tree)."""
+    import zlib
+
+    entries = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        entries.append(f"{name}:{shape}:{dtype}")
+    entries.sort()
+    digest = zlib.crc32("\n".join(entries).encode())
+    return {"n_leaves": len(entries), "digest": f"{digest:08x}", "entries": entries}
+
+
+def verify_param_signature(
+    found: Optional[dict], expected: dict, ckpt_dir: Path, max_diffs: int = 12
+) -> None:
+    """Loudly refuse resuming a checkpoint whose param-tree structure/shapes
+    mismatch the built model. A checkpoint with no recorded signature
+    (pre-guard save) loads unchanged — orbax's own restore still type-checks
+    leaf-by-leaf there."""
+    if not found:
+        return
+    if found.get("digest") == expected["digest"]:
+        return
+    f_set, e_set = set(found.get("entries") or ()), set(expected["entries"])
+    missing = sorted(e_set - f_set)  # model expects, checkpoint lacks
+    extra = sorted(f_set - e_set)  # checkpoint has, model doesn't
+    lines = [f"model expects but checkpoint lacks: {p}" for p in missing[:max_diffs]]
+    lines += [f"checkpoint has but model lacks:    {p}" for p in extra[:max_diffs]]
+    more = len(missing) + len(extra) - len(lines)
+    if more > 0:
+        lines.append(f"... and {more} more")
+    if not lines:  # same entries, different digest (should not happen)
+        lines = [f"digest {found.get('digest')} != expected {expected['digest']}"]
+    raise ValueError(
+        f"checkpoint {ckpt_dir} param-tree signature mismatches the built "
+        f"model ({found.get('n_leaves')} vs {expected['n_leaves']} leaves) — "
+        "refusing to resume. Rebuild the model with the config the "
+        "checkpoint was saved under (its config.json records it), or set "
+        "checkpoint.check_param_signature: false for deliberate surgery:\n  "
+        + "\n  ".join(lines)
+    )
+
+
 class Checkpointer:
     def __init__(self, config: CheckpointingConfig):
         self.config = config
@@ -95,6 +150,9 @@ class Checkpointer:
         # (dir, epoch, step, layout_markers) whose manifest commits when the
         # in-flight async save drains
         self._pending_commit: Optional[tuple[Path, int, int, Optional[dict]]] = None
+        # best-val marker deferred until its dir's async save COMMITS —
+        # BEST.json must never point at an uncommitted (unrestorable) tree
+        self._pending_best: Optional[tuple[Path, str, float]] = None
         # recipes point this at telemetry.record_step so integrity events
         # (fallbacks, failed verifications) land in the flight recorder
         self.event_hook: Optional[Callable[[dict], None]] = None
@@ -143,6 +201,10 @@ class Checkpointer:
                     "event": "async_save_failed", "dir": str(pending[0]),
                     "error": repr(e), "ts": time.time(),
                 })
+                # the dir never committed: a best-mark waiting on it must
+                # die with it, or BEST.json would name an unrestorable tree
+                if self._pending_best is not None and self._pending_best[0] == pending[0]:
+                    self._pending_best = None
                 return
         if pending is not None:
             self._commit(*pending)
@@ -159,6 +221,10 @@ class Checkpointer:
             out, epoch=epoch, step=step, layout_markers=layout_markers,
             checksums=self.config.manifest_checksums,
         )
+        if self._pending_best is not None and self._pending_best[0] == out:
+            _, metric, value = self._pending_best
+            self._pending_best = None
+            self._write_best(out, metric, value)
         inj = active_injector()
         if inj is not None:
             inj.after_checkpoint_save(out)
@@ -243,6 +309,11 @@ class Checkpointer:
             extra_state = {
                 **(extra_state or {}), "_layout_markers": dict(layout_markers)
             }
+        if self.config.check_param_signature:
+            extra_state = {
+                **(extra_state or {}),
+                "_param_signature": param_tree_signature(state),
+            }
         # saving the same step twice (cadence save + end-of-loop save) is
         # idempotent: replace the previous state dir
         self.wait()  # at most one async save in flight
@@ -314,6 +385,11 @@ class Checkpointer:
             protect.add(Path(self.config.restore_from).resolve())
         if self._pending_commit is not None:
             protect.add(self._pending_commit[0].resolve())
+        best = self.best_info()
+        if best is not None:
+            # the best-val checkpoint outlives keep_last_k: production
+            # resume/export points at it long after the cadence window moved
+            protect.add((self.root / best["dir"]).resolve())
         committed = self._candidate_dirs()  # newest first
         for p in committed[k:]:
             if p.resolve() in protect:
@@ -377,6 +453,14 @@ class Checkpointer:
         check_layout_markers(
             extra.get("_layout_markers"), expected_layout_markers, d
         )
+        # structure/shape guard BEFORE the array restore: a mismatched tree
+        # must refuse loudly, not crash mid-restore (or worse, half-load)
+        if self.config.check_param_signature:
+            verify_param_signature(
+                extra.get("_param_signature"),
+                param_tree_signature(abstract_state),
+                d,
+            )
         state = _orbax_restore((d / "state").absolute(), abstract_state)
         return state, extra
 
@@ -461,6 +545,61 @@ class Checkpointer:
 
     def has_checkpoint(self) -> bool:
         return self.latest_dir() is not None
+
+    # -- best-val marker ------------------------------------------------------
+    def best_info(self) -> Optional[dict]:
+        """The BEST.json record ({dir, metric, value, epoch, step, ts}), or
+        None. The named dir may have been pruned away externally — callers
+        treat a dangling record as 'no best yet'."""
+        f = self.root / "BEST.json"
+        if not f.exists():
+            return None
+        try:
+            info = json.loads(f.read_text())
+        except (OSError, ValueError):
+            return None
+        d = self.root / str(info.get("dir", ""))
+        return info if info.get("dir") and d.exists() else None
+
+    def mark_best(self, step_dir: Path, metric: str, value: float) -> None:
+        """Stamp ``step_dir`` as the best-val checkpoint: BEST.json at the
+        tree root (tmp+rename — crash-safe) plus a ``best`` symlink for
+        humans and tooling (skipped on filesystems without symlink support;
+        BEST.json is the source of truth — production resume points at it
+        without parsing the metrics JSONL). The marked dir is protected from
+        keep_last_k pruning for as long as it holds the marker.
+
+        With an async save in flight for ``step_dir`` the marker is
+        DEFERRED until that save commits (and discarded if the drain
+        fails): BEST.json must never name a dir auto-resume would skip."""
+        if self._pending_commit is not None and self._pending_commit[0] == step_dir:
+            self._pending_best = (step_dir, metric, float(value))
+            return
+        self._write_best(step_dir, metric, value)
+
+    def _write_best(self, step_dir: Path, metric: str, value: float) -> None:
+        info = {
+            "dir": step_dir.name,
+            "metric": metric,
+            "value": float(value),
+            "ts": time.time(),
+        }
+        key = _dir_key(step_dir)
+        if key is not None:
+            info["epoch"], info["step"] = key
+        tmp = self.root / "BEST.json.tmp"
+        tmp.write_text(json.dumps(info, indent=2))
+        tmp.replace(self.root / "BEST.json")
+        link = self.root / "best"
+        try:
+            if link.is_symlink() or link.exists():
+                link.unlink()
+            link.symlink_to(step_dir.name)
+        except OSError:  # symlink-less FS (some object-store FUSE mounts)
+            pass
+        logger.info(
+            "best checkpoint: %s (%s=%.6g)", step_dir.name, metric, value
+        )
 
 
 def check_layout_markers(

@@ -22,6 +22,7 @@ def _usage() -> str:
         "usage: automodel_tpu <finetune|pretrain|kd|benchmark|mine> <llm|vlm|biencoder> "
         "-c config.yaml [--dotted.key=value ...]\n"
         "       automodel_tpu generate -c config.yaml [--prompt '...'] [--dotted.key=value ...]\n"
+        "       automodel_tpu serve -c config.yaml [--dotted.key=value ...]  (stdin-JSONL; serving.http.port for HTTP)\n"
         "       automodel_tpu report <train_metrics.jsonl> [--strict]\n"
         "       automodel_tpu verify-ckpt <ckpt_dir> [--no-checksums] [--json]"
     )
@@ -72,6 +73,16 @@ def main(argv: list[str] | None = None) -> int:
         cfg = parse_args_and_load_config(argv[1:])
         initialize_distributed()
         return generate_main(cfg)
+    # `serve` runs the continuous-batching serving engine (serving/):
+    # stdin-JSONL by default, a local HTTP front when serving.http.port is
+    # set; model/mesh from the same YAML sections as `generate`
+    if argv and argv[0] == "serve":
+        from automodel_tpu.parallel.mesh import initialize_distributed
+        from automodel_tpu.serving.server import main as serve_main
+
+        cfg = parse_args_and_load_config(argv[1:])
+        initialize_distributed()
+        return serve_main(cfg)
     if len(argv) < 2 or argv[0] in ("-h", "--help"):
         print(_usage())
         return 0 if argv and argv[0] in ("-h", "--help") else 2

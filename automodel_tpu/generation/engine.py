@@ -292,14 +292,13 @@ def resolve_tokenizer(tok_cfg: Any, fallback_path: Optional[str] = None) -> Any:
         return None
 
 
-def main(cfg: Any) -> int:
-    """`automodel_tpu generate -c cfg.yaml [--prompt '...']`"""
+def build_auto_from_cfg(cfg: Any) -> Any:
+    """Model + mesh from the same YAML sections the recipes use — shared by
+    the `generate` and `serve` CLIs (serving/server.py)."""
     from automodel_tpu import auto_model
     from automodel_tpu.config.loader import ConfigNode
-    from automodel_tpu.loggers.log_utils import setup_logging
     from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
 
-    setup_logging()
     dist = cfg.get("distributed", ConfigNode())
     degrees = {
         k: dist.get(k, -1 if k == "dp_shard" else 1)
@@ -312,15 +311,23 @@ def main(cfg: Any) -> int:
     mcfg = cfg.model
     backend = dict(mcfg.get("backend", {}) or {})
     if mcfg.get("pretrained_model_name_or_path"):
-        auto = auto_model.from_pretrained(
+        return auto_model.from_pretrained(
             mcfg.pretrained_model_name_or_path, mesh_ctx, backend
         )
-    else:
-        hf = mcfg.get("hf_config")
-        auto = auto_model.from_config(
-            hf.to_dict() if isinstance(hf, ConfigNode) else hf,
-            mesh_ctx, backend, seed=cfg.get("seed", 0),
-        )
+    hf = mcfg.get("hf_config")
+    return auto_model.from_config(
+        hf.to_dict() if isinstance(hf, ConfigNode) else hf,
+        mesh_ctx, backend, seed=cfg.get("seed", 0),
+    )
+
+
+def main(cfg: Any) -> int:
+    """`automodel_tpu generate -c cfg.yaml [--prompt '...']`"""
+    from automodel_tpu.loggers.log_utils import setup_logging
+
+    setup_logging()
+    auto = build_auto_from_cfg(cfg)
+    mcfg = cfg.model
 
     gen_section = dict(cfg.get("generation", {}) or {})
     gen_config = GenerationConfig.from_dict(gen_section)

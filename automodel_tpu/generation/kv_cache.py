@@ -100,6 +100,22 @@ def init_cache(
     )
 
 
+def usable_axes(mesh_ctx, dim: int, logical: str):
+    """The mesh axes a LOGICAL axis resolves to, IF their product divides
+    ``dim`` — else None (replicate). The shared drop-to-replicated rule for
+    placing inference caches/pools: tiny eval batches or non-dividing KV
+    heads on big meshes must degrade, not crash."""
+    import numpy as np
+
+    axes = mesh_ctx.resolve((logical,))
+    names = axes[0] if len(axes) else None
+    if names is None:
+        return None
+    names = names if isinstance(names, tuple) else (names,)
+    deg = int(np.prod([mesh_ctx.mesh.shape[a] for a in names]))
+    return names if deg > 0 and dim % deg == 0 else None
+
+
 def place_cache(cache: KVCache, mesh_ctx) -> KVCache:
     """Shard a host-built cache onto the mesh: batch over the data axes,
     KV heads over tensor — the Pope et al. decode layout where each TP
@@ -109,19 +125,8 @@ def place_cache(cache: KVCache, mesh_ctx) -> KVCache:
     if mesh_ctx is None:
         return cache
 
-    def usable(dim: int, logical) -> object:
-        import numpy as np
-
-        axes = mesh_ctx.resolve((logical,))
-        names = axes[0] if len(axes) else None
-        if names is None:
-            return None
-        names = names if isinstance(names, tuple) else (names,)
-        deg = int(np.prod([mesh_ctx.mesh.shape[a] for a in names]))
-        return names if deg > 0 and dim % deg == 0 else None
-
-    b_ax = usable(cache.batch, "batch")
-    t_ax = usable(cache.k.shape[3], "tensor")
+    b_ax = usable_axes(mesh_ctx, cache.batch, "batch")
+    t_ax = usable_axes(mesh_ctx, cache.k.shape[3], "tensor")
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     kv_s = NamedSharding(mesh_ctx.mesh, P(None, b_ax, None, t_ax, None))
@@ -140,27 +145,51 @@ class CacheContext:
     over by the layer scan (only the k/v slices ride the scan as xs/ys —
     tags and positions are shared by every layer).
 
-    ``mode``: 'prefill' (attend normally over the incoming block, write it)
-    or 'decode' (write one token per slot, attend the query over the cache).
+    ``mode``: 'prefill' (attend normally over the incoming block, write it),
+    'decode' (write one token per slot, attend the query over the cache), or
+    'chunk' (serving/: write a prompt CHUNK at each slot's own offset and
+    attend the chunk's queries over the whole cache under per-query tag
+    masks — the chunked-prefill path that lets a long prompt interleave
+    with a running decode wave instead of stalling it).
     """
 
-    mode: str  # "prefill" | "decode"
+    mode: str  # "prefill" | "decode" | "chunk"
     capacity: int
     q_pos: jnp.ndarray  # [B] decode query position / [B] prompt lengths
     pos: jnp.ndarray  # [B, C] tags AFTER this call's write
     slots: Optional[jnp.ndarray] = None  # [B] decode write slot
-    prompt_len: int = 0  # static padded prompt length (prefill)
+    prompt_len: int = 0  # static padded prompt/chunk length (prefill/chunk)
+    start: Optional[jnp.ndarray] = None  # [B] chunk write offset (absolute)
 
     @property
     def decode(self) -> bool:
         return self.mode == "decode"
+
+    @property
+    def attends_cache(self) -> bool:
+        """True when the attention path must attend over the CACHE under the
+        position-tag mask (decode and chunked prefill) instead of over the
+        incoming block (ordinary whole-prompt prefill)."""
+        return self.mode in ("decode", "chunk")
 
     # -- writes --------------------------------------------------------------
     def write(
         self, ck: jnp.ndarray, cv: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Write this layer's new keys/values. ck/cv: [B, C, N_kv, H];
-        k/v: [B, S, N_kv, H] (S = prompt length in prefill, 1 in decode)."""
+        k/v: [B, S, N_kv, H] (S = prompt length in prefill, chunk length in
+        chunk mode, 1 in decode)."""
+        if self.mode == "chunk":
+            # per-slot chunk write at the slot's own absolute offset (full
+            # layout only: position == slot). dynamic_update_slice takes
+            # traced starts, so one compiled program serves every offset.
+            write = jax.vmap(
+                lambda cb, nb, s: jax.lax.dynamic_update_slice(cb, nb, (s, 0, 0))
+            )
+            return (
+                write(ck, k.astype(ck.dtype), self.start),
+                write(cv, v.astype(cv.dtype), self.start),
+            )
         if self.mode == "prefill":
             S, C = self.prompt_len, self.capacity
             if S <= C:
@@ -185,11 +214,26 @@ class CacheContext:
 
     # -- attend --------------------------------------------------------------
     def attend_mask(self, sliding_window: Optional[int] = None) -> jnp.ndarray:
-        """[B, C] bool — which cache slots this decode query may attend.
-        Per-layer ``sliding_window`` (mixed full/windowed stacks) narrows the
-        mask; the ring layout needs no extra handling because eviction and
-        window expiry coincide by construction."""
+        """Valid-slot mask for cache-attending modes. Decode: ``[B, C]`` —
+        which cache slots the single query may attend. Chunk: ``[B, S, C]``
+        — per-QUERY validity (query s sits at absolute position start+s, so
+        later chunk tokens attend earlier ones causally through the cache).
+        Per-layer ``sliding_window`` (mixed full/windowed stacks) narrows
+        the mask; the ring layout needs no extra handling in decode because
+        eviction and window expiry coincide by construction."""
         tags = self.pos
+        if self.mode == "chunk":
+            q_abs = self.start[:, None] + jnp.arange(
+                self.prompt_len, dtype=jnp.int32
+            )[None, :]  # [B, S]
+            valid = (tags >= 0)[:, None, :] & (
+                tags[:, None, :] <= q_abs[:, :, None]
+            )
+            if sliding_window is not None:
+                valid = valid & (
+                    q_abs[:, :, None] - tags[:, None, :] < sliding_window
+                )
+            return valid
         q = self.q_pos[:, None]
         valid = (tags >= 0) & (tags <= q)
         if sliding_window is not None:
@@ -219,6 +263,29 @@ def prefill_ctx(cache: KVCache, prompt_len: int, lengths: jnp.ndarray) -> tuple[
     ctx = CacheContext(
         mode="prefill", capacity=C, q_pos=lengths.astype(jnp.int32),
         pos=pos, prompt_len=S,
+    )
+    return new_cache, ctx
+
+
+def chunk_ctx(
+    cache: KVCache, chunk_len: int, start: jnp.ndarray, real_len: jnp.ndarray
+) -> tuple[KVCache, CacheContext]:
+    """Plan a chunked-prefill call (serving/): ``chunk_len`` (static, padded)
+    tokens per slot, written at absolute positions ``[start, start+real_len)``
+    of a FULL-layout cache (chunking a ring layout is unsupported — the
+    serving engine keeps windowed models on the full layout and lets the
+    per-layer window masks narrow attention instead). Positions at or past
+    ``start + real_len`` are tagged -1, so chunk padding is written but never
+    attended and the next chunk overwrites it. ``start``/``real_len``: [B]
+    int32 (traced — one compiled program serves every offset)."""
+    C = cache.capacity
+    j = jnp.arange(C, dtype=jnp.int32)
+    end = (start + real_len).astype(jnp.int32)
+    pos = jnp.where(j[None, :] < end[:, None], j[None, :], -1).astype(jnp.int32)
+    new_cache = cache.replace(pos=pos, lengths=end)
+    ctx = CacheContext(
+        mode="chunk", capacity=C, q_pos=end, pos=pos,
+        prompt_len=int(chunk_len), start=start.astype(jnp.int32),
     )
     return new_cache, ctx
 

@@ -157,7 +157,7 @@ def decoder_layer(
     new_layer_kv = None
     if cache is not None:
         new_layer_kv = cache_ctx.write(cache[0], cache[1], k, v)
-    if cache is not None and cache_ctx.decode:
+    if cache is not None and cache_ctx.attends_cache:
         from automodel_tpu.ops.attention import sdpa_decode
 
         attn_out = sdpa_decode(

@@ -189,7 +189,10 @@ def attention_block(
     if cache is not None:
         ck, cv = cache
         new_layer_kv = cache_ctx.write(ck, cv, k, v)
-        if cache_ctx.decode:
+        if cache_ctx.attends_cache:
+            # decode (single query) and chunked prefill (serving/): attend
+            # over the cache under the position-tag mask — 2D per-slot for
+            # decode, 3D per-query for a chunk
             from automodel_tpu.ops.attention import sdpa_decode
 
             attn_out = sdpa_decode(

@@ -58,7 +58,9 @@ def sdpa(
     ``kv_mask``: [B, Sk] bool — per-key validity, ANDed onto the mask. The
     KV-cache decode path (generation/) expresses slot validity this way
     (position-tag masks subsume causality/window there, so decode calls pass
-    causal=False and let the tags do the masking).
+    causal=False and let the tags do the masking). A [B, Sq, Sk] mask gives
+    PER-QUERY validity — the chunked-prefill path (serving/) uses it so each
+    chunk token attends exactly its causal cache prefix.
 
     ``attn_bias``: additive fp32 bias [B, 1|N, Sq, Sk] applied after scaling
     (DeepSeek-V3.2 sparse top-k mask; TE core_attention_bias equivalent).
@@ -100,7 +102,10 @@ def sdpa(
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         mask = mask & seg
     if kv_mask is not None:
-        mask = mask & kv_mask[:, None, None, :]
+        mask = mask & (
+            kv_mask[:, None, :, :] if kv_mask.ndim == 3
+            else kv_mask[:, None, None, :]
+        )
     logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
     if sinks is not None:
         sink_col = jnp.broadcast_to(
@@ -123,14 +128,16 @@ def sdpa_decode(
     logits_soft_cap: Optional[float] = None,
     sinks: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Single-token decode attention over a KV cache.
+    """Cache-attending attention for decode AND chunked prefill.
 
-    q: [B, 1, N, H] (the new token), k/v: [B, C, Nkv, H] (the cache),
-    kv_mask: [B, C] valid-slot mask (generation.kv_cache position tags —
-    these already encode causality and any sliding window, so no causal
-    mask is applied here). One fused XLA program: a [B, N, 1, C] logits
-    block is VPU work, so decode never needs (or benefits from) splash —
-    the MXU tile is 128 wide and a 1-row query can't fill it."""
+    q: [B, Sq, N, H] (Sq = 1 for single-token decode, the chunk length for
+    serving/'s chunked prefill), k/v: [B, C, Nkv, H] (the cache), kv_mask:
+    [B, C] (decode) or [B, Sq, C] (per-query, chunk) valid-slot mask
+    (generation.kv_cache position tags — these already encode causality and
+    any sliding window, so no causal mask is applied here). One fused XLA
+    program: a [B, N, 1, C] decode logits block is VPU work, so decode never
+    needs (or benefits from) splash — the MXU tile is 128 wide and a 1-row
+    query can't fill it."""
     return sdpa(
         q, k, v,
         causal=False, scale=scale, logits_soft_cap=logits_soft_cap,

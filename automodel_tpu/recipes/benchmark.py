@@ -151,6 +151,13 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
         # at scale, with no pets in between: watchdog eval grace covers it
         with self.guard.phase("eval"):
             result.update(self._generation_leg())
+        # serving leg (serving/): sustained throughput under Poisson request
+        # arrivals through the continuous-batching engine — tokens/s, ttft
+        # p50/p99, block-pool occupancy. Same degradation contract as the
+        # decode leg: no `serving:` section / cache-less model / any failure
+        # → null values WITH a recorded reason, never a silent 0.0.
+        with self.guard.phase("eval"):
+            result.update(self._serving_leg())
         pinfo = getattr(self.model, "pipeline_info", None)
         if pinfo:
             from automodel_tpu.utils.flops_utils import pipeline_bubble_fraction
@@ -210,6 +217,92 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             "gen_tokens": out["gen_tokens"],
             "gen_cache_bytes": out["cache_bytes"],
             "gen_failure": None,
+        }
+
+    def _serving_leg(self) -> dict:
+        """→ {serve_tokens_per_s, serve_ttft_p50_s, serve_ttft_p99_s,
+        serve_block_occupancy_peak, serve_requests, serve_failure}.
+
+        Poisson arrivals (`serving.bench_rate` req/s, exponential
+        inter-arrival gaps) over `serving.bench_requests` mixed-length
+        random prompts, driven in real time through the continuous-batching
+        engine. A warm-up request is run first so the chunk-prefill/decode
+        compiles don't pollute the measured ttfts."""
+        nulls = {
+            "serve_tokens_per_s": None,
+            "serve_ttft_p50_s": None,
+            "serve_ttft_p99_s": None,
+            "serve_block_occupancy_peak": None,
+            "serve_requests": None,
+        }
+        section = self.cfg.get("serving")
+        if section is None:
+            return {**nulls, "serve_failure": "no serving: section in config"}
+        if self.peft_config is not None:
+            return {
+                **nulls,
+                "serve_failure": "serving with peft adapters is not "
+                "supported (merge first)",
+            }
+        try:
+            from automodel_tpu.serving.engine import ServeConfig, ServingEngine
+
+            scfg = ServeConfig.from_dict(dict(section or {}))
+            gcfg = getattr(self, "_gen_section", None)
+            from automodel_tpu.generation.engine import GenerationConfig
+
+            gen_cfg = GenerationConfig.from_dict(
+                {
+                    k: v
+                    for k, v in dict(gcfg or {}).items()
+                    if k not in ("prompts", "prompt_ids", "tokenizer", "enabled")
+                }
+            )
+            # serve with the CURRENT weights, like the decode leg
+            auto = self.auto
+            params0 = auto.params
+            auto.params = self.state.params
+            try:
+                engine = ServingEngine(auto, scfg, gen_cfg)
+                vocab = int(self.model.config.vocab_size)
+                rng = np.random.default_rng(0)
+                lens = rng.integers(
+                    scfg.bench_prompt_len_min,
+                    scfg.bench_prompt_len_max + 1,
+                    size=scfg.bench_requests,
+                )
+                gaps = rng.exponential(
+                    1.0 / max(scfg.bench_rate, 1e-6), size=scfg.bench_requests
+                )
+                offsets = np.cumsum(gaps) - gaps[0]  # first arrives at t=0
+                arrivals = [
+                    (
+                        float(offsets[i]),
+                        rng.integers(1, vocab, size=int(lens[i])).tolist(),
+                        scfg.bench_max_new_tokens,
+                    )
+                    for i in range(scfg.bench_requests)
+                ]
+                # warm-up: compile chunk prefill + decode outside the window
+                engine.submit(
+                    rng.integers(1, vocab, size=int(lens[0])).tolist(),
+                    max_new_tokens=2,
+                )
+                engine.run()
+                _, stats = engine.run_workload(arrivals)
+            finally:
+                auto.params = params0
+        except Exception as e:
+            return {**nulls, "serve_failure": f"{type(e).__name__}: {e}"}
+        return {
+            "serve_tokens_per_s": round(stats["sustained_tokens_per_s"], 2),
+            "serve_ttft_p50_s": round(stats["ttft_p50_s"], 6),
+            "serve_ttft_p99_s": round(stats["ttft_p99_s"], 6),
+            "serve_block_occupancy_peak": stats["block_occupancy_peak"],
+            "serve_requests": stats["requests"],
+            "serve_prefix_cache": stats["prefix_cache"],
+            "serve_queue_depth_peak": stats["queue_depth_peak"],
+            "serve_failure": None,
         }
 
 

@@ -338,6 +338,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self.checkpointer = Checkpointer(CheckpointingConfig(**ccfg)) if ccfg.get(
             "enabled", False
         ) else None
+        # best-val tracking: the newest validation metric at save time; a
+        # save that improves on BEST.json gets the best marker (checkpoint
+        # polish, reference base_recipe.py:768-850)
+        self._last_val_metric: Optional[float] = None
         if self.checkpointer is not None:
             self.checkpointer.event_hook = self.telemetry.record_step
             # multi-host: at SIGTERM time drop a marker into the shared
@@ -537,6 +541,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.auto.adapter,
                 out / "hf_adapter",
             )
+        # best-val marker: only SAVED checkpoints can be best (the marker
+        # must always point at a restorable tree). BEST.json is re-read so a
+        # resumed run never clobbers a better pre-preemption best.
+        if self._last_val_metric is not None:
+            best = self.checkpointer.best_info()
+            if best is None or self._last_val_metric < float(best["value"]):
+                self.checkpointer.mark_best(out, "val_loss", self._last_val_metric)
         logger.info("saved checkpoint at step %d", self.step_scheduler.step)
 
     def _restore(self, before_step: Optional[int] = None) -> None:
@@ -953,7 +964,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             out = jax.device_get(self.eval_step(self.state, batch))
             tot_loss += float(out["loss_sum"])
             tot_n += int(out["num_label_tokens"])
-        return {"val_loss": tot_loss / max(tot_n, 1), "val_tokens": tot_n}
+        val_loss = tot_loss / max(tot_n, 1)
+        if val_loss == val_loss:  # a NaN eval must never look "best"
+            self._last_val_metric = val_loss
+        return {"val_loss": val_loss, "val_tokens": tot_n}
 
 
 def main(cfg: ConfigNode) -> dict:

@@ -1,0 +1,22 @@
+"""Serving subsystem: continuous batching + paged KV-cache scheduler over
+the generation engine (docs/serving.md).
+
+- :mod:`block_pool` — ref-counted fixed-size KV block allocator with
+  chain-hashed prefix caching.
+- :mod:`paged` — jitted chunked-prefill and paged-decode programs
+  (block-table gather feeding the existing cached-attention path).
+- :mod:`engine` — the continuous-batching scheduler (admission queue,
+  chunked prefill interleaved with the decode wave, mid-flight slot refill).
+- :mod:`server` — the `automodel_tpu serve` CLI (stdin-JSONL + local HTTP).
+"""
+
+from automodel_tpu.serving.block_pool import BlockPool, BlockPoolError
+from automodel_tpu.serving.engine import QueueFull, ServeConfig, ServingEngine
+
+__all__ = [
+    "BlockPool",
+    "BlockPoolError",
+    "QueueFull",
+    "ServeConfig",
+    "ServingEngine",
+]

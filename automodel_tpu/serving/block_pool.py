@@ -1,0 +1,203 @@
+"""Paged KV-cache block allocator (host side).
+
+The vLLM idea (Kwon et al., PagedAttention) translated to the existing
+generation cache: HBM holds ONE fixed pool of fixed-size blocks
+(``[L, num_blocks, block_size, N_kv, H]`` per of k/v — serving/paged.py owns
+the arrays); each sequence owns a **block table** (a list of block ids) and
+long and short requests share the pool without fragmentation — a finished
+short completion returns its blocks immediately instead of stranding a
+contiguous ``[L, B, C, ...]`` region until the longest sequence in the wave
+finishes.
+
+This module is the pure-python accountant: free list, per-block reference
+counts, and the **prefix cache** — completed prompt blocks are retained
+(keyed on a CHAIN hash of their token contents, so a hit guarantees the
+whole prefix matches) and a new request with the same prompt prefix shares
+them by incref instead of recomputing their K/V. Zero-ref cached blocks sit
+in an LRU and are evicted only when the free list runs dry, so prefix
+caching never makes an allocation fail that would otherwise succeed.
+
+Block 0 is a reserved SCRATCH block: the jitted paged decode step always
+writes its token somewhere (XLA has no conditional scatter), so inactive
+slots are pointed at block 0 and their junk writes land where no sequence
+ever reads. The allocator never hands block 0 out.
+
+Invariants (``check_invariants`` — the property tests drive a randomized
+admit/finish schedule against them):
+- every non-scratch block is in exactly ONE of {free list, LRU, in use
+  (ref > 0)};
+- free/LRU blocks have ref == 0; freeing a ref-0 block raises (double
+  free), as does freeing scratch;
+- ``counters`` account allocations/frees/hits/evictions exactly.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+
+class BlockPoolError(RuntimeError):
+    """Allocator misuse: double free, freeing scratch, corrupt accounting."""
+
+
+class BlockPool:
+    def __init__(
+        self, num_blocks: int, block_size: int, prefix_cache: bool = True
+    ):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks={num_blocks}: need >= 2 (block 0 is scratch)"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size} must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.prefix_cache_enabled = bool(prefix_cache)
+        # LIFO free list: recently freed blocks are re-handed first (warm)
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref: dict[int, int] = {b: 0 for b in range(num_blocks)}
+        self._cached: dict[int, int] = {}  # chain hash -> block id
+        self._hash_of: dict[int, int] = {}  # block id -> chain hash
+        self._lru: "OrderedDict[int, int]" = OrderedDict()  # hash -> ref-0 bid
+        self.counters = {
+            "allocated": 0,
+            "freed": 0,
+            "prefix_hits": 0,  # requests that matched >= 1 block
+            "prefix_blocks_reused": 0,
+            "prefix_tokens_reused": 0,
+            "evictions": 0,
+            "failed_allocs": 0,
+        }
+
+    # -- capacity -------------------------------------------------------------
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1  # minus scratch
+
+    def available(self) -> int:
+        """Blocks an allocate() could hand out right now (free + evictable)."""
+        return len(self._free) + len(self._lru)
+
+    def in_use(self) -> int:
+        return self.usable_blocks - self.available()
+
+    def occupancy(self) -> float:
+        """Fraction of the usable pool referenced by live sequences (cached
+        ref-0 blocks count as available — they are reclaimable on demand)."""
+        return self.in_use() / max(self.usable_blocks, 1)
+
+    # -- prefix cache ---------------------------------------------------------
+    @staticmethod
+    def _chain(parent: Optional[int], tokens: tuple) -> int:
+        return hash((parent, tokens))
+
+    def match_prefix(self, tokens: Sequence[int]) -> tuple[list[int], int]:
+        """→ (block ids, matched token count) for the longest cached
+        block-aligned prefix of ``tokens``, each hit INCREF'd for the caller.
+        Capped at ``len(tokens) - 1`` tokens: the last prompt token must
+        always be recomputed — its logits seed the first sampled token."""
+        if not self.prefix_cache_enabled:
+            return [], 0
+        bs = self.block_size
+        hits: list[int] = []
+        parent: Optional[int] = None
+        for i in range((max(len(tokens) - 1, 0)) // bs):
+            h = self._chain(parent, tuple(tokens[i * bs : (i + 1) * bs]))
+            bid = self._cached.get(h)
+            if bid is None:
+                break
+            if self._ref[bid] == 0:
+                self._lru.pop(h)
+            self._ref[bid] += 1
+            hits.append(bid)
+            parent = h
+        if hits:
+            self.counters["prefix_hits"] += 1
+            self.counters["prefix_blocks_reused"] += len(hits)
+            self.counters["prefix_tokens_reused"] += len(hits) * bs
+        return hits, len(hits) * bs
+
+    def register_prefix(self, tokens: Sequence[int], blocks: Sequence[int]) -> None:
+        """Make a prefilled prompt's FULL blocks matchable by later requests
+        (no refcount is taken — a registered block freed to ref 0 parks in
+        the LRU, matchable until evicted). ``blocks`` is the sequence's block
+        table; only the ``len(tokens) // block_size`` full blocks register."""
+        if not self.prefix_cache_enabled:
+            return
+        bs = self.block_size
+        parent: Optional[int] = None
+        for i in range(len(tokens) // bs):
+            bid = blocks[i]
+            h = self._chain(parent, tuple(tokens[i * bs : (i + 1) * bs]))
+            # first writer wins: an existing mapping (another request computed
+            # the same prefix concurrently) or a block already registered
+            # under a different hash is left alone
+            if h not in self._cached and bid not in self._hash_of:
+                self._cached[h] = bid
+                self._hash_of[bid] = h
+            parent = h
+
+    # -- allocate / free ------------------------------------------------------
+    def allocate(self, n: int) -> Optional[list[int]]:
+        """n fresh blocks (ref = 1 each), or None when the pool can't satisfy
+        the request (caller leaves the sequence queued). Evicts LRU cached
+        blocks only when the free list is empty."""
+        if n < 0:
+            raise ValueError(f"allocate({n})")
+        if n > self.available():
+            self.counters["failed_allocs"] += 1
+            return None
+        out: list[int] = []
+        for _ in range(n):
+            if self._free:
+                bid = self._free.pop()
+            else:
+                h, bid = self._lru.popitem(last=False)  # oldest cached
+                del self._cached[h]
+                del self._hash_of[bid]
+                self.counters["evictions"] += 1
+            self._ref[bid] = 1
+            out.append(bid)
+        self.counters["allocated"] += n
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Decref every block; a block reaching ref 0 returns to the free
+        list, or parks in the LRU when it is prefix-cache registered."""
+        for bid in blocks:
+            if bid == 0:
+                raise BlockPoolError("freeing the scratch block")
+            if self._ref.get(bid, 0) <= 0:
+                raise BlockPoolError(f"double free of block {bid}")
+            self._ref[bid] -= 1
+            if self._ref[bid] == 0:
+                h = self._hash_of.get(bid)
+                if h is not None:
+                    self._lru[h] = bid
+                else:
+                    self._free.append(bid)
+        self.counters["freed"] += len(blocks)
+
+    # -- audit ----------------------------------------------------------------
+    def check_invariants(self) -> None:
+        free_set = set(self._free)
+        lru_set = set(self._lru.values())
+        used_set = {b for b in range(1, self.num_blocks) if self._ref[b] > 0}
+        if free_set & lru_set or free_set & used_set or lru_set & used_set:
+            raise BlockPoolError("block in two states at once")
+        if free_set | lru_set | used_set != set(range(1, self.num_blocks)):
+            raise BlockPoolError(
+                f"leaked blocks: {set(range(1, self.num_blocks)) - (free_set | lru_set | used_set)}"
+            )
+        for b in free_set | lru_set:
+            if self._ref[b] != 0:
+                raise BlockPoolError(f"available block {b} has ref {self._ref[b]}")
+        if self._ref[0] != 0:
+            raise BlockPoolError("scratch block acquired a refcount")
+        for h, bid in self._cached.items():
+            if self._hash_of.get(bid) != h:
+                raise BlockPoolError(f"cache maps desynced on block {bid}")
+        for h in self._lru:
+            if h not in self._cached:
+                raise BlockPoolError("LRU entry not in prefix cache")

@@ -1,0 +1,453 @@
+"""Continuous-batching serving engine over the paged KV-cache pool.
+
+The scheduler loop (one ``step()`` = one engine iteration):
+
+1. **admit** — pop queued requests into free decode slots. A request's
+   WHOLE block budget (``ceil((prompt + max_new) / block_size)``) is
+   allocated at admission (minus any prefix-cache hit), so a running
+   sequence never needs a mid-flight allocation and the engine cannot
+   deadlock on a full pool: if the pool can't cover the head-of-queue
+   request it simply stays queued until completions free blocks.
+2. **prefill tick** — every mid-prefill slot advances ONE chunk
+   (``prefill_chunk`` tokens) through the jitted chunked-prefill program.
+   Bounding per-iteration prefill work is what keeps time-to-first-token of
+   queued requests from stalling behind a single long prompt: the decode
+   wave below still runs every iteration.
+3. **decode tick** — one jitted paged decode step over all slots; active
+   slots each advance one token. Slots whose token hits a stop id or whose
+   budget is spent COMPLETE: their blocks decref back to the pool (prompt
+   blocks stay matchable in the prefix cache) and the slot refills from the
+   queue on the next iteration — mid-flight, without waiting for the rest
+   of the wave.
+
+Greedy decode through this path is token-parity with the single-wave
+``generation.GenerationEngine`` (tests/test_serving.py pins it, full and
+ring-model layouts); sampled decode draws from the same per-host base key
+but a GLOBAL step counter, so streams differ from the single-wave engine by
+construction (documented in docs/serving.md).
+
+Windowed (mistral-style) models run on the FULL paged layout with the
+per-layer window masks narrowing attention — unlike the single-wave ring
+layout there is no wraparound hazard, so ragged windowed batches are fine
+here. HBM cost is bounded by ``max_seq_len``, not the window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.generation.engine import (
+    GenerationConfig,
+    GenerationUnsupported,
+    _model_max_positions,
+)
+from automodel_tpu.generation.sampling import sample
+from automodel_tpu.serving import paged
+from automodel_tpu.serving.block_pool import BlockPool
+from automodel_tpu.training.rng import sampling_key
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at max_queue: the caller must apply backpressure —
+    the engine never silently drops a request."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """The `serving:` YAML section (scheduler/allocator knobs; sampling and
+    stop tokens come from the `generation:` section)."""
+
+    slots: int = 4  # decode batch width
+    block_size: int = 16  # tokens per KV block
+    num_blocks: int = 512  # pool size (block 0 is scratch)
+    prefill_chunk: int = 64  # prompt tokens per engine iteration per slot
+    max_seq_len: int = 1024  # per-request prompt + generated cap
+    max_queue: int = 4096
+    prefix_cache: bool = True
+    # sustained-throughput bench knobs (recipes/benchmark.py serving leg)
+    bench_requests: int = 16
+    bench_rate: float = 8.0  # Poisson arrival rate, requests/second
+    bench_prompt_len_min: int = 8
+    bench_prompt_len_max: int = 48
+    bench_max_new_tokens: int = 16
+
+    def __post_init__(self):
+        if self.slots < 1 or self.block_size < 1 or self.prefill_chunk < 1:
+            raise ValueError(
+                f"serving: slots/block_size/prefill_chunk must be >= 1 "
+                f"({self.slots}/{self.block_size}/{self.prefill_chunk})"
+            )
+        if self.max_seq_len < 2:
+            raise ValueError(f"serving.max_seq_len={self.max_seq_len}")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "ServeConfig":
+        d = dict(d or {})
+        d.pop("_target_", None)
+        d.pop("http", None)  # server-level section (serving/server.py)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise TypeError(f"unknown serving keys: {sorted(unknown)}")
+        return cls(**d)
+
+    @property
+    def table_blocks(self) -> int:
+        """Static per-sequence block-table width. The extra prefill_chunk of
+        headroom keeps the chunk program's dynamic_update_slice from ever
+        clamping (paged.py view-position invariant)."""
+        return -(-(self.max_seq_len + self.prefill_chunk) // self.block_size)
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: str
+    prompt: list[int]
+    max_new: int
+    blocks: list[int]  # every block this sequence holds a ref on
+    hit_tokens: int  # prefix-cache reused tokens
+    prefill_pos: int  # next absolute prompt position to compute
+    t_submit: float
+    t_admit: float
+    decoding: bool = False
+    generated: Optional[list[int]] = None
+    t_first: Optional[float] = None
+
+
+class ServingEngine:
+    """Facade over (AutoModel, ServeConfig, GenerationConfig).
+
+    ``submit`` enqueues token-id prompts; ``step`` runs one scheduler
+    iteration and returns the requests that completed in it; ``run`` drains
+    everything. ``on_record`` (optional) receives one telemetry dict per
+    completed request (the serve CLI points it at the metrics JSONL)."""
+
+    def __init__(
+        self,
+        auto: Any,
+        config: Optional[ServeConfig] = None,
+        gen_config: Optional[GenerationConfig] = None,
+        on_record: Optional[Callable[[dict], None]] = None,
+    ):
+        if not getattr(auto.model, "supports_kv_cache", False):
+            raise GenerationUnsupported(
+                f"{type(auto.model).__name__} has no KV-cache decode path; "
+                "cache-capable families: llama-generic (llama/qwen2/qwen3/"
+                "mistral/phi3), gpt2, qwen3_moe"
+            )
+        self.auto = auto
+        self.model = auto.model
+        self.config = config or ServeConfig()
+        self.gen_config = gen_config or GenerationConfig()
+        self.on_record = on_record
+        mcfg = self.model.config
+        self._max_positions = _model_max_positions(mcfg)
+        if self._max_positions and self.config.max_seq_len > self._max_positions:
+            raise ValueError(
+                f"serving.max_seq_len={self.config.max_seq_len} exceeds the "
+                f"model context limit {self._max_positions}"
+            )
+        self.pool = BlockPool(
+            self.config.num_blocks, self.config.block_size,
+            prefix_cache=self.config.prefix_cache,
+        )
+        self._pool_k, self._pool_v = paged.init_pool(
+            int(mcfg.num_layers), self.config.num_blocks,
+            self.config.block_size, int(mcfg.num_kv_heads),
+            int(mcfg.head_dim), dtype=self.model.backend.compute_jnp_dtype,
+        )
+        self._pool_k, self._pool_v = paged.place_pool(
+            self._pool_k, self._pool_v, auto.mesh_ctx
+        )
+        constrain = auto.constrain
+
+        def apply(params, ids, **kw):
+            return self.model(params, ids, constrain=constrain, **kw)
+
+        self._chunk = paged.build_chunk_prefill_fn(
+            apply, self.config.prefill_chunk
+        )
+        self._decode = paged.build_paged_decode_fn(
+            apply, self.gen_config.sampling,
+            pad_id=self.gen_config.pad_token_id,
+        )
+        self._base_key = sampling_key(self.gen_config.seed)
+        self._eos = set(self.gen_config.eos_ids)
+
+        B, NB = self.config.slots, self.config.table_blocks
+        self._tables = np.zeros((B, NB), np.int32)
+        self._lengths = np.zeros((B,), np.int32)
+        self._cur = np.full((B,), self.gen_config.pad_token_id, np.int32)
+        self._active = np.zeros((B,), bool)
+        self._slots: list[Optional[_Slot]] = [None] * B
+        self._queue: deque = deque()
+        self._ids = itertools.count()
+        self._step_counter = 0
+        self.completed_total = 0
+
+    # -- stats ---------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def busy_slots(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def pool_bytes(self) -> int:
+        return int(self._pool_k.nbytes + self._pool_v.nbytes)
+
+    def idle(self) -> bool:
+        return not self._queue and self.busy_slots == 0
+
+    # -- submission -----------------------------------------------------------
+    def submit(
+        self,
+        prompt_ids: Sequence[int],
+        request_id: Optional[str] = None,
+        max_new_tokens: Optional[int] = None,
+        t_submit: Optional[float] = None,
+    ) -> str:
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise ValueError("empty prompt (every request needs >= 1 token)")
+        max_new = (
+            self.gen_config.max_new_tokens
+            if max_new_tokens is None
+            else int(max_new_tokens)  # explicit 0 must hit the guard below
+        )
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens={max_new}")
+        total = len(prompt) + max_new
+        cap = min(
+            self.config.max_seq_len,
+            self._max_positions or self.config.max_seq_len,
+        )
+        if total > cap:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) = "
+                f"{total} exceeds the serving limit {cap}"
+            )
+        if -(-total // self.config.block_size) > self.pool.usable_blocks:
+            raise ValueError(
+                f"request needs {-(-total // self.config.block_size)} blocks "
+                f"but the pool only has {self.pool.usable_blocks} — raise "
+                "serving.num_blocks"
+            )
+        if len(self._queue) >= self.config.max_queue:
+            raise QueueFull(
+                f"admission queue at serving.max_queue={self.config.max_queue}"
+            )
+        rid = request_id if request_id is not None else f"req-{next(self._ids)}"
+        self._queue.append(
+            (rid, prompt, max_new, time.perf_counter() if t_submit is None else t_submit)
+        )
+        return rid
+
+    # -- scheduler ------------------------------------------------------------
+    def _admit(self) -> None:
+        for b in range(self.config.slots):
+            if self._slots[b] is not None or not self._queue:
+                continue
+            rid, prompt, max_new, t_sub = self._queue[0]
+            hits, hit_tokens = self.pool.match_prefix(prompt)
+            need = -(-(len(prompt) + max_new) // self.config.block_size)
+            fresh = self.pool.allocate(need - len(hits))
+            if fresh is None:
+                # pool can't cover the head of the queue: undo the hit refs
+                # and keep FIFO order (no overtaking — ttft fairness)
+                if hits:
+                    self.pool.free(hits)
+                break
+            self._queue.popleft()
+            blocks = hits + fresh
+            row = np.zeros((self.config.table_blocks,), np.int32)
+            row[: len(blocks)] = blocks
+            self._tables[b] = row
+            self._lengths[b] = hit_tokens
+            self._active[b] = False
+            self._slots[b] = _Slot(
+                request_id=rid, prompt=prompt, max_new=max_new,
+                blocks=blocks, hit_tokens=hit_tokens,
+                prefill_pos=hit_tokens, t_submit=t_sub,
+                t_admit=time.perf_counter(),
+            )
+
+    def _prefill_tick(self) -> list[dict]:
+        done: list[dict] = []
+        chunk_len = self.config.prefill_chunk
+        pad = self.gen_config.pad_token_id
+        for b, slot in enumerate(self._slots):
+            if slot is None or slot.decoding:
+                continue
+            p = len(slot.prompt)
+            start = slot.prefill_pos
+            real = min(chunk_len, p - start)
+            ids = np.full((chunk_len,), pad, np.int32)
+            ids[:real] = slot.prompt[start : start + real]
+            last, self._pool_k, self._pool_v = self._chunk(
+                self.auto.params,
+                self._pool_k, self._pool_v,
+                jnp.asarray(self._tables[b]), jnp.asarray(ids),
+                jnp.int32(start), jnp.int32(real),
+            )
+            slot.prefill_pos = start + real
+            self._lengths[b] = slot.prefill_pos
+            if slot.prefill_pos < p:
+                continue
+            # prompt fully in: sample the first token (charged to ttft),
+            # publish the prompt blocks to the prefix cache, flip to decode
+            first = int(
+                sample(
+                    last[None, :],
+                    jax.random.fold_in(self._base_key, self._step_counter),
+                    self.gen_config.sampling,
+                )[0]
+            )
+            self.pool.register_prefix(slot.prompt, slot.blocks)
+            slot.t_first = time.perf_counter()
+            slot.generated = [first]
+            slot.decoding = True
+            self._cur[b] = first
+            self._active[b] = True
+            self._lengths[b] = p
+            if first in self._eos or slot.max_new <= 1:
+                done.append(self._finish(b))
+        return done
+
+    def _decode_tick(self) -> list[dict]:
+        if not self._active.any():
+            return []
+        params = self.auto.params
+        tokens, self._pool_k, self._pool_v = self._decode(
+            params, self._pool_k, self._pool_v,
+            jnp.asarray(self._tables), jnp.asarray(self._lengths),
+            jnp.asarray(self._cur), jnp.asarray(self._active),
+            self._base_key, jnp.int32(self._step_counter),
+        )
+        tokens = np.asarray(jax.device_get(tokens))
+        done: list[dict] = []
+        for b, slot in enumerate(self._slots):
+            if slot is None or not self._active[b]:
+                continue
+            tok = int(tokens[b])
+            slot.generated.append(tok)
+            self._lengths[b] += 1
+            self._cur[b] = tok
+            if tok in self._eos or len(slot.generated) >= slot.max_new:
+                done.append(self._finish(b))
+        return done
+
+    def _finish(self, b: int) -> dict:
+        slot = self._slots[b]
+        now = time.perf_counter()
+        n_gen = len(slot.generated)
+        decode_s = now - slot.t_first
+        self.pool.free(slot.blocks)
+        self._slots[b] = None
+        self._tables[b] = 0
+        self._lengths[b] = 0
+        self._active[b] = False
+        self._cur[b] = self.gen_config.pad_token_id
+        self.completed_total += 1
+        rec = {
+            "event": "serve_request",
+            "request_id": slot.request_id,
+            "tokens": list(slot.generated),
+            "n_generated": n_gen,
+            "prompt_tokens": len(slot.prompt),
+            "prefix_hit_tokens": slot.hit_tokens,
+            "ttft_s": slot.t_first - slot.t_submit,
+            "queue_s": slot.t_admit - slot.t_submit,
+            # the first token is charged to ttft, like the single-wave engine
+            "decode_tps": (n_gen - 1) / decode_s if decode_s > 0 and n_gen > 1 else 0.0,
+            "queue_depth": self.queue_depth,
+            "block_occupancy": round(self.pool.occupancy(), 4),
+            "ts": time.time(),
+        }
+        if self.on_record is not None:
+            try:
+                self.on_record(dict(rec))
+            except Exception:  # telemetry must never break serving
+                pass
+        return rec
+
+    def step(self) -> list[dict]:
+        """One scheduler iteration → the requests that completed in it."""
+        self._admit()
+        done = self._prefill_tick()
+        done += self._decode_tick()
+        self._step_counter += 1
+        return done
+
+    def run(self, max_iterations: Optional[int] = None) -> list[dict]:
+        """Drain the queue and every running slot. ``max_iterations`` guards
+        against scheduler bugs (default: a generous analytic bound)."""
+        if max_iterations is None:
+            n_req = len(self._queue) + self.busy_slots
+            per_req = (
+                -(-self.config.max_seq_len // self.config.prefill_chunk)
+                + self.config.max_seq_len
+            )
+            max_iterations = 64 + (n_req + 1) * (per_req + 2)
+        out: list[dict] = []
+        for _ in range(max_iterations):
+            if self.idle():
+                return out
+            out.extend(self.step())
+        raise RuntimeError(
+            f"serving engine failed to drain within {max_iterations} "
+            f"iterations (queue={self.queue_depth}, busy={self.busy_slots})"
+        )
+
+    # -- workload driver (bench leg + sustained-throughput tests) -------------
+    def run_workload(
+        self, arrivals: Sequence[tuple[float, Sequence[int], Optional[int]]]
+    ) -> tuple[list[dict], dict]:
+        """Drive a timed workload: ``arrivals`` is [(offset_s, prompt_ids,
+        max_new_tokens|None)] sorted by offset. Requests are submitted when
+        their offset elapses (wall clock); the engine steps continuously in
+        between. → (completions, aggregate stats: sustained tokens/s, ttft
+        p50/p99, peak occupancy/queue depth)."""
+        arrivals = sorted(arrivals, key=lambda a: a[0])
+        t0 = time.perf_counter()
+        pending = deque(arrivals)
+        out: list[dict] = []
+        occ_peak, q_peak = 0.0, 0
+        while pending or not self.idle():
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                _, prompt, max_new = pending.popleft()
+                self.submit(prompt, max_new_tokens=max_new)
+            if self.idle():
+                if pending:
+                    time.sleep(min(0.001, max(pending[0][0] - now, 0.0)))
+                continue
+            out.extend(self.step())
+            occ_peak = max(occ_peak, self.pool.occupancy())
+            q_peak = max(q_peak, self.queue_depth)
+        dt = time.perf_counter() - t0
+        gen = sum(r["n_generated"] for r in out)
+        ttfts = sorted(r["ttft_s"] for r in out)
+        pct = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)] if ttfts else None
+        stats = {
+            "requests": len(out),
+            "gen_tokens": gen,
+            "wall_s": dt,
+            "sustained_tokens_per_s": gen / dt if dt > 0 else 0.0,
+            "ttft_p50_s": pct(0.50),
+            "ttft_p99_s": pct(0.99),
+            "block_occupancy_peak": round(occ_peak, 4),
+            "queue_depth_peak": q_peak,
+            "prefix_cache": dict(self.pool.counters),
+        }
+        return out, stats

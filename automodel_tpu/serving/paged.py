@@ -1,0 +1,175 @@
+"""Jitted paged-KV programs: block-table gather → existing cache attention.
+
+Two programs, compiled once each per (chunk length, table width):
+
+- **chunk prefill**: one prompt chunk (static padded length, traced offset)
+  through the model's cached-attend path — queries attend the WHOLE gathered
+  cache view under per-query position-tag masks (generation.kv_cache
+  ``chunk_ctx`` + the 3D ``kv_mask`` in ops.attention.sdpa), so chunk N sees
+  chunks 0..N-1 and any prefix-cache hit without recomputing them. This is
+  what lets the scheduler interleave a long prompt with the running decode
+  wave: each engine iteration spends at most one chunk of prefill compute.
+- **paged decode**: one token per active slot. The per-slot block tables
+  gather the pool into a contiguous ``[L, B, C_view, N_kv, H]`` view (an XLA
+  gather — the TPU-native expression of paged attention; a bespoke
+  Mosaic gather-attend kernel is the known next optimization, noted in
+  docs/serving.md), the view feeds the UNCHANGED ``decode_ctx`` →
+  ``sdpa_decode`` path, and the single written token scatters back to its
+  (block, offset). Inactive slots write to scratch block 0.
+
+Both programs donate the pool arrays, so the pool is updated in place
+(no transient second copy of the whole cache).
+
+View-position invariant: the serving engine uses the FULL layout only
+(slot j of a sequence's view holds absolute position j), so a sequence's
+view capacity must exceed its highest written position — the engine sizes
+tables as ``ceil((max_seq_len + prefill_chunk) / block_size)`` blocks and
+admission enforces ``prompt + max_new <= max_seq_len``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.generation import kv_cache
+from automodel_tpu.generation.sampling import SamplingConfig, sample
+
+
+def _logits_of(primary: Any) -> jnp.ndarray:
+    return primary[0] if isinstance(primary, tuple) else primary
+
+
+def init_pool(
+    num_layers: int,
+    num_blocks: int,
+    block_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The HBM block pool: (k, v), each [L, NB, BS, N_kv, H]."""
+    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def place_pool(pool_k, pool_v, mesh_ctx):
+    """Shard the pool: KV heads over the tensor axes (each TP shard owns its
+    heads' blocks — the same no-cache-collective decode layout as
+    generation.kv_cache.place_cache); blocks are NOT batch-sharded (every
+    sequence's table may point anywhere in the pool). Non-divisible axes are
+    dropped (replicated)."""
+    if mesh_ctx is None:
+        return pool_k, pool_v
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    names = kv_cache.usable_axes(mesh_ctx, pool_k.shape[3], "tensor")
+    sh = NamedSharding(mesh_ctx.mesh, P(None, None, None, names, None))
+    return jax.device_put(pool_k, sh), jax.device_put(pool_v, sh)
+
+
+def _gather_view(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """pool [L, NB, BS, Nkv, H] + tables [B, NBseq] → view [L, B, Cv, Nkv, H]
+    (Cv = NBseq * BS): each sequence's blocks, concatenated in table order —
+    full layout, view position == absolute token position."""
+    L, _, BS, Nkv, H = pool.shape
+    B, NBseq = tables.shape
+    return pool[:, tables].reshape(L, B, NBseq * BS, Nkv, H)
+
+
+def build_chunk_prefill_fn(apply: Callable, chunk_len: int) -> Callable:
+    """→ jitted ``chunk(params, pool_k, pool_v, table [NBseq], chunk_ids
+    [chunk_len], start, real_len)`` → ``(last_logits [V] fp32, pool_k,
+    pool_v)`` for ONE sequence. ``start`` is the absolute position of the
+    chunk's first token (= prefix-cache hit length for the first chunk);
+    ``real_len`` the unpadded chunk length; ``last_logits`` the logits of
+    token ``start + real_len - 1`` (the first-token sample source once the
+    whole prompt is in)."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def chunk(params, pool_k, pool_v, table, chunk_ids, start, real_len):
+        L, _, BS, Nkv, H = pool_k.shape
+        NBseq = table.shape[0]
+        tables = table[None, :]
+        view = kv_cache.KVCache(
+            k=_gather_view(pool_k, tables),
+            v=_gather_view(pool_v, tables),
+            pos=jnp.full((1, NBseq * BS), -1, jnp.int32),
+            lengths=jnp.zeros((1,), jnp.int32),
+        )
+        kvc, ctx = kv_cache.chunk_ctx(
+            view, chunk_len, start[None].astype(jnp.int32),
+            real_len[None].astype(jnp.int32),
+        )
+        positions = (
+            start.astype(jnp.int32) + jnp.arange(chunk_len, dtype=jnp.int32)
+        )[None, :]
+        primary, new_view = apply(
+            params, chunk_ids[None, :], position_ids=positions, cache=(kvc, ctx)
+        )
+        logits = _logits_of(primary)[0].astype(jnp.float32)  # [chunk_len, V]
+        last = logits[real_len - 1]
+        # scatter the whole view back: fresh blocks carry the chunk's new
+        # K/V; shared prefix blocks rewrite their own gathered bytes
+        # (identical values); padded table entries write to scratch block 0
+        newk = new_view.k.reshape(L, NBseq, BS, Nkv, H)
+        newv = new_view.v.reshape(L, NBseq, BS, Nkv, H)
+        pool_k = pool_k.at[:, table].set(newk)
+        pool_v = pool_v.at[:, table].set(newv)
+        return last, pool_k, pool_v
+
+    return chunk
+
+
+def build_paged_decode_fn(
+    apply: Callable,
+    sampling: SamplingConfig,
+    pad_id: int = 0,
+) -> Callable:
+    """→ jitted ``step(params, pool_k, pool_v, tables [B, NBseq], lengths
+    [B], cur [B], active [B] bool, key, step_idx)`` → ``(next_tokens [B],
+    pool_k, pool_v)``.
+
+    One continuous-batching decode step: every ACTIVE slot advances one
+    token (its K/V written at ``(table[len // BS], len % BS)``); inactive
+    slots (free, or mid-prefill) compute junk that is masked from the
+    sampled output and scattered into scratch block 0. Stop-token/length
+    bookkeeping is the host scheduler's job — this program is stateless."""
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, pool_k, pool_v, tables, lengths, cur, active, key, step_idx):
+        L, _, BS, Nkv, H = pool_k.shape
+        B, NBseq = tables.shape
+        Cv = NBseq * BS
+        lengths = lengths.astype(jnp.int32)
+        j = jnp.arange(Cv, dtype=jnp.int32)
+        pos = jnp.where(j[None, :] < lengths[:, None], j[None, :], -1)
+        view = kv_cache.KVCache(
+            k=_gather_view(pool_k, tables),
+            v=_gather_view(pool_v, tables),
+            pos=pos.astype(jnp.int32),
+            lengths=lengths,
+        )
+        kvc, ctx = kv_cache.decode_ctx(view)
+        primary, new_view = apply(
+            params, cur[:, None], position_ids=ctx.q_pos[:, None],
+            cache=(kvc, ctx),
+        )
+        logits = _logits_of(primary)[:, -1].astype(jnp.float32)
+        nxt = sample(logits, jax.random.fold_in(key, step_idx), sampling)
+        nxt = jnp.where(active, nxt, jnp.int32(pad_id))
+        # scatter exactly the written token back (full layout: the decode
+        # write slot IS the absolute position lengths[b])
+        b_idx = jnp.arange(B)
+        tok_k = new_view.k[:, b_idx, lengths % Cv]  # [L, B, Nkv, H]
+        tok_v = new_view.v[:, b_idx, lengths % Cv]
+        blk = jnp.where(active, tables[b_idx, lengths // BS], 0)
+        off = jnp.where(active, lengths % BS, 0)
+        pool_k = pool_k.at[:, blk, off].set(tok_k)
+        pool_v = pool_v.at[:, blk, off].set(tok_v)
+        return nxt, pool_k, pool_v
+
+    return step

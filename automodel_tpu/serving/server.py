@@ -1,0 +1,326 @@
+"""`automodel_tpu serve` — a thin front on the continuous-batching engine.
+
+Two modes, one engine:
+
+- **stdin-JSONL** (default): one request object per line —
+  ``{"prompt": "..."} | {"prompt_ids": [...]}`` plus optional ``id`` /
+  ``max_new_tokens`` — all submitted into the admission queue, completions
+  printed as JSON lines AS THEY FINISH (continuous batching means short
+  requests return before long ones that arrived earlier).
+- **local HTTP** (``serving.http.port``): POST /generate with the same
+  request object blocks until that request completes; GET /stats returns
+  queue depth / occupancy / allocator counters. A background thread runs
+  the scheduler loop; handlers only enqueue and wait — stdlib
+  ThreadingHTTPServer, no extra dependencies, explicitly a LOCAL/dev front
+  (docs/serving.md covers what a production front needs on top).
+
+Per-request telemetry (``ttft_s``, ``decode_tps``, ``queue_s``,
+``queue_depth``, ``block_occupancy``) rides the PR 2 metrics JSONL via
+``logging.metrics_path`` and is accepted by ``automodel_tpu report
+--strict``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _encode_prompt(req: dict, tokenizer: Any) -> list[int]:
+    if req.get("prompt_ids") is not None:
+        return [int(t) for t in req["prompt_ids"]]
+    prompt = req.get("prompt")
+    if prompt is None:
+        raise ValueError("request needs 'prompt' or 'prompt_ids'")
+    if tokenizer is None:
+        # token-id mode (tiny from-config models): same convention as the
+        # generate CLI — whitespace/comma-separated ids
+        toks = str(prompt).replace(",", " ").split()
+        try:
+            return [int(t) for t in toks]
+        except ValueError:
+            raise ValueError(
+                "no tokenizer available: 'prompt' must be token ids "
+                "(e.g. \"1 2 3\") or configure generation.tokenizer"
+            )
+    if callable(tokenizer):
+        return tokenizer(str(prompt), add_special_tokens=True)["input_ids"]
+    return tokenizer.encode(str(prompt))
+
+
+def _decode_completion(tokens: list[int], tokenizer: Any) -> str:
+    if tokenizer is None:
+        return " ".join(map(str, tokens))
+    return tokenizer.decode(tokens, skip_special_tokens=True)
+
+
+class _EngineLoop:
+    """Background scheduler thread for the HTTP mode: handlers submit under
+    the lock and wait on a per-request event; the loop steps the engine
+    whenever there is work."""
+
+    def __init__(self, engine: Any):
+        self.engine = engine
+        self.lock = threading.Lock()
+        self._events: dict[str, threading.Event] = {}
+        self._results: dict[str, dict] = {}
+        self._abandoned: set[str] = set()  # timed-out waiters: drop on finish
+        self.error: Optional[str] = None  # scheduler-thread death, terminal
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def submit_blocking(
+        self, prompt_ids: list[int], max_new_tokens: Optional[int],
+        timeout_s: float,
+    ) -> dict:
+        ev = threading.Event()
+        with self.lock:
+            if self.error is not None:
+                raise RuntimeError(f"serving engine is down: {self.error}")
+            rid = self.engine.submit(prompt_ids, max_new_tokens=max_new_tokens)
+            self._events[rid] = ev
+        if not ev.wait(timeout=timeout_s):
+            with self.lock:
+                self._events.pop(rid, None)
+                # the request can't be cancelled mid-flight: remember the
+                # abandonment so its eventual completion is discarded
+                # instead of accumulating in _results forever
+                self._abandoned.add(rid)
+            raise TimeoutError(f"request {rid} timed out after {timeout_s}s")
+        with self.lock:
+            if self.error is not None and rid not in self._results:
+                raise RuntimeError(f"serving engine died: {self.error}")
+            return self._results.pop(rid)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self.lock:
+                try:
+                    idle = self.engine.idle()
+                    done = [] if idle else self.engine.step()
+                except Exception as e:  # scheduler death is TERMINAL, not silent
+                    self.error = f"{type(e).__name__}: {e}"
+                    logger.exception("serving scheduler thread died")
+                    # wake every waiter so handlers return 503 immediately
+                    # instead of blocking to their timeout
+                    for ev in self._events.values():
+                        ev.set()
+                    self._events.clear()
+                    return
+                for rec in done:
+                    rid = rec["request_id"]
+                    ev = self._events.pop(rid, None)
+                    if rid in self._abandoned:
+                        self._abandoned.discard(rid)  # waiter gave up: drop
+                        continue
+                    self._results[rid] = rec
+                    if ev is not None:
+                        ev.set()
+            if idle:
+                time.sleep(0.005)
+
+
+def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
+    """→ (ThreadingHTTPServer, _EngineLoop), both started. The caller calls
+    ``server.serve_forever()`` (CLI) or drives requests itself (tests) and
+    shuts both down."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    loop = _EngineLoop(engine)
+    loop.start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route to logging, not stderr
+            logger.debug("http: " + fmt, *args)
+
+        def _json(self, code: int, obj: dict) -> None:
+            body = (json.dumps(obj) + "\n").encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path != "/stats":
+                return self._json(404, {"error": f"unknown path {self.path}"})
+            with loop.lock:
+                self._json(200, {
+                    "queue_depth": engine.queue_depth,
+                    "busy_slots": engine.busy_slots,
+                    "completed_total": engine.completed_total,
+                    "block_occupancy": engine.pool.occupancy(),
+                    "allocator": dict(engine.pool.counters),
+                })
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._json(404, {"error": f"unknown path {self.path}"})
+            from automodel_tpu.serving.engine import QueueFull
+
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                ids = _encode_prompt(req, tokenizer)
+                rec = loop.submit_blocking(
+                    ids, req.get("max_new_tokens"),
+                    timeout_s=float(req.get("timeout_s", 300.0)),
+                )
+            except (ValueError, TypeError) as e:
+                return self._json(400, {"error": str(e)})
+            except QueueFull as e:
+                # backpressure the client can act on — never a dropped
+                # connection (the documented contract)
+                return self._json(429, {"error": str(e)})
+            except TimeoutError as e:
+                return self._json(504, {"error": str(e)})
+            except RuntimeError as e:  # scheduler thread died
+                return self._json(503, {"error": str(e)})
+            out = dict(rec)
+            out["completion"] = _decode_completion(rec["tokens"], tokenizer)
+            if req.get("id") is not None:
+                out["id"] = req["id"]
+            self._json(200, out)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    server._engine_loop = loop  # for the caller's shutdown path
+    return server, loop
+
+
+def main(cfg: Any) -> int:
+    """`automodel_tpu serve -c cfg.yaml` (stdin-JSONL, or HTTP when
+    serving.http.port is set)."""
+    from automodel_tpu.generation.engine import (
+        GenerationConfig,
+        build_auto_from_cfg,
+        resolve_tokenizer,
+    )
+    from automodel_tpu.loggers.log_utils import setup_logging
+    from automodel_tpu.serving.engine import ServeConfig, ServingEngine
+
+    setup_logging()
+    serve_section = dict(cfg.get("serving", {}) or {})
+    http_section = dict(serve_section.get("http") or {})
+    serve_cfg = ServeConfig.from_dict(serve_section)
+    gen_section = dict(cfg.get("generation", {}) or {})
+    gen_cfg = GenerationConfig.from_dict(gen_section)
+    tokenizer = resolve_tokenizer(
+        gen_section.get("tokenizer"),
+        cfg.model.get("pretrained_model_name_or_path"),
+    )
+
+    auto = build_auto_from_cfg(cfg)
+    on_record = None
+    metrics_path = (cfg.get("logging") or {}).get("metrics_path") if cfg.get("logging") else None
+    metric_logger = None
+    if metrics_path:
+        from automodel_tpu.loggers.metric_logger import MetricLogger
+
+        metric_logger = MetricLogger(metrics_path)
+
+        def on_record(rec: dict) -> None:
+            rec = dict(rec)
+            rec.pop("tokens", None)  # completions don't belong in metrics
+            metric_logger.log(rec)
+
+    engine = ServingEngine(
+        auto, serve_cfg, gen_cfg, on_record=on_record
+    )
+
+    if http_section.get("port") is not None:
+        port = int(http_section["port"])
+        host = str(http_section.get("host", "127.0.0.1"))
+        server, loop = serve_http(engine, tokenizer, port, host=host)
+        print(
+            json.dumps({
+                "event": "serve_listening",
+                "host": host, "port": server.server_address[1],
+                "slots": serve_cfg.slots, "num_blocks": serve_cfg.num_blocks,
+            }),
+            flush=True,
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.shutdown()
+            loop.close()
+            if metric_logger is not None:
+                metric_logger.close()
+        return 0
+
+    # stdin-JSONL: submit every line, print completions as they finish. A
+    # bad line is THAT client's error — it gets an error JSON line and the
+    # batch continues; crashing here would destroy every other request's
+    # in-flight work.
+    from automodel_tpu.serving.engine import QueueFull
+
+    n_submitted, n_bad = 0, 0
+    for lineno, line in enumerate(sys.stdin, 1):
+        line = line.strip()
+        if not line:
+            continue
+        rid = None
+        try:
+            req = json.loads(line)
+            if not isinstance(req, dict):
+                raise ValueError("request line is not a JSON object")
+            rid = req.get("id")
+            ids = _encode_prompt(req, tokenizer)
+            while True:
+                try:
+                    engine.submit(
+                        ids,
+                        request_id=str(rid) if rid is not None else None,
+                        max_new_tokens=req.get("max_new_tokens"),
+                    )
+                    break
+                except QueueFull:
+                    # bounded queue + unbounded stdin: drain a step, retry
+                    for rec in engine.step():
+                        _emit(rec, tokenizer)
+        except (ValueError, TypeError) as e:
+            n_bad += 1
+            err = {"error": f"line {lineno}: {e}"}
+            if rid is not None:
+                err["id"] = rid
+            print(json.dumps(err), flush=True)
+            continue
+        n_submitted += 1
+        # drain opportunistically so early completions stream out while
+        # later lines are still being read
+        for rec in engine.step():
+            _emit(rec, tokenizer)
+    if n_submitted == 0:
+        print(
+            "no requests: pipe JSONL lines like "
+            '{"prompt": "1 2 3", "max_new_tokens": 8} into stdin',
+            file=sys.stderr,
+        )
+        return 2
+    for rec in engine.run():
+        _emit(rec, tokenizer)
+    if metric_logger is not None:
+        metric_logger.close()
+    return 0 if n_bad == 0 else 1
+
+
+def _emit(rec: dict, tokenizer: Any) -> None:
+    out = dict(rec)
+    out["completion"] = _decode_completion(out.pop("tokens"), tokenizer)
+    out.pop("event", None)
+    print(json.dumps(out), flush=True)

@@ -38,6 +38,17 @@ _NUMERIC_KEYS = (
     "decode_tps",
     "gen_tokens",
     "gen_cache_bytes",
+    # serving records (serving/: per-request `serve_request` events + the
+    # sustained-throughput bench leg)
+    "queue_s",
+    "queue_depth",
+    "block_occupancy",
+    "prefix_hit_tokens",
+    "serve_tokens_per_s",
+    "serve_ttft_p50_s",
+    "serve_ttft_p99_s",
+    "serve_block_occupancy_peak",
+    "serve_requests",
     # distributed guard (watchdog liveness, consensus/straggler attribution)
     "heartbeat_age_s",
     "deadline_s",
@@ -176,6 +187,22 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
         ]
         if tpses:
             out["decode_tps_mean"] = sum(tpses) / len(tpses)
+    serves = [r for r in records if r.get("event") == "serve_request"]
+    if serves:
+        out["serve_requests"] = len(serves)
+        ttfts = sorted(
+            r["ttft_s"] for r in serves
+            if isinstance(r.get("ttft_s"), (int, float))
+        )
+        if ttfts:
+            out["serve_ttft_p50_s"] = ttfts[len(ttfts) // 2]
+            out["serve_ttft_max_s"] = ttfts[-1]
+        occ = [
+            r["block_occupancy"] for r in serves
+            if isinstance(r.get("block_occupancy"), (int, float))
+        ]
+        if occ:
+            out["serve_block_occupancy_peak"] = max(occ)
     return out
 
 
@@ -199,6 +226,7 @@ _BENCH_LEGS = (
     ("qlora_8b_mfu_pct", "qlora_8b_failure"),
     ("moe_mfu_pct", "moe_failures"),
     ("gen_decode_tps", "gen_failure"),
+    ("serve_tokens_per_s", "serve_failure"),
 )
 
 

@@ -126,17 +126,32 @@ def _reset_between_legs() -> None:
     gc.collect()
 
 
+_first_oom_pending = True
+
+
 def _oom_memory_dump(leg: str) -> str | None:
     """Force-dump allocator stats + the live-array census when a leg dies,
     BEFORE _reset_between_legs frees the buffers — the census names what
     filled the chip (the diagnostic every all-zero BENCH_r05 leg lacked).
-    → dump path, or None if even the dump failed."""
+    The dump records the leg name and whether this was the FIRST OOM of the
+    run: only the first one sees the chip in its pristine failure state —
+    later legs run after resets and their censuses reflect cascade, not
+    cause. → dump path, or None if even the dump failed."""
+    global _first_oom_pending
     try:
         from automodel_tpu.telemetry.memory import memory_snapshot
 
         path = f"bench_oom_{leg}.json"
         with open(path, "w") as f:
-            json.dump(memory_snapshot(top_k=12), f, indent=2, default=str)
+            json.dump(
+                {
+                    "leg": leg,
+                    "first_oom": _first_oom_pending,
+                    **memory_snapshot(top_k=12),
+                },
+                f, indent=2, default=str,
+            )
+        _first_oom_pending = False
         print(f"[bench] memory census for failed {leg} leg → {path}",
               file=sys.stderr, flush=True)
         return path
@@ -274,32 +289,89 @@ def _run(hf, backend, batch, seq, steps, ctx, lora=False, qlora=False):
     return tps_chip, flops_per_token_for_config(auto.model.config, seq)
 
 
-def _probe_tpu(timeout_s: float = 300) -> str:
+# stderr signatures of a broken TPU ENVIRONMENT (as opposed to a flaky
+# tunnel or a genuinely TPU-less host): the libtpu client/terminal version
+# mismatch class that zeroed BENCH_r05 — the backend initializes, every op
+# fails. (substring-pair, both must appear, case-insensitive)
+_ENV_FAILURE_SIGNATURES: tuple[tuple[str, str], ...] = (
+    ("libtpu", "version"),
+    ("libtpu", "mismatch"),
+    ("tpu driver", "version"),
+    ("client version", ""),
+    ("terminal version", ""),
+    ("pjrt api version", ""),
+    ("plugin", "incompatible"),
+)
+
+
+def classify_env_failure(stderr_text: str) -> str | None:
+    """Match a failed TPU probe's stderr against the known environment-
+    failure signatures (libtpu client/terminal version mismatch and kin).
+    → a named reason quoting the offending line, or None (not an
+    environment failure — tunnel flake / plain no-TPU host)."""
+    if not stderr_text:
+        return None
+    low = stderr_text.lower()
+    for a, b in _ENV_FAILURE_SIGNATURES:
+        if a in low and (not b or b in low):
+            line = next(
+                (
+                    ln.strip()
+                    for ln in stderr_text.splitlines()
+                    if a in ln.lower() and (not b or b in ln.lower())
+                ),
+                "",
+            ) or next(
+                (ln.strip() for ln in stderr_text.splitlines() if a in ln.lower()),
+                a,
+            )
+            return f"libtpu/TPU runtime environment failure ({a}): {line[:300]}"
+    return None
+
+
+def _probe_tpu(timeout_s: float = 300) -> tuple[str, str]:
     """Check the (tunneled) TPU backend in a SUBPROCESS with a timeout —
     a dead tunnel blocks jax's backend init for many minutes, which would
-    otherwise hang the whole bench. Returns 'tpu', 'no-tpu' (probe completed,
-    backend is not tpu) or 'flake' (probe hung/crashed — tunnel trouble)."""
+    otherwise hang the whole bench. The probe DISPATCHES one op, not just
+    lists devices: a libtpu version mismatch initializes fine and fails
+    every op, which previously read as 0.0-valued legs. Returns (status,
+    stderr): status 'tpu', 'no-tpu' (probe completed, backend is not tpu or
+    is unusable — stderr says which) or 'flake' (probe hung/crashed)."""
     import subprocess
 
+    probe_src = (
+        "import jax, numpy, sys\n"
+        "d = jax.devices()[0]\n"
+        "if d.platform != 'tpu':\n"
+        "    sys.exit(1)\n"
+        "jax.block_until_ready(jax.device_put(numpy.zeros((8, 8), numpy.float32), d) @ "
+        "jax.device_put(numpy.zeros((8, 8), numpy.float32), d))\n"
+    )
     try:
         r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, sys; sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)"],
+            [sys.executable, "-c", probe_src],
             timeout=timeout_s, capture_output=True,
         )
-        return "tpu" if r.returncode == 0 else "no-tpu"
-    except Exception:
-        return "flake"
+        stderr = (r.stderr or b"").decode(errors="replace")
+        return ("tpu" if r.returncode == 0 else "no-tpu"), stderr
+    except Exception as exc:
+        return "flake", str(exc)
 
 
-def _wait_for_tpu() -> bool:
+def _wait_for_tpu() -> tuple[bool, str | None]:
     """Bounded retry around the subprocess probe: the tunnel goes down for
     stretches (it cost round 4 its entire perf evidence — VERDICT r4 weak
     #7), and a transient outage at bench time shouldn't zero a round. Total
     wait bounded by BENCH_TPU_WAIT_S (default 20 min), each probe bounded by
     BENCH_TPU_PROBE_S; set BENCH_TPU_WAIT_S=0 for a single probe. A clean
     'no-tpu' probe with no axon tunnel configured exits immediately — there
-    is no TPU to wait for on such a host."""
+    is no TPU to wait for on such a host.
+
+    → (tpu_ok, env_failure_reason). A probe whose stderr matches the
+    environment-failure signatures (libtpu client/terminal version
+    mismatch) SHORT-CIRCUITS: waiting cannot fix a version skew, and the
+    caller must report a named environment failure instead of quietly
+    benching the CPU."""
     wait_s = float(os.environ.get("BENCH_TPU_WAIT_S", 1200))
     probe_s = float(os.environ.get("BENCH_TPU_PROBE_S", 180))
     tunneled = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
@@ -307,14 +379,17 @@ def _wait_for_tpu() -> bool:
     attempt = 0
     while True:
         attempt += 1
-        status = _probe_tpu(probe_s)
+        status, stderr = _probe_tpu(probe_s)
         if status == "tpu":
-            return True
+            return True, None
+        env_reason = classify_env_failure(stderr)
+        if env_reason is not None:
+            return False, env_reason
         if status == "no-tpu" and not tunneled:
-            return False
+            return False, None
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            return False
+            return False, None
         print(
             f"[bench] TPU probe {attempt} {status}; retrying "
             f"({remaining:.0f}s of wait budget left)",
@@ -324,7 +399,23 @@ def _wait_for_tpu() -> bool:
 
 
 def main() -> None:
-    if not _wait_for_tpu():
+    tpu_ok, env_failure = _wait_for_tpu()
+    if env_failure is not None:
+        # a version-skewed libtpu is an ENVIRONMENT failure, not a
+        # measurement: name it and exit non-zero. Reporting 0.0-valued (or
+        # CPU-smoke) legs here is exactly the VERDICT-r5 failure mode.
+        print(
+            json.dumps(
+                {
+                    "metric": "environment_failure",
+                    "value": None,
+                    "environment_failure": env_failure,
+                }
+            )
+        )
+        print(f"[bench] ENVIRONMENT FAILURE: {env_failure}", file=sys.stderr, flush=True)
+        raise SystemExit(2)
+    if not tpu_ok:
         print("[bench] TPU backend unavailable; pinning cpu", file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
 

@@ -29,6 +29,67 @@ def test_dense_flops_sane():
     assert 0 < calculate_mfu(10_000, fpt, peak_tflops=459.0) < 1.5
 
 
+def test_bench_classify_env_failure():
+    """bench.py environment-failure detection: a libtpu client/terminal
+    version mismatch in the probe's stderr is a NAMED environment failure;
+    tunnel flakes and plain no-TPU hosts are not (ROADMAP item 3 — an
+    environment failure must report as such, never as 0.0-valued legs)."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_module", Path(__file__).resolve().parent.parent / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    mismatch = (
+        "RuntimeError: Invalid argument: The libtpu version mismatch: "
+        "client version 0.0.17 is incompatible with terminal version 0.0.21\n"
+    )
+    reason = bench.classify_env_failure(mismatch)
+    assert reason is not None and "libtpu" in reason
+    assert "0.0.17" in reason  # quotes the offending line
+
+    assert bench.classify_env_failure(
+        "TPU driver version skew detected\n"
+    ) is not None
+    assert bench.classify_env_failure(
+        "PJRT API version 0.40 is older than the framework's\n"
+    ) is not None
+
+    # NOT environment failures: tunnel flake / garden-variety no-TPU
+    assert bench.classify_env_failure("") is None
+    assert bench.classify_env_failure("Connection reset by peer") is None
+    assert bench.classify_env_failure(
+        "RuntimeError: Backend 'tpu' is not in the list of known backends"
+    ) is None
+
+
+def test_bench_oom_dump_records_leg_and_first_oom(tmp_path, monkeypatch):
+    """bench_oom_<leg>.json carries the leg name, a first_oom flag, and the
+    live-buffer census (the first dump sees the pristine failure state;
+    later dumps are cascade)."""
+    import importlib.util
+    import os
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_module2", Path(__file__).resolve().parent.parent / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.chdir(tmp_path)
+    assert bench._first_oom_pending is True
+    p1 = bench._oom_memory_dump("dense_8b")
+    p2 = bench._oom_memory_dump("moe_ragged")
+    d1 = json.loads(Path(p1).read_text())
+    d2 = json.loads(Path(p2).read_text())
+    assert d1["leg"] == "dense_8b" and d1["first_oom"] is True
+    assert d2["leg"] == "moe_ragged" and d2["first_oom"] is False
+    assert "census" in d1 and "devices" in d1  # live-buffer HBM census
+
+
 def test_benchmark_recipe_cli(tmp_path):
     from automodel_tpu.cli.app import main as cli_main
 

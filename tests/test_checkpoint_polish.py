@@ -196,3 +196,179 @@ def test_native_layout_marker_gates_restore(tmp_path):
     # models without a layout contract load old checkpoints unchanged
     restored2, _ = ck2.load(abstract)
     np.testing.assert_array_equal(np.asarray(restored2["w"]), np.arange(4.0))
+
+
+def test_param_signature_guard_refuses_mismatched_tree(tmp_path):
+    """Production-resume guard (ROADMAP 5c, reference base_recipe.py:
+    768-850): a checkpoint whose param-tree structure/shapes mismatch the
+    BUILT model refuses loudly — naming the differing paths — instead of
+    crashing mid-restore or half-loading."""
+    import jax.numpy as jnp
+
+    from automodel_tpu.checkpoint.checkpointer import (
+        Checkpointer,
+        CheckpointingConfig,
+        param_tree_signature,
+    )
+
+    state = {"a": jnp.arange(4.0), "b": {"w": jnp.ones((2, 3))}}
+    ck = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "run")))
+    ck.save(state, epoch=0, step=1)
+
+    abstract_ok = jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
+    restored, extra = ck.load(abstract_ok)
+    assert "_param_signature" in extra
+    np.testing.assert_array_equal(np.asarray(restored["a"]), np.arange(4.0))
+
+    # shape change → refused, naming the path
+    bad_shape = dict(abstract_ok, b={"w": jax.ShapeDtypeStruct((2, 4), np.float32)})
+    with pytest.raises(ValueError, match="signature mismatches"):
+        ck.load(bad_shape)
+    with pytest.raises(ValueError, match="b.*w"):
+        ck.load(bad_shape)
+    # structure change (missing / extra leaf) → refused
+    with pytest.raises(ValueError, match="checkpoint has but model lacks"):
+        ck.load({"a": abstract_ok["a"]})
+    with pytest.raises(ValueError, match="model expects but checkpoint lacks"):
+        ck.load({**abstract_ok, "c": jax.ShapeDtypeStruct((1,), np.float32)})
+    # dtype change → refused
+    with pytest.raises(ValueError, match="signature mismatches"):
+        ck.load(dict(abstract_ok, a=jax.ShapeDtypeStruct((4,), np.int32)))
+    # escape hatch for deliberate surgery
+    ck_off = Checkpointer(
+        CheckpointingConfig(
+            checkpoint_dir=str(tmp_path / "run"), check_param_signature=False
+        )
+    )
+    restored2, _ = ck_off.load(abstract_ok)
+    np.testing.assert_array_equal(np.asarray(restored2["a"]), np.arange(4.0))
+
+    # legacy checkpoint without a signature loads unchanged
+    ck_legacy = Checkpointer(
+        CheckpointingConfig(
+            checkpoint_dir=str(tmp_path / "old"), check_param_signature=False
+        )
+    )
+    ck_legacy.save(state, epoch=0, step=1)
+    ck_new = Checkpointer(CheckpointingConfig(checkpoint_dir=str(tmp_path / "old")))
+    restored3, extra3 = ck_new.load(abstract_ok)
+    assert "_param_signature" not in extra3
+    np.testing.assert_array_equal(np.asarray(restored3["a"]), np.arange(4.0))
+
+    # the signature itself is stable and order-independent
+    sig = param_tree_signature(state)
+    assert sig["digest"] == param_tree_signature(
+        {"b": state["b"], "a": state["a"]}
+    )["digest"]
+
+
+def test_best_val_marker_and_prune_protection(tmp_path):
+    """BEST.json + `best` symlink track the best-val checkpoint, and the
+    marked dir outlives keep_last_k pruning (production resume/export
+    points at it long after the cadence window moved)."""
+    import jax.numpy as jnp
+
+    from automodel_tpu.checkpoint.checkpointer import Checkpointer, CheckpointingConfig
+
+    ck = Checkpointer(
+        CheckpointingConfig(checkpoint_dir=str(tmp_path / "run"), keep_last_k=2)
+    )
+    state = {"w": jnp.arange(4.0)}
+    d1 = ck.save(state, epoch=0, step=1)
+    ck.mark_best(d1, "val_loss", 0.5)
+    info = ck.best_info()
+    assert info["dir"] == d1.name and info["value"] == 0.5
+    assert info["metric"] == "val_loss" and info["step"] == 1
+    link = ck.root / "best"
+    if link.is_symlink():
+        assert (link / "MANIFEST.json").exists()
+    # later saves push past keep_last_k: the best dir survives, the other
+    # old dir is pruned
+    d2 = ck.save(state, epoch=0, step=2)
+    d3 = ck.save(state, epoch=0, step=3)
+    d4 = ck.save(state, epoch=0, step=4)
+    assert d1.exists(), "best-marked checkpoint was pruned"
+    assert not d2.exists()
+    assert d3.exists() and d4.exists()
+    # a better metric moves the marker
+    ck.mark_best(d4, "val_loss", 0.25)
+    assert ck.best_info()["dir"] == d4.name
+    # the old best is no longer protected: the next prune reclaims it
+    ck.save(state, epoch=0, step=5)
+    assert not d1.exists()
+
+
+def test_best_marker_defers_until_async_commit(tmp_path):
+    """mark_best on a dir whose ASYNC save is still in flight must not
+    write BEST.json until the save commits — the marker must never name an
+    uncommitted (auto-resume-skipped) tree."""
+    import jax.numpy as jnp
+
+    from automodel_tpu.checkpoint.checkpointer import Checkpointer, CheckpointingConfig
+
+    ck = Checkpointer(
+        CheckpointingConfig(checkpoint_dir=str(tmp_path / "run"), is_async=True)
+    )
+    d1 = ck.save({"w": jnp.arange(4.0)}, epoch=0, step=1)
+    ck.mark_best(d1, "val_loss", 0.5)  # save not yet committed
+    assert ck.best_info() is None or (d1 / "MANIFEST.json").exists()
+    ck.wait()  # drain + commit → the deferred marker lands
+    assert (d1 / "MANIFEST.json").exists()
+    info = ck.best_info()
+    assert info is not None and info["dir"] == d1.name and info["value"] == 0.5
+    ck.close()
+
+
+def test_train_ft_marks_best_checkpoint(tmp_path, devices8, monkeypatch):
+    """End to end: a train run with validation + cadence saves stamps
+    BEST.json on a really-saved, restorable checkpoint."""
+    import json as _json
+
+    monkeypatch.setattr(jax, "devices", lambda *a: devices8)
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.train_ft import main
+
+    cfg = ConfigNode(
+        {
+            "seed": 3,
+            "model": {
+                "hf_config": HF_TINY,
+                "backend": {
+                    "attn": "sdpa", "param_dtype": "float32",
+                    "compute_dtype": "float32",
+                },
+            },
+            "distributed": {"dp_shard": 8},
+            "dataset": {
+                "_target_": "automodel_tpu.data.sft.MockSFTDataset",
+                "vocab_size": 64, "seq_length": 16, "num_samples": 32,
+            },
+            "validation_dataset": {
+                "_target_": "automodel_tpu.data.sft.MockSFTDataset",
+                "vocab_size": 64, "seq_length": 16, "num_samples": 8,
+            },
+            "dataloader": {"global_batch_size": 8},
+            "step_scheduler": {
+                "grad_acc_steps": 1, "num_epochs": 2, "max_steps": 6,
+                "val_every_steps": 2, "ckpt_every_steps": 2,
+            },
+            "optimizer": {"name": "adamw", "lr": 1e-3},
+            "loss_fn": {"name": "masked_ce"},
+            "logging": {"metrics_path": str(tmp_path / "m.jsonl")},
+            "checkpoint": {
+                "enabled": True,
+                "checkpoint_dir": str(tmp_path / "ckpts"),
+                "keep_last_k": 2,
+            },
+        }
+    )
+    main(cfg)
+    best = _json.loads((tmp_path / "ckpts" / "BEST.json").read_text())
+    best_dir = tmp_path / "ckpts" / best["dir"]
+    assert best_dir.exists() and (best_dir / "MANIFEST.json").exists()
+    assert best["metric"] == "val_loss" and np.isfinite(best["value"])
+    # and the checkpoint auditor finds the best dir verified/committed
+    from automodel_tpu.checkpoint.verify import audit_dir
+
+    audit = audit_dir(best_dir)
+    assert audit["committed"] and audit["ok"], audit

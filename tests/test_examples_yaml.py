@@ -119,6 +119,13 @@ def test_example_yaml_parses_and_dry_instantiates(path):
             gen.pop(recipe_key, None)
         GenerationConfig.from_dict(gen)
 
+    # serving: → ServeConfig (minus the server-level http: subsection)
+    srv = _section(cfg, "serving")
+    if srv is not None:
+        from automodel_tpu.serving.engine import ServeConfig
+
+        ServeConfig.from_dict(srv)
+
     # launcher sections → SlurmConfig / K8sConfig
     sl = _section(cfg, "slurm")
     if sl is not None:
@@ -150,3 +157,7 @@ def test_config_dataclasses_reject_unknown_keys():
     with pytest.raises(TypeError):
         DistributedGuardConfig(watchdogg={})
     assert dataclasses.is_dataclass(DistributedGuardConfig)
+    from automodel_tpu.serving.engine import ServeConfig
+
+    with pytest.raises(TypeError):
+        ServeConfig.from_dict({"block_sizee": 8})

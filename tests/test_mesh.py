@@ -52,6 +52,96 @@ def test_sharded_array_placement(devices8):
     assert arr.sharding.spec == P("dp_shard", "tp")
 
 
+# ---- HSDP (dp_replicate > 1) -----------------------------------------------
+
+
+def test_hsdp_axes_and_batch_spec(devices8):
+    """pp1·rep2·shard2·tp2: replicate axis participates in batch/loss
+    groupings but NOT in fsdp param sharding (params replicate across
+    replicas — the HSDP contract; reference mesh_utils.py:190-197)."""
+    ctx = build_mesh(MeshConfig(dp_replicate=2, dp_shard=2, tp=2), devices=devices8)
+    assert ctx.size("dp_replicate") == 2 and ctx.dp_size == 4
+    assert ctx.resolve(("batch", None)) == P(("dp_replicate", "dp_shard"))
+    assert ctx.resolve(("fsdp", "tensor")) == P("dp_shard", "tp")
+    assert ctx.resolve(("loss_dp",)) == P(("dp_replicate", "dp_shard"))
+
+
+def test_hsdp_grads_parity_vs_pure_fsdp(devices8):
+    """One full optimizer step on the SAME model/data must produce the same
+    loss and updated params under HSDP (rep2·shard2·tp2) and pure FSDP
+    (shard4·tp2) — dp_replicate only changes WHERE the grads all-reduce,
+    never what they are. This is the first place dp_replicate > 1 actually
+    executes a step anywhere in the tree (ROADMAP item 4)."""
+    from automodel_tpu import auto_model
+    from automodel_tpu.data.loader import place_batch
+    from automodel_tpu.optim.builders import build_optimizer
+    from automodel_tpu.training.train_state import TrainState
+    from automodel_tpu.training.train_step import build_train_step, make_causal_lm_loss
+
+    hf = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": 128,
+        "hidden_size": 64,
+        "intermediate_size": 128,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "head_dim": 16,
+        "max_position_embeddings": 128,
+    }
+    backend = {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32"}
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, size=(2, 8, 16))
+    batch_np = {
+        "input_ids": np.asarray(ids, np.int32),
+        "labels": np.concatenate(
+            [ids[..., 1:], np.full((2, 8, 1), -100)], axis=-1
+        ).astype(np.int32),
+    }
+
+    # one host init feeds BOTH meshes: sharded init is layout-dependent for
+    # fsdp-sharded leaves (partitionable RNG), and this test is about the
+    # STEP math, not init reproducibility across mesh shapes
+    seed_ctx = build_mesh(MeshConfig(dp_shard=4, tp=2), devices=devices8)
+    params_host = jax.tree.map(
+        np.asarray,
+        jax.device_get(auto_model.from_config(hf, seed_ctx, backend, seed=0).params),
+    )
+
+    def one_step(cfg: MeshConfig):
+        ctx = build_mesh(cfg, devices=devices8)
+        auto = auto_model.from_config(hf, ctx, backend, seed=0)
+        auto.params = jax.device_put(params_host, ctx.replicated())
+        optimizer = build_optimizer(name="adamw", lr=1e-2, grad_clip_norm=1.0)
+        state = TrainState.create(auto.params, jax.jit(optimizer.init)(auto.params))
+        loss_fn = make_causal_lm_loss(
+            auto.model, loss="masked_ce", constrain=auto.constrain
+        )
+        step = build_train_step(loss_fn, optimizer)
+        state, metrics = step(state, place_batch(ctx, batch_np))
+        return (
+            float(jax.device_get(metrics["loss"])),
+            jax.tree.map(np.asarray, jax.device_get(state.params)),
+        )
+
+    loss_h, params_h = one_step(MeshConfig(dp_replicate=2, dp_shard=2, tp=2))
+    loss_f, params_f = one_step(MeshConfig(dp_shard=4, tp=2))
+    assert np.isfinite(loss_h)
+    np.testing.assert_allclose(loss_h, loss_f, rtol=1e-5)
+    flat_h = jax.tree_util.tree_leaves_with_path(params_h)
+    flat_f = dict(
+        ("/".join(map(str, p)), leaf)
+        for p, leaf in jax.tree_util.tree_leaves_with_path(params_f)
+    )
+    assert flat_h and len(flat_h) == len(flat_f)
+    for path, leaf in flat_h:
+        np.testing.assert_allclose(
+            leaf, flat_f["/".join(map(str, path))], atol=2e-5, rtol=2e-4,
+            err_msg=f"param {path} diverged between HSDP and FSDP",
+        )
+
+
 # ---- multi-host init + hybrid DCN x ICI (VERDICT r2 weak #7) ---------------
 def test_hybrid_mesh_shapes_default_lays_data_axes_on_dcn():
     from automodel_tpu.parallel.mesh import MeshConfig, hybrid_mesh_shapes
