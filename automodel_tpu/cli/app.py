@@ -22,7 +22,8 @@ def _usage() -> str:
         "usage: automodel_tpu <finetune|pretrain|kd|benchmark|mine> <llm|vlm|biencoder> "
         "-c config.yaml [--dotted.key=value ...]\n"
         "       automodel_tpu generate -c config.yaml [--prompt '...'] [--dotted.key=value ...]\n"
-        "       automodel_tpu serve -c config.yaml [--dotted.key=value ...]  (stdin-JSONL; serving.http.port for HTTP)\n"
+        "       automodel_tpu serve -c config.yaml [--dotted.key=value ...]  (stdin-JSONL; serving.http.port for HTTP; GET /metrics)\n"
+        "       automodel_tpu profile -c config.yaml [--profiling.mode=train|generate] [--dotted.key=value ...]\n"
         "       automodel_tpu report <train_metrics.jsonl> [--strict]\n"
         "       automodel_tpu verify-ckpt <ckpt_dir> [--no-checksums] [--json]"
     )
@@ -83,6 +84,16 @@ def main(argv: list[str] | None = None) -> int:
         cfg = parse_args_and_load_config(argv[1:])
         initialize_distributed()
         return serve_main(cfg)
+    # `profile` opens a jax.profiler trace window around N steps of the
+    # configured workload and GENERATES the PROFILE artifacts (structured
+    # report.json + PROFILE.md) — telemetry/profiling/runner.py
+    if argv and argv[0] == "profile":
+        from automodel_tpu.parallel.mesh import initialize_distributed
+        from automodel_tpu.telemetry.profiling.runner import main as profile_main
+
+        cfg = parse_args_and_load_config(argv[1:])
+        initialize_distributed()
+        return profile_main(cfg)
     if len(argv) < 2 or argv[0] in ("-h", "--help"):
         print(_usage())
         return 0 if argv and argv[0] in ("-h", "--help") else 2

@@ -144,6 +144,12 @@ class GenerationEngine:
         # per-host deterministic base stream; the decode loop folds the
         # step index in per token (training/rng.sampling_key)
         self._base_key = sampling_key(self.config.seed)
+        # cost attribution (telemetry/profiling/): when armed, the next
+        # generate_ids also records measured FLOPs/bytes of the prefill and
+        # decode programs (abstract host trace; decode's while body counts
+        # once = per-token cost)
+        self.collect_program_costs = False
+        self.program_costs: dict = {}
 
     # -- cache ---------------------------------------------------------------
     def _make_cache(
@@ -202,6 +208,11 @@ class GenerationEngine:
 
         cache = self._make_cache(B, S, lengths)
         cache_bytes = cache.nbytes
+        if self.collect_program_costs and "prefill" not in self.program_costs:
+            self._record_cost(
+                "prefill", self._prefill,
+                params, jnp.asarray(ids), jnp.asarray(lengths), cache,
+            )
         t0 = time.perf_counter()
         last_logits, cache = self._prefill(
             params, jnp.asarray(ids), jnp.asarray(lengths), cache
@@ -213,6 +224,10 @@ class GenerationEngine:
         first = jax.block_until_ready(first)
         ttft_s = time.perf_counter() - t0
 
+        if self.collect_program_costs and "decode" not in self.program_costs:
+            self._record_cost(
+                "decode", self._decode, params, cache, first, self._base_key
+            )
         t1 = time.perf_counter()
         result, cache = self._decode(params, cache, first, self._base_key)
         result = jax.device_get(result)
@@ -236,6 +251,11 @@ class GenerationEngine:
             "decode_tps": decode_tokens / decode_s if decode_s > 0 else 0.0,
             "cache_bytes": cache_bytes,
         }
+
+    def _record_cost(self, name: str, jit_fn, *args) -> None:
+        from automodel_tpu.telemetry.profiling import record_program_cost
+
+        record_program_cost(self.program_costs, name, jit_fn, *args)
 
     def generate(self, prompts: Sequence[str], params: Any = None) -> dict:
         """Text in, text out (requires a tokenizer). Returns the

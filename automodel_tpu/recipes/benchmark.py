@@ -46,6 +46,8 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
                 return self._run_benchmark_body()
         finally:
             self.guard.close()
+            if getattr(self, "_prom_server", None) is not None:
+                self._prom_server.shutdown()
 
     def _run_benchmark_body(self) -> dict:
         bcfg = dict(self.cfg.get("benchmark", {}) or {})
@@ -158,6 +160,26 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
         # → null values WITH a recorded reason, never a silent 0.0.
         with self.guard.phase("eval"):
             result.update(self._serving_leg())
+        # cost attribution (telemetry/profiling/cost.py): measured FLOPs of
+        # the ACTUAL step program beside the analytic law the `mfu` key is
+        # built from — plus the roofline class for this leg. Drift between
+        # `mfu` and `mfu_measured_pct` is the report's headline, not a bug
+        # in either: it quantifies what the analytic law does not count
+        # (remat recompute, dense-computed experts, fused heads).
+        if self.profiling.enabled and self.profiling.cost_attribution:
+            try:
+                # NOT `as prof` — that would shadow the StepProfiler above
+                from automodel_tpu.telemetry import profiling as profmod
+
+                cost = profmod.program_cost(
+                    self.train_step, self.state, batch, program="train_step"
+                )
+                basis = self.profiling.roofline_basis()
+                result["cost"] = {**cost.to_dict(), **profmod.roofline(cost, basis)}
+                m = profmod.mfu_measured_pct(cost.flops, mean_s, n_chips, basis)
+                result["mfu_measured_pct"] = round(m, 3) if m is not None else None
+            except Exception as e:
+                result["cost_error"] = f"{type(e).__name__}: {e}"
         pinfo = getattr(self.model, "pipeline_info", None)
         if pinfo:
             from automodel_tpu.utils.flops_utils import pipeline_bubble_fraction

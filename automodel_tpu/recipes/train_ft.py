@@ -263,6 +263,30 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         else:
             self.step_scheduler.install_signal_handler()
 
+        # run-artifact routing: everything a run writes (metrics JSONL,
+        # flight recorder, watchdog stacks, trace dirs, triggered captures)
+        # lands under ONE per-run `output_dir` — never the CWD. An explicit
+        # logging.metrics_path still wins (tests, operators pinning paths);
+        # the default used to be ./train_metrics.jsonl, which littered the
+        # repo root and mixed runs. The default is keyed on a CONFIG
+        # fingerprint, not a timestamp: a preempted-and-requeued run (or
+        # its multi-host peers) must land in the SAME dir so the metrics
+        # JSONL and flight-recorder evidence stay one continuous record
+        # across restarts (JSONL appends are flock-guarded, so sharing is
+        # safe by construction).
+        import zlib
+        from pathlib import Path
+
+        out_dir = cfg.get("output_dir")
+        if out_dir is None:
+            import json as _json
+
+            fp = zlib.crc32(
+                _json.dumps(cfg.to_dict(), sort_keys=True, default=str).encode()
+            )
+            out_dir = str(Path("runs") / f"run_{fp:08x}")
+        self.output_dir = Path(out_dir)
+
         # metrics (JSONL + optional wandb/MLflow fan-out,
         # reference train_ft.py:844-853) — built BEFORE the checkpointer so
         # the startup auto-resume can stamp its resume marker
@@ -279,7 +303,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
 
             sinks.append(MLflowLogger(**dict(log_cfg.get("mlflow") or {})))
         self.metric_logger = MetricLogger(
-            log_cfg.get("metrics_path", "train_metrics.jsonl"),
+            log_cfg.get("metrics_path", str(self.output_dir / "train_metrics.jsonl")),
             wandb_run=wandb_run,
             sinks=sinks,
         )
@@ -296,7 +320,57 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             default_recorder_path=str(
                 self.metric_logger.path.parent / "flight_recorder.json"
             ),
+            default_trace_dir=str(self.output_dir / "trace"),
         )
+
+        # profiling pillar (telemetry/profiling/): cost-attributed MFU on
+        # the log records (computed once at step 1, folded per window) and
+        # the anomaly-armed triggered capture. On by default — a cheap host
+        # trace at step 1, nothing on the hot path.
+        from automodel_tpu.telemetry.profiling import ProfilingConfig
+
+        self.profiling = ProfilingConfig.from_dict(dict(cfg.get("profiling") or {}))
+        self._step_cost: Optional[dict] = None
+        self._flops_per_token: Optional[float] = None
+        self.telemetry.attach_profiling(
+            self.profiling,
+            capture_dir=str(self.output_dir / "captures"),
+            event_hook=self._guard_event,
+        )
+
+        # metrics_server: a standalone Prometheus scrape port (the serving
+        # server mounts /metrics on its own HTTP front). Section presence
+        # opts in — a default port would collide across concurrent runs.
+        self._prom = None
+        self._prom_server = None
+        if cfg.get("metrics_server") is not None:
+            from automodel_tpu.telemetry.prometheus import (
+                MetricsServerConfig,
+                TrainMetricsExporter,
+                start_metrics_server,
+            )
+
+            mscfg = MetricsServerConfig.from_dict(dict(cfg.get("metrics_server") or {}))
+            if mscfg.enabled:
+                try:
+                    exporter = TrainMetricsExporter()
+                    self._prom_server = start_metrics_server(
+                        exporter.registry, mscfg.port, mscfg.host
+                    )
+                    self._prom = exporter
+                    logger.info(
+                        "metrics server listening on %s:%d",
+                        mscfg.host, self._prom_server.server_address[1],
+                    )
+                except OSError as e:
+                    # a busy scrape port (two runs on one host, a stale
+                    # process) must never kill training — observability is
+                    # best-effort everywhere else in this subsystem too
+                    logger.warning(
+                        "metrics server failed to bind %s:%d (%s) — "
+                        "continuing WITHOUT a scrape port",
+                        mscfg.host, mscfg.port, e,
+                    )
 
         # distributed guard (resilience/guard.py): hang watchdog petted at
         # every step boundary, cross-host consensus at log/checkpoint/
@@ -373,13 +447,19 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             self._restore()
 
     def _guard_event(self, rec: dict) -> None:
-        """Desync evidence goes to BOTH sinks: the flight recorder (for the
-        post-mortem bundle) and the metrics JSONL (for `report`)."""
+        """Anomaly evidence (desync, hang, trace_capture) goes to every
+        sink: the flight recorder (post-mortem bundle), the metrics JSONL
+        (for `report`), and the /metrics event counters when a scrape port
+        is up."""
         self.telemetry.record_step(rec)
         try:
             self.metric_logger.log(dict(rec), step=rec.get("step"))
         except Exception:  # evidence is best-effort; the abort is not
             pass
+        # a `skipped` trace_capture stamp is evidence of a capture that did
+        # NOT happen — it must not advance the captures counter
+        if self._prom is not None and rec.get("event") and not rec.get("skipped"):
+            self._prom.event(str(rec["event"]))
 
     def _setup_eval_generation(self, gcfg: dict) -> None:
         from automodel_tpu.generation.engine import (
@@ -465,6 +545,70 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             },
             step=self.step_scheduler.step,
         )
+
+    def _compute_step_cost(self, batch) -> None:
+        """One-time cost attribution of the jitted train step (profiling
+        pillar, telemetry/profiling/cost.py): trip-count-aware measured
+        FLOPs/bytes + category breakdown + roofline class, traced on host
+        (abstract — no device memory). Runs once, inside the step-1 compile
+        window; the static summary feeds mfu_measured_pct on every log
+        record and is logged whole as a ``cost_attribution`` event."""
+        from automodel_tpu.telemetry import profiling as prof
+
+        if not hasattr(self.train_step, "trace"):
+            # only a real jit program is attributable (tests wrap the step
+            # in plain callables with side effects; tracing those would
+            # invoke them an extra time)
+            return
+        cost = prof.program_cost(
+            self.train_step, self.state, batch, program="train_step"
+        )
+        basis = self.profiling.roofline_basis()
+        self._step_cost = {**cost.to_dict(), **prof.roofline(cost, basis)}
+        # drop null fields (unknown roofline basis on CPU): the JSONL lint
+        # treats a null numeric without a _nonfinite marker as corruption
+        rec = {
+            "event": "cost_attribution",
+            "program": "train_step",
+            **{k: v for k, v in self._step_cost.items() if v is not None},
+        }
+        self.telemetry.record_step({**rec, "ts": time.time()})
+        try:
+            self.metric_logger.log(rec)
+        except Exception:
+            pass
+
+    def _fold_mfu(self, metrics: dict) -> dict:
+        """Per-log-window MFU, both provenances (docs/performance.md):
+        ``mfu_pct`` from the analytic flops_utils law × observed tokens/s;
+        ``mfu_measured_pct`` from the measured step-program FLOPs × the
+        amortized step time. Drift between them is signal (a law missing a
+        term, a backend computing more than the law assumes, remat)."""
+        from automodel_tpu.telemetry import profiling as prof
+
+        basis = self.profiling.roofline_basis()
+        peak, _ = basis.resolve()
+        tpsd = metrics.get("tps_per_device")
+        if (
+            self._flops_per_token is not None
+            and isinstance(tpsd, (int, float))
+            and peak == peak
+        ):
+            metrics["mfu_pct"] = round(
+                100.0 * tpsd * self._flops_per_token / (peak * 1e12), 3
+            )
+        if self._step_cost is not None and isinstance(
+            metrics.get("step_time_s"), (int, float)
+        ):
+            m = prof.mfu_measured_pct(
+                self._step_cost["flops"],
+                metrics["step_time_s"],
+                self.mesh_ctx.world_size,
+                basis,
+            )
+            if m is not None:
+                metrics["mfu_measured_pct"] = round(m, 3)
+        return metrics
 
     def _make_train_step(self, loss_fn, post_step_fn=None, grad_mask=None):
         """Single construction point for the jitted step so every recipe
@@ -671,6 +815,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.guard.close()
                 res.close()
                 self.step_scheduler.restore_signal_handlers()
+                if self._prom_server is not None:
+                    self._prom_server.shutdown()
         if res.preempted:
             # run-LOCAL committed dir only: latest_dir()'s restore_from
             # bootstrap fallback must not make a nothing-committed run look
@@ -765,6 +911,11 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 "ts": time.time(),
             }
         )
+        # anomaly-armed profiler: a non-finite step arms a capture of the
+        # NEXT trace window + device memory profile (triggered.py)
+        self.telemetry.trigger_capture(step_no, "nonfinite")
+        if self._prom is not None:
+            self._prom.event("nonfinite_step")
         if action == "raise":
             raise NonFiniteError(
                 f"non-finite loss/gradients at step {step_no} "
@@ -839,6 +990,29 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             steps_window += 1
             host_rec = {"step": step_no, "tokens": n_tokens_batch, "ts": time.time()}
             if first_step:
+                # cost attribution rides the compile window: the device is
+                # busy compiling/executing step 1 while the host re-traces
+                # the step abstractly. Never load-bearing.
+                if (
+                    self.profiling.enabled
+                    and self.profiling.cost_attribution
+                    and self._step_cost is None
+                ):
+                    try:
+                        self._compute_step_cost(batch)
+                    except Exception as e:
+                        logger.warning("cost attribution failed: %s", e)
+                if self._flops_per_token is None and "input_ids" in stacked:
+                    try:
+                        from automodel_tpu.utils.flops_utils import (
+                            flops_per_token_for_config,
+                        )
+
+                        self._flops_per_token = flops_per_token_for_config(
+                            self.model.config, int(stacked["input_ids"].shape[-1])
+                        )
+                    except Exception:
+                        pass
                 metrics = {k: v for k, v in jax.device_get(metrics).items()}
                 metrics["compile_time_s"] = time.perf_counter() - t_window
                 host_rec["compile_time_s"] = metrics["compile_time_s"]
@@ -856,6 +1030,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                         step_no, metrics, params=self.state.params
                     )
                     self.metric_logger.log(metrics, step=int(metrics["step"]))
+                    if self._prom is not None:
+                        self._prom.update(metrics)
                     last = metrics
                 tel.record_step(host_rec)
                 first_step = False
@@ -874,6 +1050,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     metrics["skipped_steps_total"] = res.skipped_steps
                 if res.rollbacks:
                     metrics["rollbacks_total"] = res.rollbacks
+                metrics = self._fold_mfu(metrics)
                 metrics = tel.enrich(step_no, metrics)
                 # the log step is already a device barrier: liveness +
                 # cross-host consensus + straggler attribution ride it
@@ -881,6 +1058,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     step_no, metrics, params=self.state.params
                 )
                 self.metric_logger.log(metrics, step=int(metrics["step"]))
+                if self._prom is not None:
+                    self._prom.update(metrics)
                 last = metrics
                 host_rec.update(
                     {
@@ -928,6 +1107,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     self._log_eval_generation()
                 if tel.compile_bridge is not None:
                     tel.compile_bridge.drain()
+                # val/generation wall time must not read as a slow step
+                # (triggered profiler) any more than it reads as train
+                # throughput (the window reset below)
+                tel.skip_next_interval()
                 tokens_window = steps_window = 0
                 t_window = time.perf_counter()
             if self.step_scheduler.is_ckpt_step:
@@ -946,6 +1129,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.guard.pre_commit(step_no, self.state.params)
                 with self.guard.phase("checkpoint"):
                     self.save_checkpoint()
+                tel.skip_next_interval()
                 tokens_window = steps_window = 0
                 t_window = time.perf_counter()
         # a non-finite flag from the final step must still be enforced

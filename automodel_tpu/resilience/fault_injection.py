@@ -70,6 +70,10 @@ class FaultInjectionConfig:
     desync_on_host: int = 0  # process_index whose data hash is perturbed
     straggle_host: Optional[int] = None
     straggle_ms: float = 0.0  # per-step sleep on the straggling host
+    # None → every step (straggler-attribution tests); an int → that ONE
+    # step only, producing the step-time SPIKE the triggered-capture
+    # profiler arms on (telemetry/profiling/triggered.py)
+    straggle_at_step: Optional[int] = None
 
 
 def _process_index() -> int:
@@ -126,6 +130,8 @@ class FaultInjector:
     def maybe_straggle(self, step: int) -> None:
         c = self.config
         if c.straggle_host is None or c.straggle_ms <= 0:
+            return
+        if c.straggle_at_step is not None and step != c.straggle_at_step:
             return
         if _process_index() == c.straggle_host:
             import time

@@ -192,6 +192,17 @@ class ServingEngine:
         self._ids = itertools.count()
         self._step_counter = 0
         self.completed_total = 0
+        # /metrics exposition (telemetry/prometheus.py): histograms are
+        # observed per completion (cheap, python dict ops); gauges + pool
+        # counters sync at scrape time so the scheduler loop pays nothing
+        from automodel_tpu.telemetry.prometheus import ServingMetrics
+
+        self.metrics = ServingMetrics()
+        # cost attribution (telemetry/profiling/): when armed, the first
+        # chunk-prefill/paged-decode call also records the program's
+        # measured FLOPs/bytes (abstract host trace, one-time)
+        self.collect_program_costs = False
+        self.program_costs: dict = {}
 
     # -- stats ---------------------------------------------------------------
     @property
@@ -294,6 +305,13 @@ class ServingEngine:
             real = min(chunk_len, p - start)
             ids = np.full((chunk_len,), pad, np.int32)
             ids[:real] = slot.prompt[start : start + real]
+            if self.collect_program_costs and "chunk_prefill" not in self.program_costs:
+                self._record_cost(
+                    "chunk_prefill", self._chunk,
+                    self.auto.params, self._pool_k, self._pool_v,
+                    jnp.asarray(self._tables[b]), jnp.asarray(ids),
+                    jnp.int32(start), jnp.int32(real),
+                )
             last, self._pool_k, self._pool_v = self._chunk(
                 self.auto.params,
                 self._pool_k, self._pool_v,
@@ -328,6 +346,14 @@ class ServingEngine:
         if not self._active.any():
             return []
         params = self.auto.params
+        if self.collect_program_costs and "paged_decode" not in self.program_costs:
+            self._record_cost(
+                "paged_decode", self._decode,
+                params, self._pool_k, self._pool_v,
+                jnp.asarray(self._tables), jnp.asarray(self._lengths),
+                jnp.asarray(self._cur), jnp.asarray(self._active),
+                self._base_key, jnp.int32(self._step_counter),
+            )
         tokens, self._pool_k, self._pool_v = self._decode(
             params, self._pool_k, self._pool_v,
             jnp.asarray(self._tables), jnp.asarray(self._lengths),
@@ -374,12 +400,21 @@ class ServingEngine:
             "block_occupancy": round(self.pool.occupancy(), 4),
             "ts": time.time(),
         }
+        try:
+            self.metrics.observe_request(rec)
+        except Exception:  # telemetry must never break serving
+            pass
         if self.on_record is not None:
             try:
                 self.on_record(dict(rec))
             except Exception:  # telemetry must never break serving
                 pass
         return rec
+
+    def _record_cost(self, name: str, jit_fn, *args) -> None:
+        from automodel_tpu.telemetry.profiling import record_program_cost
+
+        record_program_cost(self.program_costs, name, jit_fn, *args)
 
     def step(self) -> list[dict]:
         """One scheduler iteration → the requests that completed in it."""

@@ -155,6 +155,22 @@ def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
             self.wfile.write(body)
 
         def do_GET(self):
+            if self.path == "/metrics":
+                # Prometheus text exposition (telemetry/prometheus.py):
+                # histograms were observed per completion; gauges + pool
+                # counters sync here, under the engine lock, so a scrape is
+                # one consistent snapshot
+                from automodel_tpu.telemetry.prometheus import CONTENT_TYPE
+
+                with loop.lock:
+                    engine.metrics.sync(engine)
+                    body = engine.metrics.registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path != "/stats":
                 return self._json(404, {"error": f"unknown path {self.path}"})
             with loop.lock:

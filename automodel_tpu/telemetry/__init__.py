@@ -93,6 +93,9 @@ class Telemetry:
         # allocator scalars sampled on the step cadence, attached to the
         # next log record (sampling must not depend on the log cadence)
         self._pending_mem: Optional[tuple] = None
+        # anomaly-armed profiler (telemetry/profiling/triggered.py) —
+        # attached by the recipe via attach_profiling()
+        self.triggered = None
 
     @classmethod
     def from_config(
@@ -100,14 +103,20 @@ class Telemetry:
         section: Any,
         fingerprint: Optional[dict] = None,
         default_recorder_path: Optional[str] = None,
+        default_trace_dir: Optional[str] = None,
     ) -> "Telemetry":
         """Build from a YAML `telemetry:` section (None → all defaults).
         ``default_recorder_path`` places the crash dump next to the metrics
-        JSONL unless the YAML pins a path."""
+        JSONL unless the YAML pins a path; ``default_trace_dir`` routes a
+        profile window's trace under the run's output_dir likewise."""
         d = dict(section or {})
         d.pop("_target_", None)
         if "flight_recorder_path" not in d and default_recorder_path:
             d["flight_recorder_path"] = default_recorder_path
+        if d.get("profile") and default_trace_dir:
+            p = dict(d["profile"])
+            p.setdefault("trace_dir", default_trace_dir)
+            d["profile"] = p
         return cls(TelemetryConfig(**d), fingerprint=fingerprint)
 
     # -- per-step hooks ------------------------------------------------------
@@ -117,8 +126,32 @@ class Telemetry:
         log_every_steps=3 and memory_every_steps=50 still samples every 50).
         The census goes to the flight-recorder ring; the two allocator
         scalars ride the next log record via enrich()."""
+        # mutual exclusion both ways — jax allows ONE active trace. The
+        # triggered profiler defers to an OPEN manual window (its
+        # trace_active check); conversely the manual window PREEMPTS an
+        # in-flight triggered capture when its start step arrives: the
+        # operator asked for that exact window, and waiting could consume
+        # it entirely (a capture spanning [start, end) would mean the
+        # manual trace silently never opens). Closing the capture early
+        # still stops the trace, dumps the memory profile, and stamps the
+        # evidence record.
         if self.profiler is not None:
-            self.profiler.on_step(step)
+            c = self.profiler.config
+            manual_wants = (
+                c.enabled
+                and not self.profiler.active
+                and c.start_step <= step < c.end_step
+            )
+            if (
+                manual_wants
+                and self.triggered is not None
+                and self.triggered.active
+            ):
+                self.triggered.close()
+            if not (self.triggered is not None and self.triggered.active):
+                self.profiler.on_step(step)
+        if self.triggered is not None:
+            self.triggered.on_step(step)
         if self.should_sample_memory(step):
             self.memory_samples += 1
             self._pending_mem = memory_telemetry.max_bytes_in_use()
@@ -160,6 +193,40 @@ class Telemetry:
             self._pending_mem = None
         return metrics
 
+    # -- profiling pillar ----------------------------------------------------
+    def attach_profiling(self, profiling_config, capture_dir: str, event_hook=None):
+        """Arm the triggered-capture profiler (telemetry/profiling/). The
+        event hook receives ``trace_capture`` records — recipes point it at
+        the flight recorder + metrics JSONL. No-op when disabled."""
+        if not (self.config.enabled and profiling_config.enabled):
+            return
+        tcfg = profiling_config.triggered_config(capture_dir)
+        if not tcfg.enabled:
+            return
+        from automodel_tpu.telemetry.profiling import TriggeredCapture
+
+        self.triggered = TriggeredCapture(
+            tcfg,
+            event_hook=event_hook or self.record_step,
+            # never double-start: a manual StepProfiler window wins
+            trace_active=(
+                (lambda: self.profiler.active) if self.profiler is not None
+                else (lambda: False)
+            ),
+        )
+
+    def trigger_capture(self, step: int, reason: str) -> None:
+        """External anomaly (non-finite policy): capture the next window."""
+        if self.triggered is not None:
+            self.triggered.trigger(step, reason)
+
+    def skip_next_interval(self) -> None:
+        """A legitimate pause (checkpoint/validation/eval generation) ends
+        here: the boundary-spanning interval must not read as a slow-step
+        anomaly (the recipes call this where their timing windows reset)."""
+        if self.triggered is not None:
+            self.triggered.skip_next_interval()
+
     # -- lifecycle -----------------------------------------------------------
     def crash_guard(self):
         """Context manager that dumps the flight recorder on any exception
@@ -167,6 +234,8 @@ class Telemetry:
         return self.flight_recorder if self.flight_recorder is not None else contextlib.nullcontext()
 
     def close(self) -> None:
+        if self.triggered is not None:
+            self.triggered.close()
         if self.profiler is not None:
             self.profiler.close()
 

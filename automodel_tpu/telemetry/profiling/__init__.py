@@ -1,0 +1,123 @@
+"""Performance-observability pillar of the telemetry subsystem.
+
+Four parts (ISSUE 7 / ROADMAP items 2–3's evidence layer):
+
+- cost.py      — cost-attributed accounting for every jitted program we
+  own: trip-count-aware measured FLOPs/bytes + category breakdown +
+  roofline classification + ``mfu_measured_pct`` beside the analytic law
+- trace.py     — ``jax.profiler`` trace parsing → structured JSON +
+  generated PROFILE markdown (top-K self-time ops, comm/compute/host-gap
+  decomposition, named-scope attribution)
+- triggered.py — anomaly-armed capture: a slow step (k× the EMA) or a
+  non-finite flag opens the next trace window + device memory profile,
+  stamped into the flight recorder
+- runner.py    — the ``automodel_tpu profile`` CLI: trace window around N
+  steps of a recipe, artifacts generated (not hand-typed) under
+  ``<output_dir>/profile/``
+
+YAML::
+
+    profiling:
+      enabled: true
+      cost_attribution: true     # mfu_measured_pct + breakdown on log records
+      peak_tflops: null          # device-table override (mandatory on CPU)
+      hbm_gbps: null             # bandwidth override for the roofline
+      top_k: 20                  # report width
+      trace_steps: 3             # `automodel_tpu profile` window length
+      trace_warmup_steps: 2      #   steps before the window opens
+      triggered:                 # anomaly-armed capture (triggered.py)
+        slow_step_factor: 3.0
+        capture_steps: 2
+        max_captures: 2
+
+    metrics_server:              # training-side /metrics port (prometheus.py)
+      port: 9100
+      host: 127.0.0.1
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from automodel_tpu.telemetry.profiling.cost import (  # noqa: F401
+    ProgramCost,
+    RooflineConfig,
+    mfu_measured_pct,
+    program_cost,
+    roofline,
+    trace_cost,
+)
+from automodel_tpu.telemetry.profiling.trace import (  # noqa: F401
+    analyze_trace,
+    load_trace_events,
+    render_markdown,
+)
+from automodel_tpu.telemetry.profiling.triggered import (  # noqa: F401
+    TriggeredCapture,
+    TriggeredCaptureConfig,
+)
+
+
+def record_program_cost(store: dict, name: str, jit_fn, *args) -> None:
+    """One-time measured-cost trace of a jitted program into ``store`` —
+    abstract (no device work, no donation), never load-bearing: a failure
+    records an error entry instead of raising. Shared by the generation
+    and serving engines' ``collect_program_costs`` hooks."""
+    try:
+        store[name] = program_cost(jit_fn, *args, program=name).to_dict()
+    except Exception as e:
+        store[name] = {"error": f"{type(e).__name__}: {e}"}
+
+
+@dataclasses.dataclass
+class ProfilingConfig:
+    """The ``profiling:`` YAML section."""
+
+    enabled: bool = True
+    cost_attribution: bool = True
+    peak_tflops: Optional[float] = None
+    hbm_gbps: Optional[float] = None
+    top_k: int = 20
+    # `automodel_tpu profile` runner knobs
+    mode: str = "train"  # train | generate
+    trace_steps: int = 3
+    trace_warmup_steps: int = 2
+    trace_dir: Optional[str] = None  # default: <output_dir>/profile/trace
+    triggered: Optional[dict] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "ProfilingConfig":
+        d = dict(d or {})
+        d.pop("_target_", None)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise TypeError(f"unknown profiling keys: {sorted(unknown)}")
+        return cls(**d)
+
+    def roofline_basis(self) -> RooflineConfig:
+        return RooflineConfig(peak_tflops=self.peak_tflops, hbm_gbps=self.hbm_gbps)
+
+    def triggered_config(self, default_dir: str) -> TriggeredCaptureConfig:
+        sub = dict(self.triggered or {})
+        sub.pop("_target_", None)
+        sub.setdefault("capture_dir", default_dir)
+        return TriggeredCaptureConfig(**sub)
+
+
+__all__ = [
+    "ProfilingConfig",
+    "ProgramCost",
+    "RooflineConfig",
+    "TriggeredCapture",
+    "TriggeredCaptureConfig",
+    "analyze_trace",
+    "load_trace_events",
+    "mfu_measured_pct",
+    "program_cost",
+    "record_program_cost",
+    "render_markdown",
+    "roofline",
+    "trace_cost",
+]

@@ -1,0 +1,352 @@
+"""Cost-attributed program accounting: measured FLOPs/bytes per jitted
+program, category breakdown, roofline classification, measured MFU.
+
+Two sources, deliberately combined:
+
+1. **Trip-count-aware jaxpr walk** (``trace_cost``) — the primary FLOPs
+   number. XLA's ``cost_analysis()`` visits ``scan``/``while`` bodies ONCE
+   (verified on this jax build: a 3-iteration scan of one matmul reports
+   one matmul of flops), and this codebase scans BOTH its layers (stacked
+   models) and its grad-accumulation microbatches — so raw HLO cost
+   analysis can under-count a train step by ``num_layers × grad_acc``. The
+   walker recurses every sub-jaxpr, multiplying ``scan`` bodies by their
+   static ``length``; ``while`` bodies (the decode loop) are counted once
+   and flagged (``while_loops`` > 0 means the totals are per-iteration for
+   those regions, which is exactly the per-token number decode wants).
+   Per-eqn attribution gives the category split: ``dot_general``/
+   ``conv_general_dilated`` FLOPs (computed exactly from the dimension
+   numbers), explicit-collective bytes (``psum``/``all_gather``/
+   ``all_to_all``/``ppermute``/``psum_scatter`` — the shard_map paths; the
+   collectives GSPMD inserts at partition time are NOT in the jaxpr and
+   only appear in compiled-HLO mode), and elementwise/other bytes.
+
+2. **``Lowered.cost_analysis()``** (``hlo_flops``/``hlo_bytes``) — XLA's
+   own numbers for the unpartitioned module, kept as a cross-check anchor:
+   for a scan-free program the two FLOPs counts must agree (the
+   dense-vs-MoE cross-check test pins this), and bytes-accessed is the
+   better HBM-traffic estimate where available (it sees fusion; the
+   walker's byte estimate counts every eqn's operands as if materialized).
+
+``mfu_measured_pct`` = walker FLOPs / wall time / (chips × peak). The
+analytic ``mfu_pct`` (flops_utils laws) rides beside it; drift between the
+two is signal — a law missing a term, a backend computing more than the
+law assumes (dense MoE computes every expert), remat recompute, etc.
+
+Roofline: arithmetic intensity = FLOPs / bytes vs the device ridge point
+(peak FLOPs / HBM bandwidth) → ``compute_bound``/``memory_bound``; the
+collective share adds ``comm_heavy`` when explicit-collective bytes
+dominate. Unknown devices (CPU) classify as ``unknown`` unless the config
+overrides peak/bandwidth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from automodel_tpu.utils.flops_utils import TPU_PEAK_BF16_TFLOPS, device_peak_tflops
+
+# HBM bandwidth per chip, GB/s (public TPU spec sheets; same key scheme as
+# the peak-FLOPs table). Unknown kinds → NaN, never a silent wrong basis.
+TPU_HBM_GBPS: dict[str, float] = {
+    "TPU v4": 1228.0,
+    "TPU v5": 2765.0,  # v5p
+    "TPU v5p": 2765.0,
+    "TPU v5 lite": 819.0,  # v5e
+    "TPU v5e": 819.0,
+    "TPU v6 lite": 1640.0,  # v6e / Trillium
+    "TPU v6e": 1640.0,
+    "TPU7x": 7370.0,  # ironwood
+}
+
+# explicit-collective primitive names; matched with trailing digits
+# stripped (jax renames across versions: psum → psum2)
+_COLLECTIVES = {
+    "psum", "all_gather", "all_to_all", "ppermute", "psum_scatter",
+    "reduce_scatter", "pmax", "pmin", "pbroadcast",
+}
+
+
+def _is_collective(name: str) -> bool:
+    return name.rstrip("0123456789") in _COLLECTIVES
+
+
+def device_hbm_gbps(device: Optional[jax.Device] = None) -> float:
+    d = device or jax.devices()[0]
+    kind = getattr(d, "device_kind", "")
+    if kind in TPU_HBM_GBPS:
+        return TPU_HBM_GBPS[kind]
+    for k, v in TPU_HBM_GBPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return float("nan")
+
+
+@dataclasses.dataclass
+class ProgramCost:
+    """Measured cost of one jitted program (whole-mesh, unpartitioned)."""
+
+    program: str = "program"
+    flops: float = 0.0  # walker total (dot + conv); trip-count aware
+    dot_flops: float = 0.0
+    conv_flops: float = 0.0
+    bytes_est: float = 0.0  # walker byte estimate (operands+results per eqn)
+    elementwise_bytes: float = 0.0  # non-dot/conv/collective eqn bytes
+    collective_bytes: float = 0.0  # explicit (shard_map) collectives only
+    collective_ops: int = 0
+    dot_ops: int = 0
+    eqns: int = 0
+    while_loops: int = 0  # bodies counted once (per-iteration cost)
+    # XLA's own numbers (Lowered.cost_analysis; scan/while bodies once)
+    hlo_flops: Optional[float] = None
+    hlo_bytes: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+def _dot_flops(eqn) -> float:
+    """Exact MAC×2 count from dot_general dimension numbers."""
+    (lhs_c, _rhs_c), (lhs_b, _rhs_b) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    out = eqn.outvars[0].aval.shape
+    k = 1
+    for d in lhs_c:
+        k *= lhs[d]
+    return 2.0 * float(np.prod(out, dtype=np.float64)) * k
+
+
+def _conv_flops(eqn) -> float:
+    """2 × out_numel × (per-output MACs) for conv_general_dilated."""
+    dn = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    out = eqn.outvars[0].aval.shape
+    # kernel spatial dims × input features / groups
+    kernel_spatial = 1
+    for d in dn.rhs_spec[2:]:
+        kernel_spatial *= rhs[d]
+    in_features = rhs[dn.rhs_spec[1]]
+    macs_per_out = kernel_spatial * in_features
+    return 2.0 * float(np.prod(out, dtype=np.float64)) * macs_per_out
+
+
+def _aval_bytes(v) -> float:
+    aval = getattr(v, "aval", None)
+    if aval is None or not hasattr(aval, "shape"):
+        return 0.0
+    dt = getattr(aval, "dtype", None)
+    try:
+        itemsize = np.dtype(dt).itemsize if dt is not None else 4
+    except TypeError:
+        # extended dtypes (PRNG key<fry>) have no numpy equivalent
+        itemsize = getattr(dt, "itemsize", 4)
+    return float(np.prod(aval.shape, dtype=np.float64)) * itemsize
+
+
+def _sub_jaxprs(params: dict):
+    """Every Jaxpr/ClosedJaxpr value hiding in an eqn's params (pjit's
+    ``jaxpr``, scan's ``jaxpr``, while's ``body_jaxpr``/``cond_jaxpr``,
+    cond's ``branches``, custom_vjp/jvp ``call_jaxpr``/``fun_jaxpr``,
+    remat, shard_map — one generic recursion covers all of them)."""
+    from jax._src import core as jcore
+
+    def walk(v):
+        if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from walk(x)
+
+    for key, v in params.items():
+        yield from ((key, j) for j in walk(v))
+
+
+def _walk(jaxpr, cost: ProgramCost, mult: float) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        cost.eqns += 1
+        if name == "dot_general":
+            f = _dot_flops(eqn) * mult
+            cost.dot_flops += f
+            cost.flops += f
+            cost.dot_ops += 1
+            cost.bytes_est += sum(map(_aval_bytes, (*eqn.invars, *eqn.outvars))) * mult
+        elif name == "conv_general_dilated":
+            f = _conv_flops(eqn) * mult
+            cost.conv_flops += f
+            cost.flops += f
+            cost.bytes_est += sum(map(_aval_bytes, (*eqn.invars, *eqn.outvars))) * mult
+        elif _is_collective(name):
+            b = sum(map(_aval_bytes, eqn.outvars)) * mult
+            cost.collective_bytes += b
+            cost.bytes_est += b
+            cost.collective_ops += 1
+        else:
+            subs = list(_sub_jaxprs(eqn.params))
+            if subs:
+                if name == "scan":
+                    length = float(eqn.params.get("length", 1))
+                    for _, sub in subs:
+                        _walk(getattr(sub, "jaxpr", sub), cost, mult * length)
+                elif name == "while":
+                    cost.while_loops += 1
+                    for key, sub in subs:
+                        if "cond" in key:
+                            continue  # predicate cost is noise
+                        _walk(getattr(sub, "jaxpr", sub), cost, mult)
+                elif name == "cond":
+                    # conservative: charge the most expensive branch
+                    best: Optional[ProgramCost] = None
+                    for _, sub in subs:
+                        c = ProgramCost()
+                        _walk(getattr(sub, "jaxpr", sub), c, mult)
+                        if best is None or c.flops > best.flops:
+                            best = c
+                    if best is not None:
+                        for f in (
+                            "flops", "dot_flops", "conv_flops", "bytes_est",
+                            "elementwise_bytes", "collective_bytes",
+                        ):
+                            setattr(cost, f, getattr(cost, f) + getattr(best, f))
+                        cost.dot_ops += best.dot_ops
+                        cost.collective_ops += best.collective_ops
+                        cost.eqns += best.eqns
+                        cost.while_loops += best.while_loops
+                else:
+                    for _, sub in subs:
+                        _walk(getattr(sub, "jaxpr", sub), cost, mult)
+            else:
+                b = sum(map(_aval_bytes, eqn.outvars)) * mult
+                cost.elementwise_bytes += b
+                cost.bytes_est += b
+
+
+def trace_cost(fn, *args, program: str = "program", **kwargs) -> ProgramCost:
+    """Trace ``fn`` abstractly (ShapeDtypeStructs welcome — no device
+    memory is touched) and walk the jaxpr. ``fn`` may be a plain callable
+    or a jitted one; tracing happens on host either way."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    cost = ProgramCost(program=program)
+    _walk(closed.jaxpr, cost, 1.0)
+    return cost
+
+
+def lowered_cost(lowered) -> tuple[Optional[float], Optional[float]]:
+    """→ (flops, bytes accessed) from ``Lowered.cost_analysis()`` — may be
+    a dict, a per-device list of dicts, or unavailable on some backends."""
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:
+        return None, None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None, None
+    return ca.get("flops"), ca.get("bytes accessed")
+
+
+def program_cost(
+    jit_fn, *args, program: str = "program", **kwargs
+) -> ProgramCost:
+    """Full measurement of a ``jax.jit``-wrapped program: ONE abstract
+    trace shared by the walker and XLA's cost analysis (``.trace()`` →
+    ``.jaxpr`` + ``.lower()``). Falls back to walker-only when the AOT
+    surface is missing (plain callables)."""
+    try:
+        traced = jit_fn.trace(*args, **kwargs)
+    except AttributeError:
+        return trace_cost(jit_fn, *args, program=program, **kwargs)
+    cost = ProgramCost(program=program)
+    _walk(traced.jaxpr.jaxpr, cost, 1.0)
+    try:
+        cost.hlo_flops, cost.hlo_bytes = lowered_cost(traced.lower())
+    except Exception:
+        pass
+    return cost
+
+
+# -- roofline + MFU ------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RooflineConfig:
+    """Device basis, overridable from YAML (``profiling.peak_tflops`` /
+    ``profiling.hbm_gbps``) — mandatory on CPU/unknown devices if a
+    classification is wanted (the tables return NaN there)."""
+
+    peak_tflops: Optional[float] = None
+    hbm_gbps: Optional[float] = None
+
+    def resolve(self) -> tuple[float, float]:
+        peak = (
+            float(self.peak_tflops)
+            if self.peak_tflops is not None
+            else device_peak_tflops()
+        )
+        bw = float(self.hbm_gbps) if self.hbm_gbps is not None else device_hbm_gbps()
+        return peak, bw
+
+
+def roofline(cost: ProgramCost, basis: RooflineConfig) -> dict:
+    """→ {arithmetic_intensity, ridge_intensity, roofline_class,
+    comm_fraction}. Bytes basis: the WALKER estimate — it is trip-count
+    aware like the FLOPs numerator (``hlo_bytes`` counts scan/while bodies
+    once, so flops/hlo_bytes would inflate intensity by ~layers×grad_acc
+    on scanned programs and misclassify them compute-bound). The walker
+    over-counts real HBM traffic by ignoring fusion, so the intensity is a
+    LOWER bound — a memory_bound verdict is conservative, a compute_bound
+    verdict is solid."""
+    peak, bw = basis.resolve()
+    bytes_basis = cost.bytes_est if cost.bytes_est else cost.hlo_bytes
+    intensity = cost.flops / bytes_basis if bytes_basis else float("nan")
+    ridge = (peak * 1e12) / (bw * 1e9) if (peak == peak and bw == bw) else float("nan")
+    comm_fraction = (
+        cost.collective_bytes / cost.bytes_est if cost.bytes_est else 0.0
+    )
+    if intensity != intensity or ridge != ridge:
+        klass = "unknown"
+    elif comm_fraction > 0.5:
+        klass = "comm_heavy"
+    elif intensity >= ridge:
+        klass = "compute_bound"
+    else:
+        klass = "memory_bound"
+    return {
+        "arithmetic_intensity": round(intensity, 3) if intensity == intensity else None,
+        "ridge_intensity": round(ridge, 3) if ridge == ridge else None,
+        "roofline_class": klass,
+        "comm_fraction": round(comm_fraction, 4),
+    }
+
+
+def mfu_measured_pct(
+    flops_per_step: float,
+    step_time_s: float,
+    n_chips: int,
+    basis: RooflineConfig,
+) -> Optional[float]:
+    """Measured-program MFU %. None when the peak basis is unknown (CPU
+    without an override) or the step time is degenerate."""
+    peak, _ = basis.resolve()
+    if peak != peak or step_time_s <= 0 or n_chips < 1:
+        return None
+    return 100.0 * flops_per_step / step_time_s / (n_chips * peak * 1e12)
+
+
+__all__ = [
+    "ProgramCost",
+    "RooflineConfig",
+    "TPU_HBM_GBPS",
+    "TPU_PEAK_BF16_TFLOPS",
+    "device_hbm_gbps",
+    "lowered_cost",
+    "mfu_measured_pct",
+    "program_cost",
+    "roofline",
+    "trace_cost",
+]

@@ -1,0 +1,199 @@
+"""`automodel_tpu profile -c cfg.yaml` — generated PROFILE artifacts.
+
+Replaces the hand-run tools/profile_*.py workflow: one command opens a
+``jax.profiler`` trace window around N steps of the configured workload,
+parses the capture, and writes committed-evidence artifacts under
+``<output_dir>/profile/``:
+
+- ``report.json``  — the structured report (trace decomposition + top-K
+  ops + scope attribution + per-program cost summaries)
+- ``PROFILE.md``   — the markdown rendering (what PROFILE_*_rNN.md used to
+  be typed from)
+- ``trace/``       — the raw capture (xplane + Chrome-trace JSON)
+
+Modes (``profiling.mode`` or ``--profiling.mode=...``):
+
+- ``train`` (default) — run the train recipe for ``trace_warmup_steps``
+  steps, trace ``trace_steps`` more, stop. Mock/real data per the config;
+  the cost-attribution pass (cost.py) rides the recipe's own wiring so the
+  report carries ``mfu_measured_pct`` + roofline class when a peak basis
+  is known (override ``profiling.peak_tflops`` on CPU).
+- ``generate`` — build the generation engine, run one compile pass, trace
+  the second ``generate_ids`` call (prefill + decode windows), and report
+  per-program costs for the prefill and decode executables.
+
+The window is the whole point: everything before ``trace_warmup_steps``
+is compile + cache warmup, and a trace polluted by the initial compile
+answers no performance question."""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from automodel_tpu.config.loader import ConfigNode
+
+logger = logging.getLogger(__name__)
+
+
+def _resolve_output_dir(cfg: Any) -> Path:
+    out = cfg.get("output_dir")
+    if out is None:
+        out = Path("runs") / time.strftime("profile_%Y%m%d_%H%M%S")
+    return Path(out)
+
+
+def _write_report(
+    out_dir: Path,
+    report: dict,
+    title: str,
+    context: dict,
+) -> tuple[Path, Path]:
+    from automodel_tpu.telemetry.profiling.trace import render_markdown
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    json_path = out_dir / "report.json"
+    json_path.write_text(json.dumps(report, indent=2, default=str) + "\n")
+    md_path = out_dir / "PROFILE.md"
+    md_path.write_text(render_markdown(report, title=title, context=context))
+    return json_path, md_path
+
+
+def _profile_train(cfg: Any, pcfg, out_dir: Path) -> dict:
+    from automodel_tpu.recipes.train_ft import (
+        TrainFinetuneRecipeForNextTokenPrediction,
+    )
+
+    warmup = max(int(pcfg.trace_warmup_steps), 1)
+    steps = max(int(pcfg.trace_steps), 1)
+    trace_dir = Path(pcfg.trace_dir) if pcfg.trace_dir else out_dir / "trace"
+
+    d = cfg.to_dict()
+    d["output_dir"] = str(out_dir.parent) if out_dir.name == "profile" else str(out_dir)
+    sched = dict(d.get("step_scheduler") or {})
+    sched["max_steps"] = warmup + steps
+    d["step_scheduler"] = sched
+    tel = dict(d.get("telemetry") or {})
+    # step numbering starts at 1; the window covers (warmup, warmup+steps]
+    tel["profile"] = {
+        "enabled": True,
+        "trace_dir": str(trace_dir),
+        "start_step": warmup + 1,
+        "end_step": warmup + 1 + steps,
+    }
+    d["telemetry"] = tel
+
+    recipe = TrainFinetuneRecipeForNextTokenPrediction(ConfigNode(d))
+    recipe.setup()
+    last = recipe.run_train_validation_loop()
+
+    costs = {}
+    if getattr(recipe, "_step_cost", None):
+        costs["train_step"] = dict(recipe._step_cost)
+    return {
+        "trace_dir": str(trace_dir),
+        "steps_traced": steps,
+        "last_metrics": {
+            k: v
+            for k, v in (last or {}).items()
+            if isinstance(v, (int, float, str)) and not isinstance(v, bool)
+        },
+        "cost": costs,
+    }
+
+
+def _profile_generate(cfg: Any, pcfg, out_dir: Path) -> dict:
+    import numpy as np
+
+    from automodel_tpu.generation.engine import (
+        GenerationConfig,
+        GenerationEngine,
+        build_auto_from_cfg,
+    )
+    from automodel_tpu.utils.profiler import start_trace
+
+    import jax
+
+    trace_dir = Path(pcfg.trace_dir) if pcfg.trace_dir else out_dir / "trace"
+    gen_section = dict(cfg.get("generation", {}) or {})
+    for k in ("prompts", "prompt_ids", "tokenizer", "enabled"):
+        gen_section.pop(k, None)
+    batch = int(gen_section.pop("bench_batch", 2))
+    prompt_len = int(gen_section.pop("bench_prompt_len", 16))
+    auto = build_auto_from_cfg(cfg)
+    engine = GenerationEngine(auto, GenerationConfig.from_dict(gen_section))
+    engine.collect_program_costs = True
+    vocab = int(auto.model.config.vocab_size)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, vocab, size=(batch, prompt_len)).tolist()
+    engine.generate_ids(prompts)  # compile pass (outside the window)
+    start_trace(str(trace_dir))
+    out = engine.generate_ids(prompts)
+    jax.profiler.stop_trace()
+    return {
+        "trace_dir": str(trace_dir),
+        "steps_traced": 1,
+        "last_metrics": {
+            "ttft_s": out["ttft_s"],
+            "decode_tps": out["decode_tps"],
+            "gen_tokens": out["gen_tokens"],
+        },
+        "cost": dict(engine.program_costs),
+    }
+
+
+def main(cfg: Any) -> int:
+    """→ process exit code. Prints one JSON line naming the artifacts."""
+    from automodel_tpu.loggers.log_utils import setup_logging
+    from automodel_tpu.telemetry.profiling import ProfilingConfig
+    from automodel_tpu.telemetry.profiling.trace import (
+        analyze_trace,
+        load_trace_events,
+    )
+
+    setup_logging()
+    pcfg = ProfilingConfig.from_dict(dict(cfg.get("profiling") or {}))
+    mode = pcfg.mode
+    if mode not in ("train", "generate"):
+        print(f"profiling.mode must be train|generate, got {mode!r}")
+        return 2
+    out_root = _resolve_output_dir(cfg)
+    out_dir = out_root / "profile"
+
+    run = _profile_train(cfg, pcfg, out_dir) if mode == "train" else _profile_generate(
+        cfg, pcfg, out_dir
+    )
+
+    events = load_trace_events(run["trace_dir"])
+    report = analyze_trace(events, top_k=pcfg.top_k)
+    report["mode"] = mode
+    report["steps_traced"] = run["steps_traced"]
+    report["cost"] = run["cost"]
+    report["run_metrics"] = run["last_metrics"]
+    context = {
+        "mode": mode,
+        "steps_traced": run["steps_traced"],
+        "trace_dir": run["trace_dir"],
+        **{f"run.{k}": v for k, v in run["last_metrics"].items()},
+    }
+    json_path, md_path = _write_report(
+        out_dir, report, title=f"PROFILE ({mode})", context=context
+    )
+    print(
+        json.dumps(
+            {
+                "event": "profile_report",
+                "report_json": str(json_path),
+                "report_md": str(md_path),
+                "trace_dir": run["trace_dir"],
+                "op_events": report["op_events"],
+                "device_busy_fraction": report["device_busy_fraction"],
+                "comm_fraction": report["comm_fraction"],
+            }
+        ),
+        flush=True,
+    )
+    return 0

@@ -1,0 +1,290 @@
+"""Trace analytics: parse a captured ``jax.profiler`` trace into a
+structured report (JSON + markdown).
+
+Input: the Chrome-trace-event JSON the profiler writes next to the xplane
+protobuf — ``*.trace.json.gz`` (always) and ``perfetto_trace.json.gz``
+(when the trace was started with ``create_perfetto_trace=True``, which
+``utils/profiler.py`` now does by default). Both are the same event
+schema: ``M`` metadata events naming processes/threads, ``X`` complete
+events with ``ts``/``dur`` in microseconds. Parsing this instead of the
+xplane protobuf keeps the analyzer dependency-free and testable against a
+committed miniature fixture.
+
+The report answers the three questions every PROFILE_* artifact so far was
+written by hand to answer:
+
+- **top-K ops by self time** — self time = span minus nested same-thread
+  child spans, aggregated by op base name (trailing ``.N``/``.clone``
+  HLO-instruction suffixes stripped so all fusions of a kind group);
+- **comm / compute / host-gap decomposition** — device busy time is the
+  interval union of op events across device-op threads; comm = collective
+  ops (all-reduce / all-gather / all-to-all / collective-permute /
+  reduce-scatter / send / recv); host gap = window − device busy (input
+  pipeline, dispatch stalls, python);
+- **per-scope attribution** — events whose (arg-provided or literal) name
+  carries a ``/``-path (jax ``named_scope`` flows into XLA op metadata)
+  aggregate by their leading scope segments. Absent metadata (CPU thunks)
+  degrades to an empty section, never a crash.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import re
+from pathlib import Path
+from typing import Any, Iterable, Optional
+
+# threads that carry XLA op events (CPU: Eigen/TfrtCpuClient workers; TPU:
+# the per-core "XLA Ops"/"TensorFlow Op" lanes under /device:TPU:N)
+_DEVICE_THREAD_RE = re.compile(
+    r"XLA|Eigen|TfrtCpuClient|TensorFlow Op|Framework Op|Steps", re.IGNORECASE
+)
+_DEVICE_PROCESS_RE = re.compile(r"/device:|/host:", re.IGNORECASE)
+
+# runtime scaffolding that shows up interleaved with op events on the same
+# threads — never ops, excluded from op aggregation
+_INFRA_RE = re.compile(
+    r"^(ThreadpoolListener|ThunkExecutor|TfrtCpu|PjitFunction|ParseArguments"
+    r"|ExecuteHelper|Execute\b|\$|<unknown>|BufferAlloc|Allocate|copy_start"
+    r"|copy_done|infeed|outfeed|program_interpreter|RunExecutable)",
+    re.IGNORECASE,
+)
+
+_COMM_RE = re.compile(
+    r"^(all-reduce|all-gather|all-to-all|collective-permute|reduce-scatter"
+    r"|collective-broadcast|send\b|recv\b|send-done|recv-done)",
+    re.IGNORECASE,
+)
+
+_SUFFIX_RE = re.compile(r"((\.\d+)|(\.clone)|(_\d+))+$")
+
+
+def load_trace_events(path: str | Path) -> list[dict]:
+    """Load Chrome-trace events from a file or a trace directory (the
+    newest ``plugins/profile/<run>/`` is searched for ``*.trace.json.gz``,
+    ``perfetto_trace.json.gz``, or plain ``*.trace.json``)."""
+    p = Path(path)
+    if p.is_dir():
+        candidates = sorted(
+            [
+                *p.rglob("*.trace.json.gz"),
+                *p.rglob("perfetto_trace.json.gz"),
+                *p.rglob("*.trace.json"),
+            ],
+            key=lambda f: f.stat().st_mtime,
+        )
+        if not candidates:
+            raise FileNotFoundError(
+                f"no *.trace.json[.gz] under {p} — was the trace window ever "
+                "open? (profiler start/end steps inside the run's step range?)"
+            )
+        p = candidates[-1]
+    raw = p.read_bytes()
+    if p.suffix == ".gz" or raw[:2] == b"\x1f\x8b":
+        raw = gzip.decompress(raw)
+    doc = json.loads(raw)
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError(f"{p}: not a Chrome trace (no traceEvents list)")
+    return events
+
+
+def _thread_tables(events: Iterable[dict]) -> tuple[dict, dict]:
+    procs: dict[int, str] = {}
+    threads: dict[tuple[int, int], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            procs[e.get("pid")] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            threads[(e.get("pid"), e.get("tid"))] = e.get("args", {}).get("name", "")
+    return procs, threads
+
+
+def _self_times(spans: list[dict]) -> None:
+    """Annotate each span (one thread, sorted by ts) with ``self_us`` =
+    dur minus directly-nested child durs. Stack-based single pass."""
+    stack: list[dict] = []
+    for s in spans:
+        while stack and s["ts"] >= stack[-1]["ts"] + stack[-1]["dur"]:
+            stack.pop()
+        if stack:
+            stack[-1]["child_us"] += s["dur"]
+        s["child_us"] = 0.0
+        stack.append(s)
+    for s in spans:
+        s["self_us"] = max(s["dur"] - s["child_us"], 0.0)
+
+
+def _merge_busy_us(intervals: list[tuple[float, float]]) -> float:
+    """Union length of [start, end) intervals in microseconds."""
+    total, cur_s, cur_e = 0.0, None, None
+    for s, e in sorted(intervals):
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
+
+
+def _base_name(name: str) -> str:
+    return _SUFFIX_RE.sub("", name.split("/")[-1]) or name
+
+
+def analyze_trace(
+    events: list[dict], top_k: int = 20, scope_depth: int = 2
+) -> dict:
+    """→ the structured report dict (schema in docs/observability.md)."""
+    procs, threads = _thread_tables(events)
+
+    def is_device_thread(pid: int, tid: int) -> bool:
+        tname = threads.get((pid, tid), "")
+        pname = procs.get(pid, "")
+        if _DEVICE_THREAD_RE.search(tname):
+            return True
+        return bool(_DEVICE_PROCESS_RE.search(pname)) and "python" not in tname
+
+    by_thread: dict[tuple[int, int], list[dict]] = {}
+    t_min, t_max = None, None
+    for e in events:
+        if e.get("ph") != "X" or not isinstance(e.get("dur"), (int, float)):
+            continue
+        ts, dur = float(e.get("ts", 0.0)), float(e["dur"])
+        name = str(e.get("name", ""))
+        # python-stack spans from inside start/stop_trace cover the whole
+        # session and would swallow the window; keep them out of the bounds
+        if not (name.startswith("$") or "_trace" in name):
+            t_min = ts if t_min is None else min(t_min, ts)
+            t_max = ts + dur if t_max is None else max(t_max, ts + dur)
+        by_thread.setdefault((e.get("pid"), e.get("tid")), []).append(
+            {
+                "name": str(e.get("name", "")),
+                "ts": ts,
+                "dur": dur,
+                "args": e.get("args") or {},
+            }
+        )
+
+    window_us = (t_max - t_min) if t_min is not None else 0.0
+    ops: dict[str, dict] = {}
+    scopes: dict[str, float] = {}
+    device_intervals: list[tuple[float, float]] = []
+    comm_us = compute_us = 0.0
+    n_op_events = 0
+
+    for key, spans in by_thread.items():
+        spans.sort(key=lambda s: (s["ts"], -s["dur"]))
+        _self_times(spans)
+        if not is_device_thread(*key):
+            continue
+        for s in spans:
+            if _INFRA_RE.search(s["name"]):
+                continue
+            n_op_events += 1
+            device_intervals.append((s["ts"], s["ts"] + s["dur"]))
+            # scope attribution: prefer the long metadata name when present
+            long = s["args"].get("long_name") or s["args"].get("name") or s["name"]
+            if "/" in str(long):
+                parts = [p for p in str(long).split("/") if p]
+                scope = "/".join(parts[:scope_depth])
+                scopes[scope] = scopes.get(scope, 0.0) + s["self_us"]
+            base = _base_name(s["name"])
+            is_comm = bool(_COMM_RE.search(base) or _COMM_RE.search(s["name"]))
+            if is_comm:
+                comm_us += s["self_us"]
+            else:
+                compute_us += s["self_us"]
+            agg = ops.setdefault(
+                base,
+                {"name": base, "count": 0, "total_us": 0.0, "self_us": 0.0,
+                 "category": "comm" if is_comm else "compute"},
+            )
+            agg["count"] += 1
+            agg["total_us"] += s["dur"]
+            agg["self_us"] += s["self_us"]
+
+    device_busy_us = _merge_busy_us(device_intervals)
+    total_self = comm_us + compute_us
+    top = sorted(ops.values(), key=lambda o: -o["self_us"])[:top_k]
+    for o in top:
+        o["total_s"] = round(o.pop("total_us") / 1e6, 6)
+        o["self_s"] = round(o["self_us"] / 1e6, 6)
+        o["share_pct"] = round(100.0 * o.pop("self_us") / total_self, 2) if total_self else 0.0
+    scope_rows = [
+        {"scope": k, "self_s": round(v / 1e6, 6),
+         "share_pct": round(100.0 * v / total_self, 2) if total_self else 0.0}
+        for k, v in sorted(scopes.items(), key=lambda kv: -kv[1])[:top_k]
+    ]
+    return {
+        "window_s": round(window_us / 1e6, 6),
+        "device_busy_s": round(device_busy_us / 1e6, 6),
+        "device_busy_fraction": (
+            round(device_busy_us / window_us, 4) if window_us else 0.0
+        ),
+        "host_gap_s": round(max(window_us - device_busy_us, 0.0) / 1e6, 6),
+        "compute_s": round(compute_us / 1e6, 6),
+        "comm_s": round(comm_us / 1e6, 6),
+        "comm_fraction": round(comm_us / total_self, 4) if total_self else 0.0,
+        "op_events": n_op_events,
+        "top_ops": top,
+        "scopes": scope_rows,
+    }
+
+
+def render_markdown(
+    report: dict,
+    title: str = "PROFILE",
+    context: Optional[dict[str, Any]] = None,
+) -> str:
+    """The generated PROFILE_* artifact body — what used to be typed by
+    hand after running tools/profile_*.py."""
+    lines = [f"# {title}", ""]
+    if context:
+        lines += ["## Context", ""]
+        for k, v in context.items():
+            lines.append(f"- **{k}**: {v}")
+        lines.append("")
+    lines += [
+        "## Decomposition",
+        "",
+        "| window | device busy | busy frac | host gap | compute | comm | comm frac |",
+        "|---|---|---|---|---|---|---|",
+        "| {window_s:.4f}s | {device_busy_s:.4f}s | {device_busy_fraction:.1%} "
+        "| {host_gap_s:.4f}s | {compute_s:.4f}s | {comm_s:.4f}s | {comm_fraction:.1%} |".format(
+            **report
+        ),
+        "",
+        f"## Top ops by self time ({len(report['top_ops'])})",
+        "",
+        "| op | category | count | self (s) | total (s) | share |",
+        "|---|---|---|---|---|---|",
+    ]
+    for o in report["top_ops"]:
+        lines.append(
+            f"| `{o['name']}` | {o['category']} | {o['count']} "
+            f"| {o['self_s']:.6f} | {o['total_s']:.6f} | {o['share_pct']:.1f}% |"
+        )
+    if report.get("scopes"):
+        lines += [
+            "",
+            "## Scope attribution",
+            "",
+            "| scope | self (s) | share |",
+            "|---|---|---|",
+        ]
+        for s in report["scopes"]:
+            lines.append(
+                f"| `{s['scope']}` | {s['self_s']:.6f} | {s['share_pct']:.1f}% |"
+            )
+    if report.get("cost"):
+        lines += ["", "## Cost attribution", ""]
+        for prog, c in report["cost"].items():
+            lines.append(f"- **{prog}**: " + json.dumps(c))
+    lines.append("")
+    return "\n".join(lines)

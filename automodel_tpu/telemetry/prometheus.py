@@ -1,0 +1,442 @@
+"""Minimal Prometheus-text-exposition registry (no client_library dep).
+
+One registry, three metric types, one renderer — enough for a scrape to
+answer "is it healthy right now" without tailing a JSONL:
+
+- serving (`serving/server.py` mounts ``GET /metrics`` on the existing
+  HTTP front): queue depth, running/prefilling slots, block-pool
+  occupancy/evictions/prefix-hits, ttft/decode_tps histograms;
+- training (`metrics_server:` YAML section starts a standalone port):
+  step, loss, step time, tokens/s, analytic + measured MFU, and the
+  hang/desync/skipped-step counters the distributed guard maintains.
+
+Exposition follows the Prometheus text format 0.0.4 (``# HELP``/``# TYPE``
+headers, ``_bucket{le=...}``/``_sum``/``_count`` for histograms). The
+format lint test (tests/test_profiling.py) parses the rendered output with
+the same grammar a scraper uses.
+
+Thread safety: one lock per registry — serving observes from the scheduler
+thread while HTTP handler threads scrape.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Optional, Sequence
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# Default ttft/latency buckets (seconds): sub-ms CPU smoke tests up to the
+# multi-second prefills of long prompts on real chips.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+# decode tokens/sec per request — spans CPU smoke (~1e1) to chip (~1e3+)
+THROUGHPUT_BUCKETS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0)
+
+
+def _fmt(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid prometheus metric name {name!r}")
+        self.name = name
+        self.help = help_text.replace("\n", " ")
+
+
+class Counter(_Metric):
+    """Monotonic counter. ``set_total`` exists for sources that already
+    maintain a cumulative value (e.g. BlockPool.counters) — it refuses to
+    go backwards, preserving counter semantics at the exposition."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help_text: str):
+        super().__init__(name, help_text)
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError(f"counter {self.name}: negative increment {v}")
+        self.value += v
+
+    def set_total(self, total: float) -> None:
+        if total > self.value:
+            self.value = float(total)
+
+    def render(self) -> list[str]:
+        return [f"{self.name}_total {_fmt(self.value)}"]
+
+    @property
+    def render_name(self) -> str:
+        return f"{self.name}_total"
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_text: str):
+        super().__init__(name, help_text)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+    def render(self) -> list[str]:
+        return [f"{self.name} {_fmt(self.value)}"]
+
+    @property
+    def render_name(self) -> str:
+        return self.name
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self, name: str, help_text: str, buckets: Sequence[float] = LATENCY_BUCKETS
+    ):
+        super().__init__(name, help_text)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError(f"histogram {name}: empty buckets")
+        self.buckets = bs
+        self.counts = [0] * len(bs)  # non-cumulative per-bucket counts
+        self.inf_count = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        if v != v:  # NaN observations poison sum and help nobody
+            return
+        self.sum += v
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.inf_count += 1
+
+    @property
+    def count(self) -> int:
+        return sum(self.counts) + self.inf_count
+
+    def render(self) -> list[str]:
+        lines, cum = [], 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            lines.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum + self.inf_count}')
+        lines.append(f"{self.name}_sum {_fmt(self.sum)}")
+        lines.append(f"{self.name}_count {self.count}")
+        return lines
+
+    @property
+    def render_name(self) -> str:
+        return self.name
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self.lock = threading.Lock()
+
+    def _register(self, metric: _Metric) -> _Metric:
+        existing = self._metrics.get(metric.name)
+        if existing is not None:
+            if type(existing) is not type(metric):
+                raise ValueError(
+                    f"metric {metric.name} already registered as {existing.kind}"
+                )
+            return existing
+        self._metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name: str, help_text: str) -> Counter:
+        with self.lock:
+            return self._register(Counter(name, help_text))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_text: str) -> Gauge:
+        with self.lock:
+            return self._register(Gauge(name, help_text))  # type: ignore[return-value]
+
+    def histogram(
+        self, name: str, help_text: str, buckets: Sequence[float] = LATENCY_BUCKETS
+    ) -> Histogram:
+        with self.lock:
+            return self._register(Histogram(name, help_text, buckets))  # type: ignore[return-value]
+
+    def render(self) -> str:
+        """→ the full exposition body (text format 0.0.4)."""
+        with self.lock:
+            out: list[str] = []
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                out.append(f"# HELP {m.render_name} {m.help}")
+                out.append(f"# TYPE {m.render_name} {m.kind}")
+                out.extend(m.render())
+            return "\n".join(out) + "\n"
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class MetricsServerConfig:
+    """The ``metrics_server:`` YAML section — a standalone training-side
+    scrape port (the serving server mounts /metrics on its existing HTTP
+    front and needs no section). The section's PRESENCE opts in; port 0
+    lets the OS pick (tests)."""
+
+    enabled: bool = True
+    port: int = 9100
+    host: str = "127.0.0.1"
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "MetricsServerConfig":
+        d = dict(d or {})
+        d.pop("_target_", None)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise TypeError(f"unknown metrics_server keys: {sorted(unknown)}")
+        return cls(**d)
+
+
+# -- serving-side metric set ---------------------------------------------------
+
+
+class ServingMetrics:
+    """The serving registry: histograms observed per completed request (from
+    the scheduler thread), gauges + pool counters synced from engine state
+    at scrape time (``sync`` — called under the engine lock, so a scrape is
+    a consistent snapshot and the hot loop pays nothing per step)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or MetricsRegistry()
+        self.registry = r
+        self.ttft = r.histogram(
+            "automodel_serve_ttft_seconds",
+            "Time from submit to first token, per completed request",
+        )
+        self.decode_tps = r.histogram(
+            "automodel_serve_decode_tps",
+            "Decode tokens/second per completed request",
+            buckets=THROUGHPUT_BUCKETS,
+        )
+        self.queue_wait = r.histogram(
+            "automodel_serve_queue_seconds",
+            "Time from submit to admission, per completed request",
+        )
+        self.completed = r.counter(
+            "automodel_serve_requests_completed",
+            "Requests completed since engine start",
+        )
+        self.gen_tokens = r.counter(
+            "automodel_serve_generated_tokens",
+            "Tokens generated since engine start",
+        )
+        self.queue_depth = r.gauge(
+            "automodel_serve_queue_depth", "Requests waiting for admission"
+        )
+        self.running = r.gauge(
+            "automodel_serve_running_slots", "Slots in the decode wave"
+        )
+        self.prefilling = r.gauge(
+            "automodel_serve_prefilling_slots", "Slots mid-prefill"
+        )
+        self.occupancy = r.gauge(
+            "automodel_serve_block_occupancy",
+            "Fraction of the usable KV block pool referenced by live sequences",
+        )
+        self.blocks_in_use = r.gauge(
+            "automodel_serve_blocks_in_use", "KV blocks referenced by live sequences"
+        )
+        self._pool_counters = {
+            key: r.counter(f"automodel_serve_block_{key}", help_text)
+            for key, help_text in (
+                ("allocated", "KV blocks handed out by the allocator"),
+                ("freed", "KV blocks returned to the allocator"),
+                ("evictions", "Prefix-cache blocks evicted to satisfy allocations"),
+                ("failed_allocs", "Allocations the pool could not satisfy"),
+                ("prefix_hits", "Requests that matched >= 1 cached prefix block"),
+                ("prefix_blocks_reused", "Prefix-cache blocks reused by admissions"),
+                ("prefix_tokens_reused", "Prompt tokens served from the prefix cache"),
+            )
+        }
+
+    def observe_request(self, rec: dict) -> None:
+        """Per-completion observation (serving/engine.py ``_finish``)."""
+        with self.registry.lock:
+            if isinstance(rec.get("ttft_s"), (int, float)):
+                self.ttft.observe(rec["ttft_s"])
+            if isinstance(rec.get("decode_tps"), (int, float)):
+                self.decode_tps.observe(rec["decode_tps"])
+            if isinstance(rec.get("queue_s"), (int, float)):
+                self.queue_wait.observe(rec["queue_s"])
+            self.completed.inc()
+            self.gen_tokens.inc(rec.get("n_generated", 0) or 0)
+
+    def sync(self, engine) -> None:
+        """Pull current scheduler/allocator state (call under the engine
+        lock; the serving HTTP handler does this per scrape)."""
+        with self.registry.lock:
+            self.queue_depth.set(engine.queue_depth)
+            running = sum(
+                1 for s in engine._slots if s is not None and s.decoding
+            )
+            prefilling = engine.busy_slots - running
+            self.running.set(running)
+            self.prefilling.set(prefilling)
+            self.occupancy.set(engine.pool.occupancy())
+            self.blocks_in_use.set(engine.pool.in_use())
+            for key, counter in self._pool_counters.items():
+                counter.set_total(engine.pool.counters.get(key, 0))
+
+
+# -- training-side metric set --------------------------------------------------
+
+# log-record key → (metric name, help). Gauges: last-logged value.
+_TRAIN_GAUGES = {
+    "step": ("automodel_train_step", "Last logged optimizer step"),
+    "loss": ("automodel_train_loss", "Last logged training loss"),
+    "step_time_s": (
+        "automodel_train_step_time_seconds",
+        "Amortized step time over the last log window",
+    ),
+    "tps": (
+        "automodel_train_tokens_per_second",
+        "Tokens/second over the last log window",
+    ),
+    "tps_per_device": (
+        "automodel_train_tokens_per_second_per_device",
+        "Tokens/second/device over the last log window",
+    ),
+    "grad_norm": ("automodel_train_grad_norm", "Last logged global gradient norm"),
+    "mfu_pct": (
+        "automodel_train_mfu_pct",
+        "Analytic MFU percent (flops_utils law) over the last log window",
+    ),
+    "mfu_measured_pct": (
+        "automodel_train_mfu_measured_pct",
+        "Measured MFU percent (cost-attributed step program) over the last log window",
+    ),
+    "heartbeat_age_s": (
+        "automodel_train_heartbeat_age_seconds",
+        "Watchdog heartbeat age at the last log barrier",
+    ),
+}
+_TRAIN_CUMULATIVE = {
+    "skipped_steps_total": (
+        "automodel_train_skipped_steps",
+        "Steps discarded by the non-finite policy",
+    ),
+    "rollbacks_total": (
+        "automodel_train_rollbacks",
+        "Checkpoint rollbacks taken by the non-finite policy",
+    ),
+    "recompiles": (
+        "automodel_train_recompiles",
+        "XLA recompiles after the initial step",
+    ),
+}
+_TRAIN_EVENT_COUNTERS = {
+    "hang": ("automodel_train_hang_events", "Watchdog hang detections"),
+    "desync": ("automodel_train_desync_events", "Cross-host desync detections"),
+    "nonfinite_step": (
+        "automodel_train_nonfinite_steps",
+        "Steps whose loss/grads were non-finite",
+    ),
+    "trace_capture": (
+        "automodel_train_trace_captures",
+        "Triggered profiler captures",
+    ),
+}
+
+
+class TrainMetricsExporter:
+    """Folds train-loop log records and telemetry events into the registry.
+    ``update(record)`` at each log barrier; ``event(name)`` from the guard/
+    telemetry event hooks."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry or MetricsRegistry()
+        self.registry = r
+        self._gauges = {k: r.gauge(*spec) for k, spec in _TRAIN_GAUGES.items()}
+        self._cumulative = {
+            k: r.counter(*spec) for k, spec in _TRAIN_CUMULATIVE.items()
+        }
+        self._events = {
+            k: r.counter(*spec) for k, spec in _TRAIN_EVENT_COUNTERS.items()
+        }
+
+    def update(self, record: dict) -> None:
+        with self.registry.lock:
+            for k, g in self._gauges.items():
+                v = record.get(k)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    g.set(v)
+            for k, c in self._cumulative.items():
+                v = record.get(k)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    if k == "recompiles":  # per-window count, not cumulative
+                        c.inc(v)
+                    else:
+                        c.set_total(v)
+
+    def event(self, name: str) -> None:
+        c = self._events.get(name)
+        if c is not None:
+            with self.registry.lock:
+                c.inc()
+
+
+# -- standalone metrics port (training side) -----------------------------------
+
+
+def start_metrics_server(
+    registry: MetricsRegistry, port: int, host: str = "127.0.0.1"
+):
+    """Serve ``GET /metrics`` from a daemon thread → the started
+    ThreadingHTTPServer (``.server_address[1]`` has the bound port; pass
+    port 0 to let the OS pick — the tests do). ``shutdown()`` stops it."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass  # scrapes are not stderr news
+
+        def do_GET(self):
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

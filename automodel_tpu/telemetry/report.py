@@ -57,6 +57,23 @@ _NUMERIC_KEYS = (
     "host_step_time_max_s",
     "host_step_time_median_s",
     "straggler_ratio",
+    # profiling pillar (telemetry/profiling/): per-window MFU provenances +
+    # the cost_attribution event's measured program numbers + the
+    # trace_capture event's trigger evidence
+    "mfu_pct",
+    "mfu_measured_pct",
+    "flops",
+    "dot_flops",
+    "conv_flops",
+    "bytes_est",
+    "elementwise_bytes",
+    "collective_bytes",
+    "hlo_flops",
+    "hlo_bytes",
+    "arithmetic_intensity",
+    "ridge_intensity",
+    "comm_fraction",
+    "factor",
 )
 
 
@@ -177,6 +194,34 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
     mfu = [r["mfu"] for r in records if isinstance(r.get("mfu"), (int, float))]
     if mfu:
         out["mfu_mean"] = sum(mfu) / len(mfu)
+    # profiling pillar: analytic vs measured MFU ride the same records; the
+    # cost_attribution event carries roofline class, the trace_capture
+    # events are anomaly evidence worth headlining
+    for key in ("mfu_pct", "mfu_measured_pct"):
+        vals = [r[key] for r in records if isinstance(r.get(key), (int, float))]
+        if vals:
+            out[f"{key}_mean"] = sum(vals) / len(vals)
+    costs = [r for r in records if r.get("event") == "cost_attribution"]
+    if costs:
+        out["cost_programs"] = [
+            {
+                "program": r.get("program"),
+                "roofline_class": r.get("roofline_class"),
+                "flops": r.get("flops"),
+            }
+            for r in costs
+        ]
+    captures = [r for r in records if r.get("event") == "trace_capture"]
+    if captures:
+        out["trace_captures"] = [
+            {
+                "step": r.get("step"),
+                "reason": r.get("reason"),
+                "capture_path": r.get("capture_path"),
+                "skipped": r.get("skipped"),
+            }
+            for r in captures
+        ]
     gens = [r for r in records if r.get("event") == "generation"]
     if gens:
         out["generation_records"] = len(gens)

@@ -26,6 +26,19 @@ class ProfilerConfig:
     trace_dir: str = "/tmp/automodel_tpu_trace"
     start_step: int = 3
     end_step: int = 5
+    # also write the Chrome-trace-event JSON (perfetto_trace.json.gz) the
+    # telemetry/profiling trace analyzer parses — on by default so every
+    # captured window is analyzable without xplane tooling
+    perfetto: bool = True
+
+
+def start_trace(trace_dir: str, perfetto: bool = True) -> None:
+    """One place to start a jax trace with the perfetto JSON enabled
+    (gracefully degrades on jax builds without the kwarg)."""
+    try:
+        jax.profiler.start_trace(trace_dir, create_perfetto_trace=perfetto)
+    except TypeError:
+        jax.profiler.start_trace(trace_dir)
 
 
 class StepProfiler:
@@ -35,6 +48,10 @@ class StepProfiler:
         self.config = config
         self._active = False
 
+    @property
+    def active(self) -> bool:
+        return self._active
+
     def on_step(self, step: int) -> None:
         c = self.config
         if not c.enabled:
@@ -43,7 +60,7 @@ class StepProfiler:
         # checkpoint at step > start_step must still open the trace for the
         # remainder of its window instead of silently never profiling
         if not self._active and c.start_step <= step < c.end_step:
-            jax.profiler.start_trace(c.trace_dir)
+            start_trace(c.trace_dir, perfetto=c.perfetto)
             self._active = True
             logger.info("profiler: trace started at step %d → %s", step, c.trace_dir)
         elif self._active and step >= c.end_step:

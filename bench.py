@@ -107,36 +107,25 @@ def _moe_backend(experts: str) -> dict:
     }
 
 
-def _reset_between_legs() -> None:
-    """Leg isolation: BENCH_r05 recorded every leg as 0.0 after cascading
-    OOMs — a failed leg's params/opt-state/compiled executables stayed
-    resident and starved every later leg. Deleting live buffers (not just
-    dropping python references — deletion returns HBM immediately instead
-    of waiting on GC) and clearing the jit/compile caches puts each leg
-    back to a cold chip."""
-    import gc
-
-    gc.collect()
-    for arr in jax.live_arrays():
-        try:
-            arr.delete()
-        except Exception:
-            pass  # already deleted / donated
-    jax.clear_caches()
-    gc.collect()
-
+# (the old in-process `_reset_between_legs` buffer-delete/cache-clear dance
+# is gone: every leg now runs in its own subprocess — see the "subprocess
+# leg isolation" section below — so a cold chip per leg holds by
+# construction, not by cleanup)
 
 _first_oom_pending = True
 
 
-def _oom_memory_dump(leg: str) -> str | None:
+def _oom_memory_dump(leg: str, extra: dict | None = None) -> str | None:
     """Force-dump allocator stats + the live-array census when a leg dies,
-    BEFORE _reset_between_legs frees the buffers — the census names what
-    filled the chip (the diagnostic every all-zero BENCH_r05 leg lacked).
-    The dump records the leg name and whether this was the FIRST OOM of the
-    run: only the first one sees the chip in its pristine failure state —
-    later legs run after resets and their censuses reflect cascade, not
-    cause. → dump path, or None if even the dump failed."""
+    BEFORE anything frees the buffers — the census names what filled the
+    chip (the diagnostic every all-zero BENCH_r05 leg lacked). With
+    subprocess leg isolation every leg dies in a pristine process, so the
+    census always reflects cause, never cascade; the ``first_oom`` flag is
+    kept (first OOM of THIS process) for artifact compatibility. ``extra``
+    merges additional evidence into the record — the worker attaches the
+    profiling subsystem's cost summary (what the step program WOULD have
+    computed/moved) beside what actually filled the chip. → dump path, or
+    None if even the dump failed."""
     global _first_oom_pending
     try:
         from automodel_tpu.telemetry.memory import memory_snapshot
@@ -148,6 +137,7 @@ def _oom_memory_dump(leg: str) -> str | None:
                     "leg": leg,
                     "first_oom": _first_oom_pending,
                     **memory_snapshot(top_k=12),
+                    **(extra or {}),
                 },
                 f, indent=2, default=str,
             )
@@ -157,6 +147,38 @@ def _oom_memory_dump(leg: str) -> str | None:
         return path
     except Exception:
         return None
+
+
+def _abstract_step_cost(hf: dict, backend: dict, batch: int, seq: int) -> dict:
+    """Cost summary of the leg's train step traced ABSTRACTLY (eval_shape
+    params + ShapeDtypeStruct batch — zero device memory, so it works in
+    the post-OOM wreckage): measured FLOPs/bytes of the program the chip
+    was asked to run. Attached to the first-OOM record so an exhausted leg
+    reports what it was trying to compute, not just a null."""
+    import jax
+
+    from automodel_tpu.models.common.config import BackendConfig
+    from automodel_tpu.models.registry import resolve_architecture
+    from automodel_tpu.optim.builders import build_optimizer
+    from automodel_tpu.telemetry.profiling import trace_cost
+    from automodel_tpu.training.train_state import TrainState
+    from automodel_tpu.training.train_step import build_train_step, make_causal_lm_loss
+
+    bk = BackendConfig(**backend) if isinstance(backend, dict) else backend
+    model, _ = resolve_architecture(hf)(hf, bk)
+    params = jax.eval_shape(model.init, jax.random.key(0))
+    loss_fn = make_causal_lm_loss(model, loss="fused_linear_ce")
+    optimizer = build_optimizer(
+        name="adamw", lr=1e-4, betas=(0.9, 0.95), moments_dtype="param"
+    )
+    opt_state = jax.eval_shape(optimizer.init, params)
+    state = TrainState.create(params, opt_state)
+    ids = jax.ShapeDtypeStruct((1, batch, seq), jax.numpy.int32)
+    step = build_train_step(loss_fn, optimizer)
+    cost = trace_cost(
+        step, state, {"input_ids": ids, "labels": ids}, program="train_step"
+    )
+    return cost.to_dict()
 
 
 def _is_oom(exc: Exception) -> bool:
@@ -398,6 +420,131 @@ def _wait_for_tpu() -> tuple[bool, str | None]:
         time.sleep(min(60.0, remaining))
 
 
+def _dense_batches(label: str, env_batch: str | None) -> list[int]:
+    """Batch attempts for one dense shape. Default batch measured on the
+    16GB v5e with activation-side LoRA: 6b fits at batch 1 (67.9% MFU); 8b
+    params alone (15.3G bf16) don't fit. Below the SMALLEST shape the
+    ladder keeps shrinking (4 → 2 → 1) so a tight chip reports 0.9b@2 or
+    @1 instead of a null round (ROADMAP item 3). An explicit BENCH_BATCH
+    pins one attempt everywhere."""
+    if env_batch is not None:
+        return [int(env_batch)]
+    default = 1 if label in ("8b", "6b") else 4
+    if label == DENSE_SHAPES[-1][0]:
+        return [b for b in (4, 2, 1) if b <= default] or [1]
+    return [default]
+
+
+# -- subprocess leg isolation --------------------------------------------------
+#
+# Every leg runs in its OWN process with a structured result file (ROADMAP
+# item 3: in-process isolation via _reset_between_legs still left cascade
+# effects — a leg that corrupted the XLA client state, or an OOM the
+# allocator never fully recovered from, poisoned every later leg; r5 zeroed
+# ALL legs that way). A subprocess gives each leg a cold chip by
+# construction, and a worker that dies (OOM-killed, segfault) still yields
+# a named failure instead of taking the whole bench down. The orchestrator
+# never initializes the device backend at all — on TPU the runtime is
+# process-exclusive, so holding it would starve every worker.
+
+
+def _worker_main(spec: dict, result_path: str) -> int:
+    """One leg, one process: run, write {ok, tps_chip, fpt, peak_tflops,
+    n_devices, platform} or {ok: false, error, oom, census_path, cost}."""
+    out: dict = {"ok": False, "leg": spec.get("leg", "?")}
+    try:
+        if spec.get("force_cpu"):
+            jax.config.update("jax_platforms", "cpu")
+        from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
+        from automodel_tpu.utils.flops_utils import device_peak_tflops
+
+        ctx = build_mesh(MeshConfig(dp_shard=-1))
+        tps, fpt = _run(
+            spec["hf"], spec["backend"], int(spec["batch"]), int(spec["seq"]),
+            int(spec["steps"]), ctx,
+            lora=bool(spec.get("lora")), qlora=bool(spec.get("qlora")),
+        )
+        out = {
+            "ok": True,
+            "leg": spec.get("leg", "?"),
+            "tps_chip": tps,
+            "fpt": fpt,
+            "peak_tflops": device_peak_tflops(),
+            "n_devices": len(jax.devices()),
+            "platform": jax.devices()[0].platform,
+        }
+    except Exception as exc:
+        oom = _is_oom(exc)
+        out.update(error=str(exc)[:2000], oom=oom)
+        if oom:
+            # the profiling subsystem's cost summary (abstract re-trace, no
+            # device memory) beside the live-buffer census: what the step
+            # wanted to compute/move vs what actually filled the chip
+            cost: dict | None
+            try:
+                cost = _abstract_step_cost(
+                    spec["hf"], spec["backend"], int(spec["batch"]), int(spec["seq"])
+                )
+                if spec.get("lora") or spec.get("qlora"):
+                    # the abstract trace models the FULL-PARAMETER step;
+                    # the leg's real program differs (frozen base, adapter-
+                    # only moments, NF4 packing) — label it so the OOM
+                    # post-mortem reads it as a bound, not an account
+                    cost["note"] = (
+                        "full-parameter dense-equivalent step: the leg ran "
+                        "LoRA/QLoRA (frozen base, adapter-only optimizer "
+                        "state, NF4-packed base for qlora) — treat FLOPs/"
+                        "bytes as an upper bound, not a byte-accurate "
+                        "account of what OOMed"
+                    )
+            except Exception as ce:
+                cost = {"error": f"{type(ce).__name__}: {ce}"}
+            out["cost"] = cost
+            out["census_path"] = _oom_memory_dump(
+                spec.get("leg", "leg"), extra={"cost": cost}
+            )
+    with open(result_path, "w") as f:
+        json.dump(out, f, indent=2, default=str)
+    return 0 if out.get("ok") else 1
+
+
+def _run_leg(leg: str, spec: dict, timeout_s: float | None = None) -> dict:
+    """Spawn `python bench.py --worker ...` → the worker's result dict.
+    A worker that crashes without writing a result (OOM-killed, segfault)
+    or times out still produces a structured failure."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("BENCH_INPROC"):  # debugging escape hatch
+        with tempfile.NamedTemporaryFile("r", suffix=".json", delete=False) as f:
+            path = f.name
+        _worker_main({**spec, "leg": leg}, path)
+        with open(path) as f:
+            return json.load(f)
+    timeout_s = timeout_s or float(os.environ.get("BENCH_LEG_TIMEOUT_S", 5400))
+    with tempfile.TemporaryDirectory(prefix="bench_leg_") as td:
+        path = os.path.join(td, f"{leg}.json")
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--worker", json.dumps({**spec, "leg": leg}), "--result", path,
+        ]
+        try:
+            r = subprocess.run(cmd, timeout=timeout_s)  # stderr streams through
+        except subprocess.TimeoutExpired:
+            return {
+                "ok": False, "leg": leg, "oom": False,
+                "error": f"leg timed out after {timeout_s:.0f}s (hung worker killed)",
+            }
+        if not os.path.exists(path):
+            return {
+                "ok": False, "leg": leg, "oom": False,
+                "error": f"worker died (rc {r.returncode}) without writing a result "
+                "— likely OOM-killed or segfaulted before the handler ran",
+            }
+        with open(path) as f:
+            return json.load(f)
+
+
 def main() -> None:
     tpu_ok, env_failure = _wait_for_tpu()
     if env_failure is not None:
@@ -415,28 +562,34 @@ def main() -> None:
         )
         print(f"[bench] ENVIRONMENT FAILURE: {env_failure}", file=sys.stderr, flush=True)
         raise SystemExit(2)
+    from automodel_tpu.utils.flops_utils import calculate_mfu
+
     if not tpu_ok:
-        print("[bench] TPU backend unavailable; pinning cpu", file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
-
-    from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
-    from automodel_tpu.utils.flops_utils import calculate_mfu, device_peak_tflops
-
-    on_tpu = jax.devices()[0].platform == "tpu"
-    ctx = build_mesh(MeshConfig(dp_shard=-1))
-    peak = device_peak_tflops()
-
-    if not on_tpu:
-        # CPU smoke path so the bench runs anywhere
+        # CPU smoke path so the bench runs anywhere — still a subprocess
+        # leg, so the smoke exercises the same isolation machinery
+        print("[bench] TPU backend unavailable; cpu smoke leg", file=sys.stderr)
         hf = _dense_hf(("smoke", 128, 352, 2, 4, 2))
         hf.update(vocab_size=1024, head_dim=32)
-        backend = {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "bfloat16"}
-        tps, fpt = _run(hf, backend, 4, 256, 2, ctx, lora=True)
+        res = _run_leg(
+            "cpu_smoke",
+            {
+                "hf": hf,
+                "backend": {
+                    "attn": "sdpa", "param_dtype": "float32",
+                    "compute_dtype": "bfloat16",
+                },
+                "batch": 4, "seq": 256, "steps": 2, "lora": True,
+                "force_cpu": True,
+            },
+        )
+        if not res.get("ok"):
+            print(f"[bench] cpu smoke failed: {res.get('error')}", file=sys.stderr)
+            raise SystemExit(1)
         print(
             json.dumps(
                 {
                     "metric": "llama_dense_lora_tflops",
-                    "value": round(tps * fpt / 1e12, 4),
+                    "value": round(res["tps_chip"] * res["fpt"] / 1e12, 4),
                     "unit": "TFLOPs/s/chip",
                     "vs_baseline": 0.0,
                     "note": "cpu smoke",
@@ -447,70 +600,91 @@ def main() -> None:
 
     seq = int(os.environ.get("BENCH_SEQ", 4096))
     steps = 8
+    peak = float("nan")  # reported by the first successful worker
 
-    # ---- dense LoRA (headline) — largest shape that fits ----
+    # ---- dense LoRA (headline) — largest shape that fits, each attempt a
+    # pristine subprocess; below the smallest shape the batch ladder
+    # (4 → 2 → 1) keeps shrinking the footprint before giving up ----
     dense_mfu, dense_label, dense_tflops = float("nan"), "none", 0.0
+    dense_done = False  # a leg RAN successfully (mfu may still be NaN when
+    # the device kind is missing from the peak table — that must stop the
+    # ladder and report TFLOPs + a named reason, not re-run every shape)
     dense_failures: list[str] = []
+    dense_backend = {
+        "attn": "flash",
+        "param_dtype": "bfloat16",
+        "compute_dtype": "bfloat16",
+        "remat": os.environ.get("BENCH_REMAT", "full"),
+    }
     for shape in DENSE_SHAPES:
         label = shape[0]
-        try:
-            backend = {
-                "attn": "flash",
-                "param_dtype": "bfloat16",
-                "compute_dtype": "bfloat16",
-                "remat": os.environ.get("BENCH_REMAT", "full"),
-            }
-            # measured on the 16GB v5e with activation-side LoRA: 6b fits at
-            # batch 1 (67.9% MFU); 8b params alone (15.3G bf16) don't fit
-            batch = int(os.environ.get("BENCH_BATCH", 1 if label in ("8b", "6b") else 4))
-            tps, fpt = _run(_dense_hf(shape), backend, batch, seq, steps, ctx, lora=True)
-            dense_mfu = calculate_mfu(tps, fpt, peak)
-            dense_tflops = tps * fpt / 1e12
-            dense_label = label
+        batches = _dense_batches(label, os.environ.get("BENCH_BATCH"))
+        for batch in batches:
+            leg = f"dense_{label}_b{batch}"
+            res = _run_leg(
+                leg,
+                {"hf": _dense_hf(shape), "backend": dense_backend,
+                 "batch": batch, "seq": seq, "steps": steps, "lora": True},
+            )
+            if res.get("ok"):
+                dense_done = True
+                peak = float(res.get("peak_tflops", float("nan")))
+                dense_mfu = calculate_mfu(res["tps_chip"], res["fpt"], peak)
+                dense_tflops = res["tps_chip"] * res["fpt"] / 1e12
+                dense_label = label if batch == batches[0] else f"{label}_b{batch}"
+                print(
+                    f"[bench] dense-{label} b{batch} LoRA tok/s/chip="
+                    f"{res['tps_chip']:,.0f} TFLOPs/s={dense_tflops:.1f} "
+                    f"MFU={dense_mfu:.3f}",
+                    file=sys.stderr, flush=True,
+                )
+                break
+            kind = "OOM" if res.get("oom") else f"error: {res.get('error')}"
+            census = res.get("census_path")
+            dense_failures.append(
+                f"{label} b{batch}: {kind}" + (f" (census: {census})" if census else "")
+            )
             print(
-                f"[bench] dense-{label} LoRA tok/s/chip={tps:,.0f} "
-                f"TFLOPs/s={dense_tflops:.1f} MFU={dense_mfu:.3f}",
+                f"[bench] dense-{label} b{batch} {kind}; trying smaller",
                 file=sys.stderr, flush=True,
             )
+        if dense_done:
             break
-        except Exception as exc:  # OOM → next smaller shape
-            if not _is_oom(exc):
-                raise
-            dump = _oom_memory_dump(f"dense_{label}")
-            dense_failures.append(f"{label}: OOM" + (f" (census: {dump})" if dump else ""))
-            print(f"[bench] dense-{label} OOM; trying smaller", file=sys.stderr, flush=True)
-            _reset_between_legs()
-    _reset_between_legs()
 
     # ---- true-8B QLoRA (VERDICT r3 #2): NF4 base ~4.5GB fits the chip ----
     qlora_mfu, qlora_tflops = float("nan"), 0.0
     qlora_failure = None
-    try:
-        backend = {
-            "attn": "flash",
-            "param_dtype": "bfloat16",
-            "compute_dtype": "bfloat16",
-            "remat": "full",
-        }
-        tps, fpt = _run(
-            _dense_hf(DENSE_SHAPES[0]), backend,
-            int(os.environ.get("BENCH_QLORA_BATCH", 1)), seq, steps, ctx,
-            qlora=True,
-        )
-        qlora_mfu = calculate_mfu(tps, fpt, peak)
-        qlora_tflops = tps * fpt / 1e12
+    res = _run_leg(
+        "qlora_8b",
+        {
+            "hf": _dense_hf(DENSE_SHAPES[0]),
+            "backend": {
+                "attn": "flash", "param_dtype": "bfloat16",
+                "compute_dtype": "bfloat16", "remat": "full",
+            },
+            "batch": int(os.environ.get("BENCH_QLORA_BATCH", 1)),
+            "seq": seq, "steps": steps, "qlora": True,
+        },
+    )
+    if res.get("ok"):
+        peak = float(res.get("peak_tflops", peak))
+        qlora_mfu = calculate_mfu(res["tps_chip"], res["fpt"], peak)
+        qlora_tflops = res["tps_chip"] * res["fpt"] / 1e12
+        if qlora_mfu != qlora_mfu:  # ran fine; device peak unknown
+            qlora_failure = (
+                f"measured {qlora_tflops:.1f} TFLOPs/s/chip but the device "
+                "kind is missing from TPU_PEAK_BF16_TFLOPS — no MFU basis"
+            )
         print(
-            f"[bench] dense-8b QLoRA tok/s/chip={tps:,.0f} "
+            f"[bench] dense-8b QLoRA tok/s/chip={res['tps_chip']:,.0f} "
             f"TFLOPs/s={qlora_tflops:.1f} MFU={qlora_mfu:.3f}",
             file=sys.stderr, flush=True,
         )
-    except Exception as exc:
-        qlora_failure = f"OOM: {exc}" if _is_oom(exc) else str(exc)
-        dump = _oom_memory_dump("qlora_8b")
-        if dump:
-            qlora_failure += f" (census: {dump})"
-        print(f"[bench] 8b QLoRA leg failed: {exc}", file=sys.stderr, flush=True)
-    _reset_between_legs()
+    else:
+        qlora_failure = ("OOM: " if res.get("oom") else "") + str(res.get("error"))
+        if res.get("census_path"):
+            qlora_failure += f" (census: {res['census_path']})"
+        print(f"[bench] 8b QLoRA leg failed: {res.get('error')}", file=sys.stderr, flush=True)
 
     # ---- MoE pretrain (fake balanced gate, reference bench conditions) ----
     # single-chip backend choice (measured on the v5e): ragged via the Pallas
@@ -519,38 +693,50 @@ def main() -> None:
     # compile helper at bench-scale token counts; the Pallas kernel is both
     # the fix and faster.) Multi-chip meshes use a2a (same kernel inside).
     # ragged_fused (one-kernel expert MLP + remat policy that saves the sort
-    # permutations) shipped in r4 but has never run on the chip — race it
-    # against ragged and publish the winner; BENCH_MOE_EXPERTS pins one.
+    # permutations) is raced against ragged; BENCH_MOE_EXPERTS pins one.
     moe_mfu, moe_tflops, moe_backend = float("nan"), 0.0, "none"
     pinned = os.environ.get("BENCH_MOE_EXPERTS")
     candidates = [pinned] if pinned else ["ragged_fused", "ragged"]
     moe_tried = {}
     moe_failures: dict[str, str] = {}
     for experts in candidates:
-        try:
-            backend = _moe_backend(experts)
-            tps, fpt = _run(
-                _moe_hf(), backend, int(os.environ.get("BENCH_MOE_BATCH", 6)),
-                seq, steps, ctx,
-            )
-            mfu = calculate_mfu(tps, fpt, peak)
+        res = _run_leg(
+            f"moe_{experts}",
+            {
+                "hf": _moe_hf(), "backend": _moe_backend(experts),
+                "batch": int(os.environ.get("BENCH_MOE_BATCH", 6)),
+                "seq": seq, "steps": steps,
+            },
+        )
+        if res.get("ok"):
+            peak = float(res.get("peak_tflops", peak))
+            mfu = calculate_mfu(res["tps_chip"], res["fpt"], peak)
+            if mfu != mfu:  # ran fine; device peak unknown — no MFU basis
+                moe_failures[experts] = (
+                    f"measured {res['tps_chip'] * res['fpt'] / 1e12:.1f} "
+                    "TFLOPs/s/chip but the device kind is missing from "
+                    "TPU_PEAK_BF16_TFLOPS — no MFU basis"
+                )
+                continue
             moe_tried[experts] = round(mfu * 100, 2)
             print(
-                f"[bench] moe[{experts}] tok/s/chip={tps:,.0f} "
-                f"TFLOPs/s={tps * fpt / 1e12:.1f} MFU={mfu:.3f}",
+                f"[bench] moe[{experts}] tok/s/chip={res['tps_chip']:,.0f} "
+                f"TFLOPs/s={res['tps_chip'] * res['fpt'] / 1e12:.1f} MFU={mfu:.3f}",
                 file=sys.stderr, flush=True,
             )
             if moe_mfu != moe_mfu or mfu > moe_mfu:
-                moe_mfu, moe_tflops, moe_backend = mfu, tps * fpt / 1e12, experts
-        except Exception as exc:
-            failure = f"OOM: {exc}" if _is_oom(exc) else str(exc)
-            dump = _oom_memory_dump(f"moe_{experts}")
-            moe_failures[experts] = failure + (f" (census: {dump})" if dump else "")
+                moe_mfu = mfu
+                moe_tflops = res["tps_chip"] * res["fpt"] / 1e12
+                moe_backend = experts
+        else:
+            failure = ("OOM: " if res.get("oom") else "") + str(res.get("error"))
+            if res.get("census_path"):
+                failure += f" (census: {res['census_path']})"
+            moe_failures[experts] = failure
             print(
-                f"[bench] moe[{experts}] leg failed: {exc}",
+                f"[bench] moe[{experts}] leg failed: {res.get('error')}",
                 file=sys.stderr, flush=True,
             )
-        _reset_between_legs()
 
     # every dense shape OOMed → value null + reason, NOT 0.0: a 0.0 in the
     # emitted JSON must mean "measured and got zero", never "leg never ran"
@@ -558,7 +744,12 @@ def main() -> None:
     dense_ok = dense_mfu == dense_mfu
     dense_failure = (
         None if dense_ok
-        else "every dense shape OOMed: " + "; ".join(dense_failures)
+        else (
+            f"measured {dense_label} at {dense_tflops:.1f} TFLOPs/s/chip but "
+            "the device kind is missing from TPU_PEAK_BF16_TFLOPS — no MFU "
+            "basis (add the new chip to utils/flops_utils.py)"
+        ) if dense_done
+        else "every dense shape failed: " + "; ".join(dense_failures)
     )
     result = {
             "metric": f"llama_dense_lora_mfu_{dense_label}",
@@ -606,4 +797,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        _spec = json.loads(sys.argv[sys.argv.index("--worker") + 1])
+        _result = sys.argv[sys.argv.index("--result") + 1]
+        raise SystemExit(_worker_main(_spec, _result))
     main()

@@ -126,6 +126,25 @@ def test_example_yaml_parses_and_dry_instantiates(path):
 
         ServeConfig.from_dict(srv)
 
+    # profiling: → ProfilingConfig (+ nested triggered: sub-section)
+    prof = _section(cfg, "profiling")
+    if prof is not None:
+        from automodel_tpu.telemetry.profiling import (
+            ProfilingConfig,
+            TriggeredCaptureConfig,
+        )
+
+        p = ProfilingConfig.from_dict(prof)
+        assert p.mode in ("train", "generate"), f"{path}: profiling.mode {p.mode!r}"
+        TriggeredCaptureConfig(**(dict(p.triggered or {})))
+
+    # metrics_server: → MetricsServerConfig
+    ms = _section(cfg, "metrics_server")
+    if ms is not None:
+        from automodel_tpu.telemetry.prometheus import MetricsServerConfig
+
+        MetricsServerConfig.from_dict(ms)
+
     # launcher sections → SlurmConfig / K8sConfig
     sl = _section(cfg, "slurm")
     if sl is not None:

@@ -14,7 +14,9 @@ echo "[chip_suite] probing TPU (timeout ${BENCH_TPU_PROBE_S:-300}s)..." >&2
 python -c '
 import os, sys
 from bench import _probe_tpu
-sys.exit(0 if _probe_tpu(float(os.environ.get("BENCH_TPU_PROBE_S", "300"))) == "tpu" else 1)
+# _probe_tpu returns (status, stderr) since the env-failure detection landed
+status, _ = _probe_tpu(float(os.environ.get("BENCH_TPU_PROBE_S", "300")))
+sys.exit(0 if status == "tpu" else 1)
 ' || { echo "[chip_suite] no TPU; aborting" >&2; exit 1; }
 
 echo "[chip_suite] bench (dense LoRA + 8B QLoRA + MoE ragged_fused-vs-ragged race)" >&2
@@ -27,4 +29,18 @@ echo "[chip_suite] MoE profile" >&2
 python tools/profile_moe.py 2>&1 | tee PROFILE_MOE_chip.txt \
     || echo "[chip_suite] profile_moe failed (bench evidence still valid)" >&2
 
-echo "[chip_suite] done — BENCH_chip.json / PROFILE_MOE_chip.txt" >&2
+# generated PROFILE artifacts (telemetry/profiling/runner.py): trace window
+# around real steps of the dense bench config → committed PROFILE_chip.md +
+# report JSON, replacing the hand-typed PROFILE_* workflow
+echo "[chip_suite] generated profile (automodel_tpu profile)" >&2
+if python -m automodel_tpu.cli.app profile \
+        -c examples/benchmark/llama_dense_bench.yaml \
+        --output_dir=chip_profile_run; then
+    cp chip_profile_run/profile/PROFILE.md PROFILE_chip.md
+    cp chip_profile_run/profile/report.json PROFILE_chip.json
+    echo "[chip_suite] committed PROFILE_chip.md / PROFILE_chip.json" >&2
+else
+    echo "[chip_suite] profile run failed (bench evidence still valid)" >&2
+fi
+
+echo "[chip_suite] done — BENCH_chip.json / PROFILE_MOE_chip.txt / PROFILE_chip.md" >&2
