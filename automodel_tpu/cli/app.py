@@ -22,7 +22,7 @@ def _usage() -> str:
         "usage: automodel_tpu <finetune|pretrain|kd|benchmark|mine> <llm|vlm|biencoder> "
         "-c config.yaml [--dotted.key=value ...]\n"
         "       automodel_tpu generate -c config.yaml [--prompt '...'] [--dotted.key=value ...]\n"
-        "       automodel_tpu serve -c config.yaml [--dotted.key=value ...]  (stdin-JSONL; serving.http.port for HTTP; GET /metrics)\n"
+        "       automodel_tpu serve -c config.yaml [--dotted.key=value ...]  (stdin-JSONL; serving.http.port for HTTP; GET /metrics /healthz /readyz; SIGTERM drains gracefully)\n"
         "       automodel_tpu profile -c config.yaml [--profiling.mode=train|generate] [--dotted.key=value ...]\n"
         "       automodel_tpu report <train_metrics.jsonl> [--strict]\n"
         "       automodel_tpu verify-ckpt <ckpt_dir> [--no-checksums] [--json]"

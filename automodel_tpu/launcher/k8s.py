@@ -122,7 +122,11 @@ class K8sConfig:
     # deletion/eviction: the emergency-checkpoint window. The hang
     # watchdog's exit-75 (a wedged host detected mid-run) rides the same
     # Ignore rules as preemption, so a hung pod recycles without burning
-    # the backoff budget.
+    # the backoff budget. A serving pod (`automodel_tpu serve`) uses the
+    # same window for its graceful drain — keep this above
+    # serving.drain.grace_s so in-flight requests finish before SIGKILL;
+    # the drained server exits REQUEUE_EXIT_CODE in-cluster
+    # (serving.drain.requeue_exit: auto), riding the same Ignore rules.
     termination_grace_s: int = 90
 
 

@@ -95,6 +95,11 @@ class SlurmConfig:
     # so hitting the wall clock becomes a normal preemption (emergency
     # checkpoint → exit 75 → requeue) instead of a SIGKILL that loses
     # everything since the last cadence save. 0 disables the directive.
+    # `automodel_tpu serve` rides the same signal: SIGTERM starts a
+    # graceful drain (in-flight requests finish within
+    # serving.drain.grace_s — keep term_grace_s above it) and the server
+    # exits REQUEUE_EXIT_CODE under slurm (serving.drain.requeue_exit:
+    # auto), so a drained replica requeues via the same rc-75 rules.
     term_grace_s: int = 90
 
 

@@ -74,6 +74,18 @@ class FaultInjectionConfig:
     # step only, producing the step-time SPIKE the triggered-capture
     # profiler arms on (telemetry/profiling/triggered.py)
     straggle_at_step: Optional[int] = None
+    # serving faults (serving/engine.py scheduler iterations, driven by the
+    # chaos harness in tests/test_serving_chaos.py): a slow/hung decode
+    # step (GIL-releasing sleep — the engine watchdog fires during it, the
+    # engine fails the wave and rebuilds when it returns), a mid-request
+    # engine exception, and allocator exhaustion (every available block
+    # grabbed for hold_steps, so admissions queue and deadline/shed paths
+    # fire)
+    serve_hang_at_step: Optional[int] = None
+    serve_hang_seconds: float = 2.0
+    serve_exception_at_step: Optional[int] = None
+    serve_exhaust_blocks_at_step: Optional[int] = None
+    serve_exhaust_hold_steps: int = 50
 
 
 def _process_index() -> int:
@@ -90,6 +102,7 @@ class FaultInjector:
         self.config = config
         self._io_attempts: dict[str, int] = {}
         self._hung = False
+        self._serve_hung = False
 
     # -- step-loop hooks ----------------------------------------------------
     def maybe_die(self, step: int) -> None:
@@ -126,6 +139,30 @@ class FaultInjector:
         if c.desync_batch_at_step is None or step != c.desync_batch_at_step:
             return False
         return _process_index() == c.desync_on_host
+
+    # -- serving hooks ------------------------------------------------------
+    def maybe_serve_hang(self, step: int) -> None:
+        """Wedge one serving scheduler iteration (a bounded GIL-releasing
+        sleep, exactly like a stuck device call): the engine watchdog is
+        expected to fire mid-sleep and the engine to rebuild after."""
+        c = self.config
+        if c.serve_hang_at_step is None or step != c.serve_hang_at_step or self._serve_hung:
+            return
+        self._serve_hung = True
+        logger.error(
+            "fault injection: hanging serving step %d for %.1fs",
+            step, c.serve_hang_seconds,
+        )
+        import time
+
+        time.sleep(c.serve_hang_seconds)
+
+    def maybe_serve_exception(self, step: int) -> None:
+        """Mid-request engine exception at serving step k (fires once: the
+        step counter passes each value exactly once)."""
+        c = self.config
+        if c.serve_exception_at_step is not None and step == c.serve_exception_at_step:
+            raise InjectedFault(f"injected serving engine crash at step {step}")
 
     def maybe_straggle(self, step: int) -> None:
         c = self.config
@@ -202,6 +239,9 @@ def activate(config: FaultInjectionConfig | dict | None) -> Optional[FaultInject
         or config.hang_at_step is not None
         or config.desync_batch_at_step is not None
         or config.straggle_host is not None
+        or config.serve_hang_at_step is not None
+        or config.serve_exception_at_step is not None
+        or config.serve_exhaust_blocks_at_step is not None
     )
     if not armed:
         # an empty `fault_injection: {}` section (the docs' example form)

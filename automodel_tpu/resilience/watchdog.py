@@ -89,6 +89,11 @@ class Watchdog:
     All cross-thread state is plain attribute stores (atomic under the
     GIL); the poll thread tolerates reading a slightly stale pet."""
 
+    # stamped on the evidence record and the flight-recorder dump reason;
+    # EngineWatchdog (serving) overrides it so a serving stall and a
+    # training hang stay distinguishable in the JSONL / report summary
+    EVENT = "hang"
+
     def __init__(
         self,
         config: WatchdogConfig,
@@ -225,7 +230,7 @@ class Watchdog:
         Every step is individually best-effort — a broken disk must not
         stop the exit that frees the reservation."""
         rec = {
-            "event": "hang",
+            "event": self.EVENT,
             "step": self._last_step,
             "heartbeat_age_s": round(age, 3),
             "deadline_s": round(deadline, 3),
@@ -235,7 +240,7 @@ class Watchdog:
         }
         self.fired = rec
         print(
-            f"[watchdog] HANG: no heartbeat for {age:.1f}s "
+            f"[watchdog] {self.EVENT.upper()}: no heartbeat for {age:.1f}s "
             f"(deadline {deadline:.1f}s, last step {self._last_step}"
             + (f", phase {self._phase}" if self._phase else "")
             + ") — dumping stacks + flight recorder",
@@ -247,7 +252,7 @@ class Watchdog:
         if self.flight_recorder is not None:
             try:
                 self.flight_recorder.record(rec)
-                path = self.flight_recorder.dump(reason="hang")
+                path = self.flight_recorder.dump(reason=self.EVENT)
                 print(f"[watchdog] flight recorder dumped to {path}",
                       file=sys.stderr, flush=True)
             except Exception:
@@ -288,6 +293,24 @@ class Watchdog:
         # thread would kill only the watchdog
         os._exit(code)
 
+    def set_phase(self, name: Optional[str]) -> None:
+        """Pin (or clear) the current phase outside the context-manager
+        form — the serving engine holds the ``compile`` grace until its
+        SECOND jitted program (paged decode) has actually compiled, which
+        the training loop's second-pet rule cannot know about."""
+        if name is not None and name not in _PHASE_GRACE_FIELDS:
+            raise ValueError(f"unknown watchdog phase {name!r}")
+        self._phase = name
+
+    def touch(self) -> None:
+        """Refresh the heartbeat WITHOUT counting a step: used by pollers
+        that are legitimately idle (a serving loop with no work) so silence
+        that means "nothing to do" is never mistaken for a wedge. The next
+        real pet's interval is excluded from the EMA — idle time is not a
+        step time."""
+        self._last_pet = time.monotonic()
+        self._skip_next_ema = True
+
     def _dump_stacks(self) -> Optional[Path]:
         """All-thread stack traces via faulthandler — the smoking gun for
         'where was everyone when the world stopped'."""
@@ -309,3 +332,56 @@ class Watchdog:
             except Exception:
                 pass
             return None
+
+
+class EngineWatchdog(Watchdog):
+    """Serving-side stall watchdog: the same adaptive-deadline EMA, phase
+    grace, and evidence machinery as the training :class:`Watchdog`, with
+    the lifecycle a RECOVERING consumer needs:
+
+    - firing is an observation, not a death sentence: ``on_hang`` (required
+      here — the serving scheduler's stall flag) receives the evidence and
+      the watchdog KEEPS WATCHING. The engine fails the stalled wave's
+      requests, rebuilds its pool/slot state, and serving continues; the
+      training watchdog's requeue-exit path is wrong for a server that can
+      shed one wave and keep its queue.
+    - it re-arms only after the NEXT pet: one wedged step fires exactly
+      once, however long the silence lasts, and the eventual recovery
+      interval is excluded from the EMA (a 30s stall must not teach the
+      deadline that 30s steps are normal).
+    - ``touch()`` (inherited) keeps an IDLE serving loop — no queue, no
+      running slots, nothing to pet — from reading as a hang.
+
+    Evidence lands in the same places: all-thread stacks file, flight
+    recorder (when given one) with an ``engine_stall`` event, metrics
+    JSONL record, ``fired``/``fired_total`` for scrape-time counters.
+    """
+
+    EVENT = "engine_stall"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.on_hang is None and self.config.exit_on_hang:
+            raise ValueError(
+                "EngineWatchdog needs an on_hang observer (the serving "
+                "scheduler's stall flag) — it never exits the process"
+            )
+        self.fired_total = 0
+
+    def _loop(self) -> None:
+        poll = max(self.config.poll_interval_s, 0.01)
+        fired_at_pet = -1
+        while not self._stop.wait(poll):
+            if self._pets == fired_at_pet:
+                # already fired for this silence: stay quiet until the
+                # wedged call returns and the scheduler pets us again
+                continue
+            age = self.heartbeat_age_s
+            deadline = self.deadline_s
+            if age > deadline:
+                self._fire(age, deadline)
+                self.fired_total += 1
+                fired_at_pet = self._pets
+                # the recovery pet's interval includes the stall — keep it
+                # out of the EMA
+                self._skip_next_ema = True

@@ -11,12 +11,26 @@ the generation engine (docs/serving.md).
 """
 
 from automodel_tpu.serving.block_pool import BlockPool, BlockPoolError
-from automodel_tpu.serving.engine import QueueFull, ServeConfig, ServingEngine
+from automodel_tpu.serving.engine import (
+    COMPLETION_REASONS,
+    DrainConfig,
+    EngineDraining,
+    LimitsConfig,
+    QueueFull,
+    ServeConfig,
+    ServingEngine,
+    StallConfig,
+)
 
 __all__ = [
     "BlockPool",
     "BlockPoolError",
+    "COMPLETION_REASONS",
+    "DrainConfig",
+    "EngineDraining",
+    "LimitsConfig",
     "QueueFull",
     "ServeConfig",
     "ServingEngine",
+    "StallConfig",
 ]

@@ -138,6 +138,18 @@ class BlockPool:
                 self._hash_of[bid] = h
             parent = h
 
+    def clear_prefix_cache(self) -> None:
+        """Forget every cached prefix — the serving engine calls this when
+        it rebuilds after a stalled/failed program, because the pool's K/V
+        contents can no longer be trusted. Ref-0 parked blocks return to
+        the free list; a registered block still referenced by a live
+        sequence merely loses its hash mapping and frees normally later."""
+        for bid in self._lru.values():
+            self._free.append(bid)
+        self._lru.clear()
+        self._cached.clear()
+        self._hash_of.clear()
+
     # -- allocate / free ------------------------------------------------------
     def allocate(self, n: int) -> Optional[list[int]]:
         """n fresh blocks (ref = 1 each), or None when the pool can't satisfy

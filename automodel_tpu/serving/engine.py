@@ -2,23 +2,41 @@
 
 The scheduler loop (one ``step()`` = one engine iteration):
 
-1. **admit** — pop queued requests into free decode slots. A request's
+1. **expire** — requests whose ``deadline_s``/``max_queue_wait_s`` elapsed
+   are cancelled wherever they are (queued, prefilling, decoding): blocks
+   freed, a ``timeout`` completion reason recorded. Nothing is ever
+   silently dropped — every submitted request produces exactly one
+   terminal record.
+2. **admit** — pop queued requests into free decode slots. A request's
    WHOLE block budget (``ceil((prompt + max_new) / block_size)``) is
    allocated at admission (minus any prefix-cache hit), so a running
    sequence never needs a mid-flight allocation and the engine cannot
    deadlock on a full pool: if the pool can't cover the head-of-queue
-   request it simply stays queued until completions free blocks.
-2. **prefill tick** — every mid-prefill slot advances ONE chunk
+   request it simply stays queued until completions free blocks. While
+   **draining** nothing admits: the queue is flushed with retriable
+   ``draining`` rejections and only in-flight requests keep running.
+3. **prefill tick** — every mid-prefill slot advances ONE chunk
    (``prefill_chunk`` tokens) through the jitted chunked-prefill program.
    Bounding per-iteration prefill work is what keeps time-to-first-token of
    queued requests from stalling behind a single long prompt: the decode
    wave below still runs every iteration.
-3. **decode tick** — one jitted paged decode step over all slots; active
+4. **decode tick** — one jitted paged decode step over all slots; active
    slots each advance one token. Slots whose token hits a stop id or whose
    budget is spent COMPLETE: their blocks decref back to the pool (prompt
    blocks stay matchable in the prefix cache) and the slot refills from the
    queue on the next iteration — mid-flight, without waiting for the rest
    of the wave.
+
+Failure containment (the PR 3/5 doctrine ported to serving): a wedged
+jitted step is detected by the :class:`EngineWatchdog` (adaptive EMA
+deadline — resilience/watchdog.py) which dumps stacks + flight recorder
+and flags the engine; when the blocked call returns (or an exception
+escapes a tick) the engine fails ONLY the affected wave's requests with an
+``engine_stall``/``engine_error`` reason, re-initializes the pool arrays
+(the failed program may have left its donated buffers in an arbitrary
+state), clears the prefix cache (contents no longer trusted), audits the
+allocator invariants, and keeps serving the queue. Repeated back-to-back
+rebuilds are a systemic fault and re-raise loudly instead of looping.
 
 Greedy decode through this path is token-parity with the single-wave
 ``generation.GenerationEngine`` (tests/test_serving.py pins it, full and
@@ -36,6 +54,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import logging
 import time
 from collections import deque
 from typing import Any, Callable, Optional, Sequence
@@ -54,10 +73,113 @@ from automodel_tpu.serving import paged
 from automodel_tpu.serving.block_pool import BlockPool
 from automodel_tpu.training.rng import sampling_key
 
+logger = logging.getLogger(__name__)
+
+# terminal `completion_reason` values every request record carries exactly
+# one of (docs/observability.md glossary):
+#   stop         — hit a configured eos id
+#   length       — spent its max_new_tokens budget
+#   timeout      — deadline_s / max_queue_wait_s expired (not retriable:
+#                  the client's own budget ran out)
+#   shed         — rejected at submit, admission queue full (retriable)
+#   draining     — rejected because the server is draining (retriable)
+#   cancelled    — in flight when the drain grace expired (retriable)
+#   engine_stall — failed by a watchdog-detected wedged step (retriable)
+#   engine_error — failed by a scheduler/program exception (retriable)
+COMPLETION_REASONS = (
+    "stop", "length", "timeout", "shed", "draining", "cancelled",
+    "engine_stall", "engine_error",
+)
+_RETRIABLE_REASONS = frozenset(
+    {"shed", "draining", "cancelled", "engine_stall", "engine_error"}
+)
+
 
 class QueueFull(RuntimeError):
-    """Admission queue at max_queue: the caller must apply backpressure —
-    the engine never silently drops a request."""
+    """Admission queue at max_queue: overload is SHED back to the caller as
+    an explicit retriable signal (HTTP 503 + Retry-After, stdin-JSONL error
+    record) — the engine never silently drops or silently queues-forever."""
+
+
+class EngineDraining(RuntimeError):
+    """Submissions rejected while the server drains (SIGTERM received):
+    retriable — the client should go to another replica. HTTP maps this to
+    503 + Retry-After, stdin-JSONL to an error record."""
+
+
+def _cfg_dict(cls, d: Optional[dict], section: str):
+    """Strict nested-section constructor shared by limits/drain/watchdog."""
+    d = dict(d or {})
+    d.pop("_target_", None)
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(d) - known
+    if unknown:
+        raise TypeError(f"unknown {section} keys: {sorted(unknown)}")
+    return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class LimitsConfig:
+    """The ``serving.limits:`` section — per-request time budgets. 0/None
+    disables a bound. Per-request ``deadline_s``/``max_queue_wait_s`` on
+    submit (or the request JSON) override these defaults."""
+
+    deadline_s: Optional[float] = None  # submit → completion wall cap
+    max_queue_wait_s: Optional[float] = None  # submit → admission wall cap
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "LimitsConfig":
+        return _cfg_dict(cls, d, "serving.limits")
+
+
+@dataclasses.dataclass(frozen=True)
+class DrainConfig:
+    """The ``serving.drain:`` section — graceful-shutdown semantics.
+
+    SIGTERM (chained through the PR 3 ``PreemptionHandler``) flips the
+    server to draining: new and queued requests are rejected retriable,
+    in-flight requests finish within ``grace_s``, then the scheduler exits
+    cleanly. ``requeue_exit`` picks the exit code: ``auto`` exits 75
+    (EX_TEMPFAIL — the launchers' requeue code) when running under slurm/
+    k8s and 0 otherwise; ``always``/``never`` force it."""
+
+    grace_s: float = 30.0
+    install_signal_handler: bool = True
+    requeue_exit: str = "auto"  # auto | always | never
+
+    def __post_init__(self):
+        if self.requeue_exit not in ("auto", "always", "never"):
+            raise ValueError(
+                f"serving.drain.requeue_exit={self.requeue_exit!r} "
+                "(want auto|always|never)"
+            )
+        if self.grace_s < 0:
+            raise ValueError(f"serving.drain.grace_s={self.grace_s}")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "DrainConfig":
+        return _cfg_dict(cls, d, "serving.drain")
+
+
+@dataclasses.dataclass(frozen=True)
+class StallConfig:
+    """The ``serving.watchdog:`` section — scheduler-level stall detection
+    (maps onto resilience.watchdog.EngineWatchdog). The watchdog thread is
+    started by the serving fronts (``start_watchdog``), not by engine
+    construction — batch ``run()`` drains own their own lifetime."""
+
+    enabled: bool = True
+    multiplier: float = 20.0
+    min_deadline_s: float = 30.0
+    max_deadline_s: float = 600.0
+    ema_alpha: float = 0.2
+    compile_grace_s: float = 1800.0  # first prefill/decode compile
+    poll_interval_s: float = 0.25
+    stacks_path: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "StallConfig":
+        return _cfg_dict(cls, d, "serving.watchdog")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +200,10 @@ class ServeConfig:
     bench_prompt_len_min: int = 8
     bench_prompt_len_max: int = 48
     bench_max_new_tokens: int = 16
+    # production-hardening sections (docs/serving.md runbook)
+    limits: LimitsConfig = dataclasses.field(default_factory=LimitsConfig)
+    drain: DrainConfig = dataclasses.field(default_factory=DrainConfig)
+    watchdog: StallConfig = dataclasses.field(default_factory=StallConfig)
 
     def __post_init__(self):
         if self.slots < 1 or self.block_size < 1 or self.prefill_chunk < 1:
@@ -97,6 +223,14 @@ class ServeConfig:
         unknown = set(d) - known
         if unknown:
             raise TypeError(f"unknown serving keys: {sorted(unknown)}")
+        for key, sub in (
+            ("limits", LimitsConfig),
+            ("drain", DrainConfig),
+            ("watchdog", StallConfig),
+        ):
+            v = d.get(key)
+            if v is not None and not isinstance(v, sub):
+                d[key] = sub.from_dict(dict(v))
         return cls(**d)
 
     @property
@@ -105,6 +239,16 @@ class ServeConfig:
         headroom keeps the chunk program's dynamic_update_slice from ever
         clamping (paged.py view-position invariant)."""
         return -(-(self.max_seq_len + self.prefill_chunk) // self.block_size)
+
+
+@dataclasses.dataclass
+class _Queued:
+    rid: str
+    prompt: list[int]
+    max_new: int
+    t_submit: float
+    deadline_at: Optional[float] = None  # perf_counter absolute
+    queue_deadline_at: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -117,6 +261,7 @@ class _Slot:
     prefill_pos: int  # next absolute prompt position to compute
     t_submit: float
     t_admit: float
+    deadline_at: Optional[float] = None
     decoding: bool = False
     generated: Optional[list[int]] = None
     t_first: Optional[float] = None
@@ -126,9 +271,16 @@ class ServingEngine:
     """Facade over (AutoModel, ServeConfig, GenerationConfig).
 
     ``submit`` enqueues token-id prompts; ``step`` runs one scheduler
-    iteration and returns the requests that completed in it; ``run`` drains
-    everything. ``on_record`` (optional) receives one telemetry dict per
-    completed request (the serve CLI points it at the metrics JSONL)."""
+    iteration and returns the requests that reached a terminal state in it
+    (completed, timed out, rejected, failed — every record carries a
+    ``completion_reason``); ``run`` drains everything. ``on_record``
+    (optional) receives one telemetry dict per terminal request (the serve
+    CLI points it at the metrics JSONL)."""
+
+    # back-to-back rebuild budget: a fault that survives this many fresh
+    # pools in a row is systemic (bad params, broken backend) — fail the
+    # scheduler loudly instead of rebuild-looping forever
+    MAX_CONSECUTIVE_REBUILDS = 8
 
     def __init__(
         self,
@@ -159,14 +311,7 @@ class ServingEngine:
             self.config.num_blocks, self.config.block_size,
             prefix_cache=self.config.prefix_cache,
         )
-        self._pool_k, self._pool_v = paged.init_pool(
-            int(mcfg.num_layers), self.config.num_blocks,
-            self.config.block_size, int(mcfg.num_kv_heads),
-            int(mcfg.head_dim), dtype=self.model.backend.compute_jnp_dtype,
-        )
-        self._pool_k, self._pool_v = paged.place_pool(
-            self._pool_k, self._pool_v, auto.mesh_ctx
-        )
+        self._init_pool_arrays()
         constrain = auto.constrain
 
         def apply(params, ids, **kw):
@@ -188,10 +333,28 @@ class ServingEngine:
         self._cur = np.full((B,), self.gen_config.pad_token_id, np.int32)
         self._active = np.zeros((B,), bool)
         self._slots: list[Optional[_Slot]] = [None] * B
-        self._queue: deque = deque()
+        self._queue: deque[_Queued] = deque()
         self._ids = itertools.count()
         self._step_counter = 0
-        self.completed_total = 0
+        self.completed_total = 0  # stop/length completions
+        self.failed_total = 0  # timeout/cancelled/stall/error terminations
+        self.shed_total = 0
+        self.timeout_total = 0
+        self.stall_total = 0  # watchdog-detected wedged steps
+        self.error_total = 0  # recovered scheduler exceptions
+        # drain state (begin_drain / drain_complete)
+        self.draining = False
+        self.drain_duration_s: Optional[float] = None
+        self._drain_started: Optional[float] = None
+        self._drain_deadline: Optional[float] = None
+        # stall watchdog (start_watchdog): evidence handed over from the
+        # watchdog thread, consumed at the next step boundary
+        self._watchdog = None
+        self._stall_evidence: Optional[dict] = None
+        self._consecutive_rebuilds = 0
+        self._exhaust_hold: Optional[tuple[list[int], int]] = None  # injection
+        self.first_decode_done = False  # readiness: first compiled decode
+        self.last_step_t: Optional[float] = None  # monotonic, health age
         # /metrics exposition (telemetry/prometheus.py): histograms are
         # observed per completion (cheap, python dict ops); gauges + pool
         # counters sync at scrape time so the scheduler loop pays nothing
@@ -203,6 +366,20 @@ class ServingEngine:
         # measured FLOPs/bytes (abstract host trace, one-time)
         self.collect_program_costs = False
         self.program_costs: dict = {}
+
+    def _init_pool_arrays(self) -> None:
+        """(Re)create the HBM pool arrays — at construction, and on a
+        rebuild after a stalled/failed program whose donated buffers can no
+        longer be trusted (or were consumed by the failed call)."""
+        mcfg = self.model.config
+        self._pool_k, self._pool_v = paged.init_pool(
+            int(mcfg.num_layers), self.config.num_blocks,
+            self.config.block_size, int(mcfg.num_kv_heads),
+            int(mcfg.head_dim), dtype=self.model.backend.compute_jnp_dtype,
+        )
+        self._pool_k, self._pool_v = paged.place_pool(
+            self._pool_k, self._pool_v, self.auto.mesh_ctx
+        )
 
     # -- stats ---------------------------------------------------------------
     @property
@@ -217,8 +394,93 @@ class ServingEngine:
     def pool_bytes(self) -> int:
         return int(self._pool_k.nbytes + self._pool_v.nbytes)
 
+    @property
+    def watchdog(self):
+        return self._watchdog
+
+    @property
+    def last_step_age_s(self) -> Optional[float]:
+        return (
+            time.monotonic() - self.last_step_t
+            if self.last_step_t is not None else None
+        )
+
     def idle(self) -> bool:
         return not self._queue and self.busy_slots == 0
+
+    # -- stall watchdog -------------------------------------------------------
+    def start_watchdog(self, flight_recorder: Any = None,
+                       metric_logger: Any = None,
+                       stacks_path: Optional[str] = None):
+        """Arm the scheduler-level stall watchdog (serving fronts call this;
+        batch ``run()`` drains don't need a thread). → the EngineWatchdog,
+        or None when serving.watchdog.enabled is false."""
+        c = self.config.watchdog
+        if not c.enabled or self._watchdog is not None:
+            return self._watchdog
+        from automodel_tpu.resilience.watchdog import EngineWatchdog, WatchdogConfig
+
+        wcfg = WatchdogConfig(
+            enabled=True, multiplier=c.multiplier,
+            min_deadline_s=c.min_deadline_s, max_deadline_s=c.max_deadline_s,
+            ema_alpha=c.ema_alpha, compile_grace_s=c.compile_grace_s,
+            poll_interval_s=c.poll_interval_s,
+            stacks_path=c.stacks_path or stacks_path,
+            exit_on_hang=False,
+        )
+        self._watchdog = EngineWatchdog(
+            wcfg, flight_recorder=flight_recorder, metric_logger=metric_logger,
+            on_hang=self._note_stall,
+        )
+        self._watchdog.start()
+        return self._watchdog
+
+    def stop_watchdog(self) -> None:
+        wd, self._watchdog = self._watchdog, None
+        if wd is not None:
+            wd.stop()
+
+    def touch_watchdog(self) -> None:
+        """Idle heartbeat: the serving loop calls this when there is no work
+        so an empty server never reads as a wedged one."""
+        if self._watchdog is not None:
+            self._watchdog.touch()
+
+    def _note_stall(self, rec: dict) -> None:
+        # called from the WATCHDOG thread while the scheduler thread is
+        # blocked inside the wedged call; consumed at the next step boundary
+        self._stall_evidence = dict(rec)
+
+    # -- drain ----------------------------------------------------------------
+    def begin_drain(self) -> None:
+        """Flip to draining: new submissions raise ``EngineDraining``, the
+        queue is flushed with retriable rejections at the next step, and
+        in-flight requests get ``drain.grace_s`` to finish before they are
+        cancelled. Idempotent."""
+        if self.draining:
+            return
+        self.draining = True
+        self._drain_started = time.perf_counter()
+        self._drain_deadline = self._drain_started + max(
+            self.config.drain.grace_s, 0.0
+        )
+        logger.warning(
+            "serving drain started: %d queued rejected retriable, %d in "
+            "flight, grace %.1fs",
+            self.queue_depth, self.busy_slots, self.config.drain.grace_s,
+        )
+
+    def drain_complete(self) -> bool:
+        """True once every in-flight request reached a terminal state after
+        ``begin_drain``. Stamps ``drain_duration_s`` (and the /metrics
+        gauge) on first observation."""
+        done = self.draining and self.idle()
+        if done and self.drain_duration_s is None:
+            self.drain_duration_s = time.perf_counter() - self._drain_started
+            logger.warning(
+                "serving drain complete in %.3fs", self.drain_duration_s
+            )
+        return done
 
     # -- submission -----------------------------------------------------------
     def submit(
@@ -227,6 +489,8 @@ class ServingEngine:
         request_id: Optional[str] = None,
         max_new_tokens: Optional[int] = None,
         t_submit: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        max_queue_wait_s: Optional[float] = None,
     ) -> str:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
@@ -254,24 +518,184 @@ class ServingEngine:
                 f"but the pool only has {self.pool.usable_blocks} — raise "
                 "serving.num_blocks"
             )
+        now = time.perf_counter() if t_submit is None else t_submit
+        rid = request_id if request_id is not None else f"req-{next(self._ids)}"
+        lim = self.config.limits
+        ddl = lim.deadline_s if deadline_s is None else float(deadline_s)
+        qw = (
+            lim.max_queue_wait_s
+            if max_queue_wait_s is None else float(max_queue_wait_s)
+        )
+        q = _Queued(
+            rid=rid, prompt=prompt, max_new=max_new, t_submit=now,
+            deadline_at=now + ddl if ddl and ddl > 0 else None,
+            queue_deadline_at=now + qw if qw and qw > 0 else None,
+        )
+        if self.draining:
+            # no terminal record here (mirror of the shed seam): the
+            # rejection is returned to the client directly, and a client
+            # honoring Retry-After would otherwise inflate failed_total and
+            # the JSONL with one synthetic record per retry attempt.
+            # ACCEPTED-then-drained requests do get records (step's queue
+            # flush) — that is the no-silent-drop contract's scope.
+            raise EngineDraining(
+                "server is draining — retry against another replica"
+            )
         if len(self._queue) >= self.config.max_queue:
             raise QueueFull(
                 f"admission queue at serving.max_queue={self.config.max_queue}"
             )
-        rid = request_id if request_id is not None else f"req-{next(self._ids)}"
-        self._queue.append(
-            (rid, prompt, max_new, time.perf_counter() if t_submit is None else t_submit)
-        )
+        self._queue.append(q)
         return rid
 
+    def record_shed(
+        self,
+        request_id: Optional[str] = None,
+        prompt_ids: Optional[Sequence[int]] = None,
+    ) -> dict:
+        """Account an ACTUAL shed — the caller gave up on a ``QueueFull``
+        and returned the overload signal to the client. Kept out of
+        ``submit`` so a front that absorbs backpressure by retrying (the
+        stdin batch mode) doesn't inflate ``requests_shed_total`` with
+        retry attempts."""
+        self.shed_total += 1
+        q = _Queued(
+            rid=request_id if request_id is not None else f"req-{next(self._ids)}",
+            prompt=[int(t) for t in (prompt_ids or [])],
+            max_new=0, t_submit=time.perf_counter(),
+        )
+        return self._rejection_record(q, "shed")
+
+    # -- terminal records -----------------------------------------------------
+    def _rejection_record(
+        self, q: _Queued, reason: str, detail: Optional[str] = None
+    ) -> dict:
+        """Terminal record for a request that never reached a slot (shed /
+        draining / queue timeout / admission failure)."""
+        now = time.perf_counter()
+        self.failed_total += 1
+        if reason == "timeout":
+            self.timeout_total += 1
+        rec = {
+            "event": "serve_request",
+            "request_id": q.rid,
+            "tokens": [],
+            "n_generated": 0,
+            "prompt_tokens": len(q.prompt),
+            "completion_reason": reason,
+            "retriable": reason in _RETRIABLE_REASONS,
+            "queue_s": now - q.t_submit,
+            "queue_depth": self.queue_depth,
+            "ts": time.time(),
+        }
+        if detail:
+            rec["detail"] = detail
+        self._emit(rec)
+        return rec
+
+    def _terminate(
+        self, b: int, reason: str, detail: Optional[str] = None
+    ) -> dict:
+        """Free slot ``b`` and produce its one terminal record. ``reason``
+        "stop"/"length" is a completion; anything else is a failure whose
+        blocks must still come back (the leak-audit contract)."""
+        slot = self._slots[b]
+        now = time.perf_counter()
+        gen = slot.generated or []
+        self.pool.free(slot.blocks)
+        self._slots[b] = None
+        self._tables[b] = 0
+        self._lengths[b] = 0
+        self._active[b] = False
+        self._cur[b] = self.gen_config.pad_token_id
+        completed = reason in ("stop", "length")
+        if completed:
+            self.completed_total += 1
+        else:
+            self.failed_total += 1
+            if reason == "timeout":
+                self.timeout_total += 1
+        rec = {
+            "event": "serve_request",
+            "request_id": slot.request_id,
+            "tokens": list(gen),
+            "n_generated": len(gen),
+            "prompt_tokens": len(slot.prompt),
+            "prefix_hit_tokens": slot.hit_tokens,
+            "completion_reason": reason,
+            "retriable": reason in _RETRIABLE_REASONS,
+            "queue_s": slot.t_admit - slot.t_submit,
+            "queue_depth": self.queue_depth,
+            "block_occupancy": round(self.pool.occupancy(), 4),
+            "ts": time.time(),
+        }
+        if slot.t_first is not None:
+            decode_s = now - slot.t_first
+            rec["ttft_s"] = slot.t_first - slot.t_submit
+            # the first token is charged to ttft, like the single-wave engine
+            rec["decode_tps"] = (
+                (len(gen) - 1) / decode_s if decode_s > 0 and len(gen) > 1
+                else 0.0
+            )
+        if detail:
+            rec["detail"] = detail
+        self._emit(rec)
+        return rec
+
+    def _emit(self, rec: dict) -> None:
+        try:
+            if rec.get("completion_reason") in ("stop", "length"):
+                self.metrics.observe_request(rec)
+            else:
+                self.metrics.observe_failure(rec.get("completion_reason", ""))
+        except Exception:  # telemetry must never break serving
+            pass
+        if self.on_record is not None:
+            try:
+                self.on_record(dict(rec))
+            except Exception:  # telemetry must never break serving
+                pass
+
     # -- scheduler ------------------------------------------------------------
-    def _admit(self) -> None:
+    def _expire_tick(self) -> list[dict]:
+        """Cancel every request whose deadline/queue-wait elapsed — queued,
+        prefilling, or decoding — freeing its blocks."""
+        now = time.perf_counter()
+        done: list[dict] = []
+        if self._queue and any(
+            q.deadline_at is not None or q.queue_deadline_at is not None
+            for q in self._queue
+        ):
+            keep: deque[_Queued] = deque()
+            for q in self._queue:
+                expired = (
+                    (q.deadline_at is not None and now >= q.deadline_at)
+                    or (
+                        q.queue_deadline_at is not None
+                        and now >= q.queue_deadline_at
+                    )
+                )
+                if expired:
+                    done.append(self._rejection_record(q, "timeout"))
+                else:
+                    keep.append(q)
+            self._queue = keep
+        for b, slot in enumerate(self._slots):
+            if (
+                slot is not None
+                and slot.deadline_at is not None
+                and now >= slot.deadline_at
+            ):
+                done.append(self._terminate(b, "timeout"))
+        return done
+
+    def _admit(self, done: list[dict]) -> None:
         for b in range(self.config.slots):
             if self._slots[b] is not None or not self._queue:
                 continue
-            rid, prompt, max_new, t_sub = self._queue[0]
-            hits, hit_tokens = self.pool.match_prefix(prompt)
-            need = -(-(len(prompt) + max_new) // self.config.block_size)
+            q = self._queue[0]
+            hits, hit_tokens = self.pool.match_prefix(q.prompt)
+            need = -(-(len(q.prompt) + q.max_new) // self.config.block_size)
             fresh = self.pool.allocate(need - len(hits))
             if fresh is None:
                 # pool can't cover the head of the queue: undo the hit refs
@@ -281,17 +705,36 @@ class ServingEngine:
                 break
             self._queue.popleft()
             blocks = hits + fresh
-            row = np.zeros((self.config.table_blocks,), np.int32)
-            row[: len(blocks)] = blocks
-            self._tables[b] = row
-            self._lengths[b] = hit_tokens
-            self._active[b] = False
-            self._slots[b] = _Slot(
-                request_id=rid, prompt=prompt, max_new=max_new,
-                blocks=blocks, hit_tokens=hit_tokens,
-                prefill_pos=hit_tokens, t_submit=t_sub,
-                t_admit=time.perf_counter(),
-            )
+            try:
+                self._bind_slot(b, q, blocks, hit_tokens)
+            except Exception as e:
+                # leak audit: an exception between admit-time allocation and
+                # slot binding must return EVERY block and fail only THIS
+                # request — loudly — not the server
+                self.pool.free(blocks)
+                self.error_total += 1
+                logger.exception("admission failed for %s", q.rid)
+                done.append(
+                    self._rejection_record(
+                        q, "engine_error",
+                        detail=f"admission: {type(e).__name__}: {e}",
+                    )
+                )
+
+    def _bind_slot(
+        self, b: int, q: _Queued, blocks: list[int], hit_tokens: int
+    ) -> None:
+        row = np.zeros((self.config.table_blocks,), np.int32)
+        row[: len(blocks)] = blocks
+        self._tables[b] = row
+        self._lengths[b] = hit_tokens
+        self._active[b] = False
+        self._slots[b] = _Slot(
+            request_id=q.rid, prompt=q.prompt, max_new=q.max_new,
+            blocks=blocks, hit_tokens=hit_tokens,
+            prefill_pos=hit_tokens, t_submit=q.t_submit,
+            t_admit=time.perf_counter(), deadline_at=q.deadline_at,
+        )
 
     def _prefill_tick(self) -> list[dict]:
         done: list[dict] = []
@@ -338,8 +781,10 @@ class ServingEngine:
             self._cur[b] = first
             self._active[b] = True
             self._lengths[b] = p
-            if first in self._eos or slot.max_new <= 1:
-                done.append(self._finish(b))
+            if first in self._eos:
+                done.append(self._terminate(b, "stop"))
+            elif slot.max_new <= 1:
+                done.append(self._terminate(b, "length"))
         return done
 
     def _decode_tick(self) -> list[dict]:
@@ -361,6 +806,7 @@ class ServingEngine:
             self._base_key, jnp.int32(self._step_counter),
         )
         tokens = np.asarray(jax.device_get(tokens))
+        self.first_decode_done = True
         done: list[dict] = []
         for b, slot in enumerate(self._slots):
             if slot is None or not self._active[b]:
@@ -369,59 +815,166 @@ class ServingEngine:
             slot.generated.append(tok)
             self._lengths[b] += 1
             self._cur[b] = tok
-            if tok in self._eos or len(slot.generated) >= slot.max_new:
-                done.append(self._finish(b))
+            if tok in self._eos:
+                done.append(self._terminate(b, "stop"))
+            elif len(slot.generated) >= slot.max_new:
+                done.append(self._terminate(b, "length"))
         return done
 
-    def _finish(self, b: int) -> dict:
-        slot = self._slots[b]
-        now = time.perf_counter()
-        n_gen = len(slot.generated)
-        decode_s = now - slot.t_first
-        self.pool.free(slot.blocks)
-        self._slots[b] = None
-        self._tables[b] = 0
-        self._lengths[b] = 0
-        self._active[b] = False
-        self._cur[b] = self.gen_config.pad_token_id
-        self.completed_total += 1
+    def _rebuild(self, reason: str, detail: Optional[str] = None) -> list[dict]:
+        """Recover from a stalled or failed program: fail the affected
+        wave's requests, re-initialize the pool arrays (the donated buffers
+        of a failed call are gone or garbage), clear the prefix cache
+        (contents no longer trusted), audit the allocator, and keep the
+        queue. Queued requests have no device state and ride through."""
+        done: list[dict] = []
+        affected = 0
+        for b, slot in enumerate(self._slots):
+            if slot is not None:
+                done.append(self._terminate(b, reason, detail=detail))
+                affected += 1
+        self.pool.clear_prefix_cache()
+        self.pool.check_invariants()
+        self._init_pool_arrays()
+        self._tables[:] = 0
+        self._lengths[:] = 0
+        self._active[:] = False
+        self._cur[:] = self.gen_config.pad_token_id
+        if reason == "engine_stall":
+            self.stall_total += 1
+        else:
+            self.error_total += 1
+        try:
+            self.metrics.observe_engine_event(reason)
+        except Exception:
+            pass
+        logger.error(
+            "serving engine %s at step %d: failed %d in-flight request(s), "
+            "pool rebuilt, queue (%d) kept — %s",
+            reason, self._step_counter, affected, self.queue_depth,
+            detail or "",
+        )
         rec = {
-            "event": "serve_request",
-            "request_id": slot.request_id,
-            "tokens": list(slot.generated),
-            "n_generated": n_gen,
-            "prompt_tokens": len(slot.prompt),
-            "prefix_hit_tokens": slot.hit_tokens,
-            "ttft_s": slot.t_first - slot.t_submit,
-            "queue_s": slot.t_admit - slot.t_submit,
-            # the first token is charged to ttft, like the single-wave engine
-            "decode_tps": (n_gen - 1) / decode_s if decode_s > 0 and n_gen > 1 else 0.0,
-            "queue_depth": self.queue_depth,
-            "block_occupancy": round(self.pool.occupancy(), 4),
+            "event": "serve_engine_event",
+            "reason": reason,
+            "step": self._step_counter,
+            "requests_failed": affected,
             "ts": time.time(),
         }
-        try:
-            self.metrics.observe_request(rec)
-        except Exception:  # telemetry must never break serving
-            pass
+        if detail:
+            rec["detail"] = detail
         if self.on_record is not None:
             try:
-                self.on_record(dict(rec))
-            except Exception:  # telemetry must never break serving
+                self.on_record(rec)
+            except Exception:
                 pass
-        return rec
+        return done
 
-    def _record_cost(self, name: str, jit_fn, *args) -> None:
-        from automodel_tpu.telemetry.profiling import record_program_cost
-
-        record_program_cost(self.program_costs, name, jit_fn, *args)
+    def _injection_tick(self, inj: Any) -> None:
+        """Serving fault hooks (resilience/fault_injection.py): allocator
+        exhaustion, a slow/hung step, a mid-request engine exception. Each
+        is a cheap None-check when unarmed."""
+        c = inj.config
+        step = self._step_counter
+        if self._exhaust_hold is not None and step >= self._exhaust_hold[1]:
+            self.pool.free(self._exhaust_hold[0])
+            self._exhaust_hold = None
+            logger.error("fault injection: released the exhausted pool")
+        if (
+            c.serve_exhaust_blocks_at_step is not None
+            and step == c.serve_exhaust_blocks_at_step
+            and self._exhaust_hold is None
+        ):
+            grabbed = self.pool.allocate(self.pool.available()) or []
+            self._exhaust_hold = (
+                grabbed, step + max(int(c.serve_exhaust_hold_steps), 1)
+            )
+            logger.error(
+                "fault injection: exhausted the block pool (%d blocks) "
+                "until step %d", len(grabbed), self._exhaust_hold[1],
+            )
+        inj.maybe_serve_hang(step)
+        inj.maybe_serve_exception(step)
 
     def step(self) -> list[dict]:
-        """One scheduler iteration → the requests that completed in it."""
-        self._admit()
-        done = self._prefill_tick()
-        done += self._decode_tick()
+        """One scheduler iteration → the requests that reached a terminal
+        state in it (every record carries a ``completion_reason``)."""
+        if self._watchdog is not None:
+            self._watchdog.pet(self._step_counter)
+            if not self.first_decode_done:
+                # the training watchdog's second-pet rule ends the compile
+                # grace too early here: serving compiles TWO programs at
+                # different steps (chunk prefill on the first prefill tick,
+                # paged decode a few steps later) — hold the grace until
+                # the decode program has actually run once
+                self._watchdog.set_phase("compile")
+        done: list[dict] = []
+        try:
+            from automodel_tpu.resilience.fault_injection import active_injector
+
+            inj = active_injector()
+            if inj is not None:
+                self._injection_tick(inj)
+            done += self._expire_tick()
+            if self.draining:
+                while self._queue:
+                    done.append(
+                        self._rejection_record(self._queue.popleft(), "draining")
+                    )
+                if (
+                    self._drain_deadline is not None
+                    and time.perf_counter() >= self._drain_deadline
+                ):
+                    for b, slot in enumerate(self._slots):
+                        if slot is not None:
+                            done.append(
+                                self._terminate(
+                                    b, "cancelled",
+                                    detail="drain grace "
+                                    f"{self.config.drain.grace_s}s expired",
+                                )
+                            )
+            else:
+                self._admit(done)
+            done += self._prefill_tick()
+            done += self._decode_tick()
+            rebuilt = False
+        except Exception as e:
+            rebuilt = True
+            self._consecutive_rebuilds += 1
+            if self._consecutive_rebuilds > self.MAX_CONSECUTIVE_REBUILDS:
+                raise  # systemic — the serving front reports scheduler death
+            done += self._rebuild(
+                "engine_error", detail=f"{type(e).__name__}: {e}"
+            )
+        ev, self._stall_evidence = self._stall_evidence, None
+        if ev is not None:
+            # the wedged call returned after the watchdog fired: its wave is
+            # suspect — fail it, rebuild, keep serving. Stall rebuilds draw
+            # on the SAME consecutive budget as exception rebuilds: a step
+            # that stalls every single time is just as systemic as one that
+            # raises every time, and must not rebuild-loop forever.
+            rebuilt = True
+            self._consecutive_rebuilds += 1
+            if self._consecutive_rebuilds > self.MAX_CONSECUTIVE_REBUILDS:
+                raise RuntimeError(
+                    f"serving engine stalled {self._consecutive_rebuilds} "
+                    "consecutive scheduler iterations — systemic fault, "
+                    "refusing to rebuild-loop"
+                )
+            done += self._rebuild(
+                "engine_stall",
+                detail=(
+                    f"no step-boundary heartbeat for {ev.get('heartbeat_age_s')}s "
+                    f"(deadline {ev.get('deadline_s')}s)"
+                ),
+            )
+        if not rebuilt:
+            self._consecutive_rebuilds = 0
         self._step_counter += 1
+        self.last_step_t = time.monotonic()
+        if self.draining:
+            self.drain_complete()  # stamps drain_duration_s when reached
         return done
 
     def run(self, max_iterations: Optional[int] = None) -> list[dict]:
@@ -443,6 +996,11 @@ class ServingEngine:
             f"serving engine failed to drain within {max_iterations} "
             f"iterations (queue={self.queue_depth}, busy={self.busy_slots})"
         )
+
+    def _record_cost(self, name: str, jit_fn, *args) -> None:
+        from automodel_tpu.telemetry.profiling import record_program_cost
+
+        record_program_cost(self.program_costs, name, jit_fn, *args)
 
     # -- workload driver (bench leg + sustained-throughput tests) -------------
     def run_workload(
@@ -471,11 +1029,16 @@ class ServingEngine:
             occ_peak = max(occ_peak, self.pool.occupancy())
             q_peak = max(q_peak, self.queue_depth)
         dt = time.perf_counter() - t0
-        gen = sum(r["n_generated"] for r in out)
-        ttfts = sorted(r["ttft_s"] for r in out)
+        completions = [
+            r for r in out if r.get("completion_reason") in ("stop", "length")
+        ]
+        gen = sum(r["n_generated"] for r in completions)
+        ttfts = sorted(
+            r["ttft_s"] for r in completions if isinstance(r.get("ttft_s"), float)
+        )
         pct = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)] if ttfts else None
         stats = {
-            "requests": len(out),
+            "requests": len(completions),
             "gen_tokens": gen,
             "wall_s": dt,
             "sustained_tokens_per_s": gen / dt if dt > 0 else 0.0,
@@ -485,4 +1048,6 @@ class ServingEngine:
             "queue_depth_peak": q_peak,
             "prefix_cache": dict(self.pool.counters),
         }
+        if len(completions) != len(out):
+            stats["failed_requests"] = len(out) - len(completions)
         return out, stats

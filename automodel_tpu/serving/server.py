@@ -4,32 +4,60 @@ Two modes, one engine:
 
 - **stdin-JSONL** (default): one request object per line —
   ``{"prompt": "..."} | {"prompt_ids": [...]}`` plus optional ``id`` /
-  ``max_new_tokens`` — all submitted into the admission queue, completions
-  printed as JSON lines AS THEY FINISH (continuous batching means short
-  requests return before long ones that arrived earlier).
+  ``max_new_tokens`` / ``deadline_s`` / ``max_queue_wait_s`` — all
+  submitted into the admission queue, completions printed as JSON lines AS
+  THEY FINISH (continuous batching means short requests return before long
+  ones that arrived earlier).
 - **local HTTP** (``serving.http.port``): POST /generate with the same
   request object blocks until that request completes; GET /stats returns
-  queue depth / occupancy / allocator counters. A background thread runs
-  the scheduler loop; handlers only enqueue and wait — stdlib
+  queue depth / occupancy / allocator counters; GET /metrics is the
+  Prometheus exposition; GET /healthz (scheduler thread alive, last step
+  age under the watchdog deadline) and GET /readyz (false while draining
+  or before the first compiled decode) feed load balancers. A background
+  thread runs the scheduler loop; handlers only enqueue and wait — stdlib
   ThreadingHTTPServer, no extra dependencies, explicitly a LOCAL/dev front
   (docs/serving.md covers what a production front needs on top).
 
+Production hardening (docs/serving.md "Failure modes & operations"):
+
+- **Graceful drain** — SIGTERM (chained through the PR 3
+  ``PreemptionHandler``) flips both fronts to draining: new and queued
+  requests are rejected retriable (HTTP 503 + ``Retry-After``, stdin-JSONL
+  error/record lines), in-flight requests finish within
+  ``serving.drain.grace_s`` (then are cancelled), the scheduler exits
+  cleanly and the CLI exits 0 — or 75 (EX_TEMPFAIL, the launchers' requeue
+  code) when running under slurm/k8s (``serving.drain.requeue_exit``).
+- **Overload shedding** — a full admission queue is an explicit 503 +
+  ``Retry-After`` (HTTP) / retriable error record (stdin), counted in
+  ``requests_shed_total``; never a silent drop or unbounded ttft.
+- **Engine stalls** — the scheduler-level ``EngineWatchdog``
+  (``serving.watchdog:``) detects a wedged jitted step, dumps stacks + the
+  flight recorder, and the engine fails only the affected wave and keeps
+  serving.
+
 Per-request telemetry (``ttft_s``, ``decode_tps``, ``queue_s``,
-``queue_depth``, ``block_occupancy``) rides the PR 2 metrics JSONL via
-``logging.metrics_path`` and is accepted by ``automodel_tpu report
---strict``.
+``queue_depth``, ``block_occupancy``, ``completion_reason``) rides the PR 2
+metrics JSONL via ``logging.metrics_path`` and is accepted by
+``automodel_tpu report --strict``.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
 import sys
 import threading
 import time
+from pathlib import Path
 from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
+
+# Retry-After advice on retriable rejections (503s): long enough for a
+# drain to finish or a queue burst to clear, short enough to keep clients
+# live. A load balancer should prefer another replica immediately.
+RETRY_AFTER_S = 5
 
 
 def _encode_prompt(req: dict, tokenizer: Any) -> list[int]:
@@ -60,10 +88,39 @@ def _decode_completion(tokens: list[int], tokenizer: Any) -> str:
     return tokenizer.decode(tokens, skip_special_tokens=True)
 
 
+def _drain_exit_code(drain_cfg: Any) -> int:
+    """0 after a clean drain — or the launchers' requeue code (75) so a
+    drained replica under slurm/k8s is restarted instead of counted as
+    done. ``auto`` sniffs the launcher env the PR 3/5 requeue rules key on."""
+    from automodel_tpu.resilience import REQUEUE_EXIT_CODE
+
+    if drain_cfg.requeue_exit == "always":
+        return REQUEUE_EXIT_CODE
+    if drain_cfg.requeue_exit == "never":
+        return 0
+    under_launcher = (
+        "SLURM_JOB_ID" in os.environ or "KUBERNETES_SERVICE_HOST" in os.environ
+    )
+    return REQUEUE_EXIT_CODE if under_launcher else 0
+
+
+_OK_REASONS = ("stop", "length")
+
+
+def _reason_status(reason: str) -> int:
+    """HTTP status for a terminal record that is not a completion."""
+    if reason in _OK_REASONS:
+        return 200
+    if reason == "timeout":
+        return 504  # the client's own budget expired — not retriable
+    return 503  # draining / cancelled / engine_stall / engine_error: retry
+
+
 class _EngineLoop:
     """Background scheduler thread for the HTTP mode: handlers submit under
     the lock and wait on a per-request event; the loop steps the engine
-    whenever there is work."""
+    whenever there is work (and keeps stepping through a drain so in-flight
+    requests finish and grace-expiry cancellations run)."""
 
     def __init__(self, engine: Any):
         self.engine = engine
@@ -73,7 +130,9 @@ class _EngineLoop:
         self._abandoned: set[str] = set()  # timed-out waiters: drop on finish
         self.error: Optional[str] = None  # scheduler-thread death, terminal
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-scheduler", daemon=True
+        )
 
     def start(self) -> None:
         self._thread.start()
@@ -82,15 +141,31 @@ class _EngineLoop:
         self._stop.set()
         self._thread.join(timeout=10)
 
+    def alive(self) -> bool:
+        return self._thread.is_alive() and self.error is None
+
     def submit_blocking(
-        self, prompt_ids: list[int], max_new_tokens: Optional[int],
-        timeout_s: float,
+        self, prompt_ids: list[int], req: dict, timeout_s: float
     ) -> dict:
+        from automodel_tpu.serving.engine import QueueFull
+
         ev = threading.Event()
         with self.lock:
             if self.error is not None:
                 raise RuntimeError(f"serving engine is down: {self.error}")
-            rid = self.engine.submit(prompt_ids, max_new_tokens=max_new_tokens)
+            try:
+                rid = self.engine.submit(
+                    prompt_ids,
+                    max_new_tokens=req.get("max_new_tokens"),
+                    deadline_s=req.get("deadline_s"),
+                    max_queue_wait_s=req.get("max_queue_wait_s"),
+                )
+            except QueueFull:
+                # the HTTP front sheds immediately — a blocked handler
+                # thread per queued-out client is exactly the unbounded
+                # latency shedding exists to prevent
+                self.engine.record_shed(prompt_ids=prompt_ids)
+                raise
             self._events[rid] = ev
         if not ev.wait(timeout=timeout_s):
             with self.lock:
@@ -130,6 +205,9 @@ class _EngineLoop:
                     if ev is not None:
                         ev.set()
             if idle:
+                # an idle server is healthy, not hung: keep the stall
+                # watchdog's heartbeat fresh without counting a step
+                self.engine.touch_watchdog()
                 time.sleep(0.005)
 
 
@@ -146,11 +224,15 @@ def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
         def log_message(self, fmt, *args):  # route to logging, not stderr
             logger.debug("http: " + fmt, *args)
 
-        def _json(self, code: int, obj: dict) -> None:
+        def _json(
+            self, code: int, obj: dict, retry_after: bool = False
+        ) -> None:
             body = (json.dumps(obj) + "\n").encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after:
+                self.send_header("Retry-After", str(RETRY_AFTER_S))
             self.end_headers()
             self.wfile.write(body)
 
@@ -171,6 +253,49 @@ def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if self.path == "/healthz":
+                # liveness: the scheduler thread exists and its last step
+                # boundary is inside the stall watchdog's deadline. An IDLE
+                # engine is healthy by definition — no steps run, so age is
+                # meaningless there. Deliberately LOCK-FREE: during the
+                # exact wedged-step stall this endpoint exists to report,
+                # the scheduler thread holds loop.lock inside engine.step()
+                # — taking it here would hang the kubelet's probe instead
+                # of answering 503. Everything read is a GIL-atomic
+                # attribute (the same contract the watchdog thread relies
+                # on), at worst one step stale.
+                alive = loop.alive()
+                idle = engine.idle()
+                age = engine.last_step_age_s
+                wd = engine.watchdog
+                deadline = wd.deadline_s if wd is not None else None
+                ok = alive and (
+                    idle or wd is None or age is None or age <= deadline
+                )
+                return self._json(200 if ok else 503, {
+                    "ok": ok,
+                    "scheduler_alive": alive,
+                    "idle": idle,
+                    "last_step_age_s": age,
+                    "stall_deadline_s": deadline,
+                    "error": loop.error,
+                })
+            if self.path == "/readyz":
+                # readiness: drop out of the load balancer while draining,
+                # and never advertise before the first decode compiled (the
+                # warm-up request flips this at startup). Lock-free for the
+                # same reason as /healthz — a stalled scheduler must not
+                # make the probe hang.
+                ready = (
+                    loop.alive()
+                    and not engine.draining
+                    and engine.first_decode_done
+                )
+                return self._json(200 if ready else 503, {
+                    "ready": ready,
+                    "draining": engine.draining,
+                    "first_decode_done": engine.first_decode_done,
+                })
             if self.path != "/stats":
                 return self._json(404, {"error": f"unknown path {self.path}"})
             with loop.lock:
@@ -178,6 +303,13 @@ def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
                     "queue_depth": engine.queue_depth,
                     "busy_slots": engine.busy_slots,
                     "completed_total": engine.completed_total,
+                    "failed_total": engine.failed_total,
+                    "shed_total": engine.shed_total,
+                    "timeout_total": engine.timeout_total,
+                    "stall_total": engine.stall_total,
+                    "error_total": engine.error_total,
+                    "draining": engine.draining,
+                    "drain_duration_s": engine.drain_duration_s,
                     "block_occupancy": engine.pool.occupancy(),
                     "allocator": dict(engine.pool.counters),
                 })
@@ -185,22 +317,31 @@ def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
         def do_POST(self):
             if self.path != "/generate":
                 return self._json(404, {"error": f"unknown path {self.path}"})
-            from automodel_tpu.serving.engine import QueueFull
+            from automodel_tpu.serving.engine import EngineDraining, QueueFull
 
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
                 ids = _encode_prompt(req, tokenizer)
                 rec = loop.submit_blocking(
-                    ids, req.get("max_new_tokens"),
-                    timeout_s=float(req.get("timeout_s", 300.0)),
+                    ids, req, timeout_s=float(req.get("timeout_s", 300.0))
                 )
             except (ValueError, TypeError) as e:
                 return self._json(400, {"error": str(e)})
             except QueueFull as e:
-                # backpressure the client can act on — never a dropped
-                # connection (the documented contract)
-                return self._json(429, {"error": str(e)})
+                # overload SHED: an explicit retriable signal the client
+                # (or its load balancer) can act on — never a dropped
+                # connection, never an unbounded queue
+                return self._json(
+                    503, {"error": str(e), "retriable": True, "reason": "shed"},
+                    retry_after=True,
+                )
+            except EngineDraining as e:
+                return self._json(
+                    503,
+                    {"error": str(e), "retriable": True, "reason": "draining"},
+                    retry_after=True,
+                )
             except TimeoutError as e:
                 return self._json(504, {"error": str(e)})
             except RuntimeError as e:  # scheduler thread died
@@ -209,11 +350,56 @@ def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
             out["completion"] = _decode_completion(rec["tokens"], tokenizer)
             if req.get("id") is not None:
                 out["id"] = req["id"]
-            self._json(200, out)
+            reason = rec.get("completion_reason", "length")
+            code = _reason_status(reason)
+            self._json(code, out, retry_after=code == 503)
 
     server = ThreadingHTTPServer((host, port), Handler)
     server._engine_loop = loop  # for the caller's shutdown path
     return server, loop
+
+
+def _install_drain_handler(engine: Any, on_term=None):
+    """Chain SIGTERM → drain through the PR 3 PreemptionHandler (prior
+    handlers — libtpu, cluster agents — still run). → the installed
+    handler, or None when serving.drain.install_signal_handler is off or
+    this is not the main thread (signal.signal would raise)."""
+    drain_cfg = engine.config.drain
+    if not drain_cfg.install_signal_handler:
+        return None
+    if threading.current_thread() is not threading.main_thread():
+        return None
+    from automodel_tpu.resilience.preemption import PreemptionHandler
+
+    handler = PreemptionHandler(
+        signals=("SIGTERM",),
+        on_preempt=on_term,
+        log_message=(
+            "serving drain: rejecting new requests retriable, finishing "
+            f"in-flight within serving.drain.grace_s={drain_cfg.grace_s}"
+        ),
+    )
+    try:
+        handler.install()
+    except ValueError:  # non-main-thread despite the check (exotic embeds)
+        return None
+    return handler
+
+
+def _warmup(engine: Any) -> None:
+    """One tiny request through the engine before the front opens: absorbs
+    the prefill/decode compiles (ttft of the FIRST real request) and flips
+    ``first_decode_done`` so /readyz can go true. Best-effort."""
+    try:
+        vocab = int(getattr(engine.model.config, "vocab_size", 2))
+        # max_new_tokens=2, not 1: a 1-token request completes at the
+        # prefill tick and never runs (or compiles) the decode program —
+        # readiness requires one real decode step
+        engine.submit([min(1, max(vocab - 1, 0))], request_id="__warmup__",
+                      max_new_tokens=2)
+        engine.run()
+    except Exception as e:
+        logger.warning("serve warm-up request failed: %r", e)
 
 
 def main(cfg: Any) -> int:
@@ -256,40 +442,135 @@ def main(cfg: Any) -> int:
         auto, serve_cfg, gen_cfg, on_record=on_record
     )
 
-    if http_section.get("port") is not None:
-        port = int(http_section["port"])
-        host = str(http_section.get("host", "127.0.0.1"))
-        server, loop = serve_http(engine, tokenizer, port, host=host)
-        print(
-            json.dumps({
-                "event": "serve_listening",
-                "host": host, "port": server.server_address[1],
-                "slots": serve_cfg.slots, "num_blocks": serve_cfg.num_blocks,
-            }),
-            flush=True,
-        )
+    # stall-watchdog evidence routing: stacks + flight recorder land next
+    # to the metrics JSONL when one is configured (same layout the training
+    # guard uses)
+    flight_recorder = None
+    stacks_path = None
+    if metrics_path:
         try:
-            server.serve_forever()
-        except KeyboardInterrupt:
-            pass
-        finally:
-            server.shutdown()
-            loop.close()
-            if metric_logger is not None:
-                metric_logger.close()
-        return 0
+            from automodel_tpu.telemetry.flight_recorder import (
+                FlightRecorder,
+                build_fingerprint,
+            )
 
-    # stdin-JSONL: submit every line, print completions as they finish. A
-    # bad line is THAT client's error — it gets an error JSON line and the
-    # batch continues; crashing here would destroy every other request's
-    # in-flight work.
-    from automodel_tpu.serving.engine import QueueFull
+            parent = Path(metrics_path).parent
+            stacks_path = str(parent / "watchdog_stacks.txt")
+            flight_recorder = FlightRecorder(
+                path=str(parent / "flight_recorder.json"),
+                fingerprint=build_fingerprint(
+                    config=cfg.to_dict() if hasattr(cfg, "to_dict") else None,
+                    mesh_ctx=auto.mesh_ctx,
+                ),
+            )
+        except Exception as e:  # evidence plumbing must not block serving
+            logger.warning("flight recorder unavailable: %r", e)
+    engine.start_watchdog(
+        flight_recorder=flight_recorder, metric_logger=metric_logger,
+        stacks_path=stacks_path,
+    )
 
-    n_submitted, n_bad = 0, 0
-    for lineno, line in enumerate(sys.stdin, 1):
+    try:
+        if http_section.get("port") is not None:
+            return _serve_http_forever(
+                engine, tokenizer, http_section, serve_cfg
+            )
+        return _serve_stdin(engine, tokenizer, serve_cfg)
+    finally:
+        engine.stop_watchdog()
+        if metric_logger is not None:
+            metric_logger.close()
+
+
+def _serve_http_forever(engine, tokenizer, http_section, serve_cfg) -> int:
+    port = int(http_section["port"])
+    host = str(http_section.get("host", "127.0.0.1"))
+    drain_cfg = serve_cfg.drain
+    if http_section.get("warmup", True):
+        _warmup(engine)
+    server, loop = serve_http(engine, tokenizer, port, host=host)
+    state = {"rc": 0}
+
+    def _drain_then_stop():
+        # begin_drain only flips flags (GIL-atomic stores the scheduler
+        # reads at its next iteration) — deliberately NOT taken under
+        # loop.lock: if SIGTERM lands while a step is wedged (the stall
+        # scenario), the scheduler holds the lock and the grace countdown
+        # would never even start
+        engine.begin_drain()
+        # the scheduler thread keeps stepping: in-flight requests finish,
+        # grace expiry cancels stragglers INSIDE engine.step — this thread
+        # only watches for completion, with margin for a slow final step
+        deadline = time.monotonic() + drain_cfg.grace_s + 10.0
+        while time.monotonic() < deadline:
+            if engine.drain_complete() or not loop.alive():
+                break
+            time.sleep(0.05)
+        state["rc"] = _drain_exit_code(drain_cfg)
+        server.shutdown()
+
+    def _on_term():
+        threading.Thread(
+            target=_drain_then_stop, name="serve-drain", daemon=True
+        ).start()
+
+    handler = _install_drain_handler(engine, on_term=_on_term)
+    print(
+        json.dumps({
+            "event": "serve_listening",
+            "host": host, "port": server.server_address[1],
+            "slots": serve_cfg.slots, "num_blocks": serve_cfg.num_blocks,
+        }),
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        loop.close()
+        if handler is not None:
+            handler.restore()
+    return state["rc"]
+
+
+def _serve_stdin(engine, tokenizer, serve_cfg) -> int:
+    """stdin-JSONL: submit every line, print terminal records as they
+    happen. A bad line is THAT client's error — it gets an error JSON line
+    and the batch continues; crashing here would destroy every other
+    request's in-flight work. SIGTERM drains: remaining input is not read,
+    queued requests are rejected retriable, in-flight requests finish
+    within the grace."""
+    import queue as queue_mod
+
+    from automodel_tpu.serving.engine import EngineDraining, QueueFull
+
+    drain_cfg = serve_cfg.drain
+    handler = _install_drain_handler(engine)
+    stdin = sys.stdin
+    # a daemon reader thread feeds a queue: the scheduler loop never blocks
+    # on stdin (completions stream out while input sits idle-open, SIGTERM
+    # is observed between steps instead of inside a blocked read — PEP 475
+    # would resume the read and swallow the drain), and select()'s
+    # buffered-IO blind spot is avoided entirely
+    lines_q: "queue_mod.Queue[str]" = queue_mod.Queue()
+
+    def _reader():
+        while True:
+            line = stdin.readline()
+            lines_q.put(line)  # "" = EOF sentinel
+            if line == "":
+                return
+
+    threading.Thread(target=_reader, name="serve-stdin", daemon=True).start()
+
+    counts = {"submitted": 0, "bad": 0}
+
+    def handle_line(line: str, lineno: int) -> None:
         line = line.strip()
         if not line:
-            continue
+            return
         rid = None
         try:
             req = json.loads(line)
@@ -303,40 +584,154 @@ def main(cfg: Any) -> int:
                         ids,
                         request_id=str(rid) if rid is not None else None,
                         max_new_tokens=req.get("max_new_tokens"),
+                        deadline_s=req.get("deadline_s"),
+                        max_queue_wait_s=req.get("max_queue_wait_s"),
                     )
                     break
                 except QueueFull:
-                    # bounded queue + unbounded stdin: drain a step, retry
+                    # bounded queue + unbounded stdin: absorb backpressure
+                    # by draining a step — but if the step retired nothing
+                    # and the queue is still full, SHED explicitly instead
+                    # of spinning
+                    before = engine.completed_total + engine.failed_total
                     for rec in engine.step():
                         _emit(rec, tokenizer)
+                    if (
+                        engine.completed_total + engine.failed_total == before
+                        and engine.queue_depth >= engine.config.max_queue
+                    ):
+                        raise
+        except QueueFull as e:
+            engine.record_shed(
+                request_id=str(rid) if rid is not None else None
+            )
+            err = {
+                "error": f"line {lineno}: {e}",
+                "retriable": True, "reason": "shed",
+            }
+            if rid is not None:
+                err["id"] = rid
+            print(json.dumps(err), flush=True)
+        except EngineDraining as e:
+            err = {
+                "error": f"line {lineno}: {e}",
+                "retriable": True, "reason": "draining",
+            }
+            if rid is not None:
+                err["id"] = rid
+            print(json.dumps(err), flush=True)
         except (ValueError, TypeError) as e:
-            n_bad += 1
+            counts["bad"] += 1
             err = {"error": f"line {lineno}: {e}"}
             if rid is not None:
                 err["id"] = rid
             print(json.dumps(err), flush=True)
-            continue
-        n_submitted += 1
+        else:
+            counts["submitted"] += 1
+
+    lineno = 0
+    eof = False
+    while not eof:
+        if handler is not None and handler.preempted and not engine.draining:
+            engine.begin_drain()
+        if engine.draining:
+            break
+        got_line = False
+        try:
+            line = lines_q.get_nowait()
+            if line == "":
+                eof = True
+            else:
+                lineno += 1
+                handle_line(line, lineno)
+                got_line = True
+        except queue_mod.Empty:
+            pass
         # drain opportunistically so early completions stream out while
-        # later lines are still being read
+        # later lines are still being read (or while stdin sits idle-open)
+        if not engine.idle():
+            for rec in engine.step():
+                _emit(rec, tokenizer)
+        elif not got_line and not eof:
+            engine.touch_watchdog()
+            time.sleep(0.02)
+
+    def _reject_buffered_lines() -> None:
+        # lines the reader thread already pulled off the pipe are gone from
+        # the client's side — dropping them silently on drain would break
+        # the one-response-per-request contract, so each gets an explicit
+        # retriable error line (they were never submitted, so there is no
+        # engine record to emit)
+        while True:
+            try:
+                line = lines_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            line = line.strip()
+            if not line:
+                continue
+            err = {
+                "error": "server is draining — retry against another replica",
+                "retriable": True, "reason": "draining",
+            }
+            try:
+                req = json.loads(line)
+                if isinstance(req, dict) and req.get("id") is not None:
+                    err["id"] = req["id"]
+            except ValueError:
+                pass
+            print(json.dumps(err), flush=True)
+
+    # EOF or drain: finish the remaining work. A SIGTERM landing in THIS
+    # phase must still start the drain — the batch (pipe-then-close) case
+    # spends almost its whole life here, after EOF. Iterations bounded by
+    # the same analytic guard as ServingEngine.run.
+    per_req = (
+        -(-engine.config.max_seq_len // engine.config.prefill_chunk)
+        + engine.config.max_seq_len
+    )
+    iter_bound = 64 + (engine.queue_depth + engine.busy_slots + 1) * (per_req + 2)
+    drained_rc = None
+    for _ in range(iter_bound):
+        if handler is not None and handler.preempted and not engine.draining:
+            engine.begin_drain()
+        if engine.draining:
+            _reject_buffered_lines()
+            # engine.step rejects the queue retriable and cancels in-flight
+            # requests once drain.grace_s expires
+            if engine.drain_complete():
+                drained_rc = _drain_exit_code(drain_cfg)
+                break
+        elif engine.idle():
+            break
         for rec in engine.step():
             _emit(rec, tokenizer)
-    if n_submitted == 0:
+    else:
+        raise RuntimeError(
+            f"serving engine failed to drain within {iter_bound} iterations "
+            f"(queue={engine.queue_depth}, busy={engine.busy_slots})"
+        )
+    if handler is not None:
+        handler.restore()
+    if drained_rc is not None:
+        _reject_buffered_lines()  # lines that raced in during the drain
+        return drained_rc
+    if counts["submitted"] == 0:
         print(
             "no requests: pipe JSONL lines like "
             '{"prompt": "1 2 3", "max_new_tokens": 8} into stdin',
             file=sys.stderr,
         )
         return 2
-    for rec in engine.run():
-        _emit(rec, tokenizer)
-    if metric_logger is not None:
-        metric_logger.close()
-    return 0 if n_bad == 0 else 1
+    return 0 if counts["bad"] == 0 else 1
 
 
 def _emit(rec: dict, tokenizer: Any) -> None:
     out = dict(rec)
-    out["completion"] = _decode_completion(out.pop("tokens"), tokenizer)
+    if out.get("event") == "serve_engine_event":
+        # engine-level evidence (stall/rebuild) — pass through as-is
+        print(json.dumps(out), flush=True)
+        return
+    out["completion"] = _decode_completion(out.pop("tokens", []), tokenizer)
     out.pop("event", None)
     print(json.dumps(out), flush=True)

@@ -270,6 +270,35 @@ class ServingMetrics:
         self.blocks_in_use = r.gauge(
             "automodel_serve_blocks_in_use", "KV blocks referenced by live sequences"
         )
+        # robustness counters (serving/engine.py drain/deadline/shed/stall)
+        self.failed = r.counter(
+            "automodel_serve_requests_failed",
+            "Requests terminated without completing (timeout/drain/stall/error)",
+        )
+        self.shed = r.counter(
+            "automodel_serve_requests_shed",
+            "Requests rejected at submit because the admission queue was full",
+        )
+        self.timeouts = r.counter(
+            "automodel_serve_requests_timeout",
+            "Requests cancelled by deadline_s / max_queue_wait_s expiry",
+        )
+        self.stalls = r.counter(
+            "automodel_serve_engine_stalls",
+            "Wedged decode/prefill steps detected by the engine watchdog",
+        )
+        self.engine_errors = r.counter(
+            "automodel_serve_engine_errors",
+            "Scheduler exceptions recovered by a pool rebuild",
+        )
+        self.draining = r.gauge(
+            "automodel_serve_draining", "1 while the server is draining"
+        )
+        self.drain_duration = r.gauge(
+            "automodel_serve_drain_duration_seconds",
+            "Wall time from drain start to the last in-flight completion "
+            "(0 until a drain finishes)",
+        )
         self._pool_counters = {
             key: r.counter(f"automodel_serve_block_{key}", help_text)
             for key, help_text in (
@@ -295,6 +324,25 @@ class ServingMetrics:
             self.completed.inc()
             self.gen_tokens.inc(rec.get("n_generated", 0) or 0)
 
+    def observe_failure(self, reason: str) -> None:
+        """Per-termination observation for a request that did NOT complete
+        (serving/engine.py failure paths)."""
+        with self.registry.lock:
+            self.failed.inc()
+            if reason == "timeout":
+                self.timeouts.inc()
+            elif reason == "shed":
+                self.shed.inc()
+
+    def observe_engine_event(self, reason: str) -> None:
+        """Once per engine-level recovery (pool rebuild after a stall or a
+        scheduler exception), not per affected request."""
+        with self.registry.lock:
+            if reason == "engine_stall":
+                self.stalls.inc()
+            else:
+                self.engine_errors.inc()
+
     def sync(self, engine) -> None:
         """Pull current scheduler/allocator state (call under the engine
         lock; the serving HTTP handler does this per scrape)."""
@@ -308,6 +356,10 @@ class ServingMetrics:
             self.prefilling.set(prefilling)
             self.occupancy.set(engine.pool.occupancy())
             self.blocks_in_use.set(engine.pool.in_use())
+            self.draining.set(1.0 if getattr(engine, "draining", False) else 0.0)
+            self.drain_duration.set(
+                float(getattr(engine, "drain_duration_s", None) or 0.0)
+            )
             for key, counter in self._pool_counters.items():
                 counter.set_total(engine.pool.counters.get(key, 0))
 

@@ -49,6 +49,9 @@ _NUMERIC_KEYS = (
     "serve_ttft_p99_s",
     "serve_block_occupancy_peak",
     "serve_requests",
+    # serving robustness (PR 9): drain/deadline/stall evidence
+    "drain_duration_s",
+    "requests_failed",
     # distributed guard (watchdog liveness, consensus/straggler attribution)
     "heartbeat_age_s",
     "deadline_s",
@@ -248,6 +251,34 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
         ]
         if occ:
             out["serve_block_occupancy_peak"] = max(occ)
+        # completion-reason histogram (PR 9): shed/timeout/stall/drain
+        # terminations are the headline of a run that had them
+        reasons: dict[str, int] = {}
+        for r in serves:
+            cr = r.get("completion_reason")
+            if isinstance(cr, str):
+                reasons[cr] = reasons.get(cr, 0) + 1
+        if reasons:
+            out["serve_completion_reasons"] = dict(sorted(reasons.items()))
+            for reason, key in (
+                ("shed", "serve_shed"),
+                ("timeout", "serve_timeouts"),
+            ):
+                if reasons.get(reason):
+                    out[key] = reasons[reason]
+    stalls = [r for r in records if r.get("event") == "serve_engine_event"]
+    if stalls:
+        out["serve_engine_events"] = [
+            {
+                "reason": r.get("reason"),
+                "step": r.get("step"),
+                "requests_failed": r.get("requests_failed"),
+            }
+            for r in stalls
+        ]
+        out["serve_stalls"] = sum(
+            1 for r in stalls if r.get("reason") == "engine_stall"
+        )
     return out
 
 

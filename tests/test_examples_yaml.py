@@ -119,12 +119,30 @@ def test_example_yaml_parses_and_dry_instantiates(path):
             gen.pop(recipe_key, None)
         GenerationConfig.from_dict(gen)
 
-    # serving: → ServeConfig (minus the server-level http: subsection)
+    # serving: → ServeConfig (minus the server-level http: subsection);
+    # the nested limits:/drain:/watchdog: sections are strict-instantiated
+    # both through from_dict and standalone (a typo'd nested key must fail
+    # here, not on a pod)
     srv = _section(cfg, "serving")
     if srv is not None:
-        from automodel_tpu.serving.engine import ServeConfig
+        from automodel_tpu.serving.engine import (
+            DrainConfig,
+            LimitsConfig,
+            ServeConfig,
+            StallConfig,
+        )
 
-        ServeConfig.from_dict(srv)
+        sc = ServeConfig.from_dict(srv)
+        assert isinstance(sc.limits, LimitsConfig)
+        assert isinstance(sc.drain, DrainConfig)
+        assert isinstance(sc.watchdog, StallConfig)
+        for key, sub in (
+            ("limits", LimitsConfig),
+            ("drain", DrainConfig),
+            ("watchdog", StallConfig),
+        ):
+            if srv.get(key) is not None:
+                sub.from_dict(dict(srv[key]))
 
     # profiling: → ProfilingConfig (+ nested triggered: sub-section)
     prof = _section(cfg, "profiling")
@@ -180,3 +198,7 @@ def test_config_dataclasses_reject_unknown_keys():
 
     with pytest.raises(TypeError):
         ServeConfig.from_dict({"block_sizee": 8})
+    with pytest.raises(TypeError):
+        ServeConfig.from_dict({"limits": {"deadline_ss": 1.0}})
+    with pytest.raises(TypeError):
+        ServeConfig.from_dict({"drain": {"grace": 1.0}})

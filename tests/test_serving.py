@@ -701,3 +701,393 @@ def test_bench_serving_leg_end_to_end(cpu_devices, monkeypatch):
     assert 0 < result["serve_ttft_p50_s"] <= result["serve_ttft_p99_s"]
     assert 0 < result["serve_block_occupancy_peak"] <= 1
     assert validate_bench_result(result) == []
+
+
+# -- robustness: deadlines / drain / shed / leak audit (PR 9) -----------------
+
+
+def test_serve_config_nested_sections_parse_and_reject_unknown_keys():
+    from automodel_tpu.serving.engine import DrainConfig, LimitsConfig, StallConfig
+
+    cfg = ServeConfig.from_dict({
+        "slots": 2,
+        "limits": {"deadline_s": 30.0, "max_queue_wait_s": 5.0},
+        "drain": {"grace_s": 10.0, "requeue_exit": "never"},
+        "watchdog": {"enabled": False, "min_deadline_s": 1.0},
+    })
+    assert cfg.limits.deadline_s == 30.0 and cfg.limits.max_queue_wait_s == 5.0
+    assert cfg.drain.grace_s == 10.0 and cfg.drain.requeue_exit == "never"
+    assert cfg.watchdog.enabled is False
+    with pytest.raises(TypeError, match="serving.limits"):
+        ServeConfig.from_dict({"limits": {"deadline_ss": 1}})
+    with pytest.raises(TypeError, match="serving.drain"):
+        ServeConfig.from_dict({"drain": {"grace": 1}})
+    with pytest.raises(TypeError, match="serving.watchdog"):
+        ServeConfig.from_dict({"watchdog": {"multiplierr": 2}})
+    with pytest.raises(ValueError, match="requeue_exit"):
+        ServeConfig.from_dict({"drain": {"requeue_exit": "sometimes"}})
+    assert LimitsConfig.from_dict(None).deadline_s is None
+    assert DrainConfig.from_dict(None).grace_s == 30.0
+    assert StallConfig.from_dict(None).enabled is True
+
+
+def test_completion_reason_on_normal_completions():
+    """Every terminal record carries exactly one completion_reason: length
+    for a spent budget, stop for an eos hit."""
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    srv = ServingEngine(
+        auto,
+        ServeConfig(slots=1, block_size=4, num_blocks=32, prefill_chunk=4, max_seq_len=32),
+        GenerationConfig(max_new_tokens=3, greedy=True),
+    )
+    srv.submit([1, 2, 3])
+    recs = srv.run()
+    assert [r["completion_reason"] for r in recs] == ["length"]
+    assert recs[0]["retriable"] is False
+    # eos → stop
+    ref = _single_wave_greedy(auto, [1, 2, 3], 4)
+    srv2 = ServingEngine(
+        auto,
+        ServeConfig(slots=1, block_size=4, num_blocks=32, prefill_chunk=4, max_seq_len=32),
+        GenerationConfig(max_new_tokens=12, greedy=True, eos_token_id=ref[1]),
+    )
+    srv2.submit([1, 2, 3])
+    recs2 = srv2.run()
+    assert recs2[0]["completion_reason"] == "stop"
+
+
+def test_deadline_cancels_mid_decode_and_frees_blocks():
+    import time as _time
+
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    recs = []
+    srv = ServingEngine(
+        auto,
+        ServeConfig(slots=1, block_size=4, num_blocks=32, prefill_chunk=4, max_seq_len=64),
+        GenerationConfig(max_new_tokens=40, greedy=True),
+        on_record=recs.append,
+    )
+    srv.submit([1, 2, 3], deadline_s=0.05)
+    out = srv.run()
+    assert len(out) == 1 and out[0]["completion_reason"] == "timeout"
+    # it was cancelled MID-decode: some tokens were produced, fewer than
+    # the budget, and every block came back
+    assert 0 < out[0]["n_generated"] < 40
+    assert out[0]["retriable"] is False
+    srv.pool.check_invariants()
+    assert srv.pool.available() == srv.pool.usable_blocks
+    assert srv.timeout_total == 1
+    # the record rode the telemetry hook and the /metrics counter moved
+    assert recs and recs[-1]["completion_reason"] == "timeout"
+    rendered = srv.metrics.registry.render()
+    assert "automodel_serve_requests_timeout_total 1" in rendered
+    assert "automodel_serve_requests_failed_total 1" in rendered
+
+
+def test_queue_wait_timeout_expires_queued_request():
+    import time as _time
+
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    srv = ServingEngine(
+        auto,
+        ServeConfig(slots=1, block_size=4, num_blocks=32, prefill_chunk=4, max_seq_len=32),
+        GenerationConfig(max_new_tokens=4, greedy=True),
+    )
+    a = srv.submit([1, 2, 3])
+    b = srv.submit([4, 5, 6], max_queue_wait_s=0.001)
+    _time.sleep(0.01)
+    done = {r["request_id"]: r for r in srv.run()}
+    assert done[a]["completion_reason"] == "length"
+    assert done[b]["completion_reason"] == "timeout"
+    assert done[b]["n_generated"] == 0 and "ttft_s" not in done[b]
+    srv.pool.check_invariants()
+
+
+def test_limits_config_defaults_apply_to_every_request():
+    """serving.limits.max_queue_wait_s applies without per-request args."""
+    import time as _time
+
+    from automodel_tpu.serving.engine import LimitsConfig
+
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    srv = ServingEngine(
+        auto,
+        ServeConfig(slots=1, block_size=4, num_blocks=8, prefill_chunk=4,
+                    max_seq_len=12, prefix_cache=False,
+                    limits=LimitsConfig(max_queue_wait_s=0.001)),
+        GenerationConfig(max_new_tokens=4, greedy=True),
+    )
+    # the pool only fits one request; the second must expire in queue
+    a = srv.submit([1, 2, 3])
+    out = srv.step()  # a admitted before its queue-wait bound elapses
+    b = srv.submit([4, 5, 6])
+    _time.sleep(0.01)
+    done = {r["request_id"]: r for r in out + srv.run()}
+    assert done[b]["completion_reason"] == "timeout"
+    assert done[a]["completion_reason"] == "length"
+
+
+def test_drain_rejects_queue_finishes_inflight_and_stamps_duration():
+    from automodel_tpu.serving.engine import DrainConfig, EngineDraining
+
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    srv = ServingEngine(
+        auto,
+        ServeConfig(slots=2, block_size=4, num_blocks=32, prefill_chunk=4,
+                    max_seq_len=32, drain=DrainConfig(grace_s=30.0)),
+        GenerationConfig(max_new_tokens=4, greedy=True),
+    )
+    a = srv.submit([1, 2, 3])
+    b = srv.submit([4, 5])
+    srv.step()  # a, b admitted
+    c = srv.submit([6, 7])  # queued behind full slots
+    srv.begin_drain()
+    with pytest.raises(EngineDraining):
+        srv.submit([9, 9])
+    out = []
+    for _ in range(200):
+        out.extend(srv.step())
+        if srv.drain_complete():
+            break
+    by = {r["request_id"]: r for r in out}
+    assert by[c]["completion_reason"] == "draining" and by[c]["retriable"] is True
+    assert by[a]["completion_reason"] == "length"
+    assert by[b]["completion_reason"] == "length"
+    assert srv.drain_duration_s is not None and srv.drain_duration_s >= 0
+    srv.pool.check_invariants()
+    assert srv.pool.available() == srv.pool.usable_blocks
+    rendered = srv.metrics.registry.render()
+    srv.metrics.sync(srv)
+    rendered = srv.metrics.registry.render()
+    assert "automodel_serve_draining 1" in rendered
+    assert "automodel_serve_drain_duration_seconds" in rendered
+
+
+def test_drain_grace_expiry_cancels_inflight():
+    from automodel_tpu.serving.engine import DrainConfig
+
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    srv = ServingEngine(
+        auto,
+        ServeConfig(slots=1, block_size=4, num_blocks=64, prefill_chunk=4,
+                    max_seq_len=64, drain=DrainConfig(grace_s=0.0)),
+        GenerationConfig(max_new_tokens=40, greedy=True),
+    )
+    a = srv.submit([1, 2, 3])
+    srv.step()  # admitted, prefilling
+    srv.begin_drain()
+    out = []
+    for _ in range(50):
+        out.extend(srv.step())
+        if srv.drain_complete():
+            break
+    assert [r["completion_reason"] for r in out] == ["cancelled"]
+    assert out[0]["retriable"] is True
+    srv.pool.check_invariants()
+    assert srv.pool.available() == srv.pool.usable_blocks
+
+
+def test_shed_accounting_record_and_counter():
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    recs = []
+    srv = ServingEngine(
+        auto,
+        ServeConfig(slots=1, block_size=4, num_blocks=16, prefill_chunk=4,
+                    max_seq_len=16, max_queue=1),
+        GenerationConfig(max_new_tokens=4, greedy=True),
+        on_record=recs.append,
+    )
+    srv.submit([1, 2])
+    with pytest.raises(QueueFull):
+        srv.submit([3, 4])
+    # submit itself never records a shed (backpressure retries must not
+    # inflate the counter) — the front calls record_shed when it gives up
+    assert srv.shed_total == 0 and not recs
+    rec = srv.record_shed(request_id="client-1", prompt_ids=[3, 4])
+    assert rec["completion_reason"] == "shed" and rec["retriable"] is True
+    assert srv.shed_total == 1
+    assert recs[-1]["request_id"] == "client-1"
+    assert "automodel_serve_requests_shed_total 1" in srv.metrics.registry.render()
+    srv.run()
+
+
+def test_block_leak_regression_exception_between_alloc_and_bind(monkeypatch):
+    """Satellite: a planted exception between admit-time allocation and
+    slot binding must free every block (invariants + free count restored)
+    and fail only that request — loudly, with an engine_error record."""
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    srv = ServingEngine(
+        auto,
+        ServeConfig(slots=2, block_size=4, num_blocks=32, prefill_chunk=4, max_seq_len=32),
+        GenerationConfig(max_new_tokens=4, greedy=True),
+    )
+    free_before = srv.pool.available()
+    monkeypatch.setattr(
+        ServingEngine, "_bind_slot",
+        lambda self, *a, **k: (_ for _ in ()).throw(RuntimeError("planted")),
+    )
+    bad = srv.submit([1, 2, 3])
+    out = srv.step()
+    monkeypatch.undo()
+    assert [r["request_id"] for r in out] == [bad]
+    assert out[0]["completion_reason"] == "engine_error"
+    assert out[0]["retriable"] is True
+    srv.pool.check_invariants()
+    assert srv.pool.available() == free_before  # zero leaked blocks
+    assert srv.error_total == 1
+    # the engine still serves after the fault
+    ok = srv.submit([4, 5, 6])
+    done = {r["request_id"]: r for r in srv.run()}
+    assert done[ok]["completion_reason"] == "length"
+
+
+def test_block_pool_clear_prefix_cache():
+    pool = BlockPool(num_blocks=8, block_size=2)
+    tokens = [1, 2, 3, 4, 5]
+    blocks = pool.allocate(3)
+    pool.register_prefix(tokens, blocks)
+    pool.free(blocks)  # parked in the LRU
+    pool.clear_prefix_cache()
+    pool.check_invariants()
+    assert pool.available() == pool.usable_blocks
+    assert pool.match_prefix(tokens) == ([], 0)
+    # clearing while a registered block is still referenced: it loses the
+    # hash mapping and frees normally later
+    blocks2 = pool.allocate(3)
+    pool.register_prefix(tokens, blocks2)
+    pool.clear_prefix_cache()
+    pool.check_invariants()
+    pool.free(blocks2)
+    pool.check_invariants()
+    assert pool.available() == pool.usable_blocks
+
+
+def test_drain_exit_code_policy(monkeypatch):
+    from automodel_tpu.resilience import REQUEUE_EXIT_CODE
+    from automodel_tpu.serving.engine import DrainConfig
+    from automodel_tpu.serving.server import _drain_exit_code
+
+    for k in ("SLURM_JOB_ID", "KUBERNETES_SERVICE_HOST"):
+        monkeypatch.delenv(k, raising=False)
+    assert _drain_exit_code(DrainConfig(requeue_exit="auto")) == 0
+    assert _drain_exit_code(DrainConfig(requeue_exit="always")) == REQUEUE_EXIT_CODE
+    monkeypatch.setenv("SLURM_JOB_ID", "1234")
+    assert _drain_exit_code(DrainConfig(requeue_exit="auto")) == REQUEUE_EXIT_CODE
+    assert _drain_exit_code(DrainConfig(requeue_exit="never")) == 0
+    monkeypatch.delenv("SLURM_JOB_ID")
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    assert _drain_exit_code(DrainConfig(requeue_exit="auto")) == REQUEUE_EXIT_CODE
+
+
+def test_http_healthz_readyz_and_drain_503(monkeypatch, cpu_devices):
+    """Satellite: /readyz false before the first compiled decode and while
+    draining; /healthz reports scheduler liveness; draining POSTs get 503 +
+    Retry-After."""
+    import urllib.error
+    import urllib.request
+
+    monkeypatch.setattr(jax, "devices", lambda *a: cpu_devices[:1])
+    from automodel_tpu.generation.engine import build_auto_from_cfg
+    from automodel_tpu.serving.server import serve_http
+
+    cfg = _tiny_serve_cfg()
+    auto = build_auto_from_cfg(cfg)
+    engine = ServingEngine(
+        auto,
+        ServeConfig.from_dict(dict(cfg.get("serving"))),
+        GenerationConfig.from_dict(dict(cfg.get("generation"))),
+    )
+    server, loop = serve_http(engine, None, port=0)
+    import threading
+
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = server.server_address[1]
+
+        def get(path):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30
+                ) as resp:
+                    return resp.status, json.loads(resp.read()), dict(resp.headers)
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read()), dict(e.headers)
+
+        code, body, _ = get("/readyz")
+        assert code == 503 and body["ready"] is False
+        assert body["first_decode_done"] is False
+        code, body, _ = get("/healthz")
+        assert code == 200 and body["ok"] is True  # idle engine is healthy
+        # one request compiles the decode → ready
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": "1 2 3", "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        assert out["completion_reason"] == "length"
+        code, body, _ = get("/readyz")
+        assert code == 200 and body["ready"] is True
+        # drain: readyz flips false, new POSTs are 503 + Retry-After
+        with loop.lock:
+            engine.begin_drain()
+        code, body, _ = get("/readyz")
+        assert code == 503 and body["draining"] is True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+        assert json.loads(ei.value.read())["reason"] == "draining"
+        # stats surface the new counters
+        code, stats, _ = get("/stats")
+        assert stats["draining"] is True and "shed_total" in stats
+    finally:
+        server.shutdown()
+        loop.close()
+
+
+def test_report_summarizes_completion_reasons_and_engine_events(tmp_path):
+    """Satellite: report --strict accepts the new serve keys and surfaces
+    shed/timeout/stall counts in the summary."""
+    from automodel_tpu.telemetry.report import lint_metrics_jsonl, summarize_metrics
+
+    path = tmp_path / "m.jsonl"
+    recs = [
+        {"event": "serve_request", "request_id": "a", "n_generated": 4,
+         "prompt_tokens": 3, "completion_reason": "length", "retriable": False,
+         "ttft_s": 0.01, "decode_tps": 50.0, "queue_s": 0.001,
+         "queue_depth": 0, "block_occupancy": 0.1, "ts": 1.0},
+        {"event": "serve_request", "request_id": "b", "n_generated": 0,
+         "prompt_tokens": 2, "completion_reason": "timeout", "retriable": False,
+         "queue_s": 0.3, "queue_depth": 1, "ts": 2.0},
+        {"event": "serve_request", "request_id": "c", "n_generated": 0,
+         "prompt_tokens": 2, "completion_reason": "shed", "retriable": True,
+         "queue_s": 0.0, "queue_depth": 9, "ts": 3.0},
+        {"event": "serve_request", "request_id": "d", "n_generated": 2,
+         "prompt_tokens": 2, "completion_reason": "engine_stall",
+         "retriable": True, "queue_s": 0.0, "queue_depth": 0, "ts": 4.0},
+        {"event": "serve_engine_event", "reason": "engine_stall", "step": 7,
+         "requests_failed": 1, "ts": 4.0},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    records, problems = lint_metrics_jsonl(str(path))
+    assert problems == []
+    summary = summarize_metrics(records)
+    assert summary["serve_requests"] == 4
+    assert summary["serve_completion_reasons"] == {
+        "engine_stall": 1, "length": 1, "shed": 1, "timeout": 1,
+    }
+    assert summary["serve_shed"] == 1
+    assert summary["serve_timeouts"] == 1
+    assert summary["serve_stalls"] == 1
+    assert summary["serve_engine_events"][0]["reason"] == "engine_stall"
