@@ -1,16 +1,33 @@
 """Fused MoE expert MLP: gate_up matmul + gated activation + down matmul in
-ONE Pallas kernel (forward only; the backward recomputes through the
-separate grouped matmuls).
+ONE Pallas kernel, with a purpose-tiled Pallas manual backward.
 
-Motivation (PROFILE_MOE_r04.md): the two-kernel expert path writes the
-[T·K, 2I] gate_up output and the [T·K, I] activation to HBM and reads them
-back (~600MB per layer at bench shape). Here both stay in VMEM: per work
-unit (m-tile × group) the kernel loops I-chunks on the grid, computing
+Forward motivation (PROFILE_MOE_r04.md): the two-kernel expert path writes
+the [T·K, 2I] gate_up output and the [T·K, I] activation to HBM and reads
+them back (~600MB per layer at bench shape). Here both stay in VMEM: per
+work unit (m-tile × group) the kernel loops I-chunks on the grid, computing
 ``acc += act(lhs @ Wgu[:, chunk]) @ Wd[chunk, :]`` with an fp32 accumulator
 — the down-projection contraction is summable over I-chunks, so the
 intermediate never materializes. Rows are lhs-masked (write-only outputs;
 boundary tiles accumulate across consecutive work units like
 ops/grouped_matmul._tgmm).
+
+Backward motivation (PROFILE_MOE_r05.md): the r5 backward composed generic
+``_tgmm``/transpose-GEMM calls and gave the forward win back (34.40 ms
+fused FWD+BWD vs 33.53 unfused; gmm2-class tiles ran 84.3 TFLOP/s vs
+gmm1's 107.0). The backward here is three purpose-tiled kernels that fold
+the dgate·dup activation-backward elementwise chain (and the sentinel-tail
+``dout`` mask) in-kernel, so ``dg``/``du``/``mid`` never materialize in HBM
+and ``lhs`` is read once for both weight grads:
+
+- ``_bwd_gu``   — dWg, dWu (+ dgb, dub row sums) in one pass over lhs.
+- ``_bwd_dwd``  — dWd (+ ddb) with the activation mid recomputed in-kernel.
+- ``_bwd_dx``   — dlhs = dg·Wg^T + du·Wu^T fused over I-chunks.
+
+Tile shapes consult the per-chip autotune registry (ops/autotune.py, swept
+by tools/kernel_bench.py); the NaN-tail masking semantics from PR 5 are
+preserved bit-for-bit — every row outside a work unit's window (boundary
+rows of the neighbouring group AND the a2a sentinel tail) is zeroed on the
+``dout`` side in-kernel, where 0·NaN can no longer survive.
 
 Same dropless semantics and work-unit plan as ops/grouped_matmul (reference
 capability: the fused SwiGLU+GEMM epilogues TE/DeepEP provide on GPU).
@@ -19,6 +36,7 @@ capability: the fused SwiGLU+GEMM epilogues TE/DeepEP provide on GPU).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -83,16 +101,7 @@ def _kernel(wg, wt, ws, we, lhs_ref, wg_ref, wu_ref, wd_ref, *rest,
             acc[...] += jnp.where(
                 lmask, db_ref[0, 0].astype(jnp.float32), 0.0
             )
-    if act_kind == "swiglu_oai":
-        g = jnp.minimum(g, 7.0)
-        u = jnp.clip(u, -7.0, 7.0)
-        mid = (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
-    else:
-        mid = jax.nn.silu(g)
-        if limit is not None:
-            mid = jnp.minimum(mid, limit)
-            u = jnp.clip(u, -limit, limit)
-        mid = mid * u
+    mid = _act_core(g, u, act_kind, limit)
     if has_bias:
         mid = jnp.where(lmask, mid, 0.0)
     acc[...] += jax.lax.dot_general(
@@ -106,6 +115,18 @@ def _kernel(wg, wt, ws, we, lhs_ref, wg_ref, wu_ref, wd_ref, *rest,
         out_ref[...] = acc[...].astype(out_ref.dtype)
 
 
+_IC_CANDS = (512, 384, 256, 128)
+
+
+def _divisor_chunk(n128: int, cap: int = 512) -> int:
+    """Largest 128-multiple ≤ cap dividing the 128-padded dim — a
+    non-divisor pads up to a chunk multiple and burns the padding as real
+    matmul work (I=768 with ic=512 pads to 1024: +33% expert FLOPs,
+    measured 29.4% vs 31.5% MFU on the qwen-style bench fingerprint).
+    128 divides any 128-multiple, so this always finds a divisor."""
+    return next(c for c in _IC_CANDS if c <= cap and c <= n128 and n128 % c == 0)
+
+
 def _fwd(lhs, gate, up, down, group_sizes, gb, ub, db, act_kind, limit,
          interpret):
     """lhs [M, D] sorted by group; gate/up [G, D, I] (pre-split halves);
@@ -116,14 +137,8 @@ def _fwd(lhs, gate, up, down, group_sizes, gb, ub, db, act_kind, limit,
     has_bias = gb is not None or ub is not None or db is not None
     tm = 512
     Dp = _round_up(D, 128)
-    # I-chunk: largest 128-multiple ≤512 that divides the 128-padded I —
-    # a non-divisor pads I up to a chunk multiple and burns the padding as
-    # real matmul work (I=768 with ic=512 pads to 1024: +33% expert FLOPs,
-    # measured 29.4% vs 31.5% MFU on the qwen-style bench fingerprint)
     I128 = _round_up(I, 128)
-    _IC_CANDS = (512, 384, 256, 128)
-    # 128 divides any I128, so this always finds a divisor
-    ic = next(c for c in _IC_CANDS if c <= I128 and I128 % c == 0)
+    ic = _divisor_chunk(I128)
 
     def _vmem(tm_, ic_):
         # double-buffered input blocks + output + fp32 accumulator; must stay
@@ -258,10 +273,10 @@ def fused_expert_mlp(lhs, gate, up, down, group_sizes,
                      gb=None, ub=None, db=None,
                      act_kind="swiglu", limit=None, platform=None,
                      interpret=None):
-    """Forward through the fused kernel; backward recomputes via the
-    composition (the standard fused-fwd/recompute-bwd trade: the fwd —
-    which remat re-runs — saves the HBM round trips; the bwd needs the
-    intermediates anyway)."""
+    """Forward through the fused kernel; backward through the purpose-tiled
+    manual kernels below (the bwd needs the g/u intermediates anyway — a
+    remat-style re-run of the cheap gate_up GEMMs feeds them without ever
+    materializing the activation chain)."""
     if interpret is None:
         interpret = _interpret_requested()
     if not (interpret or _pallas_eligible(platform)):
@@ -280,28 +295,414 @@ def _vjp_fwd(lhs, gate, up, down, group_sizes, gb, ub, db,
     return y, (lhs, gate, up, down, group_sizes, gb, ub, db)
 
 
-def _act_fn(g, u, act_kind, limit):
-    """The post-bias elementwise activation, in fp32 internally (matches the
-    kernel); jax.vjp of THIS gives exact clamp-aware derivatives."""
-    g32, u32 = g.astype(jnp.float32), u.astype(jnp.float32)
+def _act_core(g32, u32, act_kind, limit):
+    """The post-bias elementwise activation on fp32 values — ONE definition
+    shared by the forward kernel, the backward kernels (which jax.vjp it
+    tile-wise for exact clamp-aware derivatives), and `_act_fn`."""
     if act_kind == "swiglu_oai":
         gc = jnp.minimum(g32, 7.0)
         uc = jnp.clip(u32, -7.0, 7.0)
-        mid = (uc + 1.0) * (gc * jax.nn.sigmoid(1.702 * gc))
-    else:
-        mid = jax.nn.silu(g32)
-        if limit is not None:
-            mid = jnp.minimum(mid, limit)
-            u32 = jnp.clip(u32, -limit, limit)
-        mid = mid * u32
-    return mid.astype(g.dtype)
+        return (uc + 1.0) * (gc * jax.nn.sigmoid(1.702 * gc))
+    mid = jax.nn.silu(g32)
+    if limit is not None:
+        mid = jnp.minimum(mid, limit)
+        u32 = jnp.clip(u32, -limit, limit)
+    return mid * u32
+
+
+def _act_fn(g, u, act_kind, limit):
+    """The post-bias elementwise activation, in fp32 internally (matches the
+    kernel); jax.vjp of THIS gives exact clamp-aware derivatives."""
+    return _act_core(
+        g.astype(jnp.float32), u.astype(jnp.float32), act_kind, limit
+    ).astype(g.dtype)
+
+
+def _act_grads(g, u, dmid, act_kind, limit):
+    """(dg, du) fp32 of the elementwise chain — the exact jax.vjp of
+    `_act_core`, evaluated tile-wise inside the backward kernels (all VPU
+    work; the MXU contraction overlaps it)."""
+    g32, u32 = g.astype(jnp.float32), u.astype(jnp.float32)
+    _, vjp = jax.vjp(lambda a, b: _act_core(a, b, act_kind, limit), g32, u32)
+    return vjp(dmid.astype(jnp.float32))
+
+
+# -- purpose-tiled backward kernels -----------------------------------------
+#
+# All three share the grouped-matmul work-unit plan (scalar-prefetched
+# (group, m-tile, row-window) tuples) and fold the activation backward and
+# the row-window mask in-kernel. The row window doubles as the sentinel-tail
+# mask: rows past sum(group_sizes) belong to no window, so their NaN/Inf
+# garbage is zeroed on the dout side BEFORE any contraction — the PR 5
+# semantics, now without the external [M, N] selects.
+
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _autotune_tiles(key, names, budget_fn, fallback):
+    from automodel_tpu.ops import autotune
+
+    tiles = autotune.valid_tiles(autotune.lookup(key), names, budget_fn)
+    return tiles if tiles is not None else fallback
+
+
+# the per-kernel VMEM-budget models are module-level so the sweep driver
+# (tools/kernel_bench.py) filters candidates with the SAME predicate the
+# kernel validates entries against — they can never drift apart
+
+
+def _bwd_gu_budget_ok(tm, tk, tn, itemsize):
+    need = (
+        2 * itemsize * tm * tk          # lhs block
+        + 3 * 2 * itemsize * tm * tn    # g / u / dmid blocks
+        + 2 * 2 * 4 * tk * tn           # dWg / dWu fp32 slabs
+    )
+    return need <= _VMEM_BUDGET
+
+
+def _bwd_dwd_budget_ok(tm, tk, tn, itemsize):
+    need = (
+        2 * 2 * itemsize * tm * tk      # g / u blocks
+        + 2 * itemsize * tm * tn        # dy block
+        + 2 * 4 * tk * tn               # dWd fp32 slab
+    )
+    return need <= _VMEM_BUDGET
+
+
+def _bwd_dx_budget_ok(tm, tn, ic, itemsize):
+    need = (
+        3 * 2 * itemsize * tm * ic      # g / u / dmid chunks
+        + 2 * 2 * itemsize * tn * ic    # gate / up chunks
+        + 2 * itemsize * tm * tn        # out block
+        + 4 * tm * tn                   # acc scratch
+    )
+    return need <= _VMEM_BUDGET
+
+
+def _bwd_gu_tiles(D, I, dtype):
+    from automodel_tpu.ops import autotune
+
+    it = jnp.dtype(dtype).itemsize
+    ok = lambda tm, tk, tn: _bwd_gu_budget_ok(tm, tk, tn, it)
+    fb_tk = _divisor_chunk(_round_up(D, 128))
+    fb_tn = _divisor_chunk(_round_up(I, 128))
+    fb = (512, fb_tk, fb_tn)
+    while not ok(*fb) and fb[0] > 128:
+        fb = (fb[0] // 2, fb_tk, fb_tn)
+    return _autotune_tiles(
+        autotune.moe_bwd_gu_key(D, I, dtype), ("tm", "tk", "tn"), ok, fb
+    )
+
+
+def _bwd_dwd_tiles(I, D, dtype):
+    from automodel_tpu.ops import autotune
+
+    it = jnp.dtype(dtype).itemsize
+    ok = lambda tm, tk, tn: _bwd_dwd_budget_ok(tm, tk, tn, it)
+    fb = (512, _divisor_chunk(_round_up(I, 128)), _divisor_chunk(_round_up(D, 128)))
+    while not ok(*fb) and fb[0] > 128:
+        fb = (fb[0] // 2, fb[1], fb[2])
+    return _autotune_tiles(
+        autotune.moe_bwd_dwd_key(I, D, dtype), ("tm", "tk", "tn"), ok, fb
+    )
+
+
+def _bwd_dx_tiles(D, I, dtype):
+    from automodel_tpu.ops import autotune
+
+    it = jnp.dtype(dtype).itemsize
+    ok = lambda tm, tn, ic: _bwd_dx_budget_ok(tm, tn, ic, it)
+    fb = (512, _divisor_chunk(_round_up(D, 128)), _divisor_chunk(_round_up(I, 128)))
+    while not ok(*fb) and fb[0] > 128:
+        fb = (fb[0] // 2, fb[1], fb[2])
+    return _autotune_tiles(
+        autotune.moe_bwd_dx_key(D, I, dtype), ("tm", "tn", "ic"), ok, fb
+    )
+
+
+def _bwd_gu_kernel(wg, wt, ws, we, lhs_ref, g_ref, u_ref, dmid_ref,
+                   dwg_ref, dwu_ref, *rest, tm, act_kind, limit, has_bias):
+    if has_bias:
+        dgb_ref, dub_ref = rest
+    w = pl.program_id(2)
+    rows = wt[w] * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0)
+    mask = (rows >= ws[w]) & (rows < we[w])
+    dg, du = _act_grads(g_ref[...], u_ref[...], dmid_ref[...], act_kind, limit)
+    # dout mask folded in-kernel: rows outside this unit's window are the
+    # neighbouring group's rows (boundary tile) or the a2a sentinel tail —
+    # whose g/u/dmid can be NaN, which an lhs-only mask cannot neutralize
+    dg = jnp.where(mask, dg, 0.0)
+    du = jnp.where(mask, du, 0.0)
+    lhs = jnp.where(mask, lhs_ref[...], jnp.zeros_like(lhs_ref))
+    first = jnp.logical_or(w == 0, wg[jnp.maximum(w - 1, 0)] != wg[w])
+    acc_g = jax.lax.dot_general(
+        lhs, dg.astype(lhs_ref.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_u = jax.lax.dot_general(
+        lhs, du.astype(lhs_ref.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    cur = dwg_ref[0]
+    dwg_ref[0] = acc_g + jnp.where(first, jnp.zeros_like(cur), cur)
+    cur = dwu_ref[0]
+    dwu_ref[0] = acc_u + jnp.where(first, jnp.zeros_like(cur), cur)
+    if has_bias:
+        # bias grads are the dg/du row sums — the [1, tn] accumulator rides
+        # the same first-visitor rule. Its block index ignores the k grid
+        # dim, so every k pass recomputes and rewrites the IDENTICAL totals
+        # (same rows, same dg) — the final write-back is always correct.
+        cur = dgb_ref[0]
+        dgb_ref[0] = dg.sum(axis=0, keepdims=True) + jnp.where(
+            first, jnp.zeros_like(cur), cur
+        )
+        cur = dub_ref[0]
+        dub_ref[0] = du.sum(axis=0, keepdims=True) + jnp.where(
+            first, jnp.zeros_like(cur), cur
+        )
+
+
+def _bwd_gu(lhs, g, u, dmid, group_sizes, act_kind, limit, interpret,
+            has_bias):
+    """One pass over lhs → (dWg [G,D,I] f32, dWu, dgb [G,I] f32 | None,
+    dub | None). The dgate·dup chain runs in-kernel on the g/u/dmid tiles."""
+    from automodel_tpu.ops.grouped_matmul import _out_sds
+
+    M, D = lhs.shape
+    _, I = g.shape
+    G = group_sizes.shape[0]
+    tm, tk, tn = _bwd_gu_tiles(D, I, lhs.dtype)
+    Mp, Kp, Np = _round_up(M, tm), _round_up(D, tk), _round_up(I, tn)
+    if (Mp, Kp) != (M, D):
+        lhs = jnp.pad(lhs, ((0, Mp - M), (0, Kp - D)))
+    if (Mp, Np) != (M, I):
+        pad = ((0, Mp - M), (0, Np - I))
+        g, u, dmid = jnp.pad(g, pad), jnp.pad(u, pad), jnp.pad(dmid, pad)
+    wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)
+    W = Mp // tm + G
+    grid = (Kp // tk, Np // tn, W)
+    in_specs = [
+        pl.BlockSpec((tm, tk), lambda k, n, w, wg, wt, ws, we: (wt[w], k)),
+        pl.BlockSpec((tm, tn), lambda k, n, w, wg, wt, ws, we: (wt[w], n)),
+        pl.BlockSpec((tm, tn), lambda k, n, w, wg, wt, ws, we: (wt[w], n)),
+        pl.BlockSpec((tm, tn), lambda k, n, w, wg, wt, ws, we: (wt[w], n)),
+    ]
+    slab = pl.BlockSpec((1, tk, tn), lambda k, n, w, wg, wt, ws, we: (wg[w], k, n))
+    out_specs = [slab, slab]
+    out_shapes = [
+        _out_sds((G, Kp, Np), jnp.float32, lhs, g, u, dmid),
+        _out_sds((G, Kp, Np), jnp.float32, lhs, g, u, dmid),
+    ]
+    if has_bias:
+        brow = pl.BlockSpec((1, 1, tn), lambda k, n, w, wg, wt, ws, we: (wg[w], 0, n))
+        out_specs += [brow, brow]
+        out_shapes += [
+            _out_sds((G, 1, Np), jnp.float32, g, dmid),
+            _out_sds((G, 1, Np), jnp.float32, u, dmid),
+        ]
+    outs = pl.pallas_call(
+        functools.partial(
+            _bwd_gu_kernel, tm=tm, act_kind=act_kind, limit=limit,
+            has_bias=has_bias,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+        ),
+        out_shape=out_shapes,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(wg, wt, ws, we, lhs, g, u, dmid)
+    nz = (group_sizes > 0)
+    dwg = jnp.where(nz[:, None, None], outs[0][:, :D, :I], 0.0)
+    dwu = jnp.where(nz[:, None, None], outs[1][:, :D, :I], 0.0)
+    if not has_bias:
+        return dwg, dwu, None, None
+    dgb = jnp.where(nz[:, None], outs[2][:, 0, :I], 0.0)
+    dub = jnp.where(nz[:, None], outs[3][:, 0, :I], 0.0)
+    return dwg, dwu, dgb, dub
+
+
+def _bwd_dwd_kernel(wg, wt, ws, we, g_ref, u_ref, dy_ref, dwd_ref, *rest,
+                    tm, act_kind, limit, want_db):
+    if want_db:
+        (ddb_ref,) = rest
+    w = pl.program_id(2)
+    rows = wt[w] * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0)
+    mask = (rows >= ws[w]) & (rows < we[w])
+    mid = _act_core(
+        g_ref[...].astype(jnp.float32), u_ref[...].astype(jnp.float32),
+        act_kind, limit,
+    )
+    mid = jnp.where(mask, mid, 0.0)
+    # dy's sentinel tail is masked here, in-kernel — the external dy_m
+    # select the composed backward paid per [M, D] is gone
+    dy = jnp.where(mask, dy_ref[...], jnp.zeros_like(dy_ref))
+    first = jnp.logical_or(w == 0, wg[jnp.maximum(w - 1, 0)] != wg[w])
+    acc = jax.lax.dot_general(
+        mid.astype(dy_ref.dtype), dy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    cur = dwd_ref[0]
+    dwd_ref[0] = acc + jnp.where(first, jnp.zeros_like(cur), cur)
+    if want_db:
+        # same rewrite-per-k-pass rule as the gu kernel's bias rows
+        cur = ddb_ref[0]
+        ddb_ref[0] = dy.astype(jnp.float32).sum(axis=0, keepdims=True) + jnp.where(
+            first, jnp.zeros_like(cur), cur
+        )
+
+
+def _bwd_dwd(g, u, dy, group_sizes, act_kind, limit, interpret, want_db):
+    """Down-proj transpose GEMM with the activation mid recomputed in-kernel
+    → (dWd [G,I,D] f32, ddb [G,D] f32 | None)."""
+    from automodel_tpu.ops.grouped_matmul import _out_sds
+
+    M, I = g.shape
+    _, D = dy.shape
+    G = group_sizes.shape[0]
+    tm, tk, tn = _bwd_dwd_tiles(I, D, g.dtype)
+    Mp, Kp, Np = _round_up(M, tm), _round_up(I, tk), _round_up(D, tn)
+    if (Mp, Kp) != (M, I):
+        pad = ((0, Mp - M), (0, Kp - I))
+        g, u = jnp.pad(g, pad), jnp.pad(u, pad)
+    if (Mp, Np) != (M, D):
+        dy = jnp.pad(dy, ((0, Mp - M), (0, Np - D)))
+    wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)
+    W = Mp // tm + G
+    grid = (Kp // tk, Np // tn, W)
+    in_specs = [
+        pl.BlockSpec((tm, tk), lambda k, n, w, wg, wt, ws, we: (wt[w], k)),
+        pl.BlockSpec((tm, tk), lambda k, n, w, wg, wt, ws, we: (wt[w], k)),
+        pl.BlockSpec((tm, tn), lambda k, n, w, wg, wt, ws, we: (wt[w], n)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, tk, tn), lambda k, n, w, wg, wt, ws, we: (wg[w], k, n)),
+    ]
+    out_shapes = [_out_sds((G, Kp, Np), jnp.float32, g, u, dy)]
+    if want_db:
+        out_specs.append(
+            pl.BlockSpec((1, 1, tn), lambda k, n, w, wg, wt, ws, we: (wg[w], 0, n))
+        )
+        out_shapes.append(_out_sds((G, 1, Np), jnp.float32, dy))
+    outs = pl.pallas_call(
+        functools.partial(
+            _bwd_dwd_kernel, tm=tm, act_kind=act_kind, limit=limit,
+            want_db=want_db,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+        ),
+        out_shape=out_shapes,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(wg, wt, ws, we, g, u, dy)
+    nz = (group_sizes > 0)
+    dwd = jnp.where(nz[:, None, None], outs[0][:, :I, :D], 0.0)
+    ddb = jnp.where(nz[:, None], outs[1][:, 0, :D], 0.0) if want_db else None
+    return dwd, ddb
+
+
+def _bwd_dx_kernel(wg, wt, ws, we, g_ref, u_ref, dmid_ref, gate_ref, up_ref,
+                   out_ref, acc, *, tm, n_ic, act_kind, limit, W):
+    w = pl.program_id(1)
+    i = pl.program_id(2)
+    t = wt[w]
+    first = jnp.logical_or(w == 0, wt[jnp.maximum(w - 1, 0)] != t)
+    last = jnp.logical_or(w == W - 1, wt[jnp.minimum(w + 1, W - 1)] != t)
+
+    @pl.when(jnp.logical_and(i == 0, first))
+    def _():
+        acc[...] = jnp.zeros_like(acc)
+
+    rows = t * tm + jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0)
+    mask = (rows >= ws[w]) & (rows < we[w])
+    dg, du = _act_grads(g_ref[...], u_ref[...], dmid_ref[...], act_kind, limit)
+    # boundary tiles: the other group's rows must not meet THIS group's
+    # weights — mask before the contraction (accumulation across consecutive
+    # work units blends the two groups' halves, exactly like the forward)
+    dg = jnp.where(mask, dg, 0.0)
+    du = jnp.where(mask, du, 0.0)
+    cd = out_ref.dtype
+    acc[...] += jax.lax.dot_general(
+        dg.astype(cd), gate_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + jax.lax.dot_general(
+        du.astype(cd), up_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(jnp.logical_and(i == n_ic - 1, last))
+    def _():
+        out_ref[...] = acc[...].astype(cd)
+
+
+def _bwd_dx(g, u, dmid, gate, up, group_sizes, interpret, act_kind, limit):
+    """dlhs = dg·Wg^T + du·Wu^T in one kernel, I-chunked with an fp32
+    accumulator (the forward's summable-contraction trick, transposed).
+    Sentinel-tail rows come out zero or stay unwritten — the a2a consumer
+    never reads them (ragged_dot precondition)."""
+    from automodel_tpu.ops.grouped_matmul import _out_sds
+
+    M, I = g.shape
+    G, D, _ = gate.shape
+    tm, tn, ic = _bwd_dx_tiles(D, I, g.dtype)
+    Mp, Np, Ip = _round_up(M, tm), _round_up(D, tn), _round_up(I, ic)
+    if (Mp, Ip) != (M, I):
+        pad = ((0, Mp - M), (0, Ip - I))
+        g, u, dmid = jnp.pad(g, pad), jnp.pad(u, pad), jnp.pad(dmid, pad)
+    if (Np, Ip) != (D, I):
+        wpad = ((0, 0), (0, Np - D), (0, Ip - I))
+        gate, up = jnp.pad(gate, wpad), jnp.pad(up, wpad)
+    n_ic = Ip // ic
+    wg, wt, ws, we = _plan(group_sizes, Mp, tm, G)
+    W = Mp // tm + G
+    grid = (Np // tn, W, n_ic)
+    mrow = pl.BlockSpec((tm, ic), lambda n, w, i, wg, wt, ws, we: (wt[w], i))
+    wslab = pl.BlockSpec((1, tn, ic), lambda n, w, i, wg, wt, ws, we: (wg[w], n, i))
+    out = pl.pallas_call(
+        functools.partial(
+            _bwd_dx_kernel, tm=tm, n_ic=n_ic, act_kind=act_kind, limit=limit,
+            W=W,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[mrow, mrow, mrow, wslab, wslab],
+            out_specs=pl.BlockSpec(
+                (tm, tn), lambda n, w, i, wg, wt, ws, we: (wt[w], n)
+            ),
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        ),
+        out_shape=_out_sds((Mp, Np), g.dtype, g, u, dmid, gate, up),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(wg, wt, ws, we, g, u, dmid, gate, up)
+    return out[:M, :D]
+
+
+def _fused_bwd_enabled() -> bool:
+    """AUTOMODEL_FUSED_BWD=0 falls back to the r5 composed-tgmm backward —
+    the A/B knob tools/kernel_bench.py races and a safety valve for a chip
+    where the purpose-tiled kernels regress."""
+    return os.environ.get("AUTOMODEL_FUSED_BWD", "1") != "0"
 
 
 def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
     from automodel_tpu.ops.grouped_matmul import (
         _match_vma,
         _pallas_eligible,
-        _tgmm,
     )
 
     lhs, gate, up, down, group_sizes, gb, ub, db = res
@@ -323,12 +724,71 @@ def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
             mv(dgb, gb), mv(dub, ub), mv(ddb, db),
         )
 
-    # manual backward on the pallas kernels — vs jax.vjp(_reference) this
-    # skips the down-projection forward (its output is dead in the bwd),
-    # contracts the weight transposes in-kernel (transpose_rhs — no
-    # materialized W^T copies), and computes bias grads as small dense dots
-    # instead of the gather-transpose scatter-adds the profile billed at
-    # ~1.6ms each: 8 grouped passes total vs ~12 + 3 scatters.
+    if not _fused_bwd_enabled():
+        return _vjp_bwd_composed(
+            act_kind, limit, platform, interpret, res, dy, mv
+        )
+
+    # purpose-tiled manual backward: recompute the two cheap gate_up GEMMs
+    # (g, u) and the dmid transpose GEMM, then run the three fused kernels.
+    # vs the r5 composed backward this never materializes mid/dg/du (or
+    # their masked copies), reads lhs once for both weight grads, and folds
+    # the sentinel-tail dout mask + the bias-grad row sums in-kernel:
+    # 6 grouped passes total vs 8 + five [M, N]-sized selects/elementwise
+    # round trips.
+    kw = dict(platform=platform, interpret=interpret)
+    M = lhs.shape[0]
+    G = gate.shape[0]
+    g = ragged_dot(lhs, gate, group_sizes, **kw)
+    u = ragged_dot(lhs, up, group_sizes, **kw)
+    has_bias = gb is not None or ub is not None or db is not None
+    if has_bias:
+        bounds = jnp.cumsum(group_sizes.astype(jnp.int32))
+        valid = (jnp.arange(M, dtype=jnp.int32) < bounds[-1])[:, None]
+        row_g = jnp.searchsorted(
+            bounds, jnp.arange(M, dtype=jnp.int32), side="right"
+        )
+        # tail rows land on row_g == G: clamp the gather index explicitly
+        # and zero the gathered bias under the mask — never rely on XLA's
+        # out-of-bounds clamp semantics for rows whose content is garbage
+        # anyway
+        row_gc = jnp.minimum(row_g, G - 1)
+    if gb is not None:
+        g = g + jnp.where(valid, gb.astype(g.dtype)[row_gc], 0)
+    if ub is not None:
+        u = u + jnp.where(valid, ub.astype(u.dtype)[row_gc], 0)
+
+    dmid = ragged_dot(dy, down, group_sizes, transpose_rhs=True, **kw)
+    dWd, ddb = _bwd_dwd(
+        g, u, dy, group_sizes, act_kind, limit, interpret, db is not None
+    )
+    dWg, dWu, dgb, dub = _bwd_gu(
+        lhs, g, u, dmid, group_sizes, act_kind, limit, interpret,
+        gb is not None or ub is not None,
+    )
+    # dlhs tail rows stay zero/uninitialized — they ARE the sentinel tail,
+    # and the a2a consumer never reads them (ragged_dot precondition)
+    dlhs = _bwd_dx(g, u, dmid, gate, up, group_sizes, interpret, act_kind,
+                   limit)
+    return (
+        mv(dlhs.astype(lhs.dtype), lhs),
+        mv(dWg.astype(gate.dtype), gate),
+        mv(dWu.astype(up.dtype), up),
+        mv(dWd.astype(down.dtype), down),
+        None,
+        mv(dgb.astype(gb.dtype), gb) if gb is not None else None,
+        mv(dub.astype(ub.dtype), ub) if ub is not None else None,
+        mv(ddb.astype(db.dtype), db) if db is not None else None,
+    )
+
+
+def _vjp_bwd_composed(act_kind, limit, platform, interpret, res, dy, mv):
+    """The r5 manual backward: generic _tgmm/ragged_dot composition with
+    external tail masks. Kept verbatim behind AUTOMODEL_FUSED_BWD=0 as the
+    kernel-bench A/B baseline."""
+    from automodel_tpu.ops.grouped_matmul import _tgmm
+
+    lhs, gate, up, down, group_sizes, gb, ub, db = res
     kw = dict(platform=platform, interpret=interpret)
     M = lhs.shape[0]
     G = gate.shape[0]
@@ -348,10 +808,6 @@ def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
         row_g = jnp.searchsorted(
             bounds, jnp.arange(M, dtype=jnp.int32), side="right"
         )
-        # tail rows land on row_g == G: clamp the gather index explicitly
-        # and zero the gathered bias under the mask — never rely on XLA's
-        # out-of-bounds clamp semantics for rows whose content is garbage
-        # anyway
         row_gc = jnp.minimum(row_g, G - 1)
         onehot = jax.nn.one_hot(row_g, G, dtype=lhs.dtype)  # [M, G]
     if gb is not None:
@@ -368,8 +824,6 @@ def _vjp_bwd(act_kind, limit, platform, interpret, res, dy):
     dg_, du_ = act_vjp(dmid)
     dg_m = jnp.where(valid, dg_, 0)
     du_m = jnp.where(valid, du_, 0)
-    # dlhs tail rows stay uninitialized — they ARE the sentinel tail, and
-    # the a2a consumer never reads them (ragged_dot precondition)
     dlhs = (
         ragged_dot(dg_, gate, group_sizes, transpose_rhs=True, **kw)
         + ragged_dot(du_, up, group_sizes, transpose_rhs=True, **kw)

@@ -170,9 +170,10 @@ def _dkv_kernel(qp_ref, kp_ref, sq_ref, sk_ref, q_ref, k_ref, v_ref,
 
 
 def _pick_block(s: int, pref: int = 512) -> int:
-    """Large tiles amortize per-grid-step overhead (at 256² tiles a 32k ring
-    step is >100k grid steps and overhead dominates); bounded by VMEM."""
-    for b in (pref, 256, 128):
+    """Largest 128-multiple ≤ pref dividing s, preferring pref itself. Large
+    tiles amortize per-grid-step overhead (at 256² tiles a 32k ring step is
+    >100k grid steps and overhead dominates); bounded by VMEM via pref."""
+    for b in [pref] + [c for c in (1024, 512, 256, 128) if c < pref]:
         if s % b == 0:
             return b
     return s  # small/odd seq: single tile (interpret/test sizes)
@@ -216,11 +217,15 @@ def _specs(B, N, Nkv, H, bq, bkv, *, kv_major=False):
 
 
 def flash_block_fwd(q, k, v, q_pos, kv_pos, seg_q, seg_kv, *,
-                    causal, window, scale, interpret=False):
-    """q [B,Sq,N,H] × k/v [B,Sk,Nkv,H] → (out [B,Sq,N,H], lse [B,N,Sq])."""
+                    causal, window, scale, interpret=False,
+                    block_q=None, block_kv=None):
+    """q [B,Sq,N,H] × k/v [B,Sk,Nkv,H] → (out [B,Sq,N,H], lse [B,N,Sq]).
+    ``block_q``/``block_kv`` override the static preferences — the per-chip
+    autotune table (ops/autotune.py) threads through here."""
     B, Sq, N, H = q.shape
     Sk, Nkv = k.shape[1], k.shape[2]
-    bq, bkv = _pick_block(Sq), _pick_block(Sk, 1024)
+    bq = _pick_block(Sq, block_q or 512)
+    bkv = _pick_block(Sk, block_kv or 1024)
     qf, kf, vf, qp, kp, sq, sk = _prep(q, k, v, q_pos, kv_pos, seg_q, seg_kv)
     qpos, kpos, segq, segk, qspec, kspec, lspec = _specs(B, N, Nkv, H, bq, bkv)
 
@@ -251,13 +256,15 @@ def flash_block_fwd(q, k, v, q_pos, kv_pos, seg_q, seg_kv, *,
 
 
 def flash_block_bwd(q, k, v, do, lse, delta, q_pos, kv_pos, seg_q, seg_kv, *,
-                    causal, window, scale, interpret=False):
+                    causal, window, scale, interpret=False,
+                    block_q=None, block_kv=None):
     """Backward for one kv block: → (dq [B,Sq,N,H] f32, dk, dv [B,Sk,Nkv,H]
     f32). `lse`/`delta` are [B,N,Sq] (global logsumexp / rowsum(do·out))."""
     B, Sq, N, H = q.shape
     Sk, Nkv = k.shape[1], k.shape[2]
     rep = N // Nkv
-    bq, bkv = _pick_block(Sq), _pick_block(Sk, 1024)
+    bq = _pick_block(Sq, block_q or 512)
+    bkv = _pick_block(Sk, block_kv or 1024)
     qf, kf, vf, qp, kp, sq, sk = _prep(q, k, v, q_pos, kv_pos, seg_q, seg_kv)
     dof = do.transpose(0, 2, 1, 3).reshape(B * N, Sq, H)
     lsef = lse.reshape(B * N, Sq, 1)
@@ -309,6 +316,92 @@ def flash_block_bwd(q, k, v, do, lse, delta, q_pos, kv_pos, seg_q, seg_kv, *,
     dv = dv.reshape(B, Nkv, rep, Sk, H).sum(axis=2).transpose(0, 2, 1, 3)
     dq = dq.reshape(B, N, Sq, H).transpose(0, 2, 1, 3)
     return dq, dk, dv
+
+
+def flash_attention(
+    q, k, v, *,
+    causal=True, scale=None, segment_ids=None, sliding_window=None,
+    sinks=None, block_q=None, block_kv=None, interpret=False,
+):
+    """Non-ring single-chip entry over the SAME blockwise kernels the CP
+    ring uses — one kv "ring step" covering the whole sequence. This is the
+    in-tree alternative to the library splash kernel: positional masking
+    with per-tile dead-tile skipping (a 128-token sliding window kills
+    almost every kv tile), native GQA, packed-segment ids, gpt-oss sinks
+    (folded post-merge exactly as parallel/cp.py does), and no head_dim
+    divisibility constraint — head_dim 64 runs as-is. `ops/attention.flash`
+    races this against splash per shape via the autotune table.
+
+    q [B,S,N,H] × k/v [B,S,Nkv,H] → [B,S,N,H] in q.dtype; differentiable
+    (custom_vjp on the flash identities, d_sinks included)."""
+    B, S, N, H = q.shape
+    scale = scale if scale is not None else 1.0 / (H**0.5)
+    window = sliding_window
+    Sp = -(-S // 128) * 128
+    pad = Sp - S
+    if pad:
+        zeros = lambda a: jnp.pad(a, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        q, k, v = zeros(q), zeros(k), zeros(v)
+    if segment_ids is None:
+        # padded tokens get segment -1 ≠ any real id → never attended; a
+        # fully-padded q row comes out 0 via the all-masked guard and is
+        # sliced off below
+        seg0 = jnp.zeros((B, S), jnp.int32)
+    else:
+        seg0 = segment_ids.astype(jnp.int32)
+    if pad:
+        seg0 = jnp.pad(seg0, ((0, 0), (0, pad)), constant_values=-1)
+    pos = jnp.arange(Sp, dtype=jnp.int32)
+    kw = dict(causal=causal, window=window, scale=scale, interpret=interpret,
+              block_q=block_q, block_kv=block_kv)
+
+    def _fwd_impl(q, k, v, seg, sk):
+        out, lse = flash_block_fwd(q, k, v, pos, pos, seg, seg, **kw)
+        if sk is not None:
+            # the sink is one zero-value virtual key: fold it post-merge —
+            # lse' = logaddexp(lse, sink), out' = out·exp(lse − lse'). The
+            # saved lse' makes the blockwise backward exact (p = exp(s −
+            # lse') are the extended-softmax probabilities).
+            s_b = sk.astype(jnp.float32)[None, :, None]  # [1, n, 1]
+            lse_ext = jnp.logaddexp(lse, s_b)
+            out = out.astype(jnp.float32) * jnp.exp(lse - lse_ext).transpose(
+                0, 2, 1
+            )[..., None]
+            lse = lse_ext
+        return out.astype(q.dtype), lse
+
+    @jax.custom_vjp
+    def attn(q, k, v, seg, sk):
+        return _fwd_impl(q, k, v, seg, sk)[0]
+
+    def attn_fwd(q, k, v, seg, sk):
+        out, lse = _fwd_impl(q, k, v, seg, sk)
+        return out, (q, k, v, seg, sk, out, lse)
+
+    def attn_bwd(res, dout):
+        q, k, v, seg, sk, out, lse = res
+        do32 = dout.astype(jnp.float32)
+        delta = (do32 * out.astype(jnp.float32)).sum(-1).transpose(0, 2, 1)
+        dq, dk, dv = flash_block_bwd(
+            q, k, v, dout, lse, delta, pos, pos, seg, seg, **kw
+        )
+        import numpy as np
+
+        ct_seg = np.zeros(seg.shape, jax.dtypes.float0)
+        ct_sk = None
+        if sk is not None:
+            # sink column of the flash backward: dp_sink = dO·v_sink = 0, so
+            # ds_sink = p_sink·(0 − Δ); summed over its (b, s) broadcast
+            p_sink = jnp.exp(sk.astype(jnp.float32)[None, :, None] - lse)
+            ct_sk = (-(p_sink * delta).sum(axis=(0, 2))).astype(sk.dtype)
+        return (
+            dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            ct_seg, ct_sk,
+        )
+
+    attn.defvjp(attn_fwd, attn_bwd)
+    out = attn(q, k, v, seg0, sinks)
+    return out[:, :S] if pad else out
 
 
 def merge_partials(out_a, lse_a, out_t, lse_t):

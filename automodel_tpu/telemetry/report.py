@@ -77,6 +77,14 @@ _NUMERIC_KEYS = (
     "ridge_intensity",
     "comm_fraction",
     "factor",
+    # kernel microbench records (tools/kernel_bench.py `kernel_bench`
+    # events): per-candidate timing + the per-program measured MFU that
+    # surfaces kernel regressions in the same JSONL pipeline as training
+    "kernel_ms",
+    "kernel_flops",
+    "kernel_tflops",
+    "kernel_mfu_measured_pct",
+    "kernel_bench_winners",
 )
 
 
@@ -225,6 +233,29 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
             }
             for r in captures
         ]
+    # kernel sweep records (tools/kernel_bench.py): best TFLOP/s + measured
+    # MFU per kernel, so a tile regression reads off the same report as a
+    # training regression
+    kb = [r for r in records if r.get("event") == "kernel_bench"]
+    if kb:
+        out["kernel_bench_records"] = len(kb)
+        best: dict[str, float] = {}
+        for r in kb:
+            name = r.get("kernel")
+            tf = r.get("kernel_tflops")
+            if isinstance(name, str) and isinstance(tf, (int, float)):
+                best[name] = max(best.get(name, float("-inf")), tf)
+        if best:
+            out["kernel_tflops_best"] = dict(sorted(best.items()))
+        mfus = [
+            r["kernel_mfu_measured_pct"] for r in kb
+            if isinstance(r.get("kernel_mfu_measured_pct"), (int, float))
+        ]
+        if mfus:
+            out["kernel_mfu_measured_pct_max"] = max(mfus)
+        fails = [r for r in kb if r.get("ok") is False]
+        if fails:
+            out["kernel_bench_failures"] = len(fails)
     gens = [r for r in records if r.get("event") == "generation"]
     if gens:
         out["generation_records"] = len(gens)

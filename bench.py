@@ -464,6 +464,8 @@ def _worker_main(spec: dict, result_path: str) -> int:
             int(spec["steps"]), ctx,
             lora=bool(spec.get("lora")), qlora=bool(spec.get("qlora")),
         )
+        from automodel_tpu.ops import autotune
+
         out = {
             "ok": True,
             "leg": spec.get("leg", "?"),
@@ -472,6 +474,9 @@ def _worker_main(spec: dict, result_path: str) -> int:
             "peak_tflops": device_peak_tflops(),
             "n_devices": len(jax.devices()),
             "platform": jax.devices()[0].platform,
+            # which kernel autotune table the leg's kernels resolved —
+            # provenance for comparing rounds (tuned vs default tiles)
+            "autotune": autotune.table_info(),
         }
     except Exception as exc:
         oom = _is_oom(exc)
@@ -601,6 +606,7 @@ def main() -> None:
     seq = int(os.environ.get("BENCH_SEQ", 4096))
     steps = 8
     peak = float("nan")  # reported by the first successful worker
+    kernel_autotune = None  # autotune provenance from the first ok worker
 
     # ---- dense LoRA (headline) — largest shape that fits, each attempt a
     # pristine subprocess; below the smallest shape the batch ladder
@@ -629,6 +635,7 @@ def main() -> None:
             if res.get("ok"):
                 dense_done = True
                 peak = float(res.get("peak_tflops", float("nan")))
+                kernel_autotune = kernel_autotune or res.get("autotune")
                 dense_mfu = calculate_mfu(res["tps_chip"], res["fpt"], peak)
                 dense_tflops = res["tps_chip"] * res["fpt"] / 1e12
                 dense_label = label if batch == batches[0] else f"{label}_b{batch}"
@@ -668,6 +675,7 @@ def main() -> None:
     )
     if res.get("ok"):
         peak = float(res.get("peak_tflops", peak))
+        kernel_autotune = kernel_autotune or res.get("autotune")
         qlora_mfu = calculate_mfu(res["tps_chip"], res["fpt"], peak)
         qlora_tflops = res["tps_chip"] * res["fpt"] / 1e12
         if qlora_mfu != qlora_mfu:  # ran fine; device peak unknown
@@ -710,6 +718,7 @@ def main() -> None:
         )
         if res.get("ok"):
             peak = float(res.get("peak_tflops", peak))
+            kernel_autotune = kernel_autotune or res.get("autotune")
             mfu = calculate_mfu(res["tps_chip"], res["fpt"], peak)
             if mfu != mfu:  # ran fine; device peak unknown — no MFU basis
                 moe_failures[experts] = (
@@ -781,6 +790,10 @@ def main() -> None:
             "moe_experts_backend": moe_backend,
             "moe_mfu_pct_by_backend": moe_tried,
             "moe_failures": moe_failures or None,
+            # kernel-autotune provenance (ops/autotune.py): which per-chip
+            # table the workers' kernels resolved their tiles from, so a
+            # BENCH artifact says whether it ran tuned or default shapes
+            "kernel_autotune": kernel_autotune,
         }
     print(json.dumps(result))
 

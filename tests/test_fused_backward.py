@@ -1,0 +1,197 @@
+"""Purpose-tiled fused expert-MLP backward (ops/fused_expert_mlp) parity.
+
+Interpret mode executes the REAL Pallas kernel code on CPU — same scheme as
+the splash/gmm tests. The manual backward (PR 10: `_bwd_gu`/`_bwd_dwd`/
+`_bwd_dx`, activation-backward chain + sentinel-tail dout mask folded
+in-kernel) must match jax.vjp through the `_reference` two-gmm composition
+for every grad — dlhs, dWg, dWu, dWd, and the bias grads — including the
+PR 5 planted-garbage-tail case and ragged group sizes with empty experts.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from automodel_tpu.ops.fused_expert_mlp import _reference, fused_expert_mlp
+
+GRAD_NAMES = ("dlhs", "dWg", "dWu", "dWd", "dgb", "dub", "ddb")
+
+
+def _case(rng, M, D, I, G, sizes, biased, dtype=jnp.float32):
+    gs = jnp.asarray(sizes, jnp.int32)
+    assert int(gs.sum()) <= M
+    mk = lambda *s: jnp.asarray(rng.normal(size=s), dtype)
+    lhs = mk(M, D)
+    gate, up = mk(G, D, I) * 0.3, mk(G, D, I) * 0.3
+    down = mk(G, I, D) * 0.3
+    gb = mk(G, I) if biased else None
+    ub = mk(G, I) if biased else None
+    db = mk(G, D) if biased else None
+    dy = mk(M, D)
+    return lhs, gate, up, down, gs, gb, ub, db, dy
+
+
+def _grads(fn, args, biased, dy):
+    y, vjp = jax.vjp(fn, *args)
+    return y, vjp(dy)
+
+
+def _both(lhs, gate, up, down, gs, gb, ub, db, dy, act, limit):
+    biased = gb is not None
+    args = (lhs, gate, up, down) + ((gb, ub, db) if biased else ())
+
+    def f_new(*a):
+        b = a[4:] if biased else (None, None, None)
+        return fused_expert_mlp(a[0], a[1], a[2], a[3], gs, *b,
+                                act, limit, None, True)
+
+    def f_ref(*a):
+        b = a[4:] if biased else (None, None, None)
+        return _reference(a[0], a[1], a[2], a[3], gs, *b, act, limit, None)
+
+    y1, g1 = _grads(f_new, args, biased, dy)
+    y2, g2 = _grads(f_ref, args, biased, dy)
+    return y1, g1, y2, g2
+
+
+@pytest.mark.parametrize(
+    "act,limit,biased,sizes",
+    [
+        ("swiglu", None, False, [40, 0, 30, 58]),   # empty expert mid-list
+        ("swiglu", 2.0, True, [1, 63, 0, 64]),      # clamp grads + boundary
+        ("swiglu_oai", None, True, [0, 50, 50, 28]),  # empty FIRST expert
+        ("swiglu_oai", None, False, [32, 32, 32, 32]),
+    ],
+)
+def test_manual_backward_parity(act, limit, biased, sizes):
+    rng = np.random.default_rng(0)
+    lhs, gate, up, down, gs, gb, ub, db, dy = _case(
+        rng, 128, 96, 80, 4, sizes, biased
+    )
+    y1, g1, y2, g2 = _both(lhs, gate, up, down, gs, gb, ub, db, dy, act, limit)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=2e-4)
+    names = GRAD_NAMES[: len(g1)]
+    for n, a, b in zip(names, g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4,
+            err_msg=f"{n} ({act}, limit={limit}, biased={biased})",
+        )
+
+
+def test_manual_backward_parity_garbage_tail():
+    """Rows past sum(group_sizes) carry NaN in BOTH the inputs and the
+    cotangents (the a2a sentinel-tail contract). Every weight/bias grad must
+    stay finite AND match the reference computed on clean real rows; dlhs is
+    only compared on real rows (tail rows are dont-care by contract)."""
+    rng = np.random.default_rng(7)
+    M, D, I, G, n_real = 96, 64, 48, 3, 70
+    sizes = [30, 0, 40]
+    lhs, gate, up, down, gs, gb, ub, db, dy = _case(
+        rng, M, D, I, G, sizes, biased=True
+    )
+    lhs_n = np.array(lhs)  # copies — np.asarray of a jax array is read-only
+    dy_n = np.array(dy)
+    lhs_n[n_real:] = np.nan
+    dy_n[n_real:] = np.nan
+    lhs_dirty, dy_dirty = jnp.asarray(lhs_n), jnp.asarray(dy_n)
+
+    # reference grads on a CLEAN tail (zeros) — what the masked kernels must
+    # reproduce despite the garbage
+    lhs_clean = jnp.asarray(np.where(np.isfinite(lhs_n), lhs_n, 0.0))
+    dy_clean = jnp.asarray(np.where(np.isfinite(dy_n), dy_n, 0.0))
+
+    def f_new(l, g_, u_, d_, gb_, ub_, db_):
+        return fused_expert_mlp(l, g_, u_, d_, gs, gb_, ub_, db_,
+                                "swiglu_oai", None, None, True)
+
+    def f_ref(l, g_, u_, d_, gb_, ub_, db_):
+        return _reference(l, g_, u_, d_, gs, gb_, ub_, db_,
+                          "swiglu_oai", None, None)
+
+    _, vjp1 = jax.vjp(f_new, lhs_dirty, gate, up, down, gb, ub, db)
+    g1 = vjp1(dy_dirty)
+    _, vjp2 = jax.vjp(f_ref, lhs_clean, gate, up, down, gb, ub, db)
+    g2 = vjp2(dy_clean)
+    for n, a, b in zip(GRAD_NAMES, g1, g2):
+        a, b = np.asarray(a), np.asarray(b)
+        if n == "dlhs":
+            a, b = a[:n_real], b[:n_real]
+        assert np.isfinite(a).all(), f"{n} poisoned by NaN tail"
+        np.testing.assert_allclose(a, b, atol=5e-4, err_msg=n)
+        assert np.abs(a).max() > 0.0, f"{n} all-zero"
+
+
+def test_empty_expert_grads_zero():
+    rng = np.random.default_rng(3)
+    lhs, gate, up, down, gs, gb, ub, db, dy = _case(
+        rng, 64, 32, 32, 4, [30, 0, 34, 0], biased=True
+    )
+
+    def f(g_, u_, d_, gb_, ub_, db_):
+        return fused_expert_mlp(lhs, g_, u_, d_, gs, gb_, ub_, db_,
+                                "swiglu", None, None, True)
+
+    _, vjp = jax.vjp(f, gate, up, down, gb, ub, db)
+    grads = vjp(dy)
+    for n, g in zip(GRAD_NAMES[1:], grads):
+        g = np.asarray(g)
+        assert np.abs(g[1]).max() == 0.0, f"{n}[empty expert 1] nonzero"
+        assert np.abs(g[3]).max() == 0.0, f"{n}[empty expert 3] nonzero"
+        assert np.abs(g[0]).max() > 0.0, f"{n}[expert 0] all-zero"
+
+
+def test_fused_vs_composed_backward_paths_agree(monkeypatch):
+    """AUTOMODEL_FUSED_BWD=0 (the r5 composed-tgmm backward, kept as the
+    kernel-bench A/B baseline) and the default purpose-tiled path must
+    produce the same grads."""
+    rng = np.random.default_rng(5)
+    lhs, gate, up, down, gs, gb, ub, db, dy = _case(
+        rng, 96, 64, 48, 3, [30, 26, 40], biased=True
+    )
+
+    def run():
+        def f(l, g_, u_, d_, gb_, ub_, db_):
+            return fused_expert_mlp(l, g_, u_, d_, gs, gb_, ub_, db_,
+                                    "swiglu", 1.5, None, True)
+
+        _, vjp = jax.vjp(f, lhs, gate, up, down, gb, ub, db)
+        return vjp(dy)
+
+    monkeypatch.setenv("AUTOMODEL_FUSED_BWD", "0")
+    composed = run()
+    monkeypatch.delenv("AUTOMODEL_FUSED_BWD")
+    fused = run()
+    for n, a, b in zip(GRAD_NAMES, fused, composed):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, err_msg=n
+        )
+
+
+def test_manual_backward_bfloat16_smoke():
+    """bf16 end-to-end through the new kernels (the bench dtype): finite and
+    roughly matching the fp32 reference."""
+    rng = np.random.default_rng(9)
+    lhs, gate, up, down, gs, gb, ub, db, dy = _case(
+        rng, 64, 32, 32, 2, [40, 24], biased=False, dtype=jnp.bfloat16
+    )
+
+    def f(l, g_, u_, d_):
+        return fused_expert_mlp(l, g_, u_, d_, gs, None, None, None,
+                                "swiglu", None, None, True)
+
+    _, vjp = jax.vjp(f, lhs, gate, up, down)
+    grads = vjp(dy)
+    ref32 = jax.vjp(
+        lambda l, g_, u_, d_: _reference(
+            l, g_, u_, d_, gs, None, None, None, "swiglu", None, None
+        ),
+        *(a.astype(jnp.float32) for a in (lhs, gate, up, down)),
+    )[1](dy.astype(jnp.float32))
+    for n, a, b in zip(GRAD_NAMES, grads, ref32):
+        a = np.asarray(a.astype(jnp.float32))
+        assert np.isfinite(a).all(), n
+        np.testing.assert_allclose(a, np.asarray(b), atol=0.15, rtol=0.1,
+                                   err_msg=n)

@@ -29,6 +29,17 @@ echo "[chip_suite] MoE profile" >&2
 python tools/profile_moe.py 2>&1 | tee PROFILE_MOE_chip.txt \
     || echo "[chip_suite] profile_moe failed (bench evidence still valid)" >&2
 
+# kernel tile/block sweep (ops/autotune.py): regenerates the per-chip
+# autotune table the grouped-matmul/fused-backward/attention kernels load,
+# merges winners into the committed defaults, and commits the sweep report
+echo "[chip_suite] kernel sweep (tools/kernel_bench.py)" >&2
+if python tools/kernel_bench.py --output-dir chip_kernel_bench --write-defaults; then
+    cp chip_kernel_bench/KERNEL_BENCH.md KERNEL_BENCH_chip.md
+    echo "[chip_suite] committed KERNEL_BENCH_chip.md + refreshed autotune defaults" >&2
+else
+    echo "[chip_suite] kernel_bench failed (bench evidence still valid)" >&2
+fi
+
 # generated PROFILE artifacts (telemetry/profiling/runner.py): trace window
 # around real steps of the dense bench config → committed PROFILE_chip.md +
 # report JSON, replacing the hand-typed PROFILE_* workflow
