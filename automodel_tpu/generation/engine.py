@@ -312,10 +312,36 @@ def resolve_tokenizer(tok_cfg: Any, fallback_path: Optional[str] = None) -> Any:
         return None
 
 
+def build_auto_from_model_section(
+    mcfg: Any, mesh_ctx: Any, seed: int = 0
+) -> Any:
+    """AutoModel from a ``model:``-shaped section (``pretrained_model_name_
+    or_path`` or ``hf_config`` + ``backend``) on an EXISTING mesh — the
+    tail of the `generate`/`serve` CLI ladder, also how the serving
+    engine builds its speculative-decoding draft model
+    (``serving.speculative.draft:``, same schema) onto the target's mesh."""
+    from automodel_tpu import auto_model
+
+    get = mcfg.get if hasattr(mcfg, "get") else dict(mcfg).get
+    backend = dict(get("backend", {}) or {})
+    if get("pretrained_model_name_or_path"):
+        return auto_model.from_pretrained(
+            get("pretrained_model_name_or_path"), mesh_ctx, backend
+        )
+    hf = get("hf_config")
+    if hf is None:
+        raise ValueError(
+            "model section needs pretrained_model_name_or_path or hf_config"
+        )
+    return auto_model.from_config(
+        hf.to_dict() if hasattr(hf, "to_dict") else dict(hf),
+        mesh_ctx, backend, seed=seed,
+    )
+
+
 def build_auto_from_cfg(cfg: Any) -> Any:
     """Model + mesh from the same YAML sections the recipes use — shared by
     the `generate` and `serve` CLIs (serving/server.py)."""
-    from automodel_tpu import auto_model
     from automodel_tpu.config.loader import ConfigNode
     from automodel_tpu.parallel.mesh import MeshConfig, build_mesh
 
@@ -327,17 +353,8 @@ def build_auto_from_cfg(cfg: Any) -> Any:
     platform = dist.get("platform", None)
     devices = jax.devices(platform) if platform else None
     mesh_ctx = build_mesh(MeshConfig(**degrees), devices=devices)
-
-    mcfg = cfg.model
-    backend = dict(mcfg.get("backend", {}) or {})
-    if mcfg.get("pretrained_model_name_or_path"):
-        return auto_model.from_pretrained(
-            mcfg.pretrained_model_name_or_path, mesh_ctx, backend
-        )
-    hf = mcfg.get("hf_config")
-    return auto_model.from_config(
-        hf.to_dict() if isinstance(hf, ConfigNode) else hf,
-        mesh_ctx, backend, seed=cfg.get("seed", 0),
+    return build_auto_from_model_section(
+        cfg.model, mesh_ctx, seed=cfg.get("seed", 0)
     )
 
 

@@ -146,20 +146,31 @@ class CacheContext:
     tags and positions are shared by every layer).
 
     ``mode``: 'prefill' (attend normally over the incoming block, write it),
-    'decode' (write one token per slot, attend the query over the cache), or
+    'decode' (write one token per slot, attend the query over the cache),
     'chunk' (serving/: write a prompt CHUNK at each slot's own offset and
     attend the chunk's queries over the whole cache under per-query tag
     masks — the chunked-prefill path that lets a long prompt interleave
-    with a running decode wave instead of stalling it).
+    with a running decode wave instead of stalling it), or 'paged'
+    (serving/: the cache IS the block pool ``[NB, BS, Nkv, H]`` per layer —
+    no gathered view exists; writes scatter token rows through the per-slot
+    block tables and attention runs the fused Pallas paged kernel
+    (ops/paged_attention.py) that indexes the pool in place, dequantizing
+    int8 blocks on the fly).
     """
 
-    mode: str  # "prefill" | "decode" | "chunk"
+    mode: str  # "prefill" | "decode" | "chunk" | "paged"
     capacity: int
     q_pos: jnp.ndarray  # [B] decode query position / [B] prompt lengths
     pos: jnp.ndarray  # [B, C] tags AFTER this call's write
     slots: Optional[jnp.ndarray] = None  # [B] decode write slot
     prompt_len: int = 0  # static padded prompt/chunk length (prefill/chunk)
     start: Optional[jnp.ndarray] = None  # [B] chunk write offset (absolute)
+    # paged mode only: per-slot block tables + precomputed write targets
+    # (inactive slots already routed to scratch block 0 by paged_ctx)
+    tables: Optional[jnp.ndarray] = None  # [B, NBseq] int32
+    write_block: Optional[jnp.ndarray] = None  # [B, S] int32
+    write_off: Optional[jnp.ndarray] = None  # [B, S] int32
+    paged_interpret: bool = False  # run the Pallas kernel interpreted (CPU)
 
     @property
     def decode(self) -> bool:
@@ -168,9 +179,10 @@ class CacheContext:
     @property
     def attends_cache(self) -> bool:
         """True when the attention path must attend over the CACHE under the
-        position-tag mask (decode and chunked prefill) instead of over the
-        incoming block (ordinary whole-prompt prefill)."""
-        return self.mode in ("decode", "chunk")
+        position-tag mask (decode, chunked prefill, paged decode/verify)
+        instead of over the incoming block (ordinary whole-prompt
+        prefill)."""
+        return self.mode in ("decode", "chunk", "paged")
 
     # -- writes --------------------------------------------------------------
     def write(
@@ -178,7 +190,15 @@ class CacheContext:
     ) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Write this layer's new keys/values. ck/cv: [B, C, N_kv, H];
         k/v: [B, S, N_kv, H] (S = prompt length in prefill, chunk length in
-        chunk mode, 1 in decode)."""
+        chunk mode, 1 in decode). Paged mode: ck/cv are the layer's POOL
+        slice — ``[NB, BS, N_kv, H]``, or ``(int8 values, fp32 scales)``
+        when the pool is quantized — and the write scatters the S token
+        rows through the block table (quantize-on-write for int8)."""
+        if self.mode == "paged":
+            return (
+                _paged_scatter(ck, k, self.write_block, self.write_off),
+                _paged_scatter(cv, v, self.write_block, self.write_off),
+            )
         if self.mode == "chunk":
             # per-slot chunk write at the slot's own absolute offset (full
             # layout only: position == slot). dynamic_update_slice takes
@@ -213,6 +233,41 @@ class CacheContext:
         )
 
     # -- attend --------------------------------------------------------------
+    def attend(
+        self,
+        q: jnp.ndarray,
+        layer_kv: tuple,
+        *,
+        sliding_window: Optional[int] = None,
+        scale: Optional[float] = None,
+        logits_soft_cap: Optional[float] = None,
+    ) -> jnp.ndarray:
+        """Cache-attending attention for this mode — the single dispatch
+        point the model attention blocks call when ``attends_cache``.
+        ``layer_kv`` is the layer's just-written cache pair from ``write``.
+        Decode/chunk: ``sdpa_decode`` over the (gathered) cache under the
+        position-tag mask. Paged: the fused Pallas kernel indexes the block
+        pool in place through the tables (ops/paged_attention.py)."""
+        if self.mode == "paged":
+            from automodel_tpu.ops import paged_attention as _pa
+
+            ck, cv = layer_kv
+            kq, ks = ck if isinstance(ck, tuple) else (ck, None)
+            vq, vs = cv if isinstance(cv, tuple) else (cv, None)
+            return _pa.paged_attend(
+                q, kq, vq, self.tables, self.q_pos, ks, vs,
+                scale=scale, sliding_window=sliding_window,
+                logits_soft_cap=logits_soft_cap,
+                interpret=self.paged_interpret,
+            )
+        from automodel_tpu.ops.attention import sdpa_decode
+
+        return sdpa_decode(
+            q, layer_kv[0], layer_kv[1],
+            kv_mask=self.attend_mask(sliding_window),
+            scale=scale, logits_soft_cap=logits_soft_cap,
+        )
+
     def attend_mask(self, sliding_window: Optional[int] = None) -> jnp.ndarray:
         """Valid-slot mask for cache-attending modes. Decode: ``[B, C]`` —
         which cache slots the single query may attend. Chunk: ``[B, S, C]``
@@ -288,6 +343,98 @@ def chunk_ctx(
         prompt_len=int(chunk_len), start=start.astype(jnp.int32),
     )
     return new_cache, ctx
+
+
+def layer_slice(side, i: int):
+    """Layer ``i`` of a cache side — a plain ``[L, ...]`` array or the
+    paged-int8 ``(values, scales)`` pair (models' per-layer loop path)."""
+    return jax.tree.map(lambda x: x[i], side)
+
+
+def layer_range(side, start: int, stop: Optional[int] = None):
+    """Layers ``[start:stop]`` of a cache side, pytree-aware (the mixed
+    dense/MoE stacks scan disjoint layer ranges)."""
+    return jax.tree.map(lambda x: x[start:stop], side)
+
+
+def stack_layer_sides(sides: list):
+    """Inverse of ``layer_slice`` over a per-layer list (pytree-aware
+    ``jnp.stack``)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *sides)
+
+
+def concat_layer_sides(parts: list):
+    """Concatenate per-range cache sides back into one ``[L, ...]`` side
+    (pytree-aware ``jnp.concatenate`` — the inverse of ``layer_range``)."""
+    if len(parts) == 1:
+        return parts[0]
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs), *parts)
+
+
+def _paged_scatter(side, new, blk: jnp.ndarray, off: jnp.ndarray):
+    """Scatter ``new`` [B, S, Nkv, H] token rows into one layer's pool slice
+    at (blk, off) [B, S] — the paged write. ``side`` is the raw pool array
+    [NB, BS, Nkv, H] or, when the pool is int8, ``(values, scales)`` with
+    quantize-on-write (ops/paged_attention.quantize_kv_rows)."""
+    if isinstance(side, tuple):
+        from automodel_tpu.ops.paged_attention import quantize_kv_rows
+
+        vals, scales = side
+        q, s = quantize_kv_rows(new)
+        return (
+            vals.at[blk, off].set(q),
+            scales.at[blk, off].set(s.astype(scales.dtype)),
+        )
+    return side.at[blk, off].set(new.astype(side.dtype))
+
+
+def paged_write_targets(
+    tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    q_len: int,
+    active: jnp.ndarray,
+    block_size: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(block, offset) ``[B, S]`` for S token rows written at absolute
+    positions ``lengths..lengths+S-1`` through the block tables; inactive
+    slots route to scratch block 0. The ONE spelling of paged write-target
+    math — both the fused path (``paged_ctx``) and the gather path's
+    scatter-back (serving/paged.py) resolve targets here, so the two
+    backends can never write token rows to different cells."""
+    pos = lengths[:, None].astype(jnp.int32) + jnp.arange(q_len, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(pos // block_size, 0, tables.shape[1] - 1)
+    blk = jnp.where(
+        active[:, None], jnp.take_along_axis(tables, idx, axis=1), 0
+    ).astype(jnp.int32)
+    off = jnp.where(active[:, None], pos % block_size, 0).astype(jnp.int32)
+    return blk, off
+
+
+def paged_ctx(
+    cache: KVCache,
+    tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    q_len: int,
+    active: jnp.ndarray,
+    block_size: int,
+    interpret: bool = False,
+) -> tuple[KVCache, CacheContext]:
+    """Plan a paged decode/verify call (serving/ fused path): ``q_len``
+    tokens per slot (1 for decode, spec_k+1 for the speculative verify
+    forward) written at absolute positions ``[lengths, lengths + q_len)``
+    straight into the BLOCK POOL through the per-slot ``tables`` —
+    ``cache.k``/``cache.v`` here are the pool arrays ``[L, NB, BS, Nkv,
+    H]`` (or ``(values, scales)`` pairs when int8), not a gathered view.
+    Inactive slots write to scratch block 0. Validity needs no position
+    tags: the kernel masks ``pos <= lengths + qi`` directly."""
+    blk, off = paged_write_targets(tables, lengths, q_len, active, block_size)
+    ctx = CacheContext(
+        mode="paged", capacity=cache.capacity if not isinstance(cache.k, tuple) else 0,
+        q_pos=lengths.astype(jnp.int32), pos=cache.pos,
+        prompt_len=int(q_len), tables=tables.astype(jnp.int32),
+        write_block=blk, write_off=off, paged_interpret=bool(interpret),
+    )
+    return cache.replace(lengths=lengths.astype(jnp.int32) + q_len), ctx
 
 
 def decode_ctx(cache: KVCache) -> tuple[KVCache, CacheContext]:

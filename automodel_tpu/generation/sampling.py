@@ -63,6 +63,20 @@ def _apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.where(logits < kth, _NEG_INF, logits)
 
 
+def _filter_logits(logits: jnp.ndarray, config: SamplingConfig) -> jnp.ndarray:
+    """The shared transform pipeline (temperature → top-k → top-p, HF
+    order) over ``[..., V]`` fp32 logits. ``sample`` draws from these;
+    ``speculative_verify`` needs the SAME filtered distributions for both
+    target and draft so the rejection rule reproduces exactly what a
+    non-speculative sampler would draw."""
+    logits = logits / jnp.float32(config.temperature)
+    if config.top_k is not None:
+        logits = _apply_top_k(logits, config.top_k)
+    if config.top_p is not None and config.top_p < 1.0:
+        logits = _apply_top_p(logits, config.top_p)
+    return logits
+
+
 def sample(
     logits: jnp.ndarray, key: jax.Array, config: SamplingConfig
 ) -> jnp.ndarray:
@@ -70,9 +84,68 @@ def sample(
     logits = logits.astype(jnp.float32)
     if config.greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / jnp.float32(config.temperature)
-    if config.top_k is not None:
-        logits = _apply_top_k(logits, config.top_k)
-    if config.top_p is not None and config.top_p < 1.0:
-        logits = _apply_top_p(logits, config.top_p)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, _filter_logits(logits, config), axis=-1
+    ).astype(jnp.int32)
+
+
+def speculative_verify(
+    target_logits: jnp.ndarray,
+    draft_logits: Optional[jnp.ndarray],
+    draft_tokens: jnp.ndarray,
+    key: jax.Array,
+    config: SamplingConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The draft-and-verify acceptance rule (Leviathan et al., "Fast
+    Inference from Transformers via Speculative Decoding", 2023).
+
+    ``target_logits`` [B, S, V] are the ONE batched verify forward's
+    outputs over the S = k+1 fed tokens ``[cur, d_1..d_k]`` (row i is the
+    target's distribution for the token FOLLOWING fed token i);
+    ``draft_tokens`` [B, k] are the draft's proposals, ``draft_logits``
+    [B, k, V] the distributions it drew them from (ignored under greedy).
+
+    → ``(tokens [B, S] int32, n_commit [B] int32 in 1..S)``: commit
+    ``tokens[:, :n]`` — the accepted draft prefix plus one
+    correction/bonus token. Greedy is the exact-match degenerate case:
+    accept while ``d_i == argmax(target_i)``, corrections are the target
+    argmax — committed tokens are bit-identical to the non-speculative
+    greedy stream, which is the exactness guarantee the parity tests pin.
+    Sampled mode implements the standard rejection rule (accept d_i w.p.
+    ``min(1, p_i(d_i)/q_i(d_i))``, resample rejections from
+    ``norm(max(p-q, 0))``, bonus from ``p_k``), which preserves the target
+    distribution exactly in expectation."""
+    B, S, V = target_logits.shape
+    k = S - 1
+    target_logits = target_logits.astype(jnp.float32)
+    if config.greedy:
+        t = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)  # [B, S]
+        match = draft_tokens == t[:, :k]
+        n_acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+        # accepted drafts ARE the target argmaxes, so the committed stream
+        # is just the target row — prefix length n_acc + 1
+        return t, (n_acc + 1).astype(jnp.int32)
+    p = jax.nn.softmax(_filter_logits(target_logits, config), axis=-1)
+    q = jax.nn.softmax(
+        _filter_logits(draft_logits.astype(jnp.float32), config), axis=-1
+    )
+    p_d = jnp.take_along_axis(p[:, :k], draft_tokens[..., None], axis=-1)[..., 0]
+    q_d = jnp.take_along_axis(q, draft_tokens[..., None], axis=-1)[..., 0]
+    key_u, key_c = jax.random.split(key)
+    u = jax.random.uniform(key_u, (B, k))
+    accept = u < p_d / jnp.maximum(q_d, 1e-30)
+    n_acc = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+    # correction distribution per draft position (residual), bonus at S-1
+    resid = jnp.maximum(p[:, :k] - q, 0.0)
+    rsum = resid.sum(axis=-1, keepdims=True)
+    resid = jnp.where(rsum > 0, resid / jnp.maximum(rsum, 1e-30), p[:, :k])
+    corr_probs = jnp.concatenate([resid, p[:, k:]], axis=1)  # [B, S, V]
+    c = jax.random.categorical(
+        key_c, jnp.log(jnp.maximum(corr_probs, 1e-30)), axis=-1
+    ).astype(jnp.int32)
+    drafts_pad = jnp.concatenate(
+        [draft_tokens.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1
+    )
+    idx = jnp.arange(S, dtype=jnp.int32)[None, :]
+    tokens = jnp.where(idx < n_acc[:, None], drafts_pad, c)
+    return tokens, (n_acc + 1).astype(jnp.int32)

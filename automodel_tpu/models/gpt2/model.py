@@ -26,6 +26,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from automodel_tpu.generation import kv_cache
 from automodel_tpu.models.common.config import BackendConfig
 from automodel_tpu.models.llama.model import ACT_FNS, _proj as _llama_proj
 from automodel_tpu.ops.attention import attention
@@ -158,12 +159,9 @@ def decoder_layer(
     if cache is not None:
         new_layer_kv = cache_ctx.write(cache[0], cache[1], k, v)
     if cache is not None and cache_ctx.attends_cache:
-        from automodel_tpu.ops.attention import sdpa_decode
-
-        attn_out = sdpa_decode(
-            q, new_layer_kv[0], new_layer_kv[1],
-            kv_mask=cache_ctx.attend_mask(None),
-        )
+        # ctx-dispatched cache attend: sdpa_decode over the (gathered)
+        # cache, or the fused paged kernel over the block pool (serving/)
+        attn_out = cache_ctx.attend(q, new_layer_kv)
     else:
         attn_out = attention(
             q, k, v,
@@ -235,13 +233,20 @@ def forward_hidden(
         new_k, new_v = [], []
         for i in range(cfg.num_layers):
             lp = jax.tree.map(lambda x: x[i], params["layers"])
-            xs = lp if cache is None else (lp, (kvc.k[i], kvc.v[i]))
+            xs = (
+                lp
+                if cache is None
+                else (lp, (kv_cache.layer_slice(kvc.k, i), kv_cache.layer_slice(kvc.v, i)))
+            )
             h, lkv = layer_fn(h, xs)
             if cache is not None:
                 new_k.append(lkv[0])
                 new_v.append(lkv[1])
         if cache is not None:
-            new_cache = kvc.replace(k=jnp.stack(new_k), v=jnp.stack(new_v))
+            new_cache = kvc.replace(
+                k=kv_cache.stack_layer_sides(new_k),
+                v=kv_cache.stack_layer_sides(new_v),
+            )
     h = layer_norm(
         h, params["final_norm"]["scale"], params["final_norm"]["bias"],
         cfg.layer_norm_eps,

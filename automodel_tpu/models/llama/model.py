@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from automodel_tpu.generation import kv_cache
 from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
 from automodel_tpu.ops.attention import attention
 from automodel_tpu.ops.norms import rms_norm
@@ -191,13 +192,12 @@ def attention_block(
         new_layer_kv = cache_ctx.write(ck, cv, k, v)
         if cache_ctx.attends_cache:
             # decode (single query) and chunked prefill (serving/): attend
-            # over the cache under the position-tag mask — 2D per-slot for
-            # decode, 3D per-query for a chunk
-            from automodel_tpu.ops.attention import sdpa_decode
-
-            attn_out = sdpa_decode(
-                q, new_layer_kv[0], new_layer_kv[1],
-                kv_mask=cache_ctx.attend_mask(sliding_window),
+            # over the cache — sdpa_decode under the position-tag mask, or
+            # the fused paged kernel indexing the block pool in place; the
+            # ctx owns the dispatch (generation.kv_cache.CacheContext.attend)
+            attn_out = cache_ctx.attend(
+                q, new_layer_kv,
+                sliding_window=sliding_window,
                 scale=cfg.attn_scale,
                 logits_soft_cap=cfg.attn_soft_cap,
             )
@@ -328,13 +328,20 @@ def forward_hidden(
         new_k, new_v = [], []
         for i in range(L):
             lp = jax.tree.map(lambda x: x[i], params["layers"])
-            xs = lp if cache is None else (lp, (kvc.k[i], kvc.v[i]))
+            xs = (
+                lp
+                if cache is None
+                else (lp, (kv_cache.layer_slice(kvc.k, i), kv_cache.layer_slice(kvc.v, i)))
+            )
             h, lkv = make_layer_fn(_layer_sliding_window(cfg, i))(h, xs)
             if cache is not None:
                 new_k.append(lkv[0])
                 new_v.append(lkv[1])
         if cache is not None:
-            new_cache = kvc.replace(k=jnp.stack(new_k), v=jnp.stack(new_v))
+            new_cache = kvc.replace(
+                k=kv_cache.stack_layer_sides(new_k),
+                v=kv_cache.stack_layer_sides(new_v),
+            )
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
     return h if cache is None else (h, new_cache)
 

@@ -23,6 +23,7 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from automodel_tpu.generation import kv_cache as kv_cache_mod
 from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
 from automodel_tpu.models.llama.model import (
     ACT_FNS,
@@ -255,7 +256,13 @@ def forward_hidden(
         dxs = (
             params["dense_layers"]
             if cache is None
-            else (params["dense_layers"], (kvc.k[:nd], kvc.v[:nd]))
+            else (
+                params["dense_layers"],
+                (
+                    kv_cache_mod.layer_range(kvc.k, 0, nd),
+                    kv_cache_mod.layer_range(kvc.v, 0, nd),
+                ),
+            )
         )
         h, dys = jax.lax.scan(
             dense_fn if cache is not None else maybe_remat(dense_fn), h, dxs
@@ -314,7 +321,13 @@ def forward_hidden(
         mxs = (
             params["moe_layers"]
             if cache is None
-            else (params["moe_layers"], (kvc.k[nd:], kvc.v[nd:]))
+            else (
+                params["moe_layers"],
+                (
+                    kv_cache_mod.layer_range(kvc.k, nd),
+                    kv_cache_mod.layer_range(kvc.v, nd),
+                ),
+            )
         )
         h, ys = jax.lax.scan(
             moe_fn if cache is not None else maybe_remat(moe_fn), h, mxs
@@ -330,7 +343,17 @@ def forward_hidden(
         counts_l, aux_l, mk_l, mv_l = [], [], [], []
         for i in range(nm):
             lp = jax.tree.map(lambda x: x[i], params["moe_layers"])
-            xs = lp if cache is None else (lp, (kvc.k[nd + i], kvc.v[nd + i]))
+            xs = (
+                lp
+                if cache is None
+                else (
+                    lp,
+                    (
+                        kv_cache_mod.layer_slice(kvc.k, nd + i),
+                        kv_cache_mod.layer_slice(kvc.v, nd + i),
+                    ),
+                )
+            )
             h, ys = moe_fn(h, xs)
             aux = ys if cache is None else ys[0]
             if cache is not None:
@@ -341,16 +364,16 @@ def forward_hidden(
         counts = jnp.stack(counts_l)
         aux_losses = jnp.stack(aux_l)
         if cache is not None:
-            new_k_parts.append(jnp.stack(mk_l))
-            new_v_parts.append(jnp.stack(mv_l))
+            new_k_parts.append(kv_cache_mod.stack_layer_sides(mk_l))
+            new_v_parts.append(kv_cache_mod.stack_layer_sides(mv_l))
 
     h = rms_norm(h, params["final_norm"]["scale"], cfg.rms_eps)
     out = (h, MoEModelAux(counts, aux_losses.sum()))
     if cache is None:
         return out
     new_cache = kvc.replace(
-        k=jnp.concatenate(new_k_parts) if len(new_k_parts) > 1 else new_k_parts[0],
-        v=jnp.concatenate(new_v_parts) if len(new_v_parts) > 1 else new_v_parts[0],
+        k=kv_cache_mod.concat_layer_sides(new_k_parts),
+        v=kv_cache_mod.concat_layer_sides(new_v_parts),
     )
     return out, new_cache
 

@@ -83,6 +83,15 @@ def attn_key(head_dim: int, window: Optional[int], causal: bool) -> str:
     return f"attn:h{head_dim}:w{window or 0}:{'c' if causal else 'nc'}"
 
 
+def paged_key(head_dim: int, block_size: int, kv_dtype: str) -> str:
+    """Paged-attention decode backend per (head_dim, KV block size, pool
+    dtype): ``backend`` ∈ {"fused" (ops/paged_attention.py Pallas kernel),
+    "gather" (XLA gather → sdpa_decode → scatter baseline)} — raced by
+    tools/kernel_bench.py, consulted by serving/engine.py when
+    ``serving.decode_kernel: auto``."""
+    return f"paged:h{head_dim}:bs{block_size}:{kv_dtype}"
+
+
 def _dt(dtype: Any) -> str:
     import jax.numpy as jnp
 

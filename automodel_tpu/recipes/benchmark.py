@@ -256,16 +256,19 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             "serve_ttft_p99_s": None,
             "serve_block_occupancy_peak": None,
             "serve_requests": None,
+            "serve_accept_rate": None,
+            "serve_draft_tps": None,
         }
         section = self.cfg.get("serving")
         if section is None:
-            return {**nulls, "serve_failure": "no serving: section in config"}
-        if self.peft_config is not None:
             return {
                 **nulls,
-                "serve_failure": "serving with peft adapters is not "
-                "supported (merge first)",
+                "serve_failure": "no serving: section in config",
+                "serve_spec_failure": "no serving: section in config",
             }
+        if self.peft_config is not None:
+            reason = "serving with peft adapters is not supported (merge first)"
+            return {**nulls, "serve_failure": reason, "serve_spec_failure": reason}
         try:
             from automodel_tpu.serving.engine import ServeConfig, ServingEngine
 
@@ -312,11 +315,48 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
                 )
                 engine.run()
                 _, stats = engine.run_workload(arrivals)
+                decode_backend = engine.decode_backend
+                # spec-on/spec-off A/B sub-leg: the same Poisson workload
+                # through a second engine with the draft disabled, so the
+                # speedup claim is measured on identical arrivals — the
+                # speculative analogue of the fused-vs-composed backward A/B
+                ab = None
+                if scfg.speculative.enabled:
+                    import dataclasses as _dc
+
+                    # release the spec engine's pool HBM before the A/B
+                    # engine allocates its own — num_blocks is sized to the
+                    # chip, so two resident pools would OOM exactly the
+                    # configs this sub-leg exists to measure
+                    engine.release_pools()
+                    off_cfg = _dc.replace(
+                        scfg,
+                        speculative=_dc.replace(
+                            scfg.speculative, enabled=False, draft=None
+                        ),
+                    )
+                    off_engine = ServingEngine(auto, off_cfg, gen_cfg)
+                    off_engine.submit(
+                        rng.integers(1, vocab, size=int(lens[0])).tolist(),
+                        max_new_tokens=2,
+                    )
+                    off_engine.run()
+                    _, off_stats = off_engine.run_workload(arrivals)
+                    on_tps = stats["sustained_tokens_per_s"]
+                    off_tps = off_stats["sustained_tokens_per_s"]
+                    ab = {
+                        "spec_on_tokens_per_s": round(on_tps, 2),
+                        "spec_off_tokens_per_s": round(off_tps, 2),
+                        "speedup": (
+                            round(on_tps / off_tps, 3) if off_tps > 0 else None
+                        ),
+                    }
             finally:
                 auto.params = params0
         except Exception as e:
-            return {**nulls, "serve_failure": f"{type(e).__name__}: {e}"}
-        return {
+            reason = f"{type(e).__name__}: {e}"
+            return {**nulls, "serve_failure": reason, "serve_spec_failure": reason}
+        out = {
             "serve_tokens_per_s": round(stats["sustained_tokens_per_s"], 2),
             "serve_ttft_p50_s": round(stats["ttft_p50_s"], 6),
             "serve_ttft_p99_s": round(stats["ttft_p99_s"], 6),
@@ -324,8 +364,26 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             "serve_requests": stats["requests"],
             "serve_prefix_cache": stats["prefix_cache"],
             "serve_queue_depth_peak": stats["queue_depth_peak"],
+            "serve_decode_backend": decode_backend,
+            "serve_kv_cache_dtype": scfg.kv_cache_dtype,
             "serve_failure": None,
         }
+        if scfg.speculative.enabled:
+            out["serve_accept_rate"] = stats.get("accept_rate")
+            out["serve_draft_tps"] = (
+                round(stats["draft_tps"], 2)
+                if isinstance(stats.get("draft_tps"), float) else None
+            )
+            out["serve_spec_ab"] = ab
+            out["serve_spec_failure"] = (
+                None if stats.get("accept_rate") is not None
+                else "no speculative round ran inside the workload"
+            )
+        else:
+            out["serve_accept_rate"] = None
+            out["serve_draft_tps"] = None
+            out["serve_spec_failure"] = "speculative decoding disabled"
+        return out
 
 
 def main(cfg: ConfigNode) -> dict:

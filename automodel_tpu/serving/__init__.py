@@ -3,10 +3,12 @@ the generation engine (docs/serving.md).
 
 - :mod:`block_pool` — ref-counted fixed-size KV block allocator with
   chain-hashed prefix caching.
-- :mod:`paged` — jitted chunked-prefill and paged-decode programs
-  (block-table gather feeding the existing cached-attention path).
+- :mod:`paged` — jitted chunked-prefill, paged-decode (fused Pallas
+  kernel or XLA-gather fallback, bf16/int8 pools), and the speculative
+  draft-propose/verify programs.
 - :mod:`engine` — the continuous-batching scheduler (admission queue,
-  chunked prefill interleaved with the decode wave, mid-flight slot refill).
+  chunked prefill interleaved with the decode wave, mid-flight slot
+  refill, speculative decoding with per-slot accept/rollback).
 - :mod:`server` — the `automodel_tpu serve` CLI (stdin-JSONL + local HTTP).
 """
 
@@ -19,6 +21,7 @@ from automodel_tpu.serving.engine import (
     QueueFull,
     ServeConfig,
     ServingEngine,
+    SpeculativeConfig,
     StallConfig,
 )
 
@@ -32,5 +35,6 @@ __all__ = [
     "QueueFull",
     "ServeConfig",
     "ServingEngine",
+    "SpeculativeConfig",
     "StallConfig",
 ]

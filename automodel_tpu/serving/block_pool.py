@@ -41,6 +41,17 @@ class BlockPoolError(RuntimeError):
     """Allocator misuse: double free, freeing scratch, corrupt accounting."""
 
 
+def blocks_needed(total_tokens: int, block_size: int, write_overhang: int = 0) -> int:
+    """Whole-budget block count for a request: ``ceil((tokens + overhang) /
+    block_size)``. ``write_overhang`` covers positions a program may WRITE
+    past the committed budget — speculative decoding's verify forward puts
+    up to ``spec_k`` rejected-draft rows beyond the final length (they are
+    rolled back by a length decrement, never attended, but the table must
+    point their writes at real blocks, not out of range). One spelling
+    shared by submit-time validation and admission so the two can't drift."""
+    return -(-(int(total_tokens) + int(write_overhang)) // int(block_size))
+
+
 class BlockPool:
     def __init__(
         self, num_blocks: int, block_size: int, prefix_cache: bool = True

@@ -21,7 +21,10 @@ The scheduler loop (one ``step()`` = one engine iteration):
    queued requests from stalling behind a single long prompt: the decode
    wave below still runs every iteration.
 4. **decode tick** — one jitted paged decode step over all slots; active
-   slots each advance one token. Slots whose token hits a stop id or whose
+   slots each advance one token — or, with ``serving.speculative:``, one
+   draft-propose + ONE batched verify forward advancing each slot by 1 to
+   k+1 tokens (rollback of rejected drafts is a host-side length
+   decrement; no copies). Slots whose token hits a stop id or whose
    budget is spent COMPLETE: their blocks decref back to the pool (prompt
    blocks stay matchable in the prefix cache) and the slot refills from the
    queue on the next iteration — mid-flight, without waiting for the rest
@@ -70,7 +73,7 @@ from automodel_tpu.generation.engine import (
 )
 from automodel_tpu.generation.sampling import sample
 from automodel_tpu.serving import paged
-from automodel_tpu.serving.block_pool import BlockPool
+from automodel_tpu.serving.block_pool import BlockPool, blocks_needed
 from automodel_tpu.training.rng import sampling_key
 
 logger = logging.getLogger(__name__)
@@ -183,6 +186,39 @@ class StallConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    """The ``serving.speculative:`` section — draft-and-verify speculative
+    decoding (Leviathan et al. 2023). A small draft model proposes ``k``
+    tokens per slot per engine iteration; ONE batched verify forward
+    through the paged path accepts a prefix + one correction/bonus token.
+    Greedy output is bit-identical to non-speculative decoding (the
+    exactness rule); sampled output preserves the target distribution.
+
+    ``draft`` is a ``model:``-shaped section (``hf_config`` + ``backend``
+    or ``pretrained_model_name_or_path``) built onto the target's mesh via
+    the ``build_auto_from_model_section`` ladder. The draft must be
+    cache-capable and share the target's vocabulary."""
+
+    enabled: bool = False
+    k: int = 4  # draft tokens proposed per slot per engine step
+    draft: Optional[Any] = None  # model: section for the draft
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"serving.speculative.k={self.k} (want >= 1)")
+        if self.enabled and not self.draft:
+            raise ValueError(
+                "serving.speculative.enabled needs a draft model section "
+                "(serving.speculative.draft: {hf_config: ...} or "
+                "{pretrained_model_name_or_path: ...})"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "SpeculativeConfig":
+        return _cfg_dict(cls, d, "serving.speculative")
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """The `serving:` YAML section (scheduler/allocator knobs; sampling and
     stop tokens come from the `generation:` section)."""
@@ -194,6 +230,10 @@ class ServeConfig:
     max_seq_len: int = 1024  # per-request prompt + generated cap
     max_queue: int = 4096
     prefix_cache: bool = True
+    # per-token math (docs/serving.md "Raw speed"): pool precision + which
+    # decode backend runs the per-token attention
+    kv_cache_dtype: str = "bf16"  # bf16 (model compute dtype) | int8
+    decode_kernel: str = "auto"  # auto | fused (Pallas paged kernel) | gather
     # sustained-throughput bench knobs (recipes/benchmark.py serving leg)
     bench_requests: int = 16
     bench_rate: float = 8.0  # Poisson arrival rate, requests/second
@@ -204,6 +244,9 @@ class ServeConfig:
     limits: LimitsConfig = dataclasses.field(default_factory=LimitsConfig)
     drain: DrainConfig = dataclasses.field(default_factory=DrainConfig)
     watchdog: StallConfig = dataclasses.field(default_factory=StallConfig)
+    speculative: SpeculativeConfig = dataclasses.field(
+        default_factory=SpeculativeConfig
+    )
 
     def __post_init__(self):
         if self.slots < 1 or self.block_size < 1 or self.prefill_chunk < 1:
@@ -213,6 +256,16 @@ class ServeConfig:
             )
         if self.max_seq_len < 2:
             raise ValueError(f"serving.max_seq_len={self.max_seq_len}")
+        if self.kv_cache_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"serving.kv_cache_dtype={self.kv_cache_dtype!r} "
+                "(want bf16|int8)"
+            )
+        if self.decode_kernel not in ("auto", "fused", "gather"):
+            raise ValueError(
+                f"serving.decode_kernel={self.decode_kernel!r} "
+                "(want auto|fused|gather)"
+            )
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "ServeConfig":
@@ -227,6 +280,7 @@ class ServeConfig:
             ("limits", LimitsConfig),
             ("drain", DrainConfig),
             ("watchdog", StallConfig),
+            ("speculative", SpeculativeConfig),
         ):
             v = d.get(key)
             if v is not None and not isinstance(v, sub):
@@ -234,11 +288,21 @@ class ServeConfig:
         return cls(**d)
 
     @property
+    def spec_overhang(self) -> int:
+        """Positions a speculative verify may WRITE past a sequence's final
+        committed length (rejected-draft rows, rolled back by length
+        decrement): the admission block budget and the table width both
+        cover it so those writes always land in owned blocks."""
+        return self.speculative.k if self.speculative.enabled else 0
+
+    @property
     def table_blocks(self) -> int:
-        """Static per-sequence block-table width. The extra prefill_chunk of
-        headroom keeps the chunk program's dynamic_update_slice from ever
-        clamping (paged.py view-position invariant)."""
-        return -(-(self.max_seq_len + self.prefill_chunk) // self.block_size)
+        """Static per-sequence block-table width. The extra headroom
+        (prefill_chunk, or the speculative verify chunk when larger) keeps
+        per-slot writes from ever clamping past the table (paged.py
+        view-position invariant)."""
+        headroom = max(self.prefill_chunk, self.spec_overhang + 1)
+        return -(-(self.max_seq_len + headroom) // self.block_size)
 
 
 @dataclasses.dataclass
@@ -265,6 +329,8 @@ class _Slot:
     decoding: bool = False
     generated: Optional[list[int]] = None
     t_first: Optional[float] = None
+    spec_proposed: int = 0  # draft tokens proposed for this request
+    spec_accepted: int = 0  # draft tokens accepted by the verify rule
 
 
 class ServingEngine:
@@ -311,21 +377,93 @@ class ServingEngine:
             self.config.num_blocks, self.config.block_size,
             prefix_cache=self.config.prefix_cache,
         )
+        # per-token math levers (docs/serving.md "Raw speed"): pool
+        # precision, decode backend, speculative draft
+        self._quantized = self.config.kv_cache_dtype == "int8"
+        self._compute_dtype = self.model.backend.compute_jnp_dtype
+        from automodel_tpu.ops.attention import _interpret_requested
+
+        self._interpret = _interpret_requested()
+        self.decode_backend = self._resolve_decode_backend()
+        spec = self.config.speculative
+        self._spec_enabled = bool(spec.enabled)
+        self.draft_auto = None
+        if self._spec_enabled:
+            from automodel_tpu.generation.engine import (
+                build_auto_from_model_section,
+            )
+
+            self.draft_auto = build_auto_from_model_section(
+                spec.draft, auto.mesh_ctx, seed=self.gen_config.seed
+            )
+            if not getattr(self.draft_auto.model, "supports_kv_cache", False):
+                raise GenerationUnsupported(
+                    "serving.speculative.draft model "
+                    f"{type(self.draft_auto.model).__name__} has no KV-cache "
+                    "decode path"
+                )
+            dv = int(self.draft_auto.model.config.vocab_size)
+            tv = int(mcfg.vocab_size)
+            if dv != tv:
+                raise ValueError(
+                    f"speculative draft vocab_size {dv} != target vocab_size "
+                    f"{tv} — draft and target must share a vocabulary"
+                )
+            dmax = _model_max_positions(self.draft_auto.model.config)
+            if dmax and self.config.max_seq_len + spec.k > dmax:
+                # same loud refusal the target gets at line one of __init__:
+                # a too-short draft context would silently extrapolate RoPE
+                # past dmax and collapse the accept rate without ever erroring
+                raise ValueError(
+                    f"serving.max_seq_len={self.config.max_seq_len} + "
+                    f"speculative.k={spec.k} exceeds the draft model's "
+                    f"context limit {dmax}"
+                )
         self._init_pool_arrays()
         constrain = auto.constrain
 
         def apply(params, ids, **kw):
             return self.model(params, ids, constrain=constrain, **kw)
 
+        pk = dict(
+            backend=self.decode_backend,
+            block_size=self.config.block_size,
+            compute_dtype=self._compute_dtype,
+            interpret=self._interpret,
+        )
         self._chunk = paged.build_chunk_prefill_fn(
-            apply, self.config.prefill_chunk
+            apply, self.config.prefill_chunk, self._compute_dtype
         )
         self._decode = paged.build_paged_decode_fn(
             apply, self.gen_config.sampling,
-            pad_id=self.gen_config.pad_token_id,
+            pad_id=self.gen_config.pad_token_id, **pk,
         )
+        if self._spec_enabled:
+            d_model = self.draft_auto.model
+            d_constrain = self.draft_auto.constrain
+
+            def draft_apply(params, ids, **kw):
+                return d_model(params, ids, constrain=d_constrain, **kw)
+
+            d_pk = dict(pk, compute_dtype=d_model.backend.compute_jnp_dtype)
+            self._draft_chunk = paged.build_chunk_prefill_fn(
+                draft_apply, self.config.prefill_chunk,
+                d_model.backend.compute_jnp_dtype,
+            )
+            self._propose = paged.build_draft_propose_fn(
+                draft_apply, self.gen_config.sampling, spec.k,
+                pad_id=self.gen_config.pad_token_id, **d_pk,
+            )
+            self._verify = paged.build_verify_fn(
+                apply, self.gen_config.sampling, spec.k,
+                pad_id=self.gen_config.pad_token_id, **pk,
+            )
         self._base_key = sampling_key(self.gen_config.seed)
         self._eos = set(self.gen_config.eos_ids)
+        # speculative accounting (accept-rate gauge + bench keys)
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_rounds = 0
 
         B, NB = self.config.slots, self.config.table_blocks
         self._tables = np.zeros((B, NB), np.int32)
@@ -367,19 +505,73 @@ class ServingEngine:
         self.collect_program_costs = False
         self.program_costs: dict = {}
 
+    def _resolve_decode_backend(self) -> str:
+        """fused (Pallas paged kernel) vs gather (XLA baseline):
+        ``AUTOMODEL_PAGED_DECODE`` env beats ``serving.decode_kernel``
+        beats the autotune table entry (``autotune.paged_key``, raced by
+        tools/kernel_bench.py) beats the platform default (fused wherever
+        the kernel can run — TPU or interpret mode — else gather)."""
+        import os
+
+        env = os.environ.get("AUTOMODEL_PAGED_DECODE", "").strip().lower()
+        mode = env if env in ("fused", "gather") else self.config.decode_kernel
+        if mode in ("fused", "gather"):
+            return mode
+        from automodel_tpu.ops import autotune
+
+        entry = autotune.lookup(
+            autotune.paged_key(
+                int(self.model.config.head_dim), self.config.block_size,
+                self.config.kv_cache_dtype,
+            )
+        )
+        if entry is not None and entry.get("backend") in ("fused", "gather"):
+            return entry["backend"]
+        from automodel_tpu.ops.platform_check import is_tpu_platform
+
+        on_kernel_platform = self._interpret or is_tpu_platform(
+            getattr(self.model.backend, "platform", None)
+        )
+        return "fused" if on_kernel_platform else "gather"
+
     def _init_pool_arrays(self) -> None:
         """(Re)create the HBM pool arrays — at construction, and on a
         rebuild after a stalled/failed program whose donated buffers can no
-        longer be trusted (or were consumed by the failed call)."""
+        longer be trusted (or were consumed by the failed call). With
+        speculative decoding the draft model's parallel pool (same block
+        geometry, its own layer/head dims) rebuilds in the same breath —
+        a stall mid-verify must never leave half-trusted draft state."""
         mcfg = self.model.config
-        self._pool_k, self._pool_v = paged.init_pool(
-            int(mcfg.num_layers), self.config.num_blocks,
-            self.config.block_size, int(mcfg.num_kv_heads),
-            int(mcfg.head_dim), dtype=self.model.backend.compute_jnp_dtype,
+        self._pool = paged.place_pool(
+            paged.init_pool(
+                int(mcfg.num_layers), self.config.num_blocks,
+                self.config.block_size, int(mcfg.num_kv_heads),
+                int(mcfg.head_dim), dtype=self._compute_dtype,
+                quantized=self._quantized,
+            ),
+            self.auto.mesh_ctx,
         )
-        self._pool_k, self._pool_v = paged.place_pool(
-            self._pool_k, self._pool_v, self.auto.mesh_ctx
-        )
+        if self._spec_enabled:
+            dcfg = self.draft_auto.model.config
+            self._draft_pool = paged.place_pool(
+                paged.init_pool(
+                    int(dcfg.num_layers), self.config.num_blocks,
+                    self.config.block_size, int(dcfg.num_kv_heads),
+                    int(dcfg.head_dim),
+                    dtype=self.draft_auto.model.backend.compute_jnp_dtype,
+                    quantized=self._quantized,
+                ),
+                self.auto.mesh_ctx,
+            )
+
+    def release_pools(self) -> None:
+        """Drop the engine's HBM pool arrays (target + draft). For callers
+        that are DONE with this engine but keep the process alive — e.g.
+        the bench A/B sub-leg, which builds a second chip-sized engine and
+        must not hold two resident pools. The engine is unusable after."""
+        self._pool = None
+        if self._spec_enabled:
+            self._draft_pool = None
 
     # -- stats ---------------------------------------------------------------
     @property
@@ -392,7 +584,19 @@ class ServingEngine:
 
     @property
     def pool_bytes(self) -> int:
-        return int(self._pool_k.nbytes + self._pool_v.nbytes)
+        return self._pool.nbytes
+
+    @property
+    def draft_pool_bytes(self) -> int:
+        return self._draft_pool.nbytes if self._spec_enabled else 0
+
+    @property
+    def spec_accept_rate(self) -> Optional[float]:
+        """Engine-lifetime draft acceptance rate (None when speculative
+        decoding is off or no round has run yet)."""
+        if not self._spec_enabled or not self.spec_proposed_total:
+            return None
+        return self.spec_accepted_total / self.spec_proposed_total
 
     @property
     def watchdog(self):
@@ -512,11 +716,13 @@ class ServingEngine:
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) = "
                 f"{total} exceeds the serving limit {cap}"
             )
-        if -(-total // self.config.block_size) > self.pool.usable_blocks:
+        need = blocks_needed(
+            total, self.config.block_size, self.config.spec_overhang
+        )
+        if need > self.pool.usable_blocks:
             raise ValueError(
-                f"request needs {-(-total // self.config.block_size)} blocks "
-                f"but the pool only has {self.pool.usable_blocks} — raise "
-                "serving.num_blocks"
+                f"request needs {need} blocks but the pool only has "
+                f"{self.pool.usable_blocks} — raise serving.num_blocks"
             )
         now = time.perf_counter() if t_submit is None else t_submit
         rid = request_id if request_id is not None else f"req-{next(self._ids)}"
@@ -637,6 +843,12 @@ class ServingEngine:
                 (len(gen) - 1) / decode_s if decode_s > 0 and len(gen) > 1
                 else 0.0
             )
+        if self._spec_enabled and slot.spec_proposed:
+            rec["spec_proposed"] = slot.spec_proposed
+            rec["spec_accepted"] = slot.spec_accepted
+            rec["spec_accept_rate"] = round(
+                slot.spec_accepted / slot.spec_proposed, 4
+            )
         if detail:
             rec["detail"] = detail
         self._emit(rec)
@@ -695,7 +907,10 @@ class ServingEngine:
                 continue
             q = self._queue[0]
             hits, hit_tokens = self.pool.match_prefix(q.prompt)
-            need = -(-(len(q.prompt) + q.max_new) // self.config.block_size)
+            need = blocks_needed(
+                len(q.prompt) + q.max_new, self.config.block_size,
+                self.config.spec_overhang,
+            )
             fresh = self.pool.allocate(need - len(hits))
             if fresh is None:
                 # pool can't cover the head of the queue: undo the hit refs
@@ -751,16 +966,25 @@ class ServingEngine:
             if self.collect_program_costs and "chunk_prefill" not in self.program_costs:
                 self._record_cost(
                     "chunk_prefill", self._chunk,
-                    self.auto.params, self._pool_k, self._pool_v,
+                    self.auto.params, self._pool,
                     jnp.asarray(self._tables[b]), jnp.asarray(ids),
                     jnp.int32(start), jnp.int32(real),
                 )
-            last, self._pool_k, self._pool_v = self._chunk(
-                self.auto.params,
-                self._pool_k, self._pool_v,
+            last, self._pool = self._chunk(
+                self.auto.params, self._pool,
                 jnp.asarray(self._tables[b]), jnp.asarray(ids),
                 jnp.int32(start), jnp.int32(real),
             )
+            if self._spec_enabled:
+                # the draft model prefills the same chunk into its parallel
+                # pool (same tables/offsets) so its proposals see the whole
+                # prompt; its last-token logits are unused — the first
+                # sampled token always comes from the TARGET
+                _, self._draft_pool = self._draft_chunk(
+                    self.draft_auto.params, self._draft_pool,
+                    jnp.asarray(self._tables[b]), jnp.asarray(ids),
+                    jnp.int32(start), jnp.int32(real),
+                )
             slot.prefill_pos = start + real
             self._lengths[b] = slot.prefill_pos
             if slot.prefill_pos < p:
@@ -790,17 +1014,19 @@ class ServingEngine:
     def _decode_tick(self) -> list[dict]:
         if not self._active.any():
             return []
+        if self._spec_enabled:
+            return self._spec_decode_tick()
         params = self.auto.params
         if self.collect_program_costs and "paged_decode" not in self.program_costs:
             self._record_cost(
                 "paged_decode", self._decode,
-                params, self._pool_k, self._pool_v,
+                params, self._pool,
                 jnp.asarray(self._tables), jnp.asarray(self._lengths),
                 jnp.asarray(self._cur), jnp.asarray(self._active),
                 self._base_key, jnp.int32(self._step_counter),
             )
-        tokens, self._pool_k, self._pool_v = self._decode(
-            params, self._pool_k, self._pool_v,
+        tokens, self._pool = self._decode(
+            params, self._pool,
             jnp.asarray(self._tables), jnp.asarray(self._lengths),
             jnp.asarray(self._cur), jnp.asarray(self._active),
             self._base_key, jnp.int32(self._step_counter),
@@ -819,6 +1045,68 @@ class ServingEngine:
                 done.append(self._terminate(b, "stop"))
             elif len(slot.generated) >= slot.max_new:
                 done.append(self._terminate(b, "length"))
+        return done
+
+    def _spec_decode_tick(self) -> list[dict]:
+        """One speculative round for the whole decode wave: the draft
+        proposes ``spec_k`` tokens per slot (its own pool, shared tables),
+        ONE batched verify forward through the target commits the accepted
+        prefix + a correction/bonus token. Rollback of rejected drafts is
+        pure bookkeeping — the host simply advances ``lengths`` by the
+        committed count, leaving rejected K/V rows past the length where
+        no future attend can see them and the next round overwrites."""
+        k = self.config.speculative.k
+        tables = jnp.asarray(self._tables)
+        lengths = jnp.asarray(self._lengths)
+        cur = jnp.asarray(self._cur)
+        active = jnp.asarray(self._active)
+        step = jnp.int32(self._step_counter)
+        drafts, draft_logits, self._draft_pool = self._propose(
+            self.draft_auto.params, self._draft_pool,
+            tables, lengths, cur, active, self._base_key, step,
+        )
+        if self.collect_program_costs and "spec_verify" not in self.program_costs:
+            self._record_cost(
+                "spec_verify", self._verify,
+                self.auto.params, self._pool, tables, lengths, cur,
+                drafts, draft_logits, active, self._base_key, step,
+            )
+        tokens, n_commit, self._pool = self._verify(
+            self.auto.params, self._pool, tables, lengths, cur,
+            drafts, draft_logits, active, self._base_key, step,
+        )
+        tokens = np.asarray(jax.device_get(tokens))
+        n_commit = np.asarray(jax.device_get(n_commit))
+        self.first_decode_done = True
+        self.spec_rounds += 1  # one propose+verify round per WAVE, not per slot
+        done: list[dict] = []
+        for b, slot in enumerate(self._slots):
+            if slot is None or not self._active[b]:
+                continue
+            n = int(n_commit[b])
+            accepted = n - 1
+            slot.spec_proposed += k
+            slot.spec_accepted += accepted
+            self.spec_proposed_total += k
+            self.spec_accepted_total += accepted
+            reason = None
+            used = 0
+            for tok in (int(t) for t in tokens[b, :n]):
+                slot.generated.append(tok)
+                used += 1
+                if tok in self._eos:
+                    reason = "stop"
+                    break
+                if len(slot.generated) >= slot.max_new:
+                    reason = "length"
+                    break
+            # committed length only ever moves FORWARD by what was kept:
+            # the rejected tail needs no cache surgery (paged.py rollback
+            # contract); a truncated commit only happens when terminating
+            self._lengths[b] += used
+            self._cur[b] = slot.generated[-1]
+            if reason is not None:
+                done.append(self._terminate(b, reason))
         return done
 
     def _rebuild(self, reason: str, detail: Optional[str] = None) -> list[dict]:
@@ -1013,6 +1301,8 @@ class ServingEngine:
         p50/p99, peak occupancy/queue depth)."""
         arrivals = sorted(arrivals, key=lambda a: a[0])
         t0 = time.perf_counter()
+        spec_proposed0 = self.spec_proposed_total
+        spec_accepted0 = self.spec_accepted_total
         pending = deque(arrivals)
         out: list[dict] = []
         occ_peak, q_peak = 0.0, 0
@@ -1048,6 +1338,15 @@ class ServingEngine:
             "queue_depth_peak": q_peak,
             "prefix_cache": dict(self.pool.counters),
         }
+        if self._spec_enabled:
+            proposed = self.spec_proposed_total - spec_proposed0
+            accepted = self.spec_accepted_total - spec_accepted0
+            stats["spec_proposed"] = proposed
+            stats["spec_accepted"] = accepted
+            stats["accept_rate"] = (
+                round(accepted / proposed, 4) if proposed else None
+            )
+            stats["draft_tps"] = proposed / dt if dt > 0 else 0.0
         if len(completions) != len(out):
             stats["failed_requests"] = len(out) - len(completions)
         return out, stats

@@ -1,46 +1,95 @@
-"""Jitted paged-KV programs: block-table gather → existing cache attention.
+"""Jitted paged-KV programs: chunk prefill, paged decode (fused Pallas
+kernel or XLA-gather fallback), and the speculative draft/verify pair.
 
-Two programs, compiled once each per (chunk length, table width):
+The pool is a :class:`PagedKV`: per-layer k/v block arrays
+``[L, NB, BS, N_kv, H]`` in the model's compute dtype, or int8 with
+per-(token row, kv head) fp32 scales ``[L, NB, BS, N_kv]`` riding
+alongside (``serving.kv_cache_dtype: int8`` — roughly half the bytes per
+resident token, so ~2× the sequences per chip on the same HBM budget).
 
-- **chunk prefill**: one prompt chunk (static padded length, traced offset)
-  through the model's cached-attend path — queries attend the WHOLE gathered
-  cache view under per-query position-tag masks (generation.kv_cache
-  ``chunk_ctx`` + the 3D ``kv_mask`` in ops.attention.sdpa), so chunk N sees
-  chunks 0..N-1 and any prefix-cache hit without recomputing them. This is
-  what lets the scheduler interleave a long prompt with the running decode
-  wave: each engine iteration spends at most one chunk of prefill compute.
-- **paged decode**: one token per active slot. The per-slot block tables
-  gather the pool into a contiguous ``[L, B, C_view, N_kv, H]`` view (an XLA
-  gather — the TPU-native expression of paged attention; a bespoke
-  Mosaic gather-attend kernel is the known next optimization, noted in
-  docs/serving.md), the view feeds the UNCHANGED ``decode_ctx`` →
-  ``sdpa_decode`` path, and the single written token scatters back to its
-  (block, offset). Inactive slots write to scratch block 0.
+Programs, each compiled once per static shape and donating the pool:
 
-Both programs donate the pool arrays, so the pool is updated in place
-(no transient second copy of the whole cache).
+- **chunk prefill** — one prompt chunk (static padded length, traced
+  offset) for ONE sequence through the model's cached-attend path over the
+  gathered (dequantized) view; the whole table scatters back
+  quantize-on-write. Chunking is what lets a long prompt interleave with
+  the running decode wave.
+- **paged decode** — one token per active slot. Two backends, selected by
+  ``serving.decode_kernel`` / ``AUTOMODEL_PAGED_DECODE`` / the autotune
+  table (``autotune.paged_key``):
 
-View-position invariant: the serving engine uses the FULL layout only
-(slot j of a sequence's view holds absolute position j), so a sequence's
-view capacity must exceed its highest written position — the engine sizes
-tables as ``ceil((max_seq_len + prefill_chunk) / block_size)`` blocks and
-admission enforces ``prompt + max_new <= max_seq_len``.
+  * ``fused`` — the model's attention runs the Pallas paged kernel
+    (ops/paged_attention.py) that indexes the pool IN PLACE through the
+    per-slot block tables (scalar-prefetch DMA per block, int8 dequant
+    in-kernel); the only pool write is the one token row's scatter. No
+    gather → contiguous view → scatter-back round trip.
+  * ``gather`` — the historical XLA path (block-table gather → the
+    unchanged cached-attend → single-token scatter-back), kept as the
+    fallback and the A/B baseline ``tools/kernel_bench.py`` races the
+    kernel against.
+
+- **draft propose / verify** — speculative decoding (Leviathan et al.
+  2023): the draft model proposes ``spec_k`` tokens per slot (``spec_k``
+  cheap decode steps over its OWN parallel pool, sharing the target's
+  block tables so rollback is shared bookkeeping), then ONE batched
+  verify forward pushes ``[cur, d_1..d_k]`` through the target —
+  a chunk-shaped cached attend at per-slot offsets — and the rejection
+  rule (generation.sampling.speculative_verify) commits the accepted
+  prefix + one correction/bonus token. Rollback is a LENGTH DECREMENT:
+  K/V of rejected tokens stays in the pool but sits past the committed
+  length, which every attend masks out and the next round overwrites —
+  no copies, no block churn.
+
+View-position invariant (full layout only): slot j of a sequence's
+view/table holds absolute position j, so admission sizes tables with
+enough headroom for ``max(prefill_chunk, spec_k + 1)`` writes past
+``max_seq_len`` (ServeConfig.table_blocks).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
 from automodel_tpu.generation import kv_cache
-from automodel_tpu.generation.sampling import SamplingConfig, sample
+from automodel_tpu.generation.sampling import (
+    SamplingConfig,
+    sample,
+    speculative_verify,
+)
+from automodel_tpu.ops.paged_attention import dequantize_kv, quantize_kv_rows
 
 
 def _logits_of(primary: Any) -> jnp.ndarray:
     return primary[0] if isinstance(primary, tuple) else primary
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKV:
+    """The HBM block pool. ``k``/``v`` are each either a raw array
+    ``[L, NB, BS, N_kv, H]`` or, when quantized, a ``(values int8,
+    scales fp32 [L, NB, BS, N_kv])`` pair — the same pytree shape the
+    model's layer scan slices per layer."""
+
+    k: Any
+    v: Any
+
+    @property
+    def quantized(self) -> bool:
+        return isinstance(self.k, tuple)
+
+    @property
+    def values_shape(self) -> tuple:
+        return (self.k[0] if self.quantized else self.k).shape
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(x.nbytes for x in jax.tree.leaves((self.k, self.v))))
 
 
 def init_pool(
@@ -50,53 +99,195 @@ def init_pool(
     num_kv_heads: int,
     head_dim: int,
     dtype=jnp.bfloat16,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """The HBM block pool: (k, v), each [L, NB, BS, N_kv, H]."""
+    quantized: bool = False,
+) -> PagedKV:
+    """Zeroed pool; ``quantized`` stores int8 values + fp32 row scales."""
     shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
-    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    if quantized:
+        sshape = shape[:-1]
+
+        def side():
+            return (jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32))
+
+        return PagedKV(k=side(), v=side())
+    return PagedKV(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
-def place_pool(pool_k, pool_v, mesh_ctx):
+def place_pool(pool: PagedKV, mesh_ctx) -> PagedKV:
     """Shard the pool: KV heads over the tensor axes (each TP shard owns its
     heads' blocks — the same no-cache-collective decode layout as
     generation.kv_cache.place_cache); blocks are NOT batch-sharded (every
     sequence's table may point anywhere in the pool). Non-divisible axes are
-    dropped (replicated)."""
+    dropped (replicated). Int8 scales shard on the same kv-head axis."""
     if mesh_ctx is None:
-        return pool_k, pool_v
+        return pool
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    names = kv_cache.usable_axes(mesh_ctx, pool_k.shape[3], "tensor")
-    sh = NamedSharding(mesh_ctx.mesh, P(None, None, None, names, None))
-    return jax.device_put(pool_k, sh), jax.device_put(pool_v, sh)
+    nkv = pool.values_shape[3]
+    names = kv_cache.usable_axes(mesh_ctx, nkv, "tensor")
+    val_s = NamedSharding(mesh_ctx.mesh, P(None, None, None, names, None))
+    scale_s = NamedSharding(mesh_ctx.mesh, P(None, None, None, names))
+
+    def place_side(side):
+        if isinstance(side, tuple):
+            return (
+                jax.device_put(side[0], val_s),
+                jax.device_put(side[1], scale_s),
+            )
+        return jax.device_put(side, val_s)
+
+    return PagedKV(k=place_side(pool.k), v=place_side(pool.v))
 
 
-def _gather_view(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
-    """pool [L, NB, BS, Nkv, H] + tables [B, NBseq] → view [L, B, Cv, Nkv, H]
-    (Cv = NBseq * BS): each sequence's blocks, concatenated in table order —
-    full layout, view position == absolute token position."""
-    L, _, BS, Nkv, H = pool.shape
+# -- gather / scatter (the XLA fallback path + chunk prefill) ----------------
+
+
+def _gather_side(side, tables: jnp.ndarray, dtype) -> jnp.ndarray:
+    """One pool side + tables [B, NBseq] → contiguous view
+    [L, B, Cv, Nkv, H] in ``dtype`` (int8 blocks dequantize here)."""
+    if isinstance(side, tuple):
+        vals, scales = side
+        L, _, BS, Nkv, H = vals.shape
+        B, NBseq = tables.shape
+        g = dequantize_kv(vals[:, tables], scales[:, tables], dtype)
+        return g.reshape(L, B, NBseq * BS, Nkv, H)
+    L, _, BS, Nkv, H = side.shape
     B, NBseq = tables.shape
-    return pool[:, tables].reshape(L, B, NBseq * BS, Nkv, H)
+    return side[:, tables].reshape(L, B, NBseq * BS, Nkv, H)
 
 
-def build_chunk_prefill_fn(apply: Callable, chunk_len: int) -> Callable:
-    """→ jitted ``chunk(params, pool_k, pool_v, table [NBseq], chunk_ids
-    [chunk_len], start, real_len)`` → ``(last_logits [V] fp32, pool_k,
-    pool_v)`` for ONE sequence. ``start`` is the absolute position of the
-    chunk's first token (= prefix-cache hit length for the first chunk);
-    ``real_len`` the unpadded chunk length; ``last_logits`` the logits of
-    token ``start + real_len - 1`` (the first-token sample source once the
-    whole prompt is in)."""
+def _scatter_rows(side, rows: jnp.ndarray, blk: jnp.ndarray, off: jnp.ndarray):
+    """Scatter written token rows [L, B, S, Nkv, H] back into one pool side
+    at (blk, off) [B, S] — quantize-on-write when the side is int8."""
+    if isinstance(side, tuple):
+        vals, scales = side
+        q, s = quantize_kv_rows(rows)
+        return (vals.at[:, blk, off].set(q), scales.at[:, blk, off].set(s))
+    return side.at[:, blk, off].set(rows.astype(side.dtype))
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def chunk(params, pool_k, pool_v, table, chunk_ids, start, real_len):
-        L, _, BS, Nkv, H = pool_k.shape
+
+def _scatter_table(side, new: jnp.ndarray, table: jnp.ndarray):
+    """Scatter a whole single-sequence view [L, NBseq, BS, Nkv, H] back
+    (chunk prefill): fresh blocks carry the chunk's new K/V; shared prefix
+    blocks rewrite their own bytes (quantize∘dequantize is idempotent, so
+    int8 prefix blocks are bit-identical); padded table entries write to
+    scratch block 0."""
+    if isinstance(side, tuple):
+        vals, scales = side
+        q, s = quantize_kv_rows(new)
+        return (vals.at[:, table].set(q), scales.at[:, table].set(s))
+    return side.at[:, table].set(new.astype(side.dtype))
+
+
+# gather scatter-back targets resolve through the SAME helper the fused
+# path's paged_ctx uses — the two backends can never write to different cells
+_write_targets = kv_cache.paged_write_targets
+
+
+# -- forward cores -----------------------------------------------------------
+
+
+def _gather_forward(
+    apply: Callable, params, pool: PagedKV, tables, lengths, tokens, active,
+    compute_dtype, block_size: int,
+):
+    """tokens [B, S] at per-slot offsets through the GATHERED view (chunk
+    cached-attend), scattering the S written rows back. → (logits [B,S,V]
+    fp32, new pool). S = 1 is the classic paged decode step."""
+    B, S = tokens.shape
+    NBseq = tables.shape[1]
+    BS = pool.values_shape[2]
+    lengths = lengths.astype(jnp.int32)
+    view = kv_cache.KVCache(
+        k=_gather_side(pool.k, tables, compute_dtype),
+        v=_gather_side(pool.v, tables, compute_dtype),
+        pos=jnp.full((B, NBseq * BS), -1, jnp.int32),  # chunk_ctx retags
+        lengths=jnp.zeros((B,), jnp.int32),
+    )
+    kvc, ctx = kv_cache.chunk_ctx(
+        view, S, lengths, jnp.where(active, S, 0).astype(jnp.int32)
+    )
+    positions = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    primary, new_view = apply(
+        params, tokens, position_ids=positions, cache=(kvc, ctx)
+    )
+    logits = _logits_of(primary).astype(jnp.float32)
+    b_idx = jnp.arange(B)
+    rows_k = new_view.k[:, b_idx[:, None], positions]  # [L, B, S, Nkv, H]
+    rows_v = new_view.v[:, b_idx[:, None], positions]
+    blk, off = _write_targets(tables, lengths, S, active, block_size)
+    return logits, PagedKV(
+        k=_scatter_rows(pool.k, rows_k, blk, off),
+        v=_scatter_rows(pool.v, rows_v, blk, off),
+    )
+
+
+def _fused_forward(
+    apply: Callable, params, pool: PagedKV, tables, lengths, tokens, active,
+    block_size: int, interpret: bool,
+):
+    """tokens [B, S] through the paged-mode cache: per-layer writes scatter
+    the S rows straight into the pool slices (quantize-on-write) and
+    attention runs the fused Pallas kernel over the pool via the tables —
+    no view is ever materialized. → (logits [B,S,V] fp32, new pool)."""
+    B, S = tokens.shape
+    lengths = lengths.astype(jnp.int32)
+    kvc = kv_cache.KVCache(
+        k=pool.k, v=pool.v,
+        pos=jnp.zeros((B, 1), jnp.int32), lengths=lengths,
+    )
+    kvc, ctx = kv_cache.paged_ctx(
+        kvc, tables, lengths, S, active, block_size, interpret=interpret
+    )
+    positions = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    primary, new_kvc = apply(
+        params, tokens, position_ids=positions, cache=(kvc, ctx)
+    )
+    return _logits_of(primary).astype(jnp.float32), PagedKV(
+        k=new_kvc.k, v=new_kvc.v
+    )
+
+
+def _make_forward(
+    apply: Callable, backend: str, block_size: int, compute_dtype,
+    interpret: bool,
+) -> Callable:
+    if backend == "fused":
+        return functools.partial(
+            _fused_forward, apply, block_size=block_size, interpret=interpret
+        )
+    return functools.partial(
+        _gather_forward, apply,
+        compute_dtype=compute_dtype, block_size=block_size,
+    )
+
+
+# -- programs ----------------------------------------------------------------
+
+
+def build_chunk_prefill_fn(
+    apply: Callable, chunk_len: int, compute_dtype=None
+) -> Callable:
+    """→ jitted ``chunk(params, pool, table [NBseq], chunk_ids [chunk_len],
+    start, real_len)`` → ``(last_logits [V] fp32, pool)`` for ONE sequence.
+    ``start`` is the absolute position of the chunk's first token (= the
+    prefix-cache hit length for the first chunk); ``real_len`` the unpadded
+    chunk length; ``last_logits`` the logits of token ``start + real_len -
+    1`` (the first-token sample source once the whole prompt is in).
+    Always the gathered-view path: prefill is compute-bound and one
+    compiled program serves every offset."""
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def chunk(params, pool: PagedKV, table, chunk_ids, start, real_len):
+        L, _, BS, Nkv, H = pool.values_shape
         NBseq = table.shape[0]
+        cd = compute_dtype or (
+            pool.k.dtype if not pool.quantized else jnp.bfloat16
+        )
         tables = table[None, :]
         view = kv_cache.KVCache(
-            k=_gather_view(pool_k, tables),
-            v=_gather_view(pool_v, tables),
+            k=_gather_side(pool.k, tables, cd),
+            v=_gather_side(pool.v, tables, cd),
             pos=jnp.full((1, NBseq * BS), -1, jnp.int32),
             lengths=jnp.zeros((1,), jnp.int32),
         )
@@ -112,14 +303,12 @@ def build_chunk_prefill_fn(apply: Callable, chunk_len: int) -> Callable:
         )
         logits = _logits_of(primary)[0].astype(jnp.float32)  # [chunk_len, V]
         last = logits[real_len - 1]
-        # scatter the whole view back: fresh blocks carry the chunk's new
-        # K/V; shared prefix blocks rewrite their own gathered bytes
-        # (identical values); padded table entries write to scratch block 0
         newk = new_view.k.reshape(L, NBseq, BS, Nkv, H)
         newv = new_view.v.reshape(L, NBseq, BS, Nkv, H)
-        pool_k = pool_k.at[:, table].set(newk)
-        pool_v = pool_v.at[:, table].set(newv)
-        return last, pool_k, pool_v
+        return last, PagedKV(
+            k=_scatter_table(pool.k, newk, table),
+            v=_scatter_table(pool.v, newv, table),
+        )
 
     return chunk
 
@@ -128,48 +317,108 @@ def build_paged_decode_fn(
     apply: Callable,
     sampling: SamplingConfig,
     pad_id: int = 0,
+    *,
+    backend: str = "gather",
+    block_size: int = 16,
+    compute_dtype=jnp.bfloat16,
+    interpret: bool = False,
 ) -> Callable:
-    """→ jitted ``step(params, pool_k, pool_v, tables [B, NBseq], lengths
-    [B], cur [B], active [B] bool, key, step_idx)`` → ``(next_tokens [B],
-    pool_k, pool_v)``.
+    """→ jitted ``step(params, pool, tables [B, NBseq], lengths [B], cur
+    [B], active [B] bool, key, step_idx)`` → ``(next_tokens [B], pool)``.
 
     One continuous-batching decode step: every ACTIVE slot advances one
     token (its K/V written at ``(table[len // BS], len % BS)``); inactive
     slots (free, or mid-prefill) compute junk that is masked from the
     sampled output and scattered into scratch block 0. Stop-token/length
     bookkeeping is the host scheduler's job — this program is stateless."""
+    forward = _make_forward(apply, backend, block_size, compute_dtype, interpret)
 
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def step(params, pool_k, pool_v, tables, lengths, cur, active, key, step_idx):
-        L, _, BS, Nkv, H = pool_k.shape
-        B, NBseq = tables.shape
-        Cv = NBseq * BS
-        lengths = lengths.astype(jnp.int32)
-        j = jnp.arange(Cv, dtype=jnp.int32)
-        pos = jnp.where(j[None, :] < lengths[:, None], j[None, :], -1)
-        view = kv_cache.KVCache(
-            k=_gather_view(pool_k, tables),
-            v=_gather_view(pool_v, tables),
-            pos=pos.astype(jnp.int32),
-            lengths=lengths,
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def step(params, pool, tables, lengths, cur, active, key, step_idx):
+        logits, pool = forward(
+            params, pool, tables, lengths, cur[:, None], active
         )
-        kvc, ctx = kv_cache.decode_ctx(view)
-        primary, new_view = apply(
-            params, cur[:, None], position_ids=ctx.q_pos[:, None],
-            cache=(kvc, ctx),
-        )
-        logits = _logits_of(primary)[:, -1].astype(jnp.float32)
-        nxt = sample(logits, jax.random.fold_in(key, step_idx), sampling)
+        nxt = sample(logits[:, -1], jax.random.fold_in(key, step_idx), sampling)
         nxt = jnp.where(active, nxt, jnp.int32(pad_id))
-        # scatter exactly the written token back (full layout: the decode
-        # write slot IS the absolute position lengths[b])
-        b_idx = jnp.arange(B)
-        tok_k = new_view.k[:, b_idx, lengths % Cv]  # [L, B, Nkv, H]
-        tok_v = new_view.v[:, b_idx, lengths % Cv]
-        blk = jnp.where(active, tables[b_idx, lengths // BS], 0)
-        off = jnp.where(active, lengths % BS, 0)
-        pool_k = pool_k.at[:, blk, off].set(tok_k)
-        pool_v = pool_v.at[:, blk, off].set(tok_v)
-        return nxt, pool_k, pool_v
+        return nxt, pool
 
     return step
+
+
+def build_draft_propose_fn(
+    draft_apply: Callable,
+    sampling: SamplingConfig,
+    spec_k: int,
+    pad_id: int = 0,
+    *,
+    backend: str = "gather",
+    block_size: int = 16,
+    compute_dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> Callable:
+    """→ jitted ``propose(draft_params, draft_pool, tables, lengths, cur,
+    active, key, step_idx)`` → ``(draft_tokens [B, k], draft_logits
+    [B, k, V] fp32, draft_pool)``: ``spec_k`` sequential draft decode
+    steps inside one program, each writing the draft's K/V at the shared
+    block-table positions. Draft keys fold ``(step, 1 + i)`` so proposal
+    streams never collide with the verify correction stream."""
+    forward = _make_forward(
+        draft_apply, backend, block_size, compute_dtype, interpret
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def propose(params, pool, tables, lengths, cur, active, key, step_idx):
+        kstep = jax.random.fold_in(key, step_idx)
+        toks, logs = [], []
+        length, c = lengths.astype(jnp.int32), cur
+        for i in range(spec_k):
+            logits, pool = forward(
+                params, pool, tables, length, c[:, None], active
+            )
+            lg = logits[:, -1]
+            nxt = sample(lg, jax.random.fold_in(kstep, 1 + i), sampling)
+            nxt = jnp.where(active, nxt, jnp.int32(pad_id))
+            toks.append(nxt)
+            logs.append(lg)
+            length = length + 1
+            c = nxt
+        return jnp.stack(toks, axis=1), jnp.stack(logs, axis=1), pool
+
+    return propose
+
+
+def build_verify_fn(
+    apply: Callable,
+    sampling: SamplingConfig,
+    spec_k: int,
+    pad_id: int = 0,
+    *,
+    backend: str = "gather",
+    block_size: int = 16,
+    compute_dtype=jnp.bfloat16,
+    interpret: bool = False,
+) -> Callable:
+    """→ jitted ``verify(params, pool, tables, lengths, cur, drafts
+    [B, k], draft_logits [B, k, V], active, key, step_idx)`` →
+    ``(tokens [B, k+1], n_commit [B], pool)``: ONE batched forward over
+    the fed chunk ``[cur, d_1..d_k]`` at per-slot offsets (the verify
+    attend is chunk-shaped — per-query causal masks over the paged
+    cache), then the rejection rule. The pool keeps the K/V of every fed
+    token; rejected tails sit past the committed length the host keeps,
+    masked out of all future attends — rollback without copies."""
+    forward = _make_forward(apply, backend, block_size, compute_dtype, interpret)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def verify(
+        params, pool, tables, lengths, cur, drafts, draft_logits, active,
+        key, step_idx,
+    ):
+        fed = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
+        logits, pool = forward(params, pool, tables, lengths, fed, active)
+        kstep = jax.random.fold_in(jax.random.fold_in(key, step_idx), 0)
+        toks, n = speculative_verify(logits, draft_logits, drafts, kstep, sampling)
+        n = jnp.where(active, n, 0).astype(jnp.int32)
+        toks = jnp.where(active[:, None], toks, jnp.int32(pad_id))
+        return toks, n, pool
+
+    return verify

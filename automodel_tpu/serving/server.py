@@ -312,6 +312,11 @@ def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
                     "drain_duration_s": engine.drain_duration_s,
                     "block_occupancy": engine.pool.occupancy(),
                     "allocator": dict(engine.pool.counters),
+                    "decode_backend": engine.decode_backend,
+                    "kv_cache_dtype": engine.config.kv_cache_dtype,
+                    "spec_proposed_total": engine.spec_proposed_total,
+                    "spec_accepted_total": engine.spec_accepted_total,
+                    "spec_accept_rate": engine.spec_accept_rate,
                 })
 
         def do_POST(self):

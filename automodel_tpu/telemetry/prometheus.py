@@ -299,6 +299,19 @@ class ServingMetrics:
             "Wall time from drain start to the last in-flight completion "
             "(0 until a drain finishes)",
         )
+        # speculative decoding (serving.speculative:) — draft acceptance
+        self.spec_accepted = r.counter(
+            "automodel_serve_spec_accepted",
+            "Draft tokens accepted by the speculative verify rule",
+        )
+        self.spec_rejected = r.counter(
+            "automodel_serve_spec_rejected",
+            "Draft tokens rejected by the speculative verify rule",
+        )
+        self.spec_accept_rate = r.gauge(
+            "automodel_serve_spec_accept_rate",
+            "Engine-lifetime draft acceptance rate (0 until a round runs)",
+        )
         self._pool_counters = {
             key: r.counter(f"automodel_serve_block_{key}", help_text)
             for key, help_text in (
@@ -362,6 +375,13 @@ class ServingMetrics:
             )
             for key, counter in self._pool_counters.items():
                 counter.set_total(engine.pool.counters.get(key, 0))
+            proposed = getattr(engine, "spec_proposed_total", 0)
+            accepted = getattr(engine, "spec_accepted_total", 0)
+            self.spec_accepted.set_total(accepted)
+            self.spec_rejected.set_total(proposed - accepted)
+            self.spec_accept_rate.set(
+                accepted / proposed if proposed else 0.0
+            )
 
 
 # -- training-side metric set --------------------------------------------------

@@ -49,6 +49,13 @@ _NUMERIC_KEYS = (
     "serve_ttft_p99_s",
     "serve_block_occupancy_peak",
     "serve_requests",
+    # speculative decoding (serving.speculative:): per-request acceptance
+    # + the bench leg's aggregate accept-rate/draft-throughput keys
+    "spec_proposed",
+    "spec_accepted",
+    "spec_accept_rate",
+    "serve_accept_rate",
+    "serve_draft_tps",
     # serving robustness (PR 9): drain/deadline/stall evidence
     "drain_duration_s",
     "requests_failed",
@@ -282,6 +289,20 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
         ]
         if occ:
             out["serve_block_occupancy_peak"] = max(occ)
+        # speculative decoding: aggregate acceptance over the file's
+        # requests (token-weighted, not a mean of per-request rates)
+        sp = sum(
+            r["spec_proposed"] for r in serves
+            if isinstance(r.get("spec_proposed"), int)
+        )
+        sa = sum(
+            r["spec_accepted"] for r in serves
+            if isinstance(r.get("spec_accepted"), int)
+        )
+        if sp:
+            out["serve_spec_proposed"] = sp
+            out["serve_spec_accepted"] = sa
+            out["serve_accept_rate"] = round(sa / sp, 4)
         # completion-reason histogram (PR 9): shed/timeout/stall/drain
         # terminations are the headline of a run that had them
         reasons: dict[str, int] = {}
@@ -334,7 +355,14 @@ _BENCH_LEGS = (
     ("moe_mfu_pct", "moe_failures"),
     ("gen_decode_tps", "gen_failure"),
     ("serve_tokens_per_s", "serve_failure"),
+    # speculative sub-leg: a null accept rate must name why (spec disabled,
+    # engine failure, no round ran) — never read as "measured zero"
+    ("serve_accept_rate", "serve_spec_failure"),
 )
+
+# legs where a hard 0.0 IS a measurement (an accept rate of zero means the
+# draft never matched — real data, unlike a 0.0 MFU which means never-ran)
+_ZERO_VALID_LEGS = frozenset({"serve_accept_rate"})
 
 
 def validate_bench_result(result: dict[str, Any]) -> list[str]:
@@ -347,7 +375,10 @@ def validate_bench_result(result: dict[str, Any]) -> list[str]:
             continue
         value = result[value_key]
         reason = result.get(failure_key)
-        if isinstance(value, (int, float)) and not isinstance(value, bool) and value == 0.0:
+        if (
+            isinstance(value, (int, float)) and not isinstance(value, bool)
+            and value == 0.0 and value_key not in _ZERO_VALID_LEGS
+        ):
             problems.append(
                 f"{value_key} is 0.0 — a leg that never ran must report null "
                 f"+ a reason in {failure_key}, never a zero measurement"

@@ -132,14 +132,26 @@ def test_example_yaml_parses_and_dry_instantiates(path):
             StallConfig,
         )
 
+        from automodel_tpu.serving.engine import SpeculativeConfig
+
         sc = ServeConfig.from_dict(srv)
         assert isinstance(sc.limits, LimitsConfig)
         assert isinstance(sc.drain, DrainConfig)
         assert isinstance(sc.watchdog, StallConfig)
+        assert isinstance(sc.speculative, SpeculativeConfig)
+        if sc.speculative.enabled:
+            # the draft section must be model:-shaped — same invariant the
+            # engine's build_auto_from_model_section ladder enforces
+            draft = sc.speculative.draft
+            get = draft.get if hasattr(draft, "get") else dict(draft).get
+            assert get("hf_config") or get("pretrained_model_name_or_path"), (
+                f"{path}: serving.speculative.draft is not a model section"
+            )
         for key, sub in (
             ("limits", LimitsConfig),
             ("drain", DrainConfig),
             ("watchdog", StallConfig),
+            ("speculative", SpeculativeConfig),
         ):
             if srv.get(key) is not None:
                 sub.from_dict(dict(srv[key]))
@@ -202,3 +214,11 @@ def test_config_dataclasses_reject_unknown_keys():
         ServeConfig.from_dict({"limits": {"deadline_ss": 1.0}})
     with pytest.raises(TypeError):
         ServeConfig.from_dict({"drain": {"grace": 1.0}})
+    with pytest.raises(TypeError):
+        ServeConfig.from_dict({"speculative": {"kk": 4}})
+    with pytest.raises(ValueError):
+        ServeConfig.from_dict({"kv_cache_dtype": "fp4"})
+    with pytest.raises(ValueError):
+        ServeConfig.from_dict({"decode_kernel": "mosaic"})
+    with pytest.raises(ValueError):  # enabled without a draft section
+        ServeConfig.from_dict({"speculative": {"enabled": True}})

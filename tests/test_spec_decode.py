@@ -1,0 +1,654 @@
+"""Serving raw-speed levers: speculative decoding exactness, the fused
+Pallas paged-attention kernel (interpret-mode parity vs the gather path,
+incl. int8 blocks), and int8 KV-cache pools.
+
+The exactness contracts pinned here:
+
+- **greedy spec parity** — a speculative engine (any draft, any accept
+  rate) produces BIT-IDENTICAL greedy tokens to the non-speculative
+  engine, for ragged batches across the cache-capable families;
+- **fused == gather** — the paged kernel indexing the pool in place
+  equals the gather → ``sdpa_decode`` view path, bf16/fp32 and int8;
+- **int8 == fp32 tokens** — the quantized pool decodes the same greedy
+  tokens as the full-precision pool on the tiny models;
+- **rollback is leak-free** — ``BlockPool.check_invariants()`` holds
+  after every engine step of a randomized accept/reject schedule,
+  including rollbacks across a block boundary (``spec_k > block_size``).
+
+All CPU-fast tier-1 except the qwen3_moe family build (slow-marked, like
+its non-speculative parity sibling)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.auto_model import AutoModel
+from automodel_tpu.generation.engine import GenerationConfig, GenerationEngine
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.serving.engine import (
+    ServeConfig,
+    ServingEngine,
+    SpeculativeConfig,
+)
+
+FP32 = BackendConfig(attn="sdpa", param_dtype="float32", compute_dtype="float32")
+
+
+def _tiny_llama(seed=0, **over):
+    from automodel_tpu.models.llama import LlamaForCausalLM
+
+    kw = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=3,
+        num_heads=4, num_kv_heads=2, head_dim=8,
+    )
+    kw.update(over)
+    model = LlamaForCausalLM(TransformerConfig(**kw), FP32)
+    return model, model.init(jax.random.key(seed))
+
+
+def _auto(model, params):
+    return AutoModel(model=model, params=params, adapter=None, mesh_ctx=None)
+
+
+def _draft_section(**over):
+    """A model:-shaped draft section (smaller than the target, same vocab)."""
+    hf = dict(
+        architectures=["LlamaForCausalLM"], model_type="llama",
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+        head_dim=8, max_position_embeddings=128,
+    )
+    hf.update(over)
+    return {
+        "hf_config": hf,
+        "backend": {
+            "attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32",
+        },
+    }
+
+
+def _serve(auto, *, max_new=6, spec_k=None, draft=None, **over):
+    spec = (
+        SpeculativeConfig(enabled=True, k=spec_k, draft=draft or _draft_section())
+        if spec_k is not None
+        else SpeculativeConfig()
+    )
+    return ServingEngine(
+        auto,
+        ServeConfig(
+            slots=2, block_size=4, num_blocks=48, prefill_chunk=4,
+            max_seq_len=48, speculative=spec, **over,
+        ),
+        GenerationConfig(max_new_tokens=max_new, greedy=True),
+    )
+
+
+def _greedy_refs(auto, prompts, max_new):
+    eng = GenerationEngine(
+        auto, GenerationConfig(max_new_tokens=max_new, greedy=True, pad_to_multiple=1)
+    )
+    return eng.generate_ids([list(p) for p in prompts])["tokens"]
+
+
+def _run(srv, prompts):
+    ids = [srv.submit(p) for p in prompts]
+    done = {r["request_id"]: r for r in srv.run()}
+    return [done[i] for i in ids]
+
+
+# -- fused kernel parity (interpret mode) -------------------------------------
+
+
+def _kernel_case(seed=0, B=3, N=4, Nkv=2, H=16, NB=12, BS=4, NBseq=5):
+    rng = np.random.default_rng(seed)
+    kp = jnp.asarray(rng.normal(size=(NB, BS, Nkv, H)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(NB, BS, Nkv, H)), jnp.float32)
+    tables = jnp.asarray(rng.integers(1, NB, size=(B, NBseq)), jnp.int32)
+    lengths = jnp.asarray([7, 13, 0], jnp.int32)
+    return kp, vp, tables, lengths
+
+
+def _gather_ref(q, kp, vp, tables, lengths, window=None, cap=None):
+    from automodel_tpu.ops.attention import sdpa_decode
+
+    B, Sq = q.shape[:2]
+    NB, BS, Nkv, H = kp.shape
+    NBseq = tables.shape[1]
+    Cv = NBseq * BS
+    view_k = kp[tables].reshape(B, Cv, Nkv, H)
+    view_v = vp[tables].reshape(B, Cv, Nkv, H)
+    j = jnp.arange(Cv)
+    q_abs = lengths[:, None] + jnp.arange(Sq)[None]
+    mask = j[None, None, :] <= q_abs[:, :, None]
+    if window is not None:
+        mask = mask & (q_abs[:, :, None] - j[None, None, :] < window)
+    return sdpa_decode(q, view_k, view_v, kv_mask=mask, logits_soft_cap=cap)
+
+
+@pytest.mark.parametrize("sq", [1, 4])
+@pytest.mark.parametrize("window,cap", [(None, None), (6, None), (None, 5.0)])
+def test_paged_attend_kernel_parity_vs_gather(sq, window, cap):
+    """The fused kernel == the gathered-view sdpa_decode path: decode
+    (Sq=1) and verify-chunk (Sq=4) queries, causal per-query masks,
+    sliding window, logit soft cap."""
+    from automodel_tpu.ops import paged_attention as pa
+
+    kp, vp, tables, lengths = _kernel_case()
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(3, sq, 4, 16)), jnp.float32)
+    out = pa.paged_attend(
+        q, kp, vp, tables, lengths,
+        sliding_window=window, logits_soft_cap=cap, interpret=True,
+    )
+    ref = _gather_ref(q, kp, vp, tables, lengths, window=window, cap=cap)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_attend_kernel_parity_int8_blocks():
+    """Int8 pool blocks: the kernel's in-kernel dequant == dequantize the
+    whole pool then run the gather reference; quantize∘dequantize is
+    idempotent (the chunk-prefill rewrite-the-view scatter must not
+    drift)."""
+    from automodel_tpu.ops import paged_attention as pa
+
+    kp, vp, tables, lengths = _kernel_case(seed=3)
+    kq, ks = pa.quantize_kv_rows(kp)
+    vq, vs = pa.quantize_kv_rows(vp)
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.normal(size=(3, 2, 4, 16)), jnp.float32)
+    out = pa.paged_attend(q, kq, vq, tables, lengths, ks, vs, interpret=True)
+    kd = pa.dequantize_kv(kq, ks, jnp.float32)
+    vd = pa.dequantize_kv(vq, vs, jnp.float32)
+    ref = _gather_ref(q, kd, vd, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    kq2, ks2 = pa.quantize_kv_rows(pa.dequantize_kv(kq, ks, jnp.float32))
+    assert bool((kq2 == kq).all()) and np.allclose(np.asarray(ks2), np.asarray(ks))
+
+
+def test_fused_engine_greedy_parity(monkeypatch):
+    """End-to-end: the serving engine on the fused kernel (interpret mode)
+    decodes the same greedy tokens as the gather engine and the
+    single-wave reference."""
+    monkeypatch.setenv("AUTOMODEL_FLASH_INTERPRET", "1")
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14, 15, 16, 17]]
+    refs = _greedy_refs(auto, prompts, 6)
+    srv = _serve(auto, decode_kernel="fused")
+    assert srv.decode_backend == "fused"
+    recs = _run(srv, prompts)
+    assert [r["tokens"] for r in recs] == refs
+    srv.pool.check_invariants()
+    assert srv.pool.available() == srv.pool.usable_blocks
+
+
+def test_fused_engine_greedy_parity_sliding_window(monkeypatch):
+    """Windowed model on the fused kernel: the kernel's in-kernel window
+    mask == the per-layer tag-mask gather path."""
+    monkeypatch.setenv("AUTOMODEL_FLASH_INTERPRET", "1")
+    model, params = _tiny_llama(sliding_window=4, num_layers=2)
+    auto = _auto(model, params)
+    prompts = [[1, 2, 3, 4, 5, 6], [7, 8]]
+    gather = _run(_serve(auto, max_new=8, decode_kernel="gather"), prompts)
+    fused = _run(_serve(auto, max_new=8, decode_kernel="fused"), prompts)
+    assert [r["tokens"] for r in fused] == [r["tokens"] for r in gather]
+
+
+# -- int8 KV-cache pool -------------------------------------------------------
+
+
+def test_int8_pool_greedy_tokens_match_fp32():
+    """The quantized pool decodes IDENTICAL greedy tokens to the
+    full-precision pool on the tiny model (per-row scales keep the
+    attention outputs well inside the argmax margin)."""
+    model, params = _tiny_llama(seed=1)
+    auto = _auto(model, params)
+    prompts = [[1, 2, 3, 4, 5], [9, 10, 11], [20, 21, 22, 23, 24, 25]]
+    refs = _greedy_refs(auto, prompts, 6)
+    int8 = _run(_serve(auto, kv_cache_dtype="int8", decode_kernel="gather"), prompts)
+    assert [r["tokens"] for r in int8] == refs
+
+
+def test_int8_pool_fused_matches_gather(monkeypatch):
+    """int8 × fused: quantize-on-write in the paged scatter + in-kernel
+    dequant == the dequantized-gather path, token for token."""
+    monkeypatch.setenv("AUTOMODEL_FLASH_INTERPRET", "1")
+    model, params = _tiny_llama(seed=2)
+    auto = _auto(model, params)
+    prompts = [[5, 6, 7, 8], [30, 31]]
+    gather = _run(_serve(auto, kv_cache_dtype="int8", decode_kernel="gather"), prompts)
+    fused = _run(_serve(auto, kv_cache_dtype="int8", decode_kernel="fused"), prompts)
+    assert [r["tokens"] for r in fused] == [r["tokens"] for r in gather]
+
+
+def test_int8_pool_halves_kv_bytes():
+    """The capacity claim behind kv_cache_dtype: the int8 pool's value
+    arrays are half the bf16-equivalent bytes (scale overhead is 1/(2H)
+    here), so the same HBM budget holds ~2x the blocks."""
+    model, params = _tiny_llama()
+    bf16 = _serve(_auto(model, params))
+    int8 = _serve(_auto(model, params), kv_cache_dtype="int8")
+    # fp32 backend here: values shrink 4x; the general claim is
+    # values_bytes(int8) == values_bytes(dtype)/itemsize
+    assert int8.pool_bytes < bf16.pool_bytes / 2
+    assert int8._pool.quantized and not bf16._pool.quantized
+
+
+# -- speculative decoding -----------------------------------------------------
+
+
+def test_spec_greedy_parity_llama_ragged():
+    """Greedy spec parity, ragged llama batch, an uncorrelated random
+    draft (low accept rate): committed tokens are bit-identical to the
+    non-speculative engine — the rejection rule's exactness guarantee."""
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14, 15, 16, 17], [3, 1]]
+    refs = _greedy_refs(auto, prompts, 6)
+    srv = _serve(auto, spec_k=3)
+    recs = _run(srv, prompts)
+    assert [r["tokens"] for r in recs] == refs
+    assert srv.spec_proposed_total > 0
+    srv.pool.check_invariants()
+    assert srv.pool.available() == srv.pool.usable_blocks
+
+
+def test_spec_greedy_parity_gpt2():
+    from automodel_tpu.models.gpt2.model import GPT2Config, GPT2ForCausalLM
+
+    gpt2 = GPT2ForCausalLM(
+        GPT2Config(vocab_size=96, n_positions=64, hidden_size=32, num_layers=2, num_heads=4),
+        FP32,
+    )
+    auto = _auto(gpt2, gpt2.init(jax.random.key(1)))
+    prompts = [[3, 4, 5, 6], [10, 11]]
+    refs = _greedy_refs(auto, prompts, 5)
+    draft = _draft_section()
+    draft["hf_config"]["vocab_size"] = 96
+    recs = _run(_serve(auto, max_new=5, spec_k=3, draft=draft), prompts)
+    assert [r["tokens"] for r in recs] == refs
+
+
+def test_qwen3_moe_mixed_stack_int8_fused_spec(monkeypatch):
+    """The mixed dense/MoE stack slices its cache sides by LAYER RANGES
+    (dense prefix scan + MoE scan + concat) — with an int8 pool those
+    sides are (values, scales) tuples, which raw tuple slicing would
+    mis-split. Pin the tiniest qwen3_moe through all three levers at once
+    against its own fp32 non-speculative output."""
+    monkeypatch.setenv("AUTOMODEL_FLASH_INTERPRET", "1")
+    from automodel_tpu.models.qwen3_moe import MoEForCausalLM, MoETransformerConfig
+
+    hf = {
+        "architectures": ["Qwen3MoeForCausalLM"], "model_type": "qwen3_moe",
+        "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+        "moe_intermediate_size": 16, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 8,
+        "num_experts": 4, "num_experts_per_tok": 2,
+        "max_position_embeddings": 128, "tie_word_embeddings": False,
+        "first_k_dense_replace": 1,  # 1 dense + 1 MoE: both scan ranges live
+    }
+    moe = MoEForCausalLM(
+        MoETransformerConfig.from_hf(hf),
+        BackendConfig(
+            attn="sdpa", experts="dense",
+            param_dtype="float32", compute_dtype="float32",
+        ),
+    )
+    auto = _auto(moe, moe.init(jax.random.key(2)))
+    prompts = [[7, 8, 9, 10], [20, 21]]
+    base = _run(_serve(auto, max_new=4), prompts)
+    spec = _run(
+        _serve(
+            auto, max_new=4, spec_k=3,
+            kv_cache_dtype="int8", decode_kernel="fused",
+        ),
+        prompts,
+    )
+    assert [r["tokens"] for r in spec] == [r["tokens"] for r in base]
+
+
+@pytest.mark.slow
+def test_spec_greedy_parity_qwen3_moe():
+    from automodel_tpu.models.qwen3_moe import MoEForCausalLM, MoETransformerConfig
+
+    hf = {
+        "architectures": ["Qwen3MoeForCausalLM"], "model_type": "qwen3_moe",
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+        "moe_intermediate_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+        "num_experts": 8, "num_experts_per_tok": 2,
+        "max_position_embeddings": 256, "tie_word_embeddings": False,
+        "first_k_dense_replace": 1,
+    }
+    moe = MoEForCausalLM(
+        MoETransformerConfig.from_hf(hf),
+        BackendConfig(
+            attn="sdpa", experts="dense",
+            param_dtype="float32", compute_dtype="float32",
+        ),
+    )
+    auto = _auto(moe, moe.init(jax.random.key(2)))
+    prompts = [[7, 8, 9, 10], [20, 21, 22]]
+    refs = _greedy_refs(auto, prompts, 5)
+    draft = _draft_section()
+    draft["hf_config"]["vocab_size"] = 128
+    recs = _run(_serve(auto, max_new=5, spec_k=3, draft=draft), prompts)
+    assert [r["tokens"] for r in recs] == refs
+
+
+def test_spec_parity_fused_int8_compound(monkeypatch):
+    """All three levers at once — speculative decoding over an int8 pool
+    through the fused kernel — still bit-identical greedy tokens."""
+    monkeypatch.setenv("AUTOMODEL_FLASH_INTERPRET", "1")
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9]]
+    # reference: the same int8 pool WITHOUT speculation (quantization
+    # shifts logits slightly, so the exactness contract is spec-vs-nonspec
+    # at equal pool precision; int8-vs-fp32 equality is pinned separately)
+    base = _run(
+        _serve(auto, kv_cache_dtype="int8", decode_kernel="fused"), prompts
+    )
+    spec = _run(
+        _serve(auto, spec_k=3, kv_cache_dtype="int8", decode_kernel="fused"),
+        prompts,
+    )
+    assert [r["tokens"] for r in spec] == [r["tokens"] for r in base]
+
+
+def test_spec_self_draft_accepts_everything_and_stamps_records():
+    """A draft with the TARGET's own weights agrees everywhere: accept
+    rate 1.0, per-request records carry spec_accepted/spec_accept_rate,
+    run_workload reports accept_rate/draft_tps, /metrics exposes the
+    counters + gauge."""
+    model, params = _tiny_llama(num_layers=2)
+    auto = _auto(model, params)
+    draft = _draft_section(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+    )
+    srv = _serve(auto, max_new=9, spec_k=3, draft=draft)
+    srv.draft_auto.params = params  # self-draft: identical proposals
+    arrivals = [(0.0, [1, 2, 3, 4, 5], 9), (0.0, [7, 8, 9], 9)]
+    done, stats = srv.run_workload(arrivals)
+    assert srv.spec_accept_rate == 1.0
+    assert stats["accept_rate"] == 1.0
+    assert stats["spec_proposed"] == stats["spec_accepted"] > 0
+    # rounds count propose+verify WAVES, not slot-rounds: with two slots
+    # decoding concurrently, rounds must sit strictly below proposed / k
+    assert 0 < srv.spec_rounds < srv.spec_proposed_total // 3
+    assert stats["draft_tps"] > 0
+    for rec in done:
+        assert rec["spec_accept_rate"] == 1.0
+        assert rec["spec_accepted"] == rec["spec_proposed"]
+    srv.metrics.sync(srv)
+    rendered = srv.metrics.registry.render()
+    assert "automodel_serve_spec_accepted_total" in rendered
+    assert "automodel_serve_spec_rejected_total 0" in rendered
+    assert "automodel_serve_spec_accept_rate 1\n" in rendered
+
+
+def test_spec_eos_inside_accepted_block_terminates_exactly():
+    """A stop token committed mid-round (inside the accepted prefix)
+    truncates the completion exactly where the non-speculative engine
+    stops — never decodes past eos."""
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    prompts = [[1, 2, 3, 4, 5]]
+    ref = _greedy_refs(auto, prompts, 8)[0]
+    eos = ref[2]  # force a stop mid-stream
+    gen = GenerationConfig(max_new_tokens=8, greedy=True, eos_token_id=int(eos))
+    draft = _draft_section(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+    )
+    spec = SpeculativeConfig(enabled=True, k=4, draft=draft)
+    srv = ServingEngine(
+        auto,
+        ServeConfig(slots=2, block_size=4, num_blocks=48, prefill_chunk=4,
+                    max_seq_len=48, speculative=spec),
+        gen,
+    )
+    srv.draft_auto.params = params  # all-accept → eos lands inside a block
+    rec = _run(srv, prompts)[0]
+    assert rec["completion_reason"] == "stop"
+    assert rec["tokens"] == ref[: ref.index(eos) + 1]
+    srv.pool.check_invariants()
+    assert srv.pool.available() == srv.pool.usable_blocks
+
+
+def test_spec_rollback_invariants_randomized_schedule():
+    """A noisy-copy draft produces a genuinely mixed accept/reject
+    schedule; with ``spec_k > block_size`` every rejection rolls back
+    across a block boundary. BlockPool invariants audited after EVERY
+    engine step, parity still exact, pool drains to fully available."""
+    model, params = _tiny_llama(num_layers=2)
+    auto = _auto(model, params)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, 64, size=int(n)).tolist()
+        for n in rng.integers(2, 9, size=6)
+    ]
+    refs = _greedy_refs(auto, prompts, 7)
+    draft = _draft_section(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+    )
+    srv = _serve(auto, max_new=7, spec_k=6, draft=draft)  # k=6 > block_size=4
+    noisy = jax.tree.map(
+        lambda x: x + 0.05 * jax.random.normal(jax.random.key(9), x.shape, x.dtype),
+        params,
+    )
+    srv.draft_auto.params = noisy  # agrees often, not always
+    ids = [srv.submit(p) for p in prompts]
+    done = {}
+    for _ in range(10_000):
+        if srv.idle():
+            break
+        for rec in srv.step():
+            done[rec["request_id"]] = rec
+        srv.pool.check_invariants()  # after every rollback
+    assert [done[i]["tokens"] for i in ids] == refs
+    accepted, proposed = srv.spec_accepted_total, srv.spec_proposed_total
+    assert 0 < accepted < proposed, (
+        f"schedule not mixed: {accepted}/{proposed} — tune the noise"
+    )
+    assert srv.pool.available() == srv.pool.usable_blocks
+
+
+def test_spec_config_validation_draft_mismatch():
+    """Loud refusals: missing draft, vocab mismatch, cache-less draft."""
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    with pytest.raises(ValueError, match="draft"):
+        SpeculativeConfig(enabled=True)
+    bad_vocab = _draft_section(vocab_size=32)
+    with pytest.raises(ValueError, match="vocab"):
+        _serve(auto, spec_k=2, draft=bad_vocab)
+
+
+def test_decode_backend_resolution(monkeypatch, tmp_path):
+    """auto: env beats config beats autotune entry beats platform default
+    (gather on CPU without interpret; fused with interpret)."""
+    from automodel_tpu.ops import autotune
+
+    model, params = _tiny_llama()
+    auto = _auto(model, params)
+    monkeypatch.delenv("AUTOMODEL_FLASH_INTERPRET", raising=False)
+    monkeypatch.delenv("AUTOMODEL_PAGED_DECODE", raising=False)
+    assert _serve(auto).decode_backend == "gather"  # CPU default
+    monkeypatch.setenv("AUTOMODEL_FLASH_INTERPRET", "1")
+    assert _serve(auto).decode_backend == "fused"  # kernel can run
+    # an autotune entry for this (head_dim, block_size, dtype) wins over
+    # the platform default
+    table = tmp_path / "autotune.json"
+    autotune.save_table(
+        table, {autotune.paged_key(8, 4, "bf16"): {"backend": "gather"}}
+    )
+    monkeypatch.setenv(autotune.ENV_TABLE, str(table))
+    autotune.clear_cache()
+    try:
+        assert _serve(auto).decode_backend == "gather"
+        # explicit config and env still beat the table
+        assert _serve(auto, decode_kernel="fused").decode_backend == "fused"
+        monkeypatch.setenv("AUTOMODEL_PAGED_DECODE", "fused")
+        assert _serve(auto).decode_backend == "fused"
+    finally:
+        autotune.clear_cache()
+
+
+# -- bench leg + CLI wiring ---------------------------------------------------
+
+
+def test_bench_serving_leg_spec_ab_end_to_end(cpu_devices, monkeypatch):
+    """Acceptance: the Poisson serving bench leg runs e2e on CPU with
+    spec-decode ON and the interpret-gated fused kernel, reporting
+    serve_accept_rate + a spec-on/off A/B, strict-valid."""
+    monkeypatch.setattr(jax, "devices", lambda *a: cpu_devices[:1])
+    monkeypatch.setenv("AUTOMODEL_FLASH_INTERPRET", "1")
+    from automodel_tpu.config.loader import ConfigNode
+    from automodel_tpu.recipes.benchmark import (
+        BenchmarkingRecipeForNextTokenPrediction as Bench,
+    )
+    from automodel_tpu.telemetry.report import validate_bench_result
+
+    cfg = ConfigNode(
+        {
+            "seed": 1,
+            "model": {
+                "hf_config": {
+                    "architectures": ["LlamaForCausalLM"],
+                    "model_type": "llama",
+                    "vocab_size": 128, "hidden_size": 32,
+                    "intermediate_size": 64, "num_hidden_layers": 2,
+                    "num_attention_heads": 4, "num_key_value_heads": 2,
+                    "head_dim": 8, "max_position_embeddings": 128,
+                },
+                "backend": {
+                    "attn": "sdpa", "param_dtype": "float32",
+                    "compute_dtype": "float32",
+                },
+            },
+            "distributed": {"dp_shard": 1},
+            "dataset": {
+                "_target_": "automodel_tpu.data.sft.MockSFTDataset",
+                "vocab_size": 128, "seq_length": 16, "num_samples": 16,
+            },
+            "dataloader": {"global_batch_size": 4},
+            "step_scheduler": {"max_steps": 2},
+            "optimizer": {"name": "adamw", "lr": 1e-3},
+            "benchmark": {"warmup_steps": 1, "measure_steps": 1},
+            "serving": {
+                "slots": 2, "block_size": 4, "num_blocks": 64,
+                "prefill_chunk": 8, "max_seq_len": 64,
+                "kv_cache_dtype": "int8", "decode_kernel": "fused",
+                "bench_requests": 3, "bench_rate": 50.0,
+                "bench_prompt_len_min": 2, "bench_prompt_len_max": 8,
+                "bench_max_new_tokens": 3,
+                "speculative": {
+                    "enabled": True, "k": 2,
+                    "draft": {
+                        "hf_config": {
+                            "architectures": ["LlamaForCausalLM"],
+                            "model_type": "llama",
+                            "vocab_size": 128, "hidden_size": 16,
+                            "intermediate_size": 32, "num_hidden_layers": 1,
+                            "num_attention_heads": 2, "num_key_value_heads": 1,
+                            "head_dim": 8, "max_position_embeddings": 128,
+                        },
+                        "backend": {
+                            "attn": "sdpa", "param_dtype": "float32",
+                            "compute_dtype": "float32",
+                        },
+                    },
+                },
+            },
+        }
+    )
+    recipe = Bench(cfg)
+    recipe.setup()
+    result = recipe.run_benchmark()
+    assert result["serve_failure"] is None
+    assert result["serve_spec_failure"] is None
+    assert result["serve_tokens_per_s"] > 0
+    assert isinstance(result["serve_accept_rate"], float)
+    assert result["serve_draft_tps"] > 0
+    assert result["serve_decode_backend"] == "fused"
+    assert result["serve_kv_cache_dtype"] == "int8"
+    ab = result["serve_spec_ab"]
+    assert ab["spec_on_tokens_per_s"] > 0 and ab["spec_off_tokens_per_s"] > 0
+    assert validate_bench_result(result) == []
+
+
+def test_serve_cli_spec_example_yaml_e2e(tmp_path, capsys, monkeypatch, cpu_devices):
+    """The committed serve_tiny_cpu_spec.yaml drives the stdin CLI end to
+    end: speculative engine, int8 pool, per-request spec keys on the
+    metrics JSONL, report --strict clean."""
+    import io
+    from pathlib import Path
+
+    monkeypatch.setattr(jax, "devices", lambda *a: cpu_devices[:1])
+    from automodel_tpu.config.loader import load_yaml_config
+
+    yaml_path = (
+        Path(__file__).resolve().parent.parent
+        / "examples" / "generation" / "serve_tiny_cpu_spec.yaml"
+    )
+    cfg = load_yaml_config(yaml_path)
+    cfg = type(cfg)(
+        {**cfg.to_dict(), "logging": {"metrics_path": str(tmp_path / "m.jsonl")}}
+    )
+    monkeypatch.setattr(
+        "sys.stdin",
+        io.StringIO(
+            json.dumps({"id": "a", "prompt": "1 2 3"}) + "\n"
+            + json.dumps({"id": "b", "prompt_ids": [7, 8], "max_new_tokens": 4}) + "\n"
+        ),
+    )
+    from automodel_tpu.serving.server import main
+
+    rc = main(cfg)
+    assert rc == 0
+    out_lines = [
+        json.loads(l) for l in capsys.readouterr().out.splitlines()
+        if l.startswith("{")
+    ]
+    by_id = {r["request_id"]: r for r in out_lines}
+    assert set(by_id) == {"a", "b"}
+    assert by_id["b"]["n_generated"] == 4
+    assert "spec_accept_rate" in by_id["a"]
+    from automodel_tpu.telemetry.report import lint_metrics_jsonl, summarize_metrics
+
+    records, problems = lint_metrics_jsonl(str(tmp_path / "m.jsonl"))
+    assert problems == []
+    summary = summarize_metrics(records)
+    assert summary["serve_requests"] == 2
+    assert "serve_accept_rate" in summary
+
+
+def test_kernel_bench_paged_family_cpu_e2e(tmp_path, monkeypatch):
+    """tools/kernel_bench.py --skip-moe --skip-attention runs the paged
+    family through the interpreter: fused + gather candidates both gate,
+    rows carry the kernel_* keys, JSONL lints clean."""
+    monkeypatch.chdir(tmp_path)
+    import tools.kernel_bench as kb
+
+    rc = kb.main([
+        "--skip-moe", "--skip-attention", "--output-dir", str(tmp_path / "kb"),
+    ])
+    assert rc == 0
+    from automodel_tpu.telemetry.report import lint_metrics_jsonl
+
+    records, problems = lint_metrics_jsonl(str(tmp_path / "kb" / "kernel_bench.jsonl"))
+    assert problems == []
+    rows = [r for r in records if r.get("event") == "kernel_bench"]
+    backends = {r.get("kernel_backend") for r in rows}
+    assert {"fused", "gather"} <= backends
+    assert all(r["ok"] for r in rows), [r.get("error") for r in rows if not r["ok"]]
+    assert any(r["autotune_key"].startswith("paged:") for r in rows)
+    md = (tmp_path / "kb" / "KERNEL_BENCH.md").read_text()
+    assert "paged_attention" in md

@@ -432,6 +432,117 @@ def sweep_attention(sw: Sweep, small: bool, reps: int):
             }
 
 
+# -- paged-attention race (serving decode: fused Pallas kernel vs gather) ----
+
+
+def sweep_paged_attention(sw: Sweep, small: bool, reps: int):
+    """Race the fused paged-attention decode kernel
+    (ops/paged_attention.py) against the XLA gather → sdpa_decode → scatter
+    baseline across (block_size, table width, kv dtype) candidates — the
+    serving per-token hot path. Winners land as ``backend`` entries under
+    ``autotune.paged_key`` that ``serving.decode_kernel: auto`` consults."""
+    from automodel_tpu.ops import autotune
+    from automodel_tpu.ops import paged_attention as pa
+    from automodel_tpu.ops.attention import sdpa_decode
+
+    interpret = not sw.on_tpu
+    rng = np.random.default_rng(2)
+    cd = jnp.float32 if small else jnp.bfloat16
+    eps = jnp.asarray(1e-3, jnp.float32)
+    cases = (
+        [dict(B=2, BS=8, NBseq=3, Nkv=2, N=4, H=16)] if small
+        else [
+            # llama3-8B decode fingerprint: 8 kv heads, head_dim 128, a
+            # 2k-token view at two block granularities
+            dict(B=8, BS=16, NBseq=128, Nkv=8, N=32, H=128),
+            dict(B=8, BS=32, NBseq=64, Nkv=8, N=32, H=128),
+        ]
+    )
+    for case in cases:
+        B, BS, NBseq = case["B"], case["BS"], case["NBseq"]
+        Nkv, N, H = case["Nkv"], case["N"], case["H"]
+        NB = B * NBseq + 2
+        Cv = NBseq * BS
+        pool_k = jnp.asarray(rng.normal(size=(NB, BS, Nkv, H)), cd)
+        pool_v = jnp.asarray(rng.normal(size=(NB, BS, Nkv, H)), cd)
+        tables = jnp.asarray(
+            1 + rng.permutation(NB - 2)[: B * NBseq].reshape(B, NBseq),
+            jnp.int32,
+        )
+        lengths = jnp.asarray(
+            rng.integers(Cv // 2, Cv - 1, size=(B,)), jnp.int32
+        )
+        q0 = jnp.asarray(rng.normal(size=(B, 1, N, H)), jnp.float32)
+        mean_len = float(jnp.mean(lengths))
+        flops = 2 * 2 * B * N * H * mean_len  # qk + pv per decoded token
+        j = jnp.arange(Cv, dtype=jnp.int32)
+        kv_mask = j[None, :] <= lengths[:, None]
+
+        for dtype_label in ("bf16", "int8"):
+            key = autotune.paged_key(H, BS, dtype_label)
+            kernel = f"paged_attention_h{H}_bs{BS}_{dtype_label}"
+            if dtype_label == "int8":
+                kq, ks = pa.quantize_kv_rows(pool_k)
+                vq, vs = pa.quantize_kv_rows(pool_v)
+            else:
+                kq = vq = ks = vs = None
+
+            def fused_fn(c, *a):
+                if dtype_label == "int8":
+                    out = pa.paged_attend(
+                        c.astype(cd), kq, vq, tables, lengths, ks, vs,
+                        interpret=interpret,
+                    )
+                else:
+                    out = pa.paged_attend(
+                        c.astype(cd), pool_k, pool_v, tables, lengths,
+                        interpret=interpret,
+                    )
+                return c + out.astype(jnp.float32) * eps
+
+            def gather_fn(c, *a):
+                if dtype_label == "int8":
+                    view_k = pa.dequantize_kv(kq[tables], ks[tables], cd)
+                    view_v = pa.dequantize_kv(vq[tables], vs[tables], cd)
+                else:
+                    view_k, view_v = pool_k[tables], pool_v[tables]
+                out = sdpa_decode(
+                    c.astype(cd),
+                    view_k.reshape(B, Cv, Nkv, H),
+                    view_v.reshape(B, Cv, Nkv, H),
+                    kv_mask=kv_mask,
+                )
+                return c + out.astype(jnp.float32) * eps
+
+            cand = {"table_width": NBseq}
+            passed: dict[str, dict] = {}
+            it = jnp.dtype(jnp.int8 if dtype_label == "int8" else cd).itemsize
+            for backend, fn in (("fused", fused_fn), ("gather", gather_fn)):
+                if backend == "fused" and not pa._paged_budget_ok(
+                    BS, Nkv, H, 1, N // Nkv, it, dtype_label == "int8"
+                ):
+                    continue
+                ok = _run_candidate(
+                    sw, key=key, kernel=kernel, cand=cand, flops=flops,
+                    fn=fn, c0=q0, reps=max(4, reps // 4), backend=backend,
+                    use_table=False, persist=sw.on_tpu,
+                )
+                if ok:
+                    passed.setdefault(backend, cand)
+            if not sw.on_tpu and len(passed) == 1:
+                # same rule as the attention race: off-TPU there is no
+                # timing, so persist only a capability result
+                backend, c = next(iter(passed.items()))
+                sw.winners[key] = {
+                    **c, "backend": backend, "measured": False,
+                    "source": (
+                        f"kernel_bench {time.strftime('%Y-%m-%d')} (interpret "
+                        "gate: only viable backend on this build, not raced)"
+                    ),
+                    "_score": -1.0,
+                }
+
+
 # -- report ------------------------------------------------------------------
 
 
@@ -488,6 +599,7 @@ def main(argv=None) -> int:
                          "autotune_defaults.json for this chip kind")
     ap.add_argument("--skip-attention", action="store_true")
     ap.add_argument("--skip-moe", action="store_true")
+    ap.add_argument("--skip-paged", action="store_true")
     args = ap.parse_args(argv)
 
     from automodel_tpu.loggers.metric_logger import MetricLogger
@@ -514,6 +626,8 @@ def main(argv=None) -> int:
         sweep_moe_backward(sw, small, args.reps)
     if not args.skip_attention:
         sweep_attention(sw, small, args.reps)
+    if not args.skip_paged:
+        sweep_paged_attention(sw, small, args.reps)
 
     entries = sw.table_entries()
     safe_chip = chip.replace(" ", "_").replace("/", "_")
