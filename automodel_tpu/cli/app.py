@@ -23,6 +23,7 @@ def _usage() -> str:
         "-c config.yaml [--dotted.key=value ...]\n"
         "       automodel_tpu generate -c config.yaml [--prompt '...'] [--dotted.key=value ...]\n"
         "       automodel_tpu serve -c config.yaml [--dotted.key=value ...]  (stdin-JSONL; serving.http.port for HTTP; GET /metrics /healthz /readyz; SIGTERM drains gracefully)\n"
+        "       automodel_tpu route -c config.yaml [--dotted.key=value ...]  (fleet router over N serve replicas: fleet.replicas/fleet.dns; prefix-affinity + retry; same HTTP front contract)\n"
         "       automodel_tpu profile -c config.yaml [--profiling.mode=train|generate] [--dotted.key=value ...]\n"
         "       automodel_tpu report <train_metrics.jsonl> [--strict]\n"
         "       automodel_tpu verify-ckpt <ckpt_dir> [--no-checksums] [--json]"
@@ -84,6 +85,15 @@ def main(argv: list[str] | None = None) -> int:
         cfg = parse_args_and_load_config(argv[1:])
         initialize_distributed()
         return serve_main(cfg)
+    # `route` runs the fleet router (serving/fleet/router.py): spreads
+    # requests over N `serve` replicas with prefix-affinity placement,
+    # disaggregated prefill/decode, and failure-aware retry. No model is
+    # built and no device runtime initializes — a router needs no chip.
+    if argv and argv[0] == "route":
+        from automodel_tpu.serving.fleet.router import main as route_main
+
+        cfg = parse_args_and_load_config(argv[1:])
+        return route_main(cfg)
     # `profile` opens a jax.profiler trace window around N steps of the
     # configured workload and GENERATES the PROFILE artifacts (structured
     # report.json + PROFILE.md) — telemetry/profiling/runner.py

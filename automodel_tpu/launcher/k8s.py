@@ -192,3 +192,207 @@ def submit(
     if apply:
         subprocess.run(["kubectl", "apply", "-f", str(path)], check=True)
     return path
+
+
+# -- serving fleet (docs/serving.md "Fleet") ---------------------------------
+#
+# Topology: one router Deployment (no TPU — placement is pure python) in
+# front of role-labelled replica StatefulSets behind a headless Service.
+# The router discovers replica pods by resolving the Service name each
+# probe cycle (fleet.dns), so scale-ups join and deleted pods leave without
+# a router restart. Probes are the PR 9 endpoints every replica (and the
+# router itself) serves: /readyz gates load-balancer membership (false
+# while draining / before the first compiled decode), /healthz restarts a
+# wedged pod. terminationGracePeriodSeconds must stay above
+# serving.drain.grace_s so SIGTERM drains finish before SIGKILL.
+
+FLEET_SERVICE_TEMPLATE = """\
+apiVersion: v1
+kind: Service
+metadata:
+  name: {name}-replicas
+spec:
+  clusterIP: None  # headless: one A record per replica pod (fleet.dns)
+  selector:
+    app: {name}
+  ports:
+    - name: http
+      port: {replica_port}
+"""
+
+FLEET_REPLICA_TEMPLATE = """\
+apiVersion: apps/v1
+kind: StatefulSet
+metadata:
+  name: {name}-{role}
+spec:
+  serviceName: {name}-replicas
+  replicas: {replicas}
+  selector:
+    matchLabels:
+      app: {name}
+      role: {role}
+  template:
+    metadata:
+      labels:
+        app: {name}
+        role: {role}
+    spec:
+      terminationGracePeriodSeconds: {termination_grace_s}
+      nodeSelector:
+        cloud.google.com/gke-tpu-accelerator: {accelerator}
+        cloud.google.com/gke-tpu-topology: {topology}
+      containers:
+        - name: serve
+          image: {image}
+          command: ["python", "-m", "automodel_tpu.cli.app", "serve", "-c", "{config_path}", "--serving.role={role}", "--serving.http.port={replica_port}", "--serving.http.host=0.0.0.0", "--serving.kv_transfer.port={kv_port}", "--serving.kv_transfer.host=0.0.0.0"]
+          ports:
+            - containerPort: {replica_port}
+            - containerPort: {kv_port}
+          readinessProbe:
+            httpGet: {{path: /readyz, port: {replica_port}}}
+            periodSeconds: 5
+          livenessProbe:
+            httpGet: {{path: /healthz, port: {replica_port}}}
+            periodSeconds: 10
+            failureThreshold: 6
+          resources:
+            requests:
+              google.com/tpu: "{chips_per_host}"
+            limits:
+              google.com/tpu: "{chips_per_host}"
+          env:
+            - name: JAX_PLATFORMS
+              value: "tpu"
+{extra_env}
+"""
+
+FLEET_ROUTER_TEMPLATE = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {name}-router
+spec:
+  replicas: 1
+  selector:
+    matchLabels:
+      app: {name}-router
+  template:
+    metadata:
+      labels:
+        app: {name}-router
+    spec:
+      terminationGracePeriodSeconds: {termination_grace_s}
+      containers:
+        - name: route
+          image: {image}
+          command: ["python", "-m", "automodel_tpu.cli.app", "route", "-c", "{config_path}", "--fleet.dns={name}-replicas", "--fleet.dns_port={replica_port}", "--fleet.port={router_port}", "--fleet.host=0.0.0.0"]
+          ports:
+            - containerPort: {router_port}
+          readinessProbe:
+            httpGet: {{path: /readyz, port: {router_port}}}
+            periodSeconds: 5
+          livenessProbe:
+            httpGet: {{path: /healthz, port: {router_port}}}
+            periodSeconds: 10
+            failureThreshold: 6
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: {name}-router
+spec:
+  selector:
+    app: {name}-router
+  ports:
+    - name: http
+      port: {router_port}
+"""
+
+
+@dataclasses.dataclass
+class K8sFleetConfig:
+    """The ``k8s_fleet:`` section — router + role-labelled replica sets.
+    Roles with count 0 render no StatefulSet; a prefill/decode split plus
+    ``mixed: 0`` is the disaggregated topology, ``mixed: N`` alone is the
+    affinity-routed homogeneous fleet."""
+
+    name: str = "automodel-serve"
+    image: str = "python:3.12"
+    accelerator: str = "tpu-v5e-slice"
+    topology: str = "2x2"
+    chips_per_host: int = 4
+    router_port: int = 8000
+    replica_port: int = 8100
+    kv_port: int = 8200  # decode replicas' KV-transfer listener
+    mixed: int = 2
+    prefill: int = 0
+    decode: int = 0
+    env: Optional[dict] = None
+    manifest_dir: str = "k8s"
+    # must exceed serving.drain.grace_s (replica) / fleet.drain_grace_s
+    # (router) — same rule as the single-engine notes above
+    termination_grace_s: int = 90
+
+
+def render_fleet_manifest(cfg: K8sFleetConfig, config_path: str) -> str:
+    """One multi-document YAML: headless Service + one StatefulSet per
+    non-empty role + the router Deployment/Service. ``config_path`` must
+    exist inside the image (same contract as render_manifest)."""
+    if cfg.mixed + cfg.prefill + cfg.decode < 1:
+        raise ValueError("k8s_fleet: needs at least one replica in some role")
+    if cfg.prefill > 0 and cfg.decode < 1:
+        # mixed pods do NOT run the KV-transfer listener (server.py
+        # auto-enables it only for role decode), so prefill+mixed would
+        # render a fleet whose prefill chips can never hand KV off —
+        # idle TPU pods with no error anywhere. Refuse at render time.
+        raise ValueError(
+            "k8s_fleet: prefill replicas need a decode pool to stream KV "
+            "to (mixed replicas run no KV-transfer listener)"
+        )
+    extra_env = ""
+    for k, v in (cfg.env or {}).items():
+        extra_env += f'            - name: {k}\n              value: "{v}"\n'
+    docs = [
+        FLEET_SERVICE_TEMPLATE.format(
+            name=cfg.name, replica_port=cfg.replica_port
+        )
+    ]
+    for role, count in (
+        ("mixed", cfg.mixed), ("prefill", cfg.prefill), ("decode", cfg.decode)
+    ):
+        if count < 1:
+            continue
+        docs.append(
+            FLEET_REPLICA_TEMPLATE.format(
+                name=cfg.name, role=role, replicas=count, image=cfg.image,
+                accelerator=cfg.accelerator, topology=cfg.topology,
+                chips_per_host=cfg.chips_per_host,
+                replica_port=cfg.replica_port, kv_port=cfg.kv_port,
+                termination_grace_s=cfg.termination_grace_s,
+                config_path=config_path,
+                extra_env=extra_env.rstrip("\n"),
+            )
+        )
+    docs.append(
+        FLEET_ROUTER_TEMPLATE.format(
+            name=cfg.name, image=cfg.image, router_port=cfg.router_port,
+            replica_port=cfg.replica_port,
+            termination_grace_s=cfg.termination_grace_s,
+            config_path=config_path,
+        )
+    )
+    return "---\n".join(docs)
+
+
+def submit_fleet(
+    cfg: K8sFleetConfig, config_path: str, apply: bool = True
+) -> Path:
+    """Write the fleet manifest; `kubectl apply` when requested."""
+    out = Path(cfg.manifest_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    path = out / f"{cfg.name}-fleet.yaml"
+    path.write_text(render_fleet_manifest(cfg, config_path))
+    if apply:
+        subprocess.run(["kubectl", "apply", "-f", str(path)], check=True)
+    return path

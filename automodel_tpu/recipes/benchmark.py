@@ -160,6 +160,14 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
         # → null values WITH a recorded reason, never a silent 0.0.
         with self.guard.phase("eval"):
             result.update(self._serving_leg())
+        # routed fleet sub-leg (serving/fleet/): the SAME Poisson arrivals
+        # replayed through a router over >= 2 local replicas — the
+        # routed-vs-single A/B that prices the fleet tier. Gated on a
+        # `fleet:` section; degrades null-with-reason like every other leg.
+        with self.guard.phase("eval"):
+            result.update(
+                self._fleet_leg(result.get("serve_tokens_per_s"))
+            )
         # cost attribution (telemetry/profiling/cost.py): measured FLOPs of
         # the ACTUAL step program beside the analytic law the `mfu` key is
         # built from — plus the roofline class for this leg. Drift between
@@ -241,6 +249,31 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             "gen_failure": None,
         }
 
+    def _poisson_arrivals(self, scfg) -> list:
+        """The serving legs' shared workload: Poisson arrivals over mixed-
+        length random prompts, deterministically derived from seed 0 — the
+        single-replica leg and the routed fleet sub-leg replay EXACTLY the
+        same (offset, prompt, budget) list, so their tokens/s compare."""
+        vocab = int(self.model.config.vocab_size)
+        rng = np.random.default_rng(0)
+        lens = rng.integers(
+            scfg.bench_prompt_len_min,
+            scfg.bench_prompt_len_max + 1,
+            size=scfg.bench_requests,
+        )
+        gaps = rng.exponential(
+            1.0 / max(scfg.bench_rate, 1e-6), size=scfg.bench_requests
+        )
+        offsets = np.cumsum(gaps) - gaps[0]  # first arrives at t=0
+        return [
+            (
+                float(offsets[i]),
+                rng.integers(1, vocab, size=int(lens[i])).tolist(),
+                scfg.bench_max_new_tokens,
+            )
+            for i in range(scfg.bench_requests)
+        ]
+
     def _serving_leg(self) -> dict:
         """→ {serve_tokens_per_s, serve_ttft_p50_s, serve_ttft_p99_s,
         serve_block_occupancy_peak, serve_requests, serve_failure}.
@@ -287,30 +320,17 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             auto = self.auto
             params0 = auto.params
             auto.params = self.state.params
+            engine = off_engine = None
             try:
                 engine = ServingEngine(auto, scfg, gen_cfg)
                 vocab = int(self.model.config.vocab_size)
-                rng = np.random.default_rng(0)
-                lens = rng.integers(
-                    scfg.bench_prompt_len_min,
-                    scfg.bench_prompt_len_max + 1,
-                    size=scfg.bench_requests,
-                )
-                gaps = rng.exponential(
-                    1.0 / max(scfg.bench_rate, 1e-6), size=scfg.bench_requests
-                )
-                offsets = np.cumsum(gaps) - gaps[0]  # first arrives at t=0
-                arrivals = [
-                    (
-                        float(offsets[i]),
-                        rng.integers(1, vocab, size=int(lens[i])).tolist(),
-                        scfg.bench_max_new_tokens,
-                    )
-                    for i in range(scfg.bench_requests)
-                ]
+                arrivals = self._poisson_arrivals(scfg)
+                rng = np.random.default_rng(1)
                 # warm-up: compile chunk prefill + decode outside the window
                 engine.submit(
-                    rng.integers(1, vocab, size=int(lens[0])).tolist(),
+                    rng.integers(
+                        1, vocab, size=len(arrivals[0][1])
+                    ).tolist(),
                     max_new_tokens=2,
                 )
                 engine.run()
@@ -337,7 +357,9 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
                     )
                     off_engine = ServingEngine(auto, off_cfg, gen_cfg)
                     off_engine.submit(
-                        rng.integers(1, vocab, size=int(lens[0])).tolist(),
+                        rng.integers(
+                            1, vocab, size=len(arrivals[0][1])
+                        ).tolist(),
                         max_new_tokens=2,
                     )
                     off_engine.run()
@@ -353,6 +375,11 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
                     }
             finally:
                 auto.params = params0
+                # free the leg's pool HBM before the next leg (the routed
+                # fleet sub-leg builds N replica pools of its own)
+                for obj in (engine, off_engine):
+                    if obj is not None:
+                        obj.release_pools()
         except Exception as e:
             reason = f"{type(e).__name__}: {e}"
             return {**nulls, "serve_failure": reason, "serve_spec_failure": reason}
@@ -384,6 +411,156 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             out["serve_draft_tps"] = None
             out["serve_spec_failure"] = "speculative decoding disabled"
         return out
+
+    def _fleet_leg(self, single_tps) -> dict:
+        """→ {serve_fleet_tokens_per_s, serve_route_prefix_hit_rate,
+        serve_fleet_retries, serve_fleet_ab, serve_fleet_failure}.
+
+        The routed sub-leg: ``fleet.bench_replicas`` local replicas (each a
+        real ServingEngine behind a real HTTP front, sharing the current
+        weights), a Router probing and placing over them, and EXACTLY the
+        same Poisson arrivals as the single-replica leg driven through
+        POST /generate — the routed-vs-single A/B. Replica pools split the
+        single leg's block budget (``fleet.bench_num_blocks`` overrides),
+        so the comparison holds the pool HBM constant."""
+        nulls = {
+            "serve_fleet_tokens_per_s": None,
+            "serve_route_prefix_hit_rate": None,
+            "serve_fleet_retries": None,
+        }
+        fleet_section = self.cfg.get("fleet")
+        if fleet_section is None:
+            return {**nulls, "serve_fleet_failure": "no fleet: section in config"}
+        if self.cfg.get("serving") is None:
+            return {
+                **nulls,
+                "serve_fleet_failure": "no serving: section in config",
+            }
+        if self.peft_config is not None:
+            return {
+                **nulls,
+                "serve_fleet_failure": (
+                    "serving with peft adapters is not supported (merge first)"
+                ),
+            }
+        import dataclasses as _dc
+        import threading
+
+        engines, servers, loops, router = [], [], [], None
+        try:
+            from automodel_tpu.generation.engine import GenerationConfig
+            from automodel_tpu.serving.engine import ServeConfig, ServingEngine
+            from automodel_tpu.serving.fleet.router import FleetConfig, Router
+            from automodel_tpu.serving.server import serve_http
+
+            fcfg = FleetConfig.from_dict(dict(fleet_section or {}))
+            scfg = ServeConfig.from_dict(dict(self.cfg.get("serving") or {}))
+            n = fcfg.bench_replicas
+            per_blocks = fcfg.bench_num_blocks or max(
+                scfg.num_blocks // n, scfg.slots * scfg.table_blocks + 2
+            )
+            # replicas run non-speculative: the fleet A/B prices ROUTING,
+            # and N draft pools would multiply the leg's HBM footprint
+            rcfg = _dc.replace(
+                scfg, num_blocks=per_blocks,
+                speculative=_dc.replace(
+                    scfg.speculative, enabled=False, draft=None
+                ),
+            )
+            gcfg = getattr(self, "_gen_section", None)
+            gen_cfg = GenerationConfig.from_dict(
+                {
+                    k: v
+                    for k, v in dict(gcfg or {}).items()
+                    if k not in ("prompts", "prompt_ids", "tokenizer", "enabled")
+                }
+            )
+            auto = self.auto
+            params0 = auto.params
+            auto.params = self.state.params
+            try:
+                vocab = int(self.model.config.vocab_size)
+                arrivals = self._poisson_arrivals(scfg)
+                rng = np.random.default_rng(1)
+                for i in range(n):
+                    e = ServingEngine(auto, rcfg, gen_cfg)
+                    # warm: compile outside the window, flip /readyz true
+                    e.submit(
+                        rng.integers(
+                            1, vocab, size=len(arrivals[0][1])
+                        ).tolist(),
+                        max_new_tokens=2,
+                    )
+                    e.run()
+                    srv, loop = serve_http(e, None, port=0)
+                    threading.Thread(
+                        target=srv.serve_forever, daemon=True
+                    ).start()
+                    engines.append(e)
+                    servers.append(srv)
+                    loops.append(loop)
+                router = Router(
+                    FleetConfig.from_dict({
+                        **{
+                            k: v for k, v in dict(fleet_section or {}).items()
+                            if k not in ("replicas", "dns", "port")
+                        },
+                        "replicas": [
+                            {
+                                "url": f"http://127.0.0.1:{s.server_address[1]}",
+                                "name": f"bench-r{i}",
+                            }
+                            for i, s in enumerate(servers)
+                        ],
+                        "block_size": scfg.block_size,
+                        "probe_interval_s": 0.25,
+                    })
+                ).start()
+                _, fstats = router.run_workload(arrivals)
+            finally:
+                auto.params = params0
+        except Exception as e:
+            return {
+                **nulls,
+                "serve_fleet_failure": f"{type(e).__name__}: {e}",
+            }
+        finally:
+            if router is not None:
+                router.close()
+            for srv in servers:
+                srv.shutdown()
+                srv.server_close()
+            for loop in loops:
+                loop.close()
+            for e in engines:
+                e.release_pools()
+        if fstats["requests"] == 0:
+            return {
+                **nulls,
+                "serve_fleet_failure": (
+                    "no routed request completed: "
+                    f"{fstats['failed_requests']} failed"
+                ),
+            }
+        fleet_tps = fstats["fleet_tokens_per_s"]
+        return {
+            "serve_fleet_tokens_per_s": round(fleet_tps, 2),
+            "serve_route_prefix_hit_rate": round(fstats["prefix_hit_rate"], 4),
+            "serve_fleet_retries": fstats["retries"],
+            "serve_fleet_replicas": n,
+            "serve_fleet_requests": fstats["requests"],
+            "serve_fleet_kv_handoffs": fstats["kv_handoffs"],
+            "serve_fleet_ab": {
+                "fleet_tokens_per_s": round(fleet_tps, 2),
+                "single_tokens_per_s": single_tps,
+                "speedup": (
+                    round(fleet_tps / single_tps, 3)
+                    if isinstance(single_tps, (int, float)) and single_tps > 0
+                    else None
+                ),
+            },
+            "serve_fleet_failure": None,
+        }
 
 
 def main(cfg: ConfigNode) -> dict:

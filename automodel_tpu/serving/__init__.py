@@ -10,31 +10,47 @@ the generation engine (docs/serving.md).
   chunked prefill interleaved with the decode wave, mid-flight slot
   refill, speculative decoding with per-slot accept/rollback).
 - :mod:`server` — the `automodel_tpu serve` CLI (stdin-JSONL + local HTTP).
+- :mod:`fleet` — the multi-replica tier: the `automodel_tpu route` router
+  (prefix-affinity placement, disaggregated prefill/decode, failure-aware
+  retry) and the prefill→decode KV socket transport.
+
+Exports resolve lazily (PEP 562): the fleet router imports
+``serving.block_pool.prompt_chain`` through this package and must NOT drag
+in :mod:`engine`'s jax import — a router pod needs no accelerator and
+starts in milliseconds.
 """
 
-from automodel_tpu.serving.block_pool import BlockPool, BlockPoolError
-from automodel_tpu.serving.engine import (
-    COMPLETION_REASONS,
-    DrainConfig,
-    EngineDraining,
-    LimitsConfig,
-    QueueFull,
-    ServeConfig,
-    ServingEngine,
-    SpeculativeConfig,
-    StallConfig,
-)
+import importlib
 
-__all__ = [
-    "BlockPool",
-    "BlockPoolError",
-    "COMPLETION_REASONS",
-    "DrainConfig",
-    "EngineDraining",
-    "LimitsConfig",
-    "QueueFull",
-    "ServeConfig",
-    "ServingEngine",
-    "SpeculativeConfig",
-    "StallConfig",
-]
+_EXPORTS = {
+    "BlockPool": "block_pool",
+    "BlockPoolError": "block_pool",
+    "prompt_chain": "block_pool",
+    "COMPLETION_REASONS": "engine",
+    "DrainConfig": "engine",
+    "EngineDraining": "engine",
+    "KVTransferConfig": "engine",
+    "LimitsConfig": "engine",
+    "QueueFull": "engine",
+    "ServeConfig": "engine",
+    "ServingEngine": "engine",
+    "SpeculativeConfig": "engine",
+    "StallConfig": "engine",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(
+        importlib.import_module(f"{__name__}.{mod}"), name
+    )
+    globals()[name] = value  # cache: resolve once per process
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -33,12 +33,46 @@ admit/finish schedule against them):
 
 from __future__ import annotations
 
+import hashlib
+import struct
 from collections import OrderedDict
 from typing import Optional, Sequence
 
 
 class BlockPoolError(RuntimeError):
     """Allocator misuse: double free, freeing scratch, corrupt accounting."""
+
+
+def _chain_hash(parent: Optional[int], tokens: Sequence[int]) -> int:
+    """One chain link: hash of (parent chain hash, this block's tokens).
+
+    Deterministic ACROSS PROCESSES and Python versions (unlike builtin
+    ``hash``, whose ``None``/str hashing varies per interpreter): the fleet
+    router (serving/fleet/router.py) hashes a prompt's block chain in its
+    own process and matches it against the chain heads a REPLICA's prefix
+    cache advertised over /stats — the two sides must agree bit-for-bit or
+    prefix-affinity placement never hits."""
+    buf = struct.pack("<q", -1 if parent is None else int(parent))
+    buf += struct.pack(f"<{len(tokens)}q", *(int(t) for t in tokens))
+    return int.from_bytes(
+        hashlib.blake2b(buf, digest_size=8).digest(), "little", signed=True
+    )
+
+
+def prompt_chain(tokens: Sequence[int], block_size: int) -> list[int]:
+    """Cumulative chain hashes of a prompt's matchable full blocks — the
+    hashes ``match_prefix`` would look up, in order, capped at ``len(tokens)
+    - 1`` tokens (the last prompt token is always recomputed: its logits
+    seed the first sampled token). ``prompt_chain(p, bs)[i]`` equals the key
+    ``register_prefix(p, ...)`` filed block ``i`` under, by construction —
+    the router-side spelling of the replica-side chain rule."""
+    bs = int(block_size)
+    out: list[int] = []
+    parent: Optional[int] = None
+    for i in range((max(len(tokens) - 1, 0)) // bs):
+        parent = _chain_hash(parent, tokens[i * bs : (i + 1) * bs])
+        out.append(parent)
+    return out
 
 
 def blocks_needed(total_tokens: int, block_size: int, write_overhang: int = 0) -> int:
@@ -101,7 +135,7 @@ class BlockPool:
     # -- prefix cache ---------------------------------------------------------
     @staticmethod
     def _chain(parent: Optional[int], tokens: tuple) -> int:
-        return hash((parent, tokens))
+        return _chain_hash(parent, tokens)
 
     def match_prefix(self, tokens: Sequence[int]) -> tuple[list[int], int]:
         """→ (block ids, matched token count) for the longest cached
@@ -148,6 +182,24 @@ class BlockPool:
                 self._cached[h] = bid
                 self._hash_of[bid] = h
             parent = h
+
+    def cached_chain_hashes(self, limit: Optional[int] = None) -> list[int]:
+        """The chain hashes this pool's prefix cache can currently serve —
+        what a replica advertises over /stats (``hot_prefixes``) for the
+        fleet router's affinity placement. ``limit`` bounds the
+        advertisement by eviction distance: chains whose blocks are
+        REFERENCED right now cannot be evicted at all and always advertise;
+        the remaining budget fills from the most recently parked end of the
+        LRU — the parked-longest entries are the next evicted, so
+        advertising them would promise affinity the pool is about to
+        break."""
+        pinned = [h for h in self._cached if h not in self._lru]
+        parked = list(self._lru)
+        if limit is None:
+            return pinned + parked
+        n = int(limit)
+        room = max(n - len(pinned), 0)
+        return (pinned + (parked[-room:] if room else []))[:n]
 
     def clear_prefix_cache(self) -> None:
         """Forget every cached prefix — the serving engine calls this when

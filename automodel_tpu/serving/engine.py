@@ -59,7 +59,7 @@ import dataclasses
 import itertools
 import logging
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -82,6 +82,9 @@ logger = logging.getLogger(__name__)
 # one of (docs/observability.md glossary):
 #   stop         — hit a configured eos id
 #   length       — spent its max_new_tokens budget
+#   prefilled    — a prefill-only request (disaggregated fleet: the KV
+#                  payload was extracted for transfer to a decode replica)
+#                  finished its prompt; a completion, not a failure
 #   timeout      — deadline_s / max_queue_wait_s expired (not retriable:
 #                  the client's own budget ran out)
 #   shed         — rejected at submit, admission queue full (retriable)
@@ -90,9 +93,10 @@ logger = logging.getLogger(__name__)
 #   engine_stall — failed by a watchdog-detected wedged step (retriable)
 #   engine_error — failed by a scheduler/program exception (retriable)
 COMPLETION_REASONS = (
-    "stop", "length", "timeout", "shed", "draining", "cancelled",
-    "engine_stall", "engine_error",
+    "stop", "length", "prefilled", "timeout", "shed", "draining",
+    "cancelled", "engine_stall", "engine_error",
 )
+_COMPLETED_REASONS = frozenset({"stop", "length", "prefilled"})
 _RETRIABLE_REASONS = frozenset(
     {"shed", "draining", "cancelled", "engine_stall", "engine_error"}
 )
@@ -186,6 +190,25 @@ class StallConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class KVTransferConfig:
+    """The ``serving.kv_transfer:`` section — the prefill→decode KV handoff
+    listener (serving/fleet/kv_transfer.py). A DECODE-role replica starts
+    it by default (``enabled: null`` = auto); a mixed replica only when
+    explicitly enabled. ``port: 0`` binds an ephemeral port, advertised to
+    the router via the ``kv_transfer_port`` /stats field."""
+
+    enabled: Optional[bool] = None  # null = auto (on when role == decode)
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral, advertised via /stats
+    max_pending: int = 32  # undelivered handoff payloads held host-side
+    ttl_s: float = 120.0  # a payload never claimed by /generate expires
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "KVTransferConfig":
+        return _cfg_dict(cls, d, "serving.kv_transfer")
+
+
+@dataclasses.dataclass(frozen=True)
 class SpeculativeConfig:
     """The ``serving.speculative:`` section — draft-and-verify speculative
     decoding (Leviathan et al. 2023). A small draft model proposes ``k``
@@ -234,6 +257,10 @@ class ServeConfig:
     # decode backend runs the per-token attention
     kv_cache_dtype: str = "bf16"  # bf16 (model compute dtype) | int8
     decode_kernel: str = "auto"  # auto | fused (Pallas paged kernel) | gather
+    # fleet tier (docs/serving.md "Fleet"): what this replica does in a
+    # disaggregated pool and how much of its prefix cache it advertises
+    role: str = "mixed"  # mixed | prefill | decode
+    hot_prefix_advertise: int = 512  # cached chain heads exposed via /stats
     # sustained-throughput bench knobs (recipes/benchmark.py serving leg)
     bench_requests: int = 16
     bench_rate: float = 8.0  # Poisson arrival rate, requests/second
@@ -246,6 +273,9 @@ class ServeConfig:
     watchdog: StallConfig = dataclasses.field(default_factory=StallConfig)
     speculative: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig
+    )
+    kv_transfer: KVTransferConfig = dataclasses.field(
+        default_factory=KVTransferConfig
     )
 
     def __post_init__(self):
@@ -266,6 +296,14 @@ class ServeConfig:
                 f"serving.decode_kernel={self.decode_kernel!r} "
                 "(want auto|fused|gather)"
             )
+        if self.role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"serving.role={self.role!r} (want mixed|prefill|decode)"
+            )
+        if self.hot_prefix_advertise < 0:
+            raise ValueError(
+                f"serving.hot_prefix_advertise={self.hot_prefix_advertise}"
+            )
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "ServeConfig":
@@ -281,6 +319,7 @@ class ServeConfig:
             ("drain", DrainConfig),
             ("watchdog", StallConfig),
             ("speculative", SpeculativeConfig),
+            ("kv_transfer", KVTransferConfig),
         ):
             v = d.get(key)
             if v is not None and not isinstance(v, sub):
@@ -313,6 +352,9 @@ class _Queued:
     t_submit: float
     deadline_at: Optional[float] = None  # perf_counter absolute
     queue_deadline_at: Optional[float] = None
+    # disaggregated fleet (docs/serving.md "Fleet"):
+    prefill_only: bool = False  # prefill-role replica: extract KV, no decode
+    payload: Optional[dict] = None  # decode-role replica: injected prompt KV
 
 
 @dataclasses.dataclass
@@ -329,6 +371,7 @@ class _Slot:
     decoding: bool = False
     generated: Optional[list[int]] = None
     t_first: Optional[float] = None
+    prefill_only: bool = False
     spec_proposed: int = 0  # draft tokens proposed for this request
     spec_accepted: int = 0  # draft tokens accepted by the verify rule
 
@@ -491,6 +534,12 @@ class ServingEngine:
         self._stall_evidence: Optional[dict] = None
         self._consecutive_rebuilds = 0
         self._exhaust_hold: Optional[tuple[list[int], int]] = None  # injection
+        # disaggregated fleet: extracted prefill payloads awaiting pickup by
+        # the /prefill handler (bounded — an abandoned payload must not pin
+        # host memory forever), and the advertised KV-transfer listener port
+        self._prefill_payloads: "OrderedDict[str, dict]" = OrderedDict()
+        self.kv_transfer_port: Optional[int] = None  # set by the server front
+        self.kv_injected_total = 0  # handoffs admitted into this pool
         self.first_decode_done = False  # readiness: first compiled decode
         self.last_step_t: Optional[float] = None  # monotonic, health age
         # /metrics exposition (telemetry/prometheus.py): histograms are
@@ -695,6 +744,8 @@ class ServingEngine:
         t_submit: Optional[float] = None,
         deadline_s: Optional[float] = None,
         max_queue_wait_s: Optional[float] = None,
+        prefill_only: bool = False,
+        _payload: Optional[dict] = None,
     ) -> str:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
@@ -706,18 +757,22 @@ class ServingEngine:
         )
         if max_new < 1:
             raise ValueError(f"max_new_tokens={max_new}")
-        total = len(prompt) + max_new
+        # a prefill-only request never decodes: its budget is the prompt
+        # alone (positions 0..p-1), and its cap check ignores max_new
+        total = len(prompt) if prefill_only else len(prompt) + max_new
         cap = min(
             self.config.max_seq_len,
             self._max_positions or self.config.max_seq_len,
         )
         if total > cap:
             raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) = "
-                f"{total} exceeds the serving limit {cap}"
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({0 if prefill_only else max_new}) = {total} exceeds the "
+                f"serving limit {cap}"
             )
         need = blocks_needed(
-            total, self.config.block_size, self.config.spec_overhang
+            total, self.config.block_size,
+            0 if prefill_only else self.config.spec_overhang,
         )
         if need > self.pool.usable_blocks:
             raise ValueError(
@@ -736,6 +791,7 @@ class ServingEngine:
             rid=rid, prompt=prompt, max_new=max_new, t_submit=now,
             deadline_at=now + ddl if ddl and ddl > 0 else None,
             queue_deadline_at=now + qw if qw and qw > 0 else None,
+            prefill_only=prefill_only, payload=_payload,
         )
         if self.draining:
             # no terminal record here (mirror of the shed seam): the
@@ -753,6 +809,120 @@ class ServingEngine:
             )
         self._queue.append(q)
         return rid
+
+    # -- disaggregated prefill/decode (serving/fleet/) ------------------------
+    def kv_geometry(self) -> dict:
+        """The pool geometry a KV-transfer peer must match exactly — the
+        handshake header both sides validate before any block row moves."""
+        L, _, BS, Nkv, H = self._pool.values_shape
+        return {
+            "layers": int(L),
+            "block_size": int(BS),
+            "num_kv_heads": int(Nkv),
+            "head_dim": int(H),
+            "kv_cache_dtype": self.config.kv_cache_dtype,
+        }
+
+    def kv_frame_bytes_bound(self) -> int:
+        """Upper bound on a legitimate KV-transfer frame into this pool —
+        the WHOLE pool's bytes (k + v, scales included). The transfer
+        listener refuses anything larger before allocating."""
+        total = 0
+        for side in (self._pool.k, self._pool.v):
+            arrs = side if isinstance(side, tuple) else (side,)
+            for a in arrs:
+                total += int(np.prod(a.shape)) * a.dtype.itemsize
+        return total
+
+    def hot_prefixes(self) -> list[int]:
+        """Cached chain heads advertised via /stats for the fleet router's
+        prefix-affinity placement."""
+        return self.pool.cached_chain_hashes(self.config.hot_prefix_advertise)
+
+    def pop_prefill_payload(self, request_id: str) -> dict:
+        """Claim the extracted KV payload of a completed prefill-only
+        request (the /prefill handler ships it to the decode replica)."""
+        try:
+            return self._prefill_payloads.pop(request_id)
+        except KeyError:
+            raise KeyError(
+                f"no prefill payload for {request_id!r} — the request did "
+                "not complete as 'prefilled', or the payload was evicted"
+            )
+
+    def _stash_prefill_payload(self, rid: str, payload: dict) -> None:
+        self._prefill_payloads[rid] = payload
+        # bounded: an abandoned payload (router died between /prefill and
+        # pickup) must not pin host copies of prompt KV forever
+        while len(self._prefill_payloads) > max(
+            int(self.config.kv_transfer.max_pending), 1
+        ):
+            dropped, _ = self._prefill_payloads.popitem(last=False)
+            logger.warning("evicting unclaimed prefill payload %s", dropped)
+
+    def submit_prefilled(
+        self,
+        prompt_ids: Sequence[int],
+        first_token: int,
+        kv: dict,
+        request_id: Optional[str] = None,
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        max_queue_wait_s: Optional[float] = None,
+    ) -> str:
+        """Enqueue a request whose prompt KV was computed on a PREFILL
+        replica: admission allocates the normal whole budget, scatters the
+        shipped block rows into this pool through the ``paged_write_targets``
+        seam, and the slot starts directly in decode with ``first_token``
+        (sampled by the prefill replica from the prompt's last logits)
+        already committed. ``kv`` is ``{"k": rows, "v": rows}`` with each
+        side ``[L, nb, BS, Nkv, H]`` (or ``(int8 values, fp32 scales)``
+        pairs for int8 pools), ``nb = ceil(len(prompt)/block_size)``."""
+        if self._spec_enabled:
+            raise GenerationUnsupported(
+                "disaggregated KV handoff into a speculative engine is not "
+                "supported: the draft model's parallel pool would miss the "
+                "prompt KV and proposals would attend garbage"
+            )
+        prompt = [int(t) for t in prompt_ids]
+        self._validate_kv_payload(prompt, kv)
+        payload = {"first_token": int(first_token), "kv": kv}
+        return self.submit(
+            prompt, request_id=request_id, max_new_tokens=max_new_tokens,
+            deadline_s=deadline_s, max_queue_wait_s=max_queue_wait_s,
+            _payload=payload,
+        )
+
+    def _validate_kv_payload(self, prompt: list[int], kv: dict) -> None:
+        geom = self.kv_geometry()
+        nb = blocks_needed(len(prompt), self.config.block_size)
+        want = (
+            geom["layers"], nb, geom["block_size"], geom["num_kv_heads"],
+            geom["head_dim"],
+        )
+        for side in ("k", "v"):
+            rows = kv.get(side)
+            if rows is None:
+                raise ValueError(f"KV payload missing side {side!r}")
+            quantized = isinstance(rows, tuple)
+            if quantized != self._quantized:
+                raise ValueError(
+                    f"KV payload side {side!r} is "
+                    f"{'int8' if quantized else 'raw'} but this pool is "
+                    f"kv_cache_dtype={self.config.kv_cache_dtype}"
+                )
+            shape = tuple((rows[0] if quantized else rows).shape)
+            if shape != want:
+                raise ValueError(
+                    f"KV payload side {side!r} shape {shape} != expected "
+                    f"{want} (layers, ceil(prompt/block_size), block_size, "
+                    "num_kv_heads, head_dim)"
+                )
+            if quantized and tuple(rows[1].shape) != want[:-1]:
+                raise ValueError(
+                    f"KV payload side {side!r} scales shape "
+                    f"{tuple(rows[1].shape)} != expected {want[:-1]}"
+                )
 
     def record_shed(
         self,
@@ -814,7 +984,7 @@ class ServingEngine:
         self._lengths[b] = 0
         self._active[b] = False
         self._cur[b] = self.gen_config.pad_token_id
-        completed = reason in ("stop", "length")
+        completed = reason in _COMPLETED_REASONS
         if completed:
             self.completed_total += 1
         else:
@@ -856,7 +1026,7 @@ class ServingEngine:
 
     def _emit(self, rec: dict) -> None:
         try:
-            if rec.get("completion_reason") in ("stop", "length"):
+            if rec.get("completion_reason") in _COMPLETED_REASONS:
                 self.metrics.observe_request(rec)
             else:
                 self.metrics.observe_failure(rec.get("completion_reason", ""))
@@ -906,10 +1076,18 @@ class ServingEngine:
             if self._slots[b] is not None or not self._queue:
                 continue
             q = self._queue[0]
-            hits, hit_tokens = self.pool.match_prefix(q.prompt)
+            if q.payload is not None:
+                # KV handoff: the prompt's rows arrive pre-computed, so the
+                # prefix cache is bypassed (shipped blocks are scattered
+                # whole; the injected prefix registers below for FUTURE
+                # requests to hit)
+                hits, hit_tokens = [], 0
+            else:
+                hits, hit_tokens = self.pool.match_prefix(q.prompt)
             need = blocks_needed(
-                len(q.prompt) + q.max_new, self.config.block_size,
-                self.config.spec_overhang,
+                len(q.prompt) if q.prefill_only else len(q.prompt) + q.max_new,
+                self.config.block_size,
+                0 if q.prefill_only else self.config.spec_overhang,
             )
             fresh = self.pool.allocate(need - len(hits))
             if fresh is None:
@@ -921,6 +1099,9 @@ class ServingEngine:
             self._queue.popleft()
             blocks = hits + fresh
             try:
+                if q.payload is not None:
+                    self._bind_injected_slot(b, q, blocks, done)
+                    continue
                 self._bind_slot(b, q, blocks, hit_tokens)
             except Exception as e:
                 # leak audit: an exception between admit-time allocation and
@@ -949,7 +1130,43 @@ class ServingEngine:
             blocks=blocks, hit_tokens=hit_tokens,
             prefill_pos=hit_tokens, t_submit=q.t_submit,
             t_admit=time.perf_counter(), deadline_at=q.deadline_at,
+            prefill_only=q.prefill_only,
         )
+
+    def _bind_injected_slot(
+        self, b: int, q: _Queued, blocks: list[int], done: list[dict]
+    ) -> None:
+        """Admission for a KV-handoff request (``submit_prefilled``): the
+        shipped prompt rows scatter into the allocated blocks and the slot
+        starts directly in decode with the prefill replica's first token
+        already committed — this replica never touches the prompt math."""
+        p = len(q.prompt)
+        nb = blocks_needed(p, self.config.block_size)
+        row = np.zeros((self.config.table_blocks,), np.int32)
+        row[: len(blocks)] = blocks
+        self._pool = paged.inject_blocks(
+            self._pool, np.asarray(blocks[:nb], np.int32), q.payload["kv"]
+        )
+        first = int(q.payload["first_token"])
+        now = time.perf_counter()
+        self._tables[b] = row
+        self._lengths[b] = p
+        self._cur[b] = first
+        self._active[b] = True
+        self._slots[b] = _Slot(
+            request_id=q.rid, prompt=q.prompt, max_new=q.max_new,
+            blocks=blocks, hit_tokens=0, prefill_pos=p,
+            t_submit=q.t_submit, t_admit=now, deadline_at=q.deadline_at,
+            decoding=True, generated=[first], t_first=now,
+        )
+        # the injected prefix is as matchable as a locally-computed one —
+        # future affinity-routed requests hit it without another transfer
+        self.pool.register_prefix(q.prompt, blocks)
+        self.kv_injected_total += 1
+        if first in self._eos:
+            done.append(self._terminate(b, "stop"))
+        elif q.max_new <= 1:
+            done.append(self._terminate(b, "length"))
 
     def _prefill_tick(self) -> list[dict]:
         done: list[dict] = []
@@ -1001,6 +1218,19 @@ class ServingEngine:
             self.pool.register_prefix(slot.prompt, slot.blocks)
             slot.t_first = time.perf_counter()
             slot.generated = [first]
+            if slot.prefill_only:
+                # disaggregated fleet: the prompt's block rows leave for a
+                # decode replica — extract BEFORE _terminate decrefs the
+                # blocks (contents survive until reuse, but extraction from
+                # owned blocks is the contract the transfer relies on)
+                k, v = paged.extract_blocks(self._pool, slot.blocks)
+                self._stash_prefill_payload(slot.request_id, {
+                    "first_token": first,
+                    "prompt_len": p,
+                    "kv": {"k": k, "v": v},
+                })
+                done.append(self._terminate(b, "prefilled"))
+                continue
             slot.decoding = True
             self._cur[b] = first
             self._active[b] = True

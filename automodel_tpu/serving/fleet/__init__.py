@@ -1,0 +1,28 @@
+"""Fleet tier: multi-replica serving above :mod:`automodel_tpu.serving`
+(docs/serving.md "Fleet").
+
+- :mod:`router` — the `automodel_tpu route` process: replica registry
+  (static ``fleet:`` list or k8s DNS), /readyz + /stats probing,
+  prefix-affinity placement (the block pool's chain rule) with
+  power-of-two-choices fallback, disaggregated prefill→decode
+  orchestration, and bounded failure-aware retry. Same HTTP front
+  contract as a single replica (POST /generate, GET /stats /healthz
+  /readyz /metrics).
+- :mod:`kv_transfer` — the length-prefixed socket transport a prefill
+  replica streams finished KV block rows over to its assigned decode
+  replica (bf16 rows, or (int8 values, fp32 scales) pairs — bit-identical
+  round trip by construction).
+
+The router process deliberately imports NO jax: placement hashes ride
+:func:`automodel_tpu.serving.block_pool.prompt_chain` (pure python), so a
+router pod needs no accelerator and starts in milliseconds.
+"""
+
+from automodel_tpu.serving.fleet.router import (
+    FleetConfig,
+    ReplicaSpec,
+    Router,
+    serve_router_http,
+)
+
+__all__ = ["FleetConfig", "ReplicaSpec", "Router", "serve_router_http"]

@@ -1,0 +1,330 @@
+"""Prefill→decode KV handoff: a length-prefixed socket transport.
+
+The disaggregated fleet (docs/serving.md "Fleet") separates prompt math
+from token math: a PREFILL replica runs chunked prefill only, then streams
+the request's finished KV block rows to its assigned DECODE replica, which
+scatters them into its own pool through the same ``paged_write_targets``
+cell addressing chunk prefill uses — both backends land rows in the same
+cells by construction, and the decode replica starts the request directly
+in decode.
+
+Framing (one frame per handoff, one TCP connection per frame):
+
+    b"AKV1" | u32 header_len | header JSON | (u64 buf_len | raw bytes) × N
+
+The header carries the handoff id, prompt metadata, the POOL GEOMETRY both
+sides must agree on (layers, block size, kv heads, head dim, kv dtype —
+mismatch is a loud refusal, never a silent corrupt scatter), and an array
+manifest ``[{key, shape, dtype}, ...]`` naming the N raw buffers in order.
+bf16 pools ship one array per side (``k``/``v``, each ``[L, nb, BS, Nkv,
+H]``); int8 pools ship ``(values, scales)`` pairs (``k_values``/
+``k_scales``/``v_values``/``v_scales``) byte-for-byte — the round trip is
+bit-identical (pinned by tests/test_fleet.py).
+
+The receiver replies ``u32 len | JSON {"ok": true}`` (or ``{"ok": false,
+"error": ...}``) AFTER the payload is parked in its bounded
+:class:`HandoffStore`, so a prefill replica's ack to the router means the
+decode replica really holds the bytes — the router's follow-up
+POST /generate with the handoff id can never race an in-flight transfer.
+
+This module imports no jax: numpy (+ ml_dtypes for bf16) only, so the
+router and tests can exercise the wire format without a device runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+MAGIC = b"AKV1"
+_MAX_HEADER_BYTES = 1 << 20  # 1 MiB of JSON header is already absurd
+
+GEOMETRY_KEYS = (
+    "layers", "block_size", "num_kv_heads", "head_dim", "kv_cache_dtype"
+)
+
+
+class KVTransferError(RuntimeError):
+    """Transport or validation failure — the handoff did not land."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def flatten_kv(kv: dict) -> list[tuple[str, np.ndarray]]:
+    """``{"k": rows|(values, scales), "v": ...}`` → ordered named arrays."""
+    out: list[tuple[str, np.ndarray]] = []
+    for side in ("k", "v"):
+        rows = kv[side]
+        if isinstance(rows, tuple):
+            out.append((f"{side}_values", np.asarray(rows[0])))
+            out.append((f"{side}_scales", np.asarray(rows[1])))
+        else:
+            out.append((side, np.asarray(rows)))
+    return out
+
+
+def unflatten_kv(named: dict[str, np.ndarray]) -> dict:
+    """Inverse of :func:`flatten_kv`."""
+    out: dict[str, Any] = {}
+    for side in ("k", "v"):
+        if side in named:
+            out[side] = named[side]
+        else:
+            out[side] = (named[f"{side}_values"], named[f"{side}_scales"])
+    return out
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise KVTransferError(
+                f"peer closed mid-frame ({len(buf)}/{n} bytes)"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _read_frame(
+    sock: socket.socket, max_frame_bytes: Optional[int] = None
+) -> tuple[dict, dict[str, np.ndarray]]:
+    magic = _recv_exact(sock, 4)
+    if magic != MAGIC:
+        raise KVTransferError(f"bad magic {magic!r} (want {MAGIC!r})")
+    (hlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    if hlen > _MAX_HEADER_BYTES:
+        raise KVTransferError(f"header length {hlen} exceeds the sane bound")
+    header = json.loads(_recv_exact(sock, hlen))
+    arrays: dict[str, np.ndarray] = {}
+    total = 0
+    for spec in header.get("arrays", []):
+        (blen,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        # the wire length is untrusted until it matches what the manifest's
+        # shape × dtype implies, and the frame total is capped (the
+        # receiver's bound: one pool's worth of bytes) — a corrupt or
+        # hostile length claim must fail loudly BEFORE any allocation, not
+        # OOM the decode replica
+        try:
+            want = int(np.prod([int(d) for d in spec["shape"]], dtype=np.int64))
+            want *= _np_dtype(spec["dtype"]).itemsize
+        except (TypeError, ValueError) as e:
+            raise KVTransferError(f"bad array manifest {spec!r}: {e}")
+        if blen != want:
+            raise KVTransferError(
+                f"array {spec.get('key')!r} claims {blen} bytes but its "
+                f"manifest shape/dtype implies {want}"
+            )
+        total += blen
+        if max_frame_bytes is not None and total > max_frame_bytes:
+            raise KVTransferError(
+                f"frame exceeds the receiver's bound ({total} > "
+                f"{max_frame_bytes} bytes — more than this pool could hold)"
+            )
+        raw = _recv_exact(sock, blen)
+        arr = np.frombuffer(raw, dtype=_np_dtype(spec["dtype"]))
+        arrays[spec["key"]] = arr.reshape([int(d) for d in spec["shape"]])
+    return header, arrays
+
+
+def _write_frame(sock: socket.socket, header: dict, arrays) -> None:
+    specs = []
+    bufs = []
+    for key, arr in arrays:
+        arr = np.ascontiguousarray(arr)
+        specs.append(
+            {"key": key, "shape": list(arr.shape), "dtype": arr.dtype.name}
+        )
+        bufs.append(arr.tobytes())
+    hdr = json.dumps({**header, "arrays": specs}).encode()
+    sock.sendall(MAGIC + struct.pack("<I", len(hdr)) + hdr)
+    for raw in bufs:
+        sock.sendall(struct.pack("<Q", len(raw)) + raw)
+
+
+def _write_response(sock: socket.socket, obj: dict) -> None:
+    body = json.dumps(obj).encode()
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def _read_response(sock: socket.socket) -> dict:
+    (blen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return json.loads(_recv_exact(sock, blen))
+
+
+def send_kv(
+    addr: tuple[str, int],
+    meta: dict,
+    kv: dict,
+    timeout_s: float = 30.0,
+) -> dict:
+    """Ship one handoff payload to a decode replica's listener. ``meta``
+    must carry ``handoff_id``/``prompt_len``/``first_token`` and the
+    sender's pool ``geometry``. → the receiver's ack dict; raises
+    :class:`KVTransferError` when the transfer or validation failed."""
+    try:
+        with socket.create_connection(addr, timeout=timeout_s) as sock:
+            _write_frame(sock, dict(meta), flatten_kv(kv))
+            resp = _read_response(sock)
+    except (OSError, ValueError) as e:
+        raise KVTransferError(f"KV transfer to {addr} failed: {e}") from e
+    if not resp.get("ok"):
+        raise KVTransferError(
+            f"decode replica at {addr} refused the handoff: "
+            f"{resp.get('error', 'unknown error')}"
+        )
+    return resp
+
+
+class HandoffStore:
+    """Bounded host-side parking lot for received payloads between the
+    transfer landing and the router's POST /generate claiming it. TTL +
+    max_pending keep an orphaned handoff (router died in between) from
+    pinning prompt-KV bytes forever."""
+
+    def __init__(self, max_pending: int = 32, ttl_s: float = 120.0):
+        self.max_pending = max(int(max_pending), 1)
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple[float, dict]] = {}
+
+    def put(self, handoff_id: str, entry: dict) -> None:
+        now = time.monotonic()
+        with self._lock:
+            expired = [
+                h for h, (t, _) in self._entries.items()
+                if now - t > self.ttl_s
+            ]
+            for h in expired:
+                del self._entries[h]
+                logger.warning("KV handoff %s expired unclaimed", h)
+            while len(self._entries) >= self.max_pending:
+                oldest = min(self._entries, key=lambda h: self._entries[h][0])
+                del self._entries[oldest]
+                logger.warning("KV handoff %s evicted (store full)", oldest)
+            self._entries[handoff_id] = (now, entry)
+
+    def pop(self, handoff_id: str) -> dict:
+        with self._lock:
+            try:
+                _, entry = self._entries.pop(handoff_id)
+            except KeyError:
+                raise KeyError(
+                    f"no pending KV handoff {handoff_id!r} (never arrived, "
+                    "expired, or already claimed)"
+                )
+        return entry
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class KVTransferServer:
+    """The decode replica's listener: one thread-per-connection TCP server
+    validating each frame's geometry against THIS replica's pool and
+    parking accepted payloads in the :class:`HandoffStore`."""
+
+    def __init__(
+        self,
+        expected_geometry: dict,
+        store: Optional[HandoffStore] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_pending: int = 32,
+        ttl_s: float = 120.0,
+        max_frame_bytes: Optional[int] = None,
+    ):
+        self.expected = {k: expected_geometry[k] for k in GEOMETRY_KEYS}
+        self.store = store or HandoffStore(max_pending=max_pending, ttl_s=ttl_s)
+        self.max_frame_bytes = max_frame_bytes
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    header, arrays = _read_frame(
+                        self.request, max_frame_bytes=outer.max_frame_bytes
+                    )
+                except KVTransferError as e:
+                    logger.warning("bad KV transfer frame: %s", e)
+                    try:
+                        _write_response(self.request, {"ok": False, "error": str(e)})
+                    except OSError:
+                        pass
+                    return
+                err = outer._validate(header, arrays)
+                if err is not None:
+                    _write_response(self.request, {"ok": False, "error": err})
+                    return
+                outer.store.put(str(header["handoff_id"]), {
+                    "meta": {
+                        k: header.get(k)
+                        for k in ("request_id", "prompt_len", "first_token")
+                    },
+                    "kv": unflatten_kv(arrays),
+                })
+                _write_response(
+                    self.request, {"ok": True, "handoff_id": header["handoff_id"]}
+                )
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, int(port)), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="kv-transfer", daemon=True
+        )
+
+    def _validate(self, header: dict, arrays: dict) -> Optional[str]:
+        if "handoff_id" not in header:
+            return "frame header has no handoff_id"
+        geom = header.get("geometry") or {}
+        got = {k: geom.get(k) for k in GEOMETRY_KEYS}
+        if got != self.expected:
+            return (
+                f"pool geometry mismatch: sender {got} != receiver "
+                f"{self.expected} — prefill and decode replicas must share "
+                "layers/block_size/num_kv_heads/head_dim/kv_cache_dtype"
+            )
+        p = header.get("prompt_len")
+        if not isinstance(p, int) or p < 1:
+            return f"bad prompt_len {p!r}"
+        bs = int(self.expected["block_size"])
+        nb = -(-p // bs)
+        for key, arr in arrays.items():
+            if int(arr.shape[1]) != nb:
+                return (
+                    f"array {key} carries {arr.shape[1]} blocks for a "
+                    f"{p}-token prompt (expected ceil({p}/{bs}) = {nb})"
+                )
+        return None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "KVTransferServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

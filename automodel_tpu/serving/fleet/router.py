@@ -1,0 +1,1009 @@
+"""`automodel_tpu route` — the fleet router above N serving replicas.
+
+One ``ServingEngine`` is bounded by one chip's HBM; the router is the tier
+that spreads heavy traffic over a fleet (docs/serving.md "Fleet"). It
+keeps the SAME HTTP front contract as a single replica (POST /generate,
+GET /stats /healthz /readyz /metrics), so a client — or a load balancer —
+cannot tell a routed fleet from one engine.
+
+Placement is **prefix affinity first, load second**:
+
+1. The prompt's block chain is hashed with the SAME chain rule the
+   replica's prefix cache keys its blocks under
+   (:func:`automodel_tpu.serving.block_pool.prompt_chain` — deterministic
+   across processes), and the replica whose advertised hot-prefix set
+   (the ``hot_prefixes`` /stats field) contains the LONGEST match wins:
+   its pool already holds the prompt's KV, so routing there turns a
+   per-replica coin flip into a guaranteed hit.
+2. No match → power-of-two-choices: two random ready replicas, the less
+   loaded one (queue depth + busy slots from /stats) takes the request —
+   near-best-of-N balancing at O(1) probe cost.
+
+**Disaggregated prefill/decode** (Splitwise/DistServe): replicas declare a
+role (``serving.role: prefill|decode|mixed``). When the fleet has prefill
+replicas, a long prompt's math runs on one of them (POST /prefill), the
+finished KV block rows stream to the chosen decode replica over the
+:mod:`kv_transfer` socket transport, and the decode replica starts the
+request directly in decode — long prompts never steal decode throughput.
+A strong affinity hit (the decode replica already holds ≥ half the prompt)
+bypasses the handoff entirely: recomputing the short tail is cheaper than
+shipping it.
+
+**Failure-aware retry**: every replica-side terminal record carries
+``completion_reason`` + ``retriable`` (PR 9). The router resubmits
+retriable failures (replica death, ``engine_stall``, shed, draining) to a
+DIFFERENT replica within a bounded per-request ``retry_budget`` — a
+replica killed mid-decode loses zero requests (tests/test_serving_chaos.py
+pins it). Client-budget expiries (``timeout``) are never retried.
+
+This module imports no jax: a router pod needs no accelerator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+from typing import Any, Callable, Optional, Sequence
+
+from automodel_tpu.serving.block_pool import prompt_chain
+
+logger = logging.getLogger(__name__)
+
+RETRY_AFTER_S = 5
+
+
+class ReplicaUnreachable(RuntimeError):
+    """TCP-level failure talking to a replica (dead pod, reset socket):
+    always retriable — the request never reached a scheduler."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaSpec:
+    """One static ``fleet.replicas:`` entry."""
+
+    url: str
+    name: Optional[str] = None  # metrics label; default r0, r1, ...
+    role: Optional[str] = None  # pin prefill|decode|mixed; None = from /stats
+
+    def __post_init__(self):
+        if self.role not in (None, "mixed", "prefill", "decode"):
+            raise ValueError(
+                f"fleet replica role={self.role!r} "
+                "(want mixed|prefill|decode or omit)"
+            )
+
+    @classmethod
+    def from_value(cls, v: Any, index: int) -> "ReplicaSpec":
+        if isinstance(v, str):
+            return cls(url=v, name=f"r{index}")
+        d = dict(v)
+        d.pop("_target_", None)
+        unknown = set(d) - {"url", "name", "role"}
+        if unknown:
+            raise TypeError(f"unknown fleet replica keys: {sorted(unknown)}")
+        if "url" not in d:
+            raise TypeError("fleet replica needs a url")
+        d.setdefault("name", f"r{index}")
+        return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """The ``fleet:`` YAML section — the router's whole world."""
+
+    replicas: tuple = ()  # static registry: urls or {url, name?, role?}
+    dns: Optional[str] = None  # k8s headless service; re-resolved per probe
+    dns_port: int = 8100  # replica HTTP port behind the DNS name
+    port: Optional[int] = None  # router front port (`automodel_tpu route`)
+    host: str = "127.0.0.1"
+    block_size: int = 16  # MUST match the replicas' serving.block_size
+    probe_interval_s: float = 2.0
+    probe_timeout_s: float = 2.0
+    request_timeout_s: float = 300.0
+    retry_budget: int = 2  # resubmissions per request (0 = never retry)
+    affinity: bool = True  # prefix-affinity placement (else pure load)
+    disaggregate: Optional[bool] = None  # null = auto (prefill replicas seen)
+    drain_grace_s: float = 10.0  # SIGTERM → in-flight forward budget
+    seed: int = 0  # power-of-two-choices rng
+    # routed bench sub-leg knobs (recipes/benchmark.py _fleet_leg)
+    bench_replicas: int = 2
+    bench_num_blocks: Optional[int] = None  # default: serving.num_blocks // N
+
+    def __post_init__(self):
+        if self.retry_budget < 0:
+            raise ValueError(f"fleet.retry_budget={self.retry_budget}")
+        if self.block_size < 1:
+            raise ValueError(f"fleet.block_size={self.block_size}")
+        if self.bench_replicas < 2:
+            raise ValueError(
+                f"fleet.bench_replicas={self.bench_replicas} (want >= 2 — "
+                "a one-replica fleet measures nothing the serving leg "
+                "doesn't)"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "FleetConfig":
+        d = dict(d or {})
+        d.pop("_target_", None)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise TypeError(f"unknown fleet keys: {sorted(unknown)}")
+        reps = d.get("replicas")
+        if reps is not None:
+            d["replicas"] = tuple(
+                r if isinstance(r, ReplicaSpec) else ReplicaSpec.from_value(r, i)
+                for i, r in enumerate(reps)
+            )
+            # the registry is keyed by name: a duplicate (copy-paste typo)
+            # would silently collapse two replicas into one and halve the
+            # fleet — refuse loudly instead
+            names = [r.name or r.url for r in d["replicas"]]
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            if dupes:
+                raise ValueError(f"duplicate fleet replica names: {dupes}")
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class _Replica:
+    """Runtime state the probe thread maintains per replica."""
+
+    spec: ReplicaSpec
+    alive: bool = False
+    ready: bool = False
+    role: str = "mixed"
+    stats: dict = dataclasses.field(default_factory=dict)
+    hot: frozenset = frozenset()  # advertised prefix-cache chain heads
+    kv_port: Optional[int] = None
+    block_size_ok: bool = True
+    last_probe_t: Optional[float] = None
+
+    @property
+    def name(self) -> str:
+        return self.spec.name or self.spec.url
+
+    @property
+    def url(self) -> str:
+        return self.spec.url.rstrip("/")
+
+    @property
+    def load(self) -> float:
+        return float(
+            (self.stats.get("queue_depth") or 0)
+            + (self.stats.get("busy_slots") or 0)
+        )
+
+    def decode_capable(self) -> bool:
+        return self.role in ("mixed", "decode")
+
+
+def _http_json(
+    url: str, obj: Optional[dict], timeout_s: float
+) -> tuple[int, dict]:
+    """One GET (obj None) or POST (obj) → (status, parsed body). HTTP error
+    statuses return normally (the body carries the replica's structured
+    rejection); TCP-level failures raise :class:`ReplicaUnreachable`."""
+    data = None if obj is None else json.dumps(obj).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={} if data is None else {"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            body = json.loads(raw or b"{}")
+        except ValueError:
+            body = {"error": raw.decode(errors="replace")}
+        return e.code, body
+    except (OSError, urllib.error.URLError, ValueError) as e:
+        raise ReplicaUnreachable(f"{url}: {e}") from e
+
+
+class RouterMetrics:
+    """The router's /metrics surface (telemetry/prometheus.py registry):
+    the ISSUE-named counters plus per-replica health gauges."""
+
+    def __init__(self):
+        from automodel_tpu.telemetry.prometheus import (
+            LATENCY_BUCKETS,
+            MetricsRegistry,
+        )
+
+        self.registry = MetricsRegistry()
+        self.requests = self.registry.labeled_counter(
+            "automodel_route_requests",
+            "Requests routed to a terminal response, by replica",
+            "replica",
+        )
+        self.prefix_hits = self.registry.counter(
+            "automodel_route_prefix_hits",
+            "Requests placed by prefix affinity (>= 1 matched chain block)",
+        )
+        self.retries = self.registry.counter(
+            "automodel_route_retries",
+            "Retriable replica failures resubmitted to a different replica",
+        )
+        self.unroutable = self.registry.counter(
+            "automodel_route_unroutable",
+            "Requests that exhausted the retry budget or found no replica",
+        )
+        self.handoffs = self.registry.counter(
+            "automodel_route_kv_handoffs",
+            "Disaggregated prefill->decode KV transfers orchestrated",
+        )
+        self.replica_up = self.registry.labeled_gauge(
+            "automodel_route_replica_up",
+            "1 when the replica answered its last /readyz probe, else 0",
+            "replica",
+        )
+        self.replicas_ready = self.registry.gauge(
+            "automodel_route_replicas_ready",
+            "Ready replicas in the registry right now",
+        )
+        self.latency = self.registry.histogram(
+            "automodel_route_request_seconds",
+            "Router-observed request latency (submit to terminal response)",
+            buckets=LATENCY_BUCKETS,
+        )
+
+
+class Router:
+    """Replica registry + placement + retry. Thread-safe: HTTP handler
+    threads call :meth:`handle_generate` concurrently while the probe
+    thread refreshes replica state."""
+
+    def __init__(
+        self,
+        config: FleetConfig,
+        tokenizer: Any = None,
+        on_record: Optional[Callable[[dict], None]] = None,
+    ):
+        self.config = config
+        self.tokenizer = tokenizer
+        self.on_record = on_record
+        self.metrics = RouterMetrics()
+        self._lock = threading.Lock()
+        self._replicas: dict[str, _Replica] = {}
+        for spec in config.replicas:
+            self._replicas[spec.name or spec.url] = _Replica(
+                spec=spec, role=spec.role or "mixed"
+            )
+        if not self._replicas and not config.dns:
+            raise ValueError(
+                "fleet: needs replicas (static list) or dns (k8s headless "
+                "service) — the router has nothing to route to"
+            )
+        self._rng = random.Random(config.seed)
+        self._ids = itertools.count()
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self.draining = False
+        # plain-int mirrors of the /metrics counters for /stats + bench
+        self.requests_total = 0
+        self.completed_total = 0
+        self.retries_total = 0
+        self.prefix_hits_total = 0
+        self.unroutable_total = 0
+        self.handoffs_total = 0
+        self._warned_block_size: set[str] = set()
+
+    # -- registry / probing ---------------------------------------------------
+    def _resolve_dns(self) -> None:
+        """k8s headless-service discovery: every A record behind
+        ``fleet.dns`` is a replica pod. Re-resolved each probe cycle so
+        scale-ups join and deleted pods leave without a router restart."""
+        import socket as socket_mod
+
+        try:
+            infos = socket_mod.getaddrinfo(
+                self.config.dns, self.config.dns_port,
+                proto=socket_mod.IPPROTO_TCP,
+            )
+        except OSError as e:
+            logger.warning("fleet.dns %s resolution failed: %s", self.config.dns, e)
+            return
+        ips = sorted({info[4][0] for info in infos})
+        current = {f"dns-{ip.replace('.', '-').replace(':', '-')}": ip for ip in ips}
+        with self._lock:
+            for name in [
+                n for n, r in self._replicas.items()
+                if n.startswith("dns-") and n not in current
+            ]:
+                del self._replicas[name]
+            for name, ip in current.items():
+                if name not in self._replicas:
+                    host = f"[{ip}]" if ":" in ip else ip
+                    self._replicas[name] = _Replica(
+                        spec=ReplicaSpec(
+                            url=f"http://{host}:{self.config.dns_port}",
+                            name=name,
+                        )
+                    )
+
+    def probe_once(self) -> None:
+        """One probe sweep: /readyz for health, /stats for load + roles +
+        hot prefixes + the KV-transfer port. Replicas probe CONCURRENTLY:
+        sequentially, every dead pod would cost a full probe_timeout_s and
+        a large fleet's sweep (and the synchronous ``start()``) would take
+        O(N × timeout) — instead the whole sweep is bounded at roughly one
+        probe timeout."""
+        if self.config.dns:
+            self._resolve_dns()
+        with self._lock:
+            reps = list(self._replicas.values())
+        threads = [
+            threading.Thread(
+                target=self._probe_replica, args=(rep,), daemon=True
+            )
+            for rep in reps
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.metrics.replicas_ready.set(
+            sum(1 for r in reps if r.ready)
+        )
+
+    def _probe_replica(self, rep: "_Replica") -> None:
+        alive, ready, stats = False, False, rep.stats
+        try:
+            code, _ = _http_json(
+                rep.url + "/readyz", None, self.config.probe_timeout_s
+            )
+            alive = True
+            _, stats = _http_json(
+                rep.url + "/stats", None, self.config.probe_timeout_s
+            )
+            # ready only when BOTH legs answered: a replica that died
+            # between /readyz and /stats must not be published as ready
+            # with stale stats for a whole probe interval
+            ready = code == 200
+        except ReplicaUnreachable:
+            alive, ready = False, False
+        with self._lock:
+            rep.alive, rep.ready = alive, ready
+            rep.last_probe_t = time.monotonic()
+            if alive:
+                rep.stats = stats
+                rep.role = rep.spec.role or stats.get("role") or rep.role
+                rep.kv_port = stats.get("kv_transfer_port")
+                hot = stats.get("hot_prefixes")
+                rep.hot = (
+                    frozenset(int(h) for h in hot)
+                    if isinstance(hot, list) else frozenset()
+                )
+                rbs = stats.get("block_size")
+                rep.block_size_ok = (
+                    rbs is None or int(rbs) == self.config.block_size
+                )
+                if (
+                    not rep.block_size_ok
+                    and rep.name not in self._warned_block_size
+                ):
+                    self._warned_block_size.add(rep.name)
+                    logger.warning(
+                        "replica %s serves block_size=%s but "
+                        "fleet.block_size=%d — prefix affinity is OFF "
+                        "for it (chain hashes cannot match)",
+                        rep.name, rbs, self.config.block_size,
+                    )
+        self.metrics.replica_up.set(rep.name, 1.0 if ready else 0.0)
+
+    def _probe_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.probe_once()
+            except Exception:  # a probe bug must not kill routing
+                logger.exception("replica probe sweep failed")
+            self._stop.wait(self.config.probe_interval_s)
+
+    def start(self) -> "Router":
+        """Probe immediately (so the first request can route), then keep
+        probing in the background."""
+        self.probe_once()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="fleet-probe", daemon=True
+        )
+        self._probe_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+
+    def _mark_down(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.alive = False
+            rep.ready = False
+        self.metrics.replica_up.set(rep.name, 0.0)
+
+    # -- placement ------------------------------------------------------------
+    def _candidates(
+        self, exclude: set, pool: str
+    ) -> list[_Replica]:
+        with self._lock:
+            reps = list(self._replicas.values())
+        if pool == "prefill":
+            return [
+                r for r in reps
+                if r.ready and r.role == "prefill" and r.name not in exclude
+            ]
+        return [
+            r for r in reps
+            if r.ready and r.decode_capable() and r.name not in exclude
+        ]
+
+    def _match_blocks(self, rep: _Replica, chains: Sequence[int]) -> int:
+        """Longest CONSECUTIVE chain prefix this replica's hot set covers —
+        consecutive because ``match_prefix`` walks from block 1 and stops
+        at the first miss; an orphaned deeper hash is unreachable there."""
+        if not rep.block_size_ok:
+            return 0
+        n = 0
+        for h in chains:
+            if h not in rep.hot:
+                break
+            n += 1
+        return n
+
+    def place_decode(
+        self, chains: Sequence[int], exclude: Optional[set] = None
+    ) -> tuple[Optional[_Replica], int]:
+        """→ (replica, matched chain blocks). Affinity first (longest
+        advertised prefix match, ties to the least loaded), else
+        power-of-two-choices on load."""
+        cands = self._candidates(exclude or set(), "decode")
+        if not cands:
+            return None, 0
+        if self.config.affinity and chains:
+            matched = [(self._match_blocks(r, chains), r) for r in cands]
+            best = max(m for m, _ in matched)
+            if best > 0:
+                tied = [r for m, r in matched if m == best]
+                return min(tied, key=lambda r: r.load), best
+        if len(cands) <= 2:
+            return min(cands, key=lambda r: r.load), 0
+        with self._lock:
+            two = self._rng.sample(cands, 2)
+        return min(two, key=lambda r: r.load), 0
+
+    def place_prefill(self, exclude: Optional[set] = None) -> Optional[_Replica]:
+        cands = self._candidates(exclude or set(), "prefill")
+        return min(cands, key=lambda r: r.load) if cands else None
+
+    def _disaggregate_active(self) -> bool:
+        if self.config.disaggregate is False:
+            return False
+        return self.place_prefill() is not None
+
+    # -- request path ---------------------------------------------------------
+    def _encode(self, req: dict) -> Optional[list[int]]:
+        """Token ids for chain hashing (and forwarded so every replica in a
+        retry chain sees identical ids). None = unhashable here (text
+        prompt, no router-side tokenizer): the request forwards verbatim
+        and placement falls back to load-only."""
+        if req.get("prompt_ids") is not None:
+            return [int(t) for t in req["prompt_ids"]]
+        prompt = req.get("prompt")
+        if prompt is None:
+            return None
+        if self.tokenizer is not None:
+            if callable(self.tokenizer):
+                return self.tokenizer(str(prompt), add_special_tokens=True)[
+                    "input_ids"
+                ]
+            return self.tokenizer.encode(str(prompt))
+        try:  # token-id mode (tiny from-config fleets)
+            return [int(t) for t in str(prompt).replace(",", " ").split()]
+        except ValueError:
+            return None
+
+    def _emit(self, rec: dict) -> None:
+        if self.on_record is not None:
+            try:
+                self.on_record(dict(rec))
+            except Exception:  # telemetry must never break routing
+                pass
+
+    def _count_retry(self) -> None:
+        """One resubmission: the /metrics counter and its /stats mirror
+        move together, always."""
+        self.metrics.retries.inc()
+        with self._lock:
+            self.retries_total += 1
+
+    def handle_generate(self, req: dict) -> tuple[int, dict]:
+        """Route one request to a terminal response. → (HTTP status, body).
+        The body is the winning replica's response verbatim (plus the
+        router's ``route`` provenance block)."""
+        t0 = time.perf_counter()
+        rid = str(req.get("id")) if req.get("id") is not None else (
+            f"route-{next(self._ids)}"
+        )
+        if self.draining:
+            return 503, {
+                "error": "router is draining — retry against another router",
+                "retriable": True, "reason": "draining", "id": rid,
+            }
+        ids = self._encode(req)
+        chains = (
+            prompt_chain(ids, self.config.block_size)
+            if ids and self.config.affinity else []
+        )
+        with self._lock:
+            self.requests_total += 1
+        tried: set = set()
+        tried_prefill: set = set()
+        retries = 0
+        last_error = "no ready decode-capable replica"
+        rep = None
+        match = 0
+        # the forward timeout must EXCEED the replica-side budget (the
+        # replica's submit_blocking answers 504 within the client's
+        # timeout_s): if the two raced at the same value, a long-but-legal
+        # decode would read as replica death — mark-down, resubmit, and the
+        # same request terminalized on two replicas
+        fwd_timeout = max(
+            self.config.request_timeout_s,
+            float(req.get("timeout_s") or 300.0) + 30.0,
+        )
+        while retries <= self.config.retry_budget:
+            rep, match = self.place_decode(chains, exclude=tried)
+            if rep is None:
+                break
+            fwd = {k: v for k, v in req.items() if k != "prompt_ids"}
+            if req.get("prompt_ids") is not None:
+                fwd["prompt_ids"] = ids
+            elif ids is not None and self.tokenizer is not None:
+                # router-side tokenization: every replica in a retry chain
+                # sees identical ids. WITHOUT a tokenizer a text prompt
+                # forwards verbatim (docs/serving.md) — the token-id parse
+                # is for affinity hashing only, and a numeric-looking text
+                # prompt must not silently bypass the replica's tokenizer
+                fwd.pop("prompt", None)
+                fwd["prompt_ids"] = ids
+            fwd["id"] = rid
+            used_prefill = None
+            if (
+                ids is not None
+                # disaggregation needs ids BOTH sides agree on: client-sent
+                # prompt_ids or a router-side tokenizer. Fallback-parsed
+                # text must NOT disaggregate — /prefill would compute KV
+                # for the parse while the decode replica re-encodes the
+                # forwarded text with its own tokenizer
+                and (
+                    req.get("prompt_ids") is not None
+                    or self.tokenizer is not None
+                )
+                and self._disaggregate_active()
+                # strong affinity hit: the decode replica already holds at
+                # least half the prompt — recomputing the tail beats a
+                # whole-prompt KV transfer
+                and match * self.config.block_size < max(len(ids) // 2, 1)
+                and rep.kv_port
+            ):
+                pre = self.place_prefill(exclude=tried_prefill)
+                if pre is not None:
+                    handoff_id = uuid.uuid4().hex
+                    host = urllib.parse.urlsplit(rep.url).hostname
+                    try:
+                        code, body = _http_json(
+                            pre.url + "/prefill",
+                            {
+                                "prompt_ids": ids, "id": rid,
+                                "transfer": {
+                                    "host": host, "port": int(rep.kv_port),
+                                    "handoff_id": handoff_id,
+                                },
+                            },
+                            fwd_timeout,
+                        )
+                    except ReplicaUnreachable as e:
+                        self._mark_down(pre)
+                        tried_prefill.add(pre.name)
+                        retries += 1
+                        self._count_retry()
+                        last_error = f"prefill replica unreachable: {e}"
+                        continue
+                    if code != 200 or not body.get("ok"):
+                        last_error = (
+                            f"prefill on {pre.name} failed: "
+                            f"{body.get('error', code)}"
+                        )
+                        if code == 502:
+                            # 502 = the TRANSFER to the decode replica
+                            # failed (server.py wraps KVTransferError as
+                            # 502): the suspect is the decode target's
+                            # listener (stale kv_port after a restart),
+                            # not the prefill replica that ran the prompt
+                            # — exclude the decode replica and keep the
+                            # prefill pool intact
+                            tried.add(rep.name)
+                            retries += 1
+                            self._count_retry()
+                            continue
+                        if body.get("retriable", code == 503):
+                            tried_prefill.add(pre.name)
+                            retries += 1
+                            self._count_retry()
+                            continue
+                        # terminal prefill failure (client budget expiry,
+                        # bad request): one route_request record per
+                        # terminal outcome — this path counts too
+                        self.metrics.requests.inc(pre.name)
+                        self.metrics.latency.observe(
+                            time.perf_counter() - t0
+                        )
+                        self._emit({
+                            "event": "route_request",
+                            "request_id": rid,
+                            "replica": pre.name,
+                            "retries": retries,
+                            "prefix_match_blocks": match,
+                            "disaggregated": True,
+                            "prefill_replica": pre.name,
+                            "completion_reason": body.get(
+                                "completion_reason", "prefill_failed"
+                            ),
+                            "status": code,
+                            "route_s": round(time.perf_counter() - t0, 6),
+                            "ts": time.time(),
+                        })
+                        return code, {**body, "id": rid}
+                    fwd["handoff_id"] = handoff_id
+                    used_prefill = pre.name
+                    self.metrics.handoffs.inc()
+                    with self._lock:
+                        self.handoffs_total += 1
+            try:
+                code, body = _http_json(
+                    rep.url + "/generate", fwd, fwd_timeout
+                )
+            except ReplicaUnreachable as e:
+                # TCP-level death: the replica never answered — always
+                # retriable, and the registry marks it down until a probe
+                # sees it healthy again
+                self._mark_down(rep)
+                tried.add(rep.name)
+                retries += 1
+                self._count_retry()
+                last_error = f"replica {rep.name} unreachable: {e}"
+                continue
+            # 503 = shed/draining/engine down; 409 = the claimed handoff
+            # never arrived or expired on that decode replica — both
+            # resubmit elsewhere (the next round redoes prefill+transfer)
+            if code in (503, 409) and body.get("retriable"):
+                tried.add(rep.name)
+                retries += 1
+                self._count_retry()
+                last_error = (
+                    f"{rep.name} rejected retriable: "
+                    f"{body.get('reason') or body.get('error')}"
+                )
+                continue
+            # terminal — success (200), client-budget expiry (504), bad
+            # request (400), or a non-retriable replica error
+            if match > 0:
+                self.metrics.prefix_hits.inc()
+                with self._lock:
+                    self.prefix_hits_total += 1
+            self.metrics.requests.inc(rep.name)
+            self.metrics.latency.observe(time.perf_counter() - t0)
+            if code == 200:
+                with self._lock:
+                    self.completed_total += 1
+            body = dict(body)
+            body["id"] = rid
+            body["route"] = {
+                "replica": rep.name, "retries": retries,
+                "prefix_match_blocks": match,
+                "prefill_replica": used_prefill,
+            }
+            self._emit({
+                "event": "route_request",
+                "request_id": rid,
+                "replica": rep.name,
+                "retries": retries,
+                "prefix_match_blocks": match,
+                "disaggregated": used_prefill is not None,
+                "prefill_replica": used_prefill,
+                "completion_reason": body.get("completion_reason"),
+                "n_generated": body.get("n_generated"),
+                "status": code,
+                "route_s": round(time.perf_counter() - t0, 6),
+                "ts": time.time(),
+            })
+            return code, body
+        # exhausted: budget spent or nothing to route to — an explicit
+        # retriable answer, never a silent drop
+        self.metrics.unroutable.inc()
+        with self._lock:
+            self.unroutable_total += 1
+        self._emit({
+            "event": "route_request",
+            "request_id": rid,
+            "replica": rep.name if rep is not None else None,
+            "retries": retries,
+            "prefix_match_blocks": match,
+            "completion_reason": "unroutable",
+            "status": 503,
+            "route_s": round(time.perf_counter() - t0, 6),
+            "ts": time.time(),
+        })
+        return 503, {
+            "error": (
+                f"no replica could serve the request after {retries} "
+                f"retr{'y' if retries == 1 else 'ies'}: {last_error}"
+            ),
+            "retriable": True, "reason": "unroutable", "id": rid,
+        }
+
+    # -- fronts ---------------------------------------------------------------
+    def begin_drain(self) -> None:
+        self.draining = True
+
+    def ready(self) -> bool:
+        """The router is ready while >= 1 decode-capable replica is — ONE
+        replica down must not drop the whole fleet out of a load balancer."""
+        return not self.draining and bool(self._candidates(set(), "decode"))
+
+    def healthy(self) -> bool:
+        return self._probe_thread is None or self._probe_thread.is_alive()
+
+    def stats(self) -> dict:
+        with self._lock:
+            reps = {
+                r.name: {
+                    "url": r.url,
+                    "role": r.role,
+                    "alive": r.alive,
+                    "ready": r.ready,
+                    "queue_depth": r.stats.get("queue_depth"),
+                    "busy_slots": r.stats.get("busy_slots"),
+                    "block_occupancy": r.stats.get("block_occupancy"),
+                    "shed_total": r.stats.get("shed_total"),
+                    "hot_prefixes": len(r.hot),
+                    "kv_transfer_port": r.kv_port,
+                }
+                for r in self._replicas.values()
+            }
+            return {
+                "replicas": reps,
+                "replicas_ready": sum(1 for r in reps.values() if r["ready"]),
+                "requests_total": self.requests_total,
+                "completed_total": self.completed_total,
+                "retries_total": self.retries_total,
+                "prefix_hits_total": self.prefix_hits_total,
+                "unroutable_total": self.unroutable_total,
+                "kv_handoffs_total": self.handoffs_total,
+                "disaggregated": self._disaggregate_active_unlocked(),
+                "draining": self.draining,
+            }
+
+    def _disaggregate_active_unlocked(self) -> bool:
+        if self.config.disaggregate is False:
+            return False
+        return any(
+            r.ready and r.role == "prefill" for r in self._replicas.values()
+        )
+
+    # -- workload driver (routed bench sub-leg + chaos tests) ------------------
+    def run_workload(
+        self, arrivals: Sequence[tuple[float, Sequence[int], Optional[int]]]
+    ) -> tuple[list[dict], dict]:
+        """Drive the same timed-arrival workload shape as
+        ``ServingEngine.run_workload``, but through the ROUTER: one thread
+        per request submits at its offset and blocks on the routed
+        response. → (terminal bodies, aggregate stats)."""
+        arrivals = sorted(arrivals, key=lambda a: a[0])
+        results: list[Optional[tuple[int, dict]]] = [None] * len(arrivals)
+        req0 = {
+            "retries": self.retries_total,
+            "hits": self.prefix_hits_total,
+            "handoffs": self.handoffs_total,
+        }
+        t0 = time.perf_counter()
+
+        def worker(i: int, offset: float, ids, max_new) -> None:
+            delay = offset - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            body = {"prompt_ids": list(ids), "id": f"bench-{i}"}
+            if max_new is not None:
+                body["max_new_tokens"] = int(max_new)
+            results[i] = self.handle_generate(body)
+
+        threads = [
+            threading.Thread(target=worker, args=(i, off, ids, mn), daemon=True)
+            for i, (off, ids, mn) in enumerate(arrivals)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        done = [r for r in results if r is not None]
+        out = [body for _, body in done]
+        completions = [
+            b for s, b in done
+            if s == 200 and b.get("completion_reason") in ("stop", "length")
+        ]
+        gen = sum(int(b.get("n_generated") or 0) for b in completions)
+        routed = len(completions)
+        stats = {
+            "requests": routed,
+            "gen_tokens": gen,
+            "wall_s": wall,
+            "fleet_tokens_per_s": gen / wall if wall > 0 else 0.0,
+            "retries": self.retries_total - req0["retries"],
+            "prefix_hits": self.prefix_hits_total - req0["hits"],
+            "kv_handoffs": self.handoffs_total - req0["handoffs"],
+            "prefix_hit_rate": (
+                (self.prefix_hits_total - req0["hits"]) / len(arrivals)
+                if arrivals else 0.0
+            ),
+            "failed_requests": len(arrivals) - routed,
+        }
+        return out, stats
+
+
+def serve_router_http(
+    router: Router, port: int, host: str = "127.0.0.1"
+):
+    """→ started ThreadingHTTPServer exposing the router with the SAME
+    front contract as a single replica (serving/server.py)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            logger.debug("router http: " + fmt, *args)
+
+        def _json(self, code: int, obj: dict, retry_after: bool = False):
+            body = (json.dumps(obj) + "\n").encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if retry_after:
+                self.send_header("Retry-After", str(RETRY_AFTER_S))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                from automodel_tpu.telemetry.prometheus import CONTENT_TYPE
+
+                body = router.metrics.registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if self.path == "/healthz":
+                ok = router.healthy()
+                return self._json(200 if ok else 503, {
+                    "ok": ok, "probe_thread_alive": ok,
+                })
+            if self.path == "/readyz":
+                ready = router.ready()
+                return self._json(200 if ready else 503, {
+                    "ready": ready,
+                    "draining": router.draining,
+                    "replicas_ready": len(router._candidates(set(), "decode")),
+                })
+            if self.path != "/stats":
+                return self._json(404, {"error": f"unknown path {self.path}"})
+            return self._json(200, router.stats())
+
+        def do_POST(self):
+            if self.path != "/generate":
+                return self._json(404, {"error": f"unknown path {self.path}"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError("request body is not a JSON object")
+            except (ValueError, TypeError) as e:
+                return self._json(400, {"error": str(e)})
+            code, body = router.handle_generate(req)
+            self._json(code, body, retry_after=code == 503)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    return server
+
+
+def main(cfg: Any) -> int:
+    """`automodel_tpu route -c cfg.yaml` — run the fleet router. The config
+    needs a ``fleet:`` section (static ``replicas:`` or ``dns:``); the
+    ``model:`` section is only consulted for an optional router-side
+    tokenizer (text-prompt affinity hashing) and never built."""
+    from automodel_tpu.loggers.log_utils import setup_logging
+
+    setup_logging()
+    fleet_section = dict(cfg.get("fleet", {}) or {})
+    fcfg = FleetConfig.from_dict(fleet_section)
+    if fcfg.port is None:
+        print(
+            "fleet.port is required for `automodel_tpu route` "
+            "(the router's HTTP front)",
+        )
+        return 2
+    tokenizer = None
+    gen_section = dict(cfg.get("generation", {}) or {})
+    if gen_section.get("tokenizer") is not None:
+        # imports jax transitively — only paid when text-prompt affinity
+        # hashing is actually configured
+        from automodel_tpu.generation.engine import resolve_tokenizer
+
+        tokenizer = resolve_tokenizer(gen_section.get("tokenizer"), None)
+    on_record = None
+    metric_logger = None
+    logging_section = dict(cfg.get("logging", {}) or {})
+    if logging_section.get("metrics_path"):
+        from automodel_tpu.loggers.metric_logger import MetricLogger
+
+        metric_logger = MetricLogger(logging_section["metrics_path"])
+        on_record = metric_logger.log
+    router = Router(fcfg, tokenizer=tokenizer, on_record=on_record)
+    router.start()
+    server = serve_router_http(router, fcfg.port, host=fcfg.host)
+
+    def _drain_then_stop():
+        router.begin_drain()
+        deadline = time.monotonic() + fcfg.drain_grace_s
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+        server.shutdown()
+
+    def _on_term():
+        threading.Thread(
+            target=_drain_then_stop, name="route-drain", daemon=True
+        ).start()
+
+    handler = None
+    if threading.current_thread() is threading.main_thread():
+        from automodel_tpu.resilience.preemption import PreemptionHandler
+
+        handler = PreemptionHandler(
+            signals=("SIGTERM",), on_preempt=_on_term,
+            log_message=(
+                "router drain: rejecting new requests retriable, letting "
+                f"in-flight forwards finish within {fcfg.drain_grace_s}s"
+            ),
+        )
+        handler.install()
+    print(
+        json.dumps({
+            "event": "route_listening",
+            "host": fcfg.host, "port": server.server_address[1],
+            "replicas": len(router._replicas), "dns": fcfg.dns,
+        }),
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        router.close()
+        if handler is not None:
+            handler.restore()
+        if metric_logger is not None:
+            metric_logger.close()
+    return 0

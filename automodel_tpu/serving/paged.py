@@ -184,6 +184,69 @@ def _scatter_table(side, new: jnp.ndarray, table: jnp.ndarray):
 _write_targets = kv_cache.paged_write_targets
 
 
+# -- KV extraction / injection (disaggregated prefill→decode handoff) --------
+
+
+def extract_blocks(pool: PagedKV, blocks) -> tuple:
+    """Pull one request's block rows out of the pool to host memory —
+    ``(k, v)``, each ``[L, nb, BS, Nkv, H]`` (or ``(int8 values, fp32
+    scales)`` pairs for quantized pools). The prefill replica ships exactly
+    these bytes; positions past the prompt inside the last block are junk
+    the receiver's attend masks out (and the first decode write overwrites
+    the next row before it is ever attended)."""
+    import numpy as np
+
+    idx = jnp.asarray(np.asarray(blocks, np.int32))
+
+    def side(s):
+        if isinstance(s, tuple):
+            return (
+                np.asarray(jax.device_get(s[0][:, idx])),
+                np.asarray(jax.device_get(s[1][:, idx])),
+            )
+        return np.asarray(jax.device_get(s[:, idx]))
+
+    return side(pool.k), side(pool.v)
+
+
+@functools.lru_cache(maxsize=32)
+def _inject_fn(nb: int, quantized: bool):
+    """Jitted whole-block scatter for a KV handoff — donated pool, one
+    compiled program per (block count, quantization). Block counts follow
+    prompt lengths, so a production front should bucket prompts to bound
+    compile churn (docs/serving.md); the handoff itself is correct at any
+    nb."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def inject(pool: PagedKV, table, k_rows, v_rows):
+        def side(s, rows):
+            if isinstance(s, tuple):
+                return (
+                    s[0].at[:, table].set(jnp.asarray(rows[0], s[0].dtype)),
+                    s[1].at[:, table].set(jnp.asarray(rows[1], s[1].dtype)),
+                )
+            return s.at[:, table].set(jnp.asarray(rows, s.dtype))
+
+        return PagedKV(k=side(pool.k, k_rows), v=side(pool.v, v_rows))
+
+    return inject
+
+
+def inject_blocks(pool: PagedKV, blocks, kv: dict) -> PagedKV:
+    """Scatter shipped block rows ``kv = {"k": rows, "v": rows}`` into the
+    pool cells named by ``blocks`` — the receiving half of the prefill→
+    decode handoff. Int8 payloads land their (values, scales) pairs as-is
+    (no requantization: the round trip is bit-identical by construction);
+    the scatter rides the same ``.at[:, table]`` cell addressing as chunk
+    prefill's scatter-back, so sender and receiver land rows in the same
+    cells for the same table."""
+    import numpy as np
+
+    table = jnp.asarray(np.asarray(blocks, np.int32))
+    fn = _inject_fn(int(table.shape[0]), pool.quantized)
+    return fn(pool, table, kv["k"], kv["v"])
+
+
 # -- forward cores -----------------------------------------------------------
 
 

@@ -145,8 +145,15 @@ class _EngineLoop:
         return self._thread.is_alive() and self.error is None
 
     def submit_blocking(
-        self, prompt_ids: list[int], req: dict, timeout_s: float
+        self,
+        prompt_ids: list[int],
+        req: dict,
+        timeout_s: float,
+        submit: Optional[Any] = None,
     ) -> dict:
+        """``submit`` (optional, called under the lock) replaces the plain
+        ``engine.submit`` — the /prefill and KV-handoff paths enqueue
+        through their own entry points but share this wait machinery."""
         from automodel_tpu.serving.engine import QueueFull
 
         ev = threading.Event()
@@ -154,12 +161,15 @@ class _EngineLoop:
             if self.error is not None:
                 raise RuntimeError(f"serving engine is down: {self.error}")
             try:
-                rid = self.engine.submit(
-                    prompt_ids,
-                    max_new_tokens=req.get("max_new_tokens"),
-                    deadline_s=req.get("deadline_s"),
-                    max_queue_wait_s=req.get("max_queue_wait_s"),
-                )
+                if submit is not None:
+                    rid = submit()
+                else:
+                    rid = self.engine.submit(
+                        prompt_ids,
+                        max_new_tokens=req.get("max_new_tokens"),
+                        deadline_s=req.get("deadline_s"),
+                        max_queue_wait_s=req.get("max_queue_wait_s"),
+                    )
             except QueueFull:
                 # the HTTP front sheds immediately — a blocked handler
                 # thread per queued-out client is exactly the unbounded
@@ -211,10 +221,18 @@ class _EngineLoop:
                 time.sleep(0.005)
 
 
-def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
+def serve_http(
+    engine: Any,
+    tokenizer: Any,
+    port: int,
+    host: str = "127.0.0.1",
+    kv_store: Any = None,
+):
     """→ (ThreadingHTTPServer, _EngineLoop), both started. The caller calls
     ``server.serve_forever()`` (CLI) or drives requests itself (tests) and
-    shuts both down."""
+    shuts both down. ``kv_store`` (a fleet ``HandoffStore``) arms the
+    disaggregated paths: POST /generate with a ``handoff_id`` claims a
+    transferred prefill payload from it."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     loop = _EngineLoop(engine)
@@ -317,19 +335,156 @@ def serve_http(engine: Any, tokenizer: Any, port: int, host: str = "127.0.0.1"):
                     "spec_proposed_total": engine.spec_proposed_total,
                     "spec_accepted_total": engine.spec_accepted_total,
                     "spec_accept_rate": engine.spec_accept_rate,
+                    # fleet tier (serving/fleet/router.py probes these):
+                    # role for pool membership, block_size so the router can
+                    # refuse affinity on a geometry mismatch, hot_prefixes
+                    # for prefix-affinity placement, kv_transfer_port for
+                    # the prefill→decode handoff
+                    "role": engine.config.role,
+                    "block_size": engine.config.block_size,
+                    "kv_transfer_port": engine.kv_transfer_port,
+                    "kv_injected_total": engine.kv_injected_total,
+                    "hot_prefixes": engine.hot_prefixes(),
                 })
 
+        def _read_req(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("request body is not a JSON object")
+            return req
+
+        def _prefill(self):
+            """Disaggregated fleet: run chunked prefill ONLY, then stream
+            the finished KV block rows to the decode replica named in
+            ``transfer: {host, port, handoff_id}``. Responds after the
+            receiver acked — the router's follow-up /generate can never
+            race the transfer."""
+            from automodel_tpu.serving.engine import EngineDraining, QueueFull
+            from automodel_tpu.serving.fleet.kv_transfer import (
+                KVTransferError,
+                send_kv,
+            )
+
+            try:
+                req = self._read_req()
+                transfer = dict(req.get("transfer") or {})
+                if not transfer.get("handoff_id") or not transfer.get("host") \
+                        or transfer.get("port") is None:
+                    return self._json(400, {
+                        "error": "prefill needs transfer.{host,port,handoff_id}"
+                    })
+                ids = _encode_prompt(req, tokenizer)
+                rec = loop.submit_blocking(
+                    ids, req, timeout_s=float(req.get("timeout_s", 300.0)),
+                    submit=lambda: engine.submit(
+                        ids, prefill_only=True,
+                        deadline_s=req.get("deadline_s"),
+                        max_queue_wait_s=req.get("max_queue_wait_s"),
+                    ),
+                )
+            except (ValueError, TypeError) as e:
+                return self._json(400, {"error": str(e)})
+            except QueueFull as e:
+                # submit_blocking already recorded the shed — mirroring
+                # /generate, no second record here
+                return self._json(
+                    503, {"error": str(e), "retriable": True, "reason": "shed"},
+                    retry_after=True,
+                )
+            except EngineDraining as e:
+                return self._json(
+                    503,
+                    {"error": str(e), "retriable": True, "reason": "draining"},
+                    retry_after=True,
+                )
+            except TimeoutError as e:
+                return self._json(504, {"error": str(e)})
+            except RuntimeError as e:
+                return self._json(503, {"error": str(e), "retriable": True})
+            reason = rec.get("completion_reason")
+            if reason != "prefilled":
+                code = _reason_status(reason)
+                return self._json(code, {
+                    "error": f"prefill ended as {reason}",
+                    "completion_reason": reason,
+                    "retriable": bool(rec.get("retriable")),
+                }, retry_after=code == 503)
+            try:
+                with loop.lock:
+                    payload = engine.pop_prefill_payload(rec["request_id"])
+            except KeyError as e:
+                # the bounded stash evicted this payload before pickup
+                # (kv_transfer.max_pending prefills completed in between) —
+                # a transient capacity condition, not a dead replica: answer
+                # 503 retriable instead of dying without a response (which
+                # the router would read as replica death)
+                return self._json(
+                    503, {"error": str(e), "retriable": True},
+                    retry_after=True,
+                )
+            meta = {
+                "handoff_id": str(transfer["handoff_id"]),
+                "request_id": rec["request_id"],
+                "prompt_len": payload["prompt_len"],
+                "first_token": payload["first_token"],
+                "geometry": engine.kv_geometry(),
+            }
+            try:
+                send_kv(
+                    (str(transfer["host"]), int(transfer["port"])),
+                    meta, payload["kv"],
+                )
+            except KVTransferError as e:
+                return self._json(
+                    502, {"ok": False, "error": str(e), "retriable": True}
+                )
+            return self._json(200, {
+                "ok": True,
+                "handoff_id": meta["handoff_id"],
+                "first_token": payload["first_token"],
+                "prompt_tokens": payload["prompt_len"],
+                "prefix_hit_tokens": rec.get("prefix_hit_tokens", 0),
+                "ttft_s": rec.get("ttft_s"),
+            })
+
         def do_POST(self):
+            if self.path == "/prefill":
+                return self._prefill()
             if self.path != "/generate":
                 return self._json(404, {"error": f"unknown path {self.path}"})
             from automodel_tpu.serving.engine import EngineDraining, QueueFull
 
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(n) or b"{}")
+                req = self._read_req()
                 ids = _encode_prompt(req, tokenizer)
+                submit = None
+                if req.get("handoff_id") is not None:
+                    # disaggregated decode: claim the transferred prefill
+                    # payload and start the request directly in decode
+                    if kv_store is None:
+                        return self._json(400, {
+                            "error": "this replica runs no KV-transfer "
+                            "listener (serving.role: decode, or "
+                            "serving.kv_transfer.enabled: true)"
+                        })
+                    try:
+                        entry = kv_store.pop(str(req["handoff_id"]))
+                    except KeyError as e:
+                        # never arrived / expired: the router retries the
+                        # whole prefill→decode flow elsewhere
+                        return self._json(
+                            409, {"error": str(e), "retriable": True}
+                        )
+                    submit = lambda: engine.submit_prefilled(  # noqa: E731
+                        ids, entry["meta"]["first_token"], entry["kv"],
+                        max_new_tokens=req.get("max_new_tokens"),
+                        deadline_s=req.get("deadline_s"),
+                        max_queue_wait_s=req.get("max_queue_wait_s"),
+                    )
                 rec = loop.submit_blocking(
-                    ids, req, timeout_s=float(req.get("timeout_s", 300.0))
+                    ids, req, timeout_s=float(req.get("timeout_s", 300.0)),
+                    submit=submit,
                 )
             except (ValueError, TypeError) as e:
                 return self._json(400, {"error": str(e)})
@@ -447,6 +602,23 @@ def main(cfg: Any) -> int:
         auto, serve_cfg, gen_cfg, on_record=on_record
     )
 
+    # disaggregated fleet: a decode-role replica listens for prefill→decode
+    # KV handoffs (serving.kv_transfer.enabled: null = auto-on for role
+    # decode); the bound port is advertised to the router via /stats
+    kv_server = None
+    ktc = serve_cfg.kv_transfer
+    kv_on = ktc.enabled if ktc.enabled is not None else serve_cfg.role == "decode"
+    if kv_on:
+        from automodel_tpu.serving.fleet.kv_transfer import KVTransferServer
+
+        kv_server = KVTransferServer(
+            engine.kv_geometry(), host=ktc.host, port=ktc.port,
+            max_pending=ktc.max_pending, ttl_s=ktc.ttl_s,
+            max_frame_bytes=engine.kv_frame_bytes_bound(),
+        ).start()
+        engine.kv_transfer_port = kv_server.port
+        logger.info("KV-transfer listener on port %d", kv_server.port)
+
     # stall-watchdog evidence routing: stacks + flight recorder land next
     # to the metrics JSONL when one is configured (same layout the training
     # guard uses)
@@ -478,22 +650,29 @@ def main(cfg: Any) -> int:
     try:
         if http_section.get("port") is not None:
             return _serve_http_forever(
-                engine, tokenizer, http_section, serve_cfg
+                engine, tokenizer, http_section, serve_cfg,
+                kv_store=kv_server.store if kv_server is not None else None,
             )
         return _serve_stdin(engine, tokenizer, serve_cfg)
     finally:
         engine.stop_watchdog()
+        if kv_server is not None:
+            kv_server.close()
         if metric_logger is not None:
             metric_logger.close()
 
 
-def _serve_http_forever(engine, tokenizer, http_section, serve_cfg) -> int:
+def _serve_http_forever(
+    engine, tokenizer, http_section, serve_cfg, kv_store=None
+) -> int:
     port = int(http_section["port"])
     host = str(http_section.get("host", "127.0.0.1"))
     drain_cfg = serve_cfg.drain
     if http_section.get("warmup", True):
         _warmup(engine)
-    server, loop = serve_http(engine, tokenizer, port, host=host)
+    server, loop = serve_http(
+        engine, tokenizer, port, host=host, kv_store=kv_store
+    )
     state = {"rc": 0}
 
     def _drain_then_stop():

@@ -107,6 +107,79 @@ class Gauge(_Metric):
         return self.name
 
 
+_LABEL_VALUE_OK = re.compile(r"^[a-zA-Z0-9_.+-]+$")
+
+
+def _label_value(v: str) -> str:
+    """Sanitize a label value to the charset the exposition lint (and a
+    conservative scraper) accepts — replica names like ``r0`` pass through;
+    anything exotic (a raw URL) degrades to dashes instead of breaking the
+    scrape."""
+    v = str(v)
+    if _LABEL_VALUE_OK.match(v):
+        return v
+    return re.sub(r"[^a-zA-Z0-9_.+-]", "-", v) or "unknown"
+
+
+class _Labeled(_Metric):
+    """One metric name fanned out over ONE label (e.g. the fleet router's
+    ``automodel_route_requests_total{replica="r0"}``). Child values are
+    created on first touch and render as one sample line per label value.
+    Mutations take a per-metric lock: unlike the scalar float updates,
+    inserting a NEW label key (a replica joining via DNS) during a
+    concurrent /metrics render would die with "dictionary changed size
+    during iteration"."""
+
+    def __init__(self, name: str, help_text: str, label: str):
+        super().__init__(name, help_text)
+        if not _NAME_RE.match(label):
+            raise ValueError(f"invalid prometheus label name {label!r}")
+        self.label = label
+        self.values: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def _lines(self, suffix: str) -> list[str]:
+        with self._lock:
+            items = sorted(self.values.items())
+        return [
+            f'{self.name}{suffix}{{{self.label}="{lv}"}} {_fmt(v)}'
+            for lv, v in items
+        ]
+
+
+class LabeledCounter(_Labeled):
+    kind = "counter"
+
+    def inc(self, label_value: str, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError(f"counter {self.name}: negative increment {v}")
+        lv = _label_value(label_value)
+        with self._lock:
+            self.values[lv] = self.values.get(lv, 0.0) + v
+
+    def render(self) -> list[str]:
+        return self._lines("_total")
+
+    @property
+    def render_name(self) -> str:
+        return f"{self.name}_total"
+
+
+class LabeledGauge(_Labeled):
+    kind = "gauge"
+
+    def set(self, label_value: str, v: float) -> None:
+        with self._lock:
+            self.values[_label_value(label_value)] = float(v)
+
+    def render(self) -> list[str]:
+        return self._lines("")
+
+    @property
+    def render_name(self) -> str:
+        return self.name
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
@@ -174,6 +247,16 @@ class MetricsRegistry:
     def gauge(self, name: str, help_text: str) -> Gauge:
         with self.lock:
             return self._register(Gauge(name, help_text))  # type: ignore[return-value]
+
+    def labeled_counter(
+        self, name: str, help_text: str, label: str
+    ) -> LabeledCounter:
+        with self.lock:
+            return self._register(LabeledCounter(name, help_text, label))  # type: ignore[return-value]
+
+    def labeled_gauge(self, name: str, help_text: str, label: str) -> LabeledGauge:
+        with self.lock:
+            return self._register(LabeledGauge(name, help_text, label))  # type: ignore[return-value]
 
     def histogram(
         self, name: str, help_text: str, buckets: Sequence[float] = LATENCY_BUCKETS

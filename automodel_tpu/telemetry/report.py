@@ -59,6 +59,17 @@ _NUMERIC_KEYS = (
     # serving robustness (PR 9): drain/deadline/stall evidence
     "drain_duration_s",
     "requests_failed",
+    # fleet router (serving/fleet/): per-request `route_request` events +
+    # the routed bench sub-leg's aggregate keys
+    "retries",
+    "prefix_match_blocks",
+    "route_s",
+    "serve_fleet_tokens_per_s",
+    "serve_route_prefix_hit_rate",
+    "serve_fleet_retries",
+    "serve_fleet_replicas",
+    "serve_fleet_requests",
+    "serve_fleet_kv_handoffs",
     # distributed guard (watchdog liveness, consensus/straggler attribution)
     "heartbeat_age_s",
     "deadline_s",
@@ -318,6 +329,35 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
             ):
                 if reasons.get(reason):
                     out[key] = reasons[reason]
+    routes = [r for r in records if r.get("event") == "route_request"]
+    if routes:
+        # fleet router records: every routed request's terminal outcome —
+        # the per-replica spread, the retry bill, and the affinity hit rate
+        out["route_requests"] = len(routes)
+        out["route_retries"] = sum(
+            r["retries"] for r in routes if isinstance(r.get("retries"), int)
+        )
+        hits = sum(
+            1 for r in routes
+            if isinstance(r.get("prefix_match_blocks"), int)
+            and r["prefix_match_blocks"] > 0
+        )
+        out["route_prefix_hit_rate"] = round(hits / len(routes), 4)
+        by_replica: dict[str, int] = {}
+        for r in routes:
+            name = r.get("replica")
+            if isinstance(name, str):
+                by_replica[name] = by_replica.get(name, 0) + 1
+        if by_replica:
+            out["route_replicas"] = dict(sorted(by_replica.items()))
+        unroutable = sum(
+            1 for r in routes if r.get("completion_reason") == "unroutable"
+        )
+        if unroutable:
+            out["route_unroutable"] = unroutable
+        handoffs = sum(1 for r in routes if r.get("disaggregated"))
+        if handoffs:
+            out["route_kv_handoffs"] = handoffs
     stalls = [r for r in records if r.get("event") == "serve_engine_event"]
     if stalls:
         out["serve_engine_events"] = [
@@ -358,11 +398,16 @@ _BENCH_LEGS = (
     # speculative sub-leg: a null accept rate must name why (spec disabled,
     # engine failure, no round ran) — never read as "measured zero"
     ("serve_accept_rate", "serve_spec_failure"),
+    # routed fleet sub-leg (serving/fleet/): same contract — absent fleet:
+    # section / any failure records its reason, never a silent null/zero
+    ("serve_fleet_tokens_per_s", "serve_fleet_failure"),
+    ("serve_route_prefix_hit_rate", "serve_fleet_failure"),
 )
 
 # legs where a hard 0.0 IS a measurement (an accept rate of zero means the
-# draft never matched — real data, unlike a 0.0 MFU which means never-ran)
-_ZERO_VALID_LEGS = frozenset({"serve_accept_rate"})
+# draft never matched — real data, unlike a 0.0 MFU which means never-ran;
+# a 0.0 prefix-hit rate means the workload shared no prefixes — also real)
+_ZERO_VALID_LEGS = frozenset({"serve_accept_rate", "serve_route_prefix_hit_rate"})
 
 
 def validate_bench_result(result: dict[str, Any]) -> list[str]:

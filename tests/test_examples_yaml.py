@@ -147,14 +147,40 @@ def test_example_yaml_parses_and_dry_instantiates(path):
             assert get("hf_config") or get("pretrained_model_name_or_path"), (
                 f"{path}: serving.speculative.draft is not a model section"
             )
+        from automodel_tpu.serving.engine import KVTransferConfig
+
+        assert isinstance(sc.kv_transfer, KVTransferConfig)
         for key, sub in (
             ("limits", LimitsConfig),
             ("drain", DrainConfig),
             ("watchdog", StallConfig),
             ("speculative", SpeculativeConfig),
+            ("kv_transfer", KVTransferConfig),
         ):
             if srv.get(key) is not None:
                 sub.from_dict(dict(srv[key]))
+
+    # fleet: → FleetConfig (router registry + policy; strict, incl. the
+    # per-replica {url, name, role} entries)
+    fl = _section(cfg, "fleet")
+    if fl is not None:
+        from automodel_tpu.serving.fleet.router import FleetConfig
+
+        fc = FleetConfig.from_dict(fl)
+        if srv is not None:
+            # chain-hash parity precondition: the router hashes with
+            # fleet.block_size, the replica caches with serving.block_size
+            assert fc.block_size == ServeConfig.from_dict(srv).block_size, (
+                f"{path}: fleet.block_size != serving.block_size — prefix "
+                "affinity could never hit"
+            )
+
+    # k8s_fleet: → K8sFleetConfig (router Deployment + replica StatefulSets)
+    kf = _section(cfg, "k8s_fleet")
+    if kf is not None:
+        from automodel_tpu.launcher.k8s import K8sFleetConfig
+
+        K8sFleetConfig(**kf)
 
     # profiling: → ProfilingConfig (+ nested triggered: sub-section)
     prof = _section(cfg, "profiling")
@@ -222,3 +248,17 @@ def test_config_dataclasses_reject_unknown_keys():
         ServeConfig.from_dict({"decode_kernel": "mosaic"})
     with pytest.raises(ValueError):  # enabled without a draft section
         ServeConfig.from_dict({"speculative": {"enabled": True}})
+    with pytest.raises(ValueError):
+        ServeConfig.from_dict({"role": "router"})
+    with pytest.raises(TypeError):
+        ServeConfig.from_dict({"kv_transfer": {"portt": 1}})
+    from automodel_tpu.serving.fleet.router import FleetConfig
+
+    with pytest.raises(TypeError):
+        FleetConfig.from_dict({"replicass": []})
+    with pytest.raises(TypeError):
+        FleetConfig.from_dict({"replicas": [{"url": "http://x", "rol": "mixed"}]})
+    with pytest.raises(ValueError):
+        FleetConfig.from_dict({"replicas": [{"url": "http://x", "role": "router"}]})
+    with pytest.raises(ValueError):
+        FleetConfig.from_dict({"retry_budget": -1})
