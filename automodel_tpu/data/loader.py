@@ -6,10 +6,17 @@ GLOBAL microbatch; `place_batch` device_puts it with the (batch, seq) sharding
 so each device receives only its slice. Multi-host: the loader yields
 host-local slices and `jax.make_array_from_process_local_data` assembles the
 global array.
+
+Batch construction is FUNCTIONAL — `batch_for(epoch, i)` builds batch i of
+epoch `epoch` from nothing but the (seed, epoch) shuffle order, with no
+mutable cursor involved — so the sync iterator and the prefetch pipeline's
+collate workers (data/prefetch.py, which call it concurrently from a thread
+pool) produce bit-identical streams from any resume point.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Iterator
 
 import jax
@@ -54,6 +61,14 @@ class DataLoader:
         self.infinite = infinite
         self.epoch = 0
         self.batch_in_epoch = 0
+        # ((seed, epoch) → order) for the epochs currently in flight;
+        # collate workers near an epoch boundary want e and e+1 at once.
+        # Keyed by seed too: load_state_dict/seek may change the seed, and a
+        # stale cached order would silently replay the old shuffle. Guarded:
+        # concurrent recomputation would be deterministic anyway, the lock
+        # only keeps the dict mutation safe.
+        self._order_cache: dict[tuple, np.ndarray] = {}
+        self._order_lock = threading.Lock()
 
     def __len__(self) -> int:
         n = len(self.dataset) // self.global_batch_size
@@ -61,27 +76,55 @@ class DataLoader:
             n += 1
         return n
 
-    def _epoch_order(self) -> np.ndarray:
+    def _epoch_order(self, epoch: int | None = None) -> np.ndarray:
+        epoch = self.epoch if epoch is None else epoch
+        key = (self.seed, epoch)
+        with self._order_lock:
+            order = self._order_cache.get(key)
+            if order is not None:
+                return order
         order = np.arange(len(self.dataset))
         if self.shuffle:
-            np.random.default_rng(self.seed * 1000003 + self.epoch).shuffle(order)
+            np.random.default_rng(self.seed * 1000003 + epoch).shuffle(order)
+        with self._order_lock:
+            self._order_cache[key] = order
+            for k in [k for k in self._order_cache if k[0] != self.seed or k[1] < epoch - 1]:
+                del self._order_cache[k]
         return order
+
+    def batch_for(self, epoch: int, i: int) -> dict:
+        """Collate batch ``i`` of epoch ``epoch`` (pure w.r.t. the cursor;
+        thread-safe given a read-only dataset). Both the sync iterator and
+        the prefetch collate workers go through here, so the injected
+        collate delay (fault_injection.slow_collate_ms) hits both paths."""
+        order = self._epoch_order(epoch)
+        idx = order[i * self.global_batch_size : (i + 1) * self.global_batch_size]
+        examples = [self.dataset[int(j)] for j in idx]
+        batch = self.collate_fn(examples, **self.collate_kwargs)
+        from automodel_tpu.resilience.fault_injection import active_injector
+
+        inj = active_injector()
+        if inj is not None:
+            inj.maybe_slow_collate()
+        return batch
 
     def __iter__(self) -> Iterator[dict]:
         while True:
-            order = self._epoch_order()
             nb = len(self)
             while self.batch_in_epoch < nb:
-                i = self.batch_in_epoch
-                idx = order[i * self.global_batch_size : (i + 1) * self.global_batch_size]
-                examples = [self.dataset[int(j)] for j in idx]
-                batch = self.collate_fn(examples, **self.collate_kwargs)
+                batch = self.batch_for(self.epoch, self.batch_in_epoch)
                 self.batch_in_epoch += 1
                 yield batch
             self.epoch += 1
             self.batch_in_epoch = 0
             if not self.infinite:
                 return
+
+    def seek(self, epoch: int, batch_in_epoch: int) -> None:
+        """Jump the cursor to an exact position (resume restore; the
+        rollback fast-forward in train_ft._rollback)."""
+        self.epoch = int(epoch)
+        self.batch_in_epoch = int(batch_in_epoch)
 
     def state_dict(self) -> dict:
         return {"epoch": self.epoch, "batch_in_epoch": self.batch_in_epoch, "seed": self.seed}
@@ -94,19 +137,28 @@ class DataLoader:
 
 def place_batch(ctx: MeshContext | None, batch: dict, microbatched: bool = True) -> dict:
     """device_put a (possibly [A]-stacked) numpy batch with (batch, seq)
-    sharding. Non-array keys pass through."""
-    out: dict = {}
+    sharding — ONE batched transfer for all keys (a per-key loop serializes
+    a host/device round-trip per key; the batched form lets the runtime
+    coalesce the copies). Non-array keys pass through."""
+    keys: list = []
+    arrays: list = []
+    shardings: list = []
     for k, v in batch.items():
         if not isinstance(v, np.ndarray):
             continue  # host-side scalars (num_label_tokens) stay off-device
         if ctx is None:
-            out[k] = jax.numpy.asarray(v)
+            keys.append(k)
+            arrays.append(jax.numpy.asarray(v))
             continue
         spec = BATCH_KEY_SPECS.get(k, ("batch",))
         if microbatched:
             spec = (None, *spec)
-        out[k] = jax.device_put(v, ctx.sharding(*spec))
-    return out
+        keys.append(k)
+        arrays.append(v)
+        shardings.append(ctx.sharding(*spec))
+    if ctx is None:
+        return dict(zip(keys, arrays))
+    return dict(zip(keys, jax.device_put(arrays, shardings)))
 
 
 def microbatch_iterator(

@@ -1,0 +1,379 @@
+"""Host-overlap input pipeline: background collate workers + N-deep device
+prefetch with deterministic resume.
+
+The sync train loop pays, serially per step: `next(it)` (python collate +
+numpy stacking), then the blocking H2D `device_put` in `place_batch`, then
+dispatch — every millisecond of host batch prep is added to step time
+instead of hidden under device compute. The reference hides this behind
+torch DataLoader worker processes + StatefulDataLoader resume
+(base_recipe.py:541); this is the single-controller JAX equivalent: a small
+thread pool collates upcoming batches in parallel (the GIL is released in
+numpy/tokenizer/disk work, which is where collate time goes), one producer
+thread stacks/zigzags/`device_put`s them in order, and a bounded queue holds
+up to ``data.prefetch.depth`` device-ready optimizer batches ahead. The
+train loop's per-step input cost collapses to a queue pop.
+
+Correctness crux — resume semantics: ``state_dict()`` reflects the
+**consumption** cursor, not the fetch cursor. The producer runs ahead of
+training; a checkpoint taken at step N must resume at the first batch the
+optimizer has NOT folded in, so every queue item carries the loader cursor
+as of *after that item*, and the facade adopts it only when the consumer
+pops the item. Prefetched-but-unconsumed batches are dropped at shutdown
+and replayed exactly once after a restart; the rollback fast-forward
+(`train_ft._rollback`) calls ``seek()``, which flushes the queue, joins the
+producer, and restarts fetching at the rolled-back cursor — a rollback
+across a prefetched window stays bit-exact with a sync run.
+
+Multi-host: each host's pipeline prefetches its local slice; whatever the
+``place`` callback does (``jax.device_put`` with a NamedSharding, or
+``make_array_from_process_local_data`` assembly) runs in the producer
+thread, off the hot path.
+
+YAML::
+
+    data:
+      prefetch:
+        enabled: true        # section presence opts in; this key opts out
+        depth: 2             # device-ready optimizer batches held ahead
+        collate_workers: 2   # parallel collate threads feeding the producer
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterator, Optional
+
+from automodel_tpu.data.collators import stack_microbatches
+
+
+@dataclasses.dataclass
+class PrefetchConfig:
+    """The ``data.prefetch:`` YAML section (strict keys)."""
+
+    enabled: bool = True
+    depth: int = 2
+    collate_workers: int = 2
+
+    def __post_init__(self) -> None:
+        if self.enabled and self.depth < 1:
+            raise ValueError(f"data.prefetch.depth must be >= 1, got {self.depth}")
+        if self.enabled and self.collate_workers < 1:
+            raise ValueError(
+                f"data.prefetch.collate_workers must be >= 1, got {self.collate_workers}"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "PrefetchConfig":
+        d = dict(d or {})
+        d.pop("_target_", None)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise TypeError(f"unknown data.prefetch keys: {sorted(unknown)}")
+        return cls(**d)
+
+    @classmethod
+    def from_data_section(cls, section: Any) -> "PrefetchConfig":
+        """From the whole ``data:`` section (None → disabled). The section
+        is SHARED — other recipes keep their own keys there (the
+        hard-negatives miner's ``data.queries``/``data.corpus``), so only
+        ``prefetch:`` is consumed; its keys are strict (a typo'd
+        ``depth`` fails the examples dry-instantiation in tier-1, not on a
+        pod)."""
+        if section is None:
+            return cls(enabled=False)
+        pf = dict(section).get("prefetch")
+        if pf is None:
+            return cls(enabled=False)
+        return cls.from_dict(dict(pf))
+
+
+@dataclasses.dataclass
+class PreparedBatch:
+    """One device-ready optimizer batch: the host-side stacked arrays (the
+    guard's data hash and token accounting read these), the placed device
+    tree, the token count, and the loader cursor as of after this batch."""
+
+    host: dict
+    device: Any
+    n_tokens: int
+    state_after: dict
+
+
+class _EpochEnd:
+    __slots__ = ("state_after",)
+
+    def __init__(self, state_after: dict):
+        self.state_after = state_after
+
+
+class _Failure:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def default_prepare(group: list) -> tuple[dict, int]:
+    """Stack a grad-acc group; token count over all ``*input_ids`` leaves
+    (the same numerator the train loop's tps uses)."""
+    import numpy as np
+
+    stacked = stack_microbatches(group)
+    n_tokens = int(
+        sum(
+            np.prod(v.shape)
+            for k, v in stacked.items()
+            if k.endswith("input_ids") and isinstance(v, np.ndarray)
+        )
+    )
+    return stacked, n_tokens
+
+
+class PrefetchingLoader:
+    """Bounded background pipeline over a ``DataLoader``.
+
+    Duck-types the loader's stateful-resume surface (``state_dict`` /
+    ``load_state_dict`` / ``seek`` / ``epoch`` / ``batch_in_epoch`` /
+    ``__len__``) against the CONSUMPTION cursor, and iterates like the
+    loader (one epoch per ``__iter__`` call) — but yields
+    :class:`PreparedBatch` groups of ``group_size`` microbatches
+    (``yields_groups = True``; StepScheduler detects this and skips its own
+    grouping), with stacking and device placement already done in the
+    producer thread. Partial epoch-tail groups are discarded exactly as the
+    scheduler's sync grouping discards them, so cursor replay math
+    (`train_ft._rollback`) is identical on both paths.
+
+    The wrapped loader must expose ``batch_for(epoch, i)`` (thread-safe,
+    functional batch construction — ``DataLoader`` does) and a read-only
+    dataset: collate workers call it concurrently.
+    """
+
+    yields_groups = True
+
+    def __init__(
+        self,
+        loader: Any,
+        config: PrefetchConfig,
+        prepare: Callable[[list], tuple[dict, int]] | None = None,
+        place: Callable[[dict], Any] | None = None,
+        group_size: int = 1,
+    ):
+        self.loader = loader
+        self.config = config
+        self.prepare = prepare or default_prepare
+        self.place = place or (lambda host: host)
+        self.group_size = max(int(group_size), 1)
+        state = loader.state_dict()
+        self._consumed = {
+            "epoch": int(state.get("epoch", 0)),
+            "batch_in_epoch": int(state.get("batch_in_epoch", 0)),
+            "seed": state.get("seed"),
+        }
+        self._q: queue.Queue = queue.Queue(maxsize=config.depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._closed = False
+
+    # -- loader surface (consumption cursor) --------------------------------
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    @property
+    def epoch(self) -> int:
+        return self._consumed["epoch"]
+
+    @property
+    def batch_in_epoch(self) -> int:
+        return self._consumed["batch_in_epoch"]
+
+    @property
+    def queue_depth(self) -> int:
+        """Device-ready batches waiting ahead of the consumer (the /metrics
+        gauge + per-log-window record key)."""
+        return self._q.qsize()
+
+    def state_dict(self) -> dict:
+        return {k: v for k, v in self._consumed.items() if v is not None}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.seek(
+            int(state["epoch"]), int(state["batch_in_epoch"]), seed=state.get("seed")
+        )
+
+    def seek(self, epoch: int, batch_in_epoch: int, seed: Any = None) -> None:
+        """Flush everything fetched ahead and restart fetching at an exact
+        cursor (resume restore; rollback fast-forward). Blocks until the
+        producer has joined, so no stale batch can race into the queue."""
+        self._halt_producer()
+        if seed is not None:
+            self.loader.seed = seed
+        # the inner loader's own cursor is irrelevant while prefetching (the
+        # producer does its own math) but is kept in lockstep so an unwrap
+        # or a direct inspection reads the same position
+        if hasattr(self.loader, "seek"):
+            self.loader.seek(epoch, batch_in_epoch)
+        self._consumed = {
+            "epoch": int(epoch),
+            "batch_in_epoch": int(batch_in_epoch),
+            "seed": getattr(self.loader, "seed", None),
+        }
+        self._closed = False
+
+    # -- iteration -----------------------------------------------------------
+    def __iter__(self) -> Iterator[PreparedBatch]:
+        """One epoch of prepared groups (mirrors ``DataLoader.__iter__``'s
+        one-epoch contract; the producer runs ahead across epochs)."""
+        while True:
+            item = self._next_item()
+            if isinstance(item, _EpochEnd):
+                self._consumed = dict(item.state_after)
+                return
+            # consumption happens HERE: a checkpoint taken after this pop
+            # must resume at the next batch, never replay this one
+            self._consumed = dict(item.state_after)
+            yield item
+
+    def _next_item(self):
+        if self._closed:
+            raise RuntimeError("PrefetchingLoader is closed")
+        self._ensure_started()
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._thread is None or not self._thread.is_alive():
+                    raise RuntimeError(
+                        "prefetch producer died without a recorded failure"
+                    )
+                continue
+            if isinstance(item, _Failure):
+                self._halt_producer()
+                raise item.exc
+            return item
+
+    # -- producer ------------------------------------------------------------
+    def _ensure_started(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.config.collate_workers,
+            thread_name_prefix="collate",
+        )
+        self._thread = threading.Thread(
+            target=self._produce,
+            args=(dict(self._consumed),),
+            name="prefetch-producer",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _produce(self, cursor: dict) -> None:
+        """Fetch cursor walk: submit collate jobs ``lookahead`` batches
+        ahead to the worker pool, reassemble in order, stack + place each
+        full group, enqueue with the cursor-after. Partial tails are never
+        fetched (the scheduler would discard them); the epoch-end sentinel
+        carries the next epoch's cursor."""
+        gs = self.group_size
+        lookahead = self.config.depth * gs + self.config.collate_workers
+        epoch, b = int(cursor["epoch"]), int(cursor["batch_in_epoch"])
+        try:
+            while not self._stop.is_set():
+                nb = len(self.loader)
+                full_end = b + ((nb - b) // gs) * gs if nb >= b + gs else b
+                inflight: list = []
+                next_submit = b
+                group: list = []
+                while not self._stop.is_set() and (inflight or next_submit < full_end):
+                    while next_submit < full_end and len(inflight) < lookahead:
+                        inflight.append(
+                            self._pool.submit(self.loader.batch_for, epoch, next_submit)
+                        )
+                        next_submit += 1
+                    if not inflight:
+                        break
+                    batch = inflight.pop(0).result()
+                    group.append(batch)
+                    if len(group) < gs:
+                        continue
+                    host, n_tokens = self.prepare(group)
+                    b += gs
+                    group = []
+                    item = PreparedBatch(
+                        host=host,
+                        device=self.place(host),
+                        n_tokens=n_tokens,
+                        state_after={
+                            "epoch": epoch,
+                            "batch_in_epoch": b,
+                            "seed": getattr(self.loader, "seed", None),
+                        },
+                    )
+                    if not self._q_put(item):
+                        return
+                if self._stop.is_set():
+                    return
+                epoch, b = epoch + 1, 0
+                if not self._q_put(
+                    _EpochEnd(
+                        {
+                            "epoch": epoch,
+                            "batch_in_epoch": 0,
+                            "seed": getattr(self.loader, "seed", None),
+                        }
+                    )
+                ):
+                    return
+        except BaseException as exc:  # surfaced at the consumer's next pop
+            self._q_put(_Failure(exc))
+
+    def _q_put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- lifecycle -----------------------------------------------------------
+    def _halt_producer(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # drain so a producer blocked on a full queue can observe stop
+            while self._thread.is_alive():
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    self._thread.join(timeout=0.05)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True, cancel_futures=True)
+            self._pool = None
+        self._thread = None
+        while True:  # anything raced in between drain and join
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def suspend(self) -> None:
+        """Join the producer and drop the run-ahead WITHOUT closing: the
+        next pop restarts fetching at the consumption cursor. The recipes
+        call this after each validation pass — otherwise the val pipeline
+        would collate + device_put the NEXT val epoch's batches immediately
+        and pin them in device memory for the whole interval between
+        validations, contending with training steps for nothing."""
+        self._halt_producer()
+
+    def close(self) -> None:
+        """Join the producer and drop everything fetched ahead. Called on
+        preemption drain BEFORE the emergency save (a live worker would
+        device_put into the save's barrier) and at loop exit. Idempotent;
+        the consumption cursor survives, so ``state_dict()`` stays valid."""
+        self._halt_producer()
+        self._closed = True

@@ -22,6 +22,7 @@ import numpy as np
 from automodel_tpu.config.loader import ConfigNode
 from automodel_tpu.data.collators import stack_microbatches
 from automodel_tpu.data.loader import place_batch
+from automodel_tpu.data.prefetch import PreparedBatch
 from automodel_tpu.recipes.train_ft import TrainFinetuneRecipeForNextTokenPrediction
 from automodel_tpu.telemetry import memory_snapshot
 from automodel_tpu.utils.flops_utils import (
@@ -46,6 +47,7 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
                 return self._run_benchmark_body()
         finally:
             self.guard.close()
+            self._close_prefetch()
             if getattr(self, "_prom_server", None) is not None:
                 self._prom_server.shutdown()
 
@@ -59,8 +61,12 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
 
         it = iter(self.step_scheduler)
         group = next(it)
-        stacked = stack_microbatches(group)
-        batch = place_batch(self.mesh_ctx, stacked)
+        if isinstance(group, PreparedBatch):
+            # data.prefetch: the pipeline already stacked + placed the group
+            stacked, batch = group.host, group.device
+        else:
+            stacked = stack_microbatches(group)
+            batch = place_batch(self.mesh_ctx, stacked)
         tokens_per_step = int(np.prod(stacked["input_ids"].shape))
 
         state = self.state

@@ -32,6 +32,11 @@ from automodel_tpu.checkpoint.checkpointer import Checkpointer, CheckpointingCon
 from automodel_tpu.config.loader import ConfigNode
 from automodel_tpu.data.collators import stack_microbatches
 from automodel_tpu.data.loader import DataLoader, place_batch
+from automodel_tpu.data.prefetch import (
+    PrefetchConfig,
+    PrefetchingLoader,
+    PreparedBatch,
+)
 from automodel_tpu.loggers.log_utils import setup_logging
 from automodel_tpu.loggers.metric_logger import MetricLogger
 from automodel_tpu.optim.builders import build_optimizer
@@ -249,13 +254,44 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 cfg.get("validation_dataset"), cfg.get("validation_dataloader", cfg.get("dataloader", {}))
             )
 
+        # host-overlap input pipeline (data.prefetch: — docs/performance.md,
+        # "Host overlap"): background collate workers + N-deep device
+        # prefetch. Wrapped BEFORE the scheduler so every recipe subclass
+        # inherits it through the single loop. The facade's state_dict() is
+        # the CONSUMPTION cursor (fetch run-ahead is never persisted), so
+        # checkpoint resume and the rollback fast-forward stay bit-exact.
+        scfg = dict(cfg.get("step_scheduler", {}) or {})
+        self.prefetch_config = PrefetchConfig.from_data_section(cfg.get("data"))
+        if self.prefetch_config.enabled:
+            self.dataloader = PrefetchingLoader(
+                self.dataloader,
+                self.prefetch_config,
+                prepare=self._prepare_group,
+                place=self._place_group,
+                group_size=int(scfg.get("grad_acc_steps", 1)),
+            )
+            if self.val_dataloader is not None:
+                self.val_dataloader = PrefetchingLoader(
+                    self.val_dataloader,
+                    self.prefetch_config,
+                    # parity with run_validation's sync branch, which stacks
+                    # WITHOUT the zigzag-CP permutation — toggling prefetch
+                    # must never change a val loss
+                    prepare=self._prepare_val_group,
+                    place=self._place_group,
+                    group_size=1,
+                )
+            logger.info(
+                "prefetch: depth=%d collate_workers=%d",
+                self.prefetch_config.depth, self.prefetch_config.collate_workers,
+            )
+
         # step scheduler + signal wiring: with resilience enabled (default),
         # SIGTERM means PREEMPTION — the handler flips the preempted flag and
         # asks the scheduler to stop at the next step boundary, after which
         # the loop saves an emergency checkpoint and exits with the requeue
         # code. With resilience disabled, the scheduler's own (chaining)
         # graceful-shutdown handler is installed as before.
-        scfg = dict(cfg.get("step_scheduler", {}) or {})
         self.step_scheduler = StepScheduler(dataloader=self.dataloader, **scfg)
         if self.resilience.preemption is not None:
             self.resilience.preemption.on_preempt = self.step_scheduler.request_shutdown
@@ -643,6 +679,59 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         """Subclass hook (VLM recipe: freeze-pattern masking)."""
         return optimizer
 
+    def _prepare_group(self, group: list) -> tuple[dict, int]:
+        """One grad-acc group of collated microbatches → ([A]-stacked host
+        batch with zigzag-CP permutation applied, token count). Shared by
+        the sync loop body and the prefetch producer thread, so both paths
+        build bit-identical batches."""
+        stacked = stack_microbatches(group)
+        if self._zigzag_cp:
+            from automodel_tpu.parallel.cp import apply_zigzag
+
+            stacked = {
+                k: (
+                    apply_zigzag(v, self._zigzag_cp, axis=2)
+                    if k in ("input_ids", "labels", "position_ids", "segment_ids")
+                    else v
+                )
+                for k, v in stacked.items()
+            }
+        # tps numerator: all *input_ids leaves (biencoder batches carry
+        # query_/doc_input_ids instead of a single input_ids)
+        n_tokens = int(
+            sum(
+                np.prod(v.shape)
+                for k, v in stacked.items()
+                if k.endswith("input_ids") and isinstance(v, np.ndarray)
+            )
+        )
+        return stacked, n_tokens
+
+    def _prepare_val_group(self, group: list) -> tuple[dict, int]:
+        """Validation variant of :meth:`_prepare_group`: stack only, no
+        zigzag permutation — bit-parity with run_validation's sync branch
+        (`place_batch(stack_microbatches([vb]))`)."""
+        stacked = stack_microbatches(group)
+        n_tokens = int(
+            sum(
+                np.prod(v.shape)
+                for k, v in stacked.items()
+                if k.endswith("input_ids") and isinstance(v, np.ndarray)
+            )
+        )
+        return stacked, n_tokens
+
+    def _place_group(self, stacked: dict) -> dict:
+        return place_batch(self.mesh_ctx, stacked)
+
+    def _close_prefetch(self) -> None:
+        """Join the prefetch producers and drop their run-ahead (idempotent;
+        the consumption cursor survives, so a state_dict() taken after the
+        close — the emergency checkpoint — is still exact)."""
+        for dl in (getattr(self, "dataloader", None), getattr(self, "val_dataloader", None)):
+            if isinstance(dl, PrefetchingLoader):
+                dl.close()
+
     def _build_dataloader(self, dataset_cfg: Any, dl_cfg: Any) -> DataLoader:
         if dataset_cfg is None:
             raise ValueError("A `dataset:` section is required")
@@ -766,6 +855,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     last = self._train_loop_with_rollback(tel)
             finally:
                 tel.close()
+                # preemption drain discipline: join the prefetch workers
+                # BEFORE the emergency save below — a producer mid-
+                # device_put would contend with the save's device barrier,
+                # and its run-ahead must be dropped (not persisted: the
+                # consumption cursor already excludes it)
+                self._close_prefetch()
             if self.checkpointer:
                 if not res.preempted or res.config.emergency_checkpoint:
                     # drain + commit any in-flight cadence save FIRST, then
@@ -879,7 +974,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 steps_left -= in_epoch
                 epoch += 1
                 pos = 0
-        dl.epoch, dl.batch_in_epoch = epoch, pos
+        # seek() on the prefetch facade flushes the run-ahead queue, joins
+        # the producer, and restarts fetching at the rolled-back cursor —
+        # a rollback across a prefetched window stays bit-exact. The plain
+        # attribute assignment covers duck-typed loaders without seek().
+        seek = getattr(dl, "seek", None)
+        if seek is not None:
+            seek(epoch, pos)
+        else:
+            dl.epoch, dl.batch_in_epoch = epoch, pos
         # keep the scheduler's epoch budget in sync: the skipped window may
         # contain epoch boundaries the scheduler will now never observe
         self.step_scheduler.epoch = epoch
@@ -936,36 +1039,28 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         first_step = not restarted
         tokens_window = 0
         steps_window = 0
+        # host time spent ACQUIRING the next device-ready batch (collate +
+        # stack + H2D when sync; a queue pop when prefetched) — the per-log-
+        # window decomposition key that makes the overlap visible
+        input_wait_window = 0.0
         t_window = time.perf_counter()
         while True:
+            t_input = time.perf_counter()
             tel.timers("data_wait").start()
             try:
                 group = next(it)
             except StopIteration:
                 break
             tel.timers("data_wait").stop()
-            stacked = stack_microbatches(group)
-            if self._zigzag_cp:
-                from automodel_tpu.parallel.cp import apply_zigzag
-
-                stacked = {
-                    k: (
-                        apply_zigzag(v, self._zigzag_cp, axis=2)
-                        if k in ("input_ids", "labels", "position_ids", "segment_ids")
-                        else v
-                    )
-                    for k, v in stacked.items()
-                }
-            # tps numerator: all *input_ids leaves (biencoder batches
-            # carry query_/doc_input_ids instead of a single input_ids)
-            n_tokens_batch = int(
-                sum(
-                    np.prod(v.shape)
-                    for k, v in stacked.items()
-                    if k.endswith("input_ids")
-                )
-            )
-            batch = place_batch(self.mesh_ctx, stacked)
+            if isinstance(group, PreparedBatch):
+                # prefetch pipeline: collate/stack/zigzag/device_put already
+                # happened in the producer thread — this was a queue pop
+                stacked, batch = group.host, group.device
+                n_tokens_batch = group.n_tokens
+            else:
+                stacked, n_tokens_batch = self._prepare_group(group)
+                batch = self._place_group(stacked)
+            input_wait_window += time.perf_counter() - t_input
             step_no = self.step_scheduler.step
             tel.on_step(step_no)
             tel.timers("dispatch").start()
@@ -1036,6 +1131,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 tel.record_step(host_rec)
                 first_step = False
                 tokens_window = steps_window = 0
+                input_wait_window = 0.0
                 t_window = time.perf_counter()
             elif self.step_scheduler.is_log_step:
                 tel.timers("device_sync").start()
@@ -1046,6 +1142,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 metrics["step_time_s"] = dt / max(steps_window, 1)
                 metrics["tps"] = tokens_window / max(dt, 1e-9)
                 metrics["tps_per_device"] = metrics["tps"] / self.mesh_ctx.world_size
+                # input-pipeline decomposition beside step_time_s: amortized
+                # host input wait per step, + the prefetch run-ahead gauge
+                metrics["host_input_wait_s"] = input_wait_window / max(
+                    steps_window, 1
+                )
+                if isinstance(self.dataloader, PrefetchingLoader):
+                    metrics["prefetch_depth"] = self.dataloader.queue_depth
                 if res.skipped_steps:
                     metrics["skipped_steps_total"] = res.skipped_steps
                 if res.rollbacks:
@@ -1070,6 +1173,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 )
                 tel.record_step(host_rec)
                 tokens_window = steps_window = 0
+                input_wait_window = 0.0
                 t_window = time.perf_counter()
             else:
                 tel.record_step(host_rec)
@@ -1112,6 +1216,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 # throughput (the window reset below)
                 tel.skip_next_interval()
                 tokens_window = steps_window = 0
+                input_wait_window = 0.0
                 t_window = time.perf_counter()
             if self.step_scheduler.is_ckpt_step:
                 # resolve THIS step's flag before persisting: a cadence save
@@ -1131,6 +1236,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     self.save_checkpoint()
                 tel.skip_next_interval()
                 tokens_window = steps_window = 0
+                input_wait_window = 0.0
                 t_window = time.perf_counter()
         # a non-finite flag from the final step must still be enforced
         if res.config.enabled:
@@ -1144,11 +1250,21 @@ class TrainFinetuneRecipeForNextTokenPrediction:
     def run_validation(self) -> dict:
         tot_loss, tot_n = 0.0, 0
         for vb in self.val_dataloader:
-            batch = place_batch(self.mesh_ctx, stack_microbatches([vb]))
+            # the prefetch facade yields device-ready batches (placed in its
+            # producer thread); the sync path stacks + places inline
+            batch = (
+                vb.device
+                if isinstance(vb, PreparedBatch)
+                else place_batch(self.mesh_ctx, stack_microbatches([vb]))
+            )
             out = jax.device_get(self.eval_step(self.state, batch))
             tot_loss += float(out["loss_sum"])
             tot_n += int(out["num_label_tokens"])
         val_loss = tot_loss / max(tot_n, 1)
+        if isinstance(self.val_dataloader, PrefetchingLoader):
+            # don't let the producer pre-stage the NEXT val epoch and pin
+            # depth placed batches in device memory until the next val step
+            self.val_dataloader.suspend()
         if val_loss == val_loss:  # a NaN eval must never look "best"
             self._last_val_metric = val_loss
         return {"val_loss": val_loss, "val_tokens": tot_n}

@@ -21,6 +21,11 @@ wrong, deterministically, on CPU, in tier-1. Four fault classes:
 - ``hang_at_step``          — block the training loop at step k (a bounded
   ``time.sleep``, which releases the GIL exactly like a wedged collective
   would), driving the hang watchdog's detect → dump → requeue-exit path
+- ``slow_collate_ms``       — sleep that long inside EVERY batch collate
+  (``DataLoader.batch_for``), simulating an expensive host input pipeline
+  (tokenization, disk reads) so the prefetch overlap (data/prefetch.py) is
+  provable on CPU: a sync loop pays the delay per step, a prefetched loop
+  hides it under device compute
 - ``desync_batch_at_step``  — perturb THIS host's rolling data-batch hash
   at step k (on ``desync_on_host`` only), driving the cross-host consensus
   check's detect-and-name-the-culprit path
@@ -66,6 +71,9 @@ class FaultInjectionConfig:
     # distributed-guard faults (watchdog / consensus / straggler)
     hang_at_step: Optional[int] = None
     hang_seconds: float = 3600.0  # bounded — the watchdog exits long before
+    # per-batch collate delay (data/loader.py batch_for) — the input-
+    # pipeline overlap proof knob (bench.py input-pipeline A/B leg)
+    slow_collate_ms: float = 0.0
     desync_batch_at_step: Optional[int] = None
     desync_on_host: int = 0  # process_index whose data hash is perturbed
     straggle_host: Optional[int] = None
@@ -133,6 +141,16 @@ class FaultInjector:
         import time
 
         time.sleep(c.hang_seconds)
+
+    def maybe_slow_collate(self) -> None:
+        """Per-batch collate delay (called from ``DataLoader.batch_for``, so
+        it fires on the sync path AND inside prefetch collate workers — the
+        sleep releases the GIL exactly like tokenizer/disk work would)."""
+        ms = self.config.slow_collate_ms
+        if ms > 0:
+            import time
+
+            time.sleep(ms / 1000.0)
 
     def should_desync(self, step: int) -> bool:
         c = self.config
@@ -237,6 +255,7 @@ def activate(config: FaultInjectionConfig | dict | None) -> Optional[FaultInject
         or config.corrupt_ckpt_file
         or config.fail_io_attempts > 0
         or config.hang_at_step is not None
+        or config.slow_collate_ms > 0
         or config.desync_batch_at_step is not None
         or config.straggle_host is not None
         or config.serve_hang_at_step is not None

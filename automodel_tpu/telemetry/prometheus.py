@@ -498,6 +498,17 @@ _TRAIN_GAUGES = {
         "automodel_train_heartbeat_age_seconds",
         "Watchdog heartbeat age at the last log barrier",
     ),
+    "host_input_wait_s": (
+        "automodel_train_host_input_wait_seconds",
+        "Amortized host time per step acquiring the next batch over the "
+        "last log window (collate+stack+H2D when sync; a queue pop when "
+        "prefetched)",
+    ),
+    "prefetch_depth": (
+        "automodel_train_prefetch_queue_depth",
+        "Device-ready batches the input pipeline holds ahead of the train "
+        "loop, sampled at the last log barrier",
+    ),
 }
 _TRAIN_CUMULATIVE = {
     "skipped_steps_total": (

@@ -31,6 +31,10 @@ _NUMERIC_KEYS = (
     "compile_time_s",
     "lr",
     "mfu",
+    # input pipeline (data/prefetch.py): per-log-window host input wait
+    # beside step_time_s, + the prefetch run-ahead gauge
+    "host_input_wait_s",
+    "prefetch_depth",
     "pp_bubble_fraction",
     "expert_load_imbalance",
     # generation records (in-training eval sampling + the bench decode leg)
@@ -226,7 +230,7 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
     # profiling pillar: analytic vs measured MFU ride the same records; the
     # cost_attribution event carries roofline class, the trace_capture
     # events are anomaly evidence worth headlining
-    for key in ("mfu_pct", "mfu_measured_pct"):
+    for key in ("mfu_pct", "mfu_measured_pct", "host_input_wait_s"):
         vals = [r[key] for r in records if isinstance(r.get(key), (int, float))]
         if vals:
             out[f"{key}_mean"] = sum(vals) / len(vals)
@@ -402,6 +406,9 @@ _BENCH_LEGS = (
     # section / any failure records its reason, never a silent null/zero
     ("serve_fleet_tokens_per_s", "serve_fleet_failure"),
     ("serve_route_prefix_hit_rate", "serve_fleet_failure"),
+    # input-pipeline A/B sub-leg (sync vs prefetch under an injected collate
+    # delay): a null speedup must name why — never read as "measured zero"
+    ("input_pipeline_speedup", "input_pipeline_failure"),
 )
 
 # legs where a hard 0.0 IS a measurement (an accept rate of zero means the

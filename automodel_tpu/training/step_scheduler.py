@@ -70,26 +70,34 @@ class StepScheduler:
         return self._shutdown
 
     # -- iteration ----------------------------------------------------------
-    def __iter__(self) -> Iterator[list]:
-        """Yield lists of `grad_acc_steps` microbatches (one optimizer step)."""
+    def __iter__(self) -> Iterator[Any]:
+        """Yield one item per optimizer step: a list of `grad_acc_steps`
+        microbatches — or, when the dataloader is a prefetch pipeline
+        (``yields_groups``, data/prefetch.py), the already-grouped
+        ``PreparedBatch`` it yields (the pipeline does the grad-acc grouping
+        and tail-discard in its producer thread; step/epoch budget, max
+        steps, and shutdown draining stay HERE on both paths)."""
         from automodel_tpu.data.collators import stack_microbatches  # noqa: F401
 
+        grouped = bool(getattr(self.dataloader, "yields_groups", False))
         while self.epoch < self.num_epochs:
             group: list = []
             for batch in self.dataloader:
-                group.append(batch)
-                if len(group) == self.grad_acc_steps:
-                    if self.max_steps is not None and self.step >= self.max_steps:
-                        return
-                    # increment BEFORE yielding so the consumer's loop body
-                    # (cadence predicates, checkpoint naming) sees the step
-                    # number of the optimizer step it is currently taking,
-                    # matching TrainState.step after train_step.
-                    self.step += 1
-                    yield group
-                    group = []
-                    if self._shutdown:
-                        return
+                if not grouped:
+                    group.append(batch)
+                    if len(group) < self.grad_acc_steps:
+                        continue
+                if self.max_steps is not None and self.step >= self.max_steps:
+                    return
+                # increment BEFORE yielding so the consumer's loop body
+                # (cadence predicates, checkpoint naming) sees the step
+                # number of the optimizer step it is currently taking,
+                # matching TrainState.step after train_step.
+                self.step += 1
+                yield batch if grouped else group
+                group = []
+                if self._shutdown:
+                    return
             self.epoch += 1
             # a signal landing in the epoch tail (after the last full
             # group yielded) must stop HERE, not a full epoch later

@@ -214,6 +214,14 @@ def test_example_yaml_parses_and_dry_instantiates(path):
         k8.pop("apply", None)  # popped by the CLI before K8sConfig
         K8sConfig(**k8)
 
+    # data: → PrefetchConfig (strict at both levels: unknown data: keys and
+    # unknown data.prefetch: keys raise)
+    data = cfg.get("data")
+    if data is not None:
+        from automodel_tpu.data.prefetch import PrefetchConfig
+
+        PrefetchConfig.from_data_section(data)
+
     # dataset/dataloader/logging are validated lightly: dataset needs a
     # _target_ to instantiate (network-bound targets are not constructed)
     ds = cfg.get("dataset")
@@ -262,3 +270,18 @@ def test_config_dataclasses_reject_unknown_keys():
         FleetConfig.from_dict({"replicas": [{"url": "http://x", "role": "router"}]})
     with pytest.raises(ValueError):
         FleetConfig.from_dict({"retry_budget": -1})
+    from automodel_tpu.data.prefetch import PrefetchConfig
+
+    with pytest.raises(TypeError):
+        PrefetchConfig.from_dict({"depthh": 3})
+    with pytest.raises(TypeError):  # strict through the data: entry point too
+        PrefetchConfig.from_data_section({"prefetch": {"workers": 2}})
+    with pytest.raises(ValueError):
+        PrefetchConfig.from_dict({"depth": 0})
+    with pytest.raises(ValueError):
+        PrefetchConfig.from_dict({"collate_workers": 0})
+    assert PrefetchConfig.from_data_section(None).enabled is False
+    assert PrefetchConfig.from_data_section({"prefetch": {}}).enabled is True
+    # the data: section is shared (mine_hard_negatives keeps its datasets
+    # there) — foreign keys without a prefetch: entry mean "no prefetch"
+    assert PrefetchConfig.from_data_section({"queries": {}}).enabled is False
