@@ -94,6 +94,13 @@ class FaultInjectionConfig:
     serve_exception_at_step: Optional[int] = None
     serve_exhaust_blocks_at_step: Optional[int] = None
     serve_exhaust_hold_steps: int = 50
+    # request-tracing attribution proof (telemetry/tracing.py): sleep
+    # trace_delay_ms inside EVERY execution of the named stage (span stage
+    # names — prefill/decode/kv_inject/kv_send/kv_receive/placement/
+    # forward), so the assembled waterfall and the /metrics per-stage
+    # histogram must charge the delay to exactly that stage
+    trace_delay_stage: Optional[str] = None
+    trace_delay_ms: float = 0.0
 
 
 def _process_index() -> int:
@@ -182,6 +189,17 @@ class FaultInjector:
         if c.serve_exception_at_step is not None and step == c.serve_exception_at_step:
             raise InjectedFault(f"injected serving engine crash at step {step}")
 
+    def maybe_trace_delay(self, stage: str) -> None:
+        """Sleep inside the named tracing stage's measured window (called
+        at each stage's execution site in serving/engine.py, fleet/router.py
+        and fleet/kv_transfer.py) — the delay must surface on that stage's
+        span and /metrics histogram, nowhere else."""
+        c = self.config
+        if c.trace_delay_stage == stage and c.trace_delay_ms > 0:
+            import time
+
+            time.sleep(c.trace_delay_ms / 1000.0)
+
     def maybe_straggle(self, step: int) -> None:
         c = self.config
         if c.straggle_host is None or c.straggle_ms <= 0:
@@ -261,6 +279,7 @@ def activate(config: FaultInjectionConfig | dict | None) -> Optional[FaultInject
         or config.serve_hang_at_step is not None
         or config.serve_exception_at_step is not None
         or config.serve_exhaust_blocks_at_step is not None
+        or (config.trace_delay_stage is not None and config.trace_delay_ms > 0)
     )
     if not armed:
         # an empty `fault_injection: {}` section (the docs' example form)

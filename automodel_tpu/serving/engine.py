@@ -74,6 +74,7 @@ from automodel_tpu.generation.engine import (
 from automodel_tpu.generation.sampling import sample
 from automodel_tpu.serving import paged
 from automodel_tpu.serving.block_pool import BlockPool, blocks_needed
+from automodel_tpu.telemetry.tracing import SpanContext, Tracer, WallAnchor
 from automodel_tpu.training.rng import sampling_key
 
 logger = logging.getLogger(__name__)
@@ -355,6 +356,9 @@ class _Queued:
     # disaggregated fleet (docs/serving.md "Fleet"):
     prefill_only: bool = False  # prefill-role replica: extract KV, no decode
     payload: Optional[dict] = None  # decode-role replica: injected prompt KV
+    # request tracing: this request's ROOT span context on this process
+    # (child of the router's forward span when one propagated in)
+    trace: Optional[SpanContext] = None
 
 
 @dataclasses.dataclass
@@ -374,6 +378,7 @@ class _Slot:
     prefill_only: bool = False
     spec_proposed: int = 0  # draft tokens proposed for this request
     spec_accepted: int = 0  # draft tokens accepted by the verify rule
+    trace: Optional[SpanContext] = None
 
 
 class ServingEngine:
@@ -397,6 +402,7 @@ class ServingEngine:
         config: Optional[ServeConfig] = None,
         gen_config: Optional[GenerationConfig] = None,
         on_record: Optional[Callable[[dict], None]] = None,
+        tracer: Optional[Tracer] = None,
     ):
         if not getattr(auto.model, "supports_kv_cache", False):
             raise GenerationUnsupported(
@@ -548,6 +554,17 @@ class ServingEngine:
         from automodel_tpu.telemetry.prometheus import ServingMetrics
 
         self.metrics = ServingMetrics()
+        # request tracing (telemetry/tracing.py): spans ride on_record like
+        # every other telemetry record; every emitted span also observes
+        # the /metrics per-stage histogram. All record timestamps derive
+        # from ONE wall anchor + the monotonic clock — `ts` can never
+        # disagree with the monotonic-difference durations it sits beside.
+        self._clock = WallAnchor()
+        self.tracer = tracer
+        if tracer is not None:
+            tracer.clock = self._clock  # one anchor per process, shared
+            if tracer.observe is None:
+                tracer.observe = self.metrics.observe_stage
         # cost attribution (telemetry/profiling/): when armed, the first
         # chunk-prefill/paged-decode call also records the program's
         # measured FLOPs/bytes (abstract host trace, one-time)
@@ -745,6 +762,7 @@ class ServingEngine:
         deadline_s: Optional[float] = None,
         max_queue_wait_s: Optional[float] = None,
         prefill_only: bool = False,
+        trace: Optional[SpanContext] = None,
         _payload: Optional[dict] = None,
     ) -> str:
         prompt = [int(t) for t in prompt_ids]
@@ -787,11 +805,16 @@ class ServingEngine:
             lim.max_queue_wait_s
             if max_queue_wait_s is None else float(max_queue_wait_s)
         )
+        # the engine's ROOT span for this request: child of the propagated
+        # context (a router forward span) when one came in, a freshly
+        # minted trace otherwise (the engine front IS the entry point for
+        # direct requests). Unsampled contexts flow through but emit nothing.
+        root = self.tracer.start(parent=trace) if self.tracer is not None else None
         q = _Queued(
             rid=rid, prompt=prompt, max_new=max_new, t_submit=now,
             deadline_at=now + ddl if ddl and ddl > 0 else None,
             queue_deadline_at=now + qw if qw and qw > 0 else None,
-            prefill_only=prefill_only, payload=_payload,
+            prefill_only=prefill_only, payload=_payload, trace=root,
         )
         if self.draining:
             # no terminal record here (mirror of the shed seam): the
@@ -869,6 +892,7 @@ class ServingEngine:
         max_new_tokens: Optional[int] = None,
         deadline_s: Optional[float] = None,
         max_queue_wait_s: Optional[float] = None,
+        trace: Optional[SpanContext] = None,
     ) -> str:
         """Enqueue a request whose prompt KV was computed on a PREFILL
         replica: admission allocates the normal whole budget, scatters the
@@ -890,7 +914,7 @@ class ServingEngine:
         return self.submit(
             prompt, request_id=request_id, max_new_tokens=max_new_tokens,
             deadline_s=deadline_s, max_queue_wait_s=max_queue_wait_s,
-            _payload=payload,
+            trace=trace, _payload=payload,
         )
 
     def _validate_kv_payload(self, prompt: list[int], kv: dict) -> None:
@@ -943,6 +967,34 @@ class ServingEngine:
         return self._rejection_record(q, "shed")
 
     # -- terminal records -----------------------------------------------------
+    def _wall_ts(self) -> float:
+        """Record timestamp: the process wall anchor + the monotonic clock.
+        Never raw ``time.time()`` — a wall step mid-request would otherwise
+        put a ``ts`` beside monotonic-difference durations it contradicts
+        (the mixed-clock bug report --strict now lints for)."""
+        return round(self._clock.wall(), 6)
+
+    def _child_span(
+        self,
+        root: Optional[SpanContext],
+        stage: str,
+        t0: float,
+        t1: Optional[float] = None,
+        **attrs: Any,
+    ) -> None:
+        if self.tracer is not None and self.tracer.active(root):
+            self.tracer.child(root, stage, t0, t1, **attrs)
+
+    def _root_span(
+        self,
+        root: Optional[SpanContext],
+        t0: float,
+        t1: Optional[float] = None,
+        **attrs: Any,
+    ) -> None:
+        if self.tracer is not None and self.tracer.active(root):
+            self.tracer.record(root, "serve", t0, t1, **attrs)
+
     def _rejection_record(
         self, q: _Queued, reason: str, detail: Optional[str] = None
     ) -> dict:
@@ -962,10 +1014,19 @@ class ServingEngine:
             "retriable": reason in _RETRIABLE_REASONS,
             "queue_s": now - q.t_submit,
             "queue_depth": self.queue_depth,
-            "ts": time.time(),
+            "ts": self._wall_ts(),
         }
         if detail:
             rec["detail"] = detail
+        # drain/timeout/shed paths leave spans too: the whole life of this
+        # request was the queue, and the root says why it ended
+        self._child_span(
+            q.trace, "queue", q.t_submit, now, request_id=q.rid
+        )
+        self._root_span(
+            q.trace, q.t_submit, now,
+            request_id=q.rid, completion_reason=reason,
+        )
         self._emit(rec)
         return rec
 
@@ -1003,7 +1064,7 @@ class ServingEngine:
             "queue_s": slot.t_admit - slot.t_submit,
             "queue_depth": self.queue_depth,
             "block_occupancy": round(self.pool.occupancy(), 4),
-            "ts": time.time(),
+            "ts": self._wall_ts(),
         }
         if slot.t_first is not None:
             decode_s = now - slot.t_first
@@ -1021,6 +1082,20 @@ class ServingEngine:
             )
         if detail:
             rec["detail"] = detail
+        # tracing: the decode stage is the window from first token to
+        # terminal (one span per request, attrs carry the volume); the root
+        # span covers submit→terminal and names how it ended — including
+        # the cancel/stall/drain paths, which land here like completions
+        if slot.decoding and slot.t_first is not None:
+            self._child_span(
+                slot.trace, "decode", slot.t_first, now,
+                request_id=slot.request_id, tokens=max(len(gen) - 1, 0),
+            )
+        self._root_span(
+            slot.trace, slot.t_submit, now,
+            request_id=slot.request_id, completion_reason=reason,
+            n_generated=len(gen), prompt_tokens=len(slot.prompt),
+        )
         self._emit(rec)
         return rec
 
@@ -1076,6 +1151,7 @@ class ServingEngine:
             if self._slots[b] is not None or not self._queue:
                 continue
             q = self._queue[0]
+            t_adm0 = time.perf_counter()  # tracing: admission stage start
             if q.payload is not None:
                 # KV handoff: the prompt's rows arrive pre-computed, so the
                 # prefix cache is bypassed (shipped blocks are scattered
@@ -1101,8 +1177,19 @@ class ServingEngine:
             try:
                 if q.payload is not None:
                     self._bind_injected_slot(b, q, blocks, done)
-                    continue
-                self._bind_slot(b, q, blocks, hit_tokens)
+                else:
+                    self._bind_slot(b, q, blocks, hit_tokens)
+                # queue wait and admission (prefix match + whole-budget
+                # block allocation + slot bind) as sibling stages under the
+                # request root — the two ways a slow admission can hide
+                self._child_span(
+                    q.trace, "queue", q.t_submit, t_adm0, request_id=q.rid
+                )
+                self._child_span(
+                    q.trace, "admission", t_adm0,
+                    request_id=q.rid, blocks=len(blocks),
+                    hit_tokens=hit_tokens if q.payload is None else 0,
+                )
             except Exception as e:
                 # leak audit: an exception between admit-time allocation and
                 # slot binding must return EVERY block and fail only THIS
@@ -1130,7 +1217,7 @@ class ServingEngine:
             blocks=blocks, hit_tokens=hit_tokens,
             prefill_pos=hit_tokens, t_submit=q.t_submit,
             t_admit=time.perf_counter(), deadline_at=q.deadline_at,
-            prefill_only=q.prefill_only,
+            prefill_only=q.prefill_only, trace=q.trace,
         )
 
     def _bind_injected_slot(
@@ -1144,8 +1231,18 @@ class ServingEngine:
         nb = blocks_needed(p, self.config.block_size)
         row = np.zeros((self.config.table_blocks,), np.int32)
         row[: len(blocks)] = blocks
+        t_inj0 = time.perf_counter()
+        from automodel_tpu.resilience.fault_injection import active_injector
+
+        inj = active_injector()
+        if inj is not None:
+            inj.maybe_trace_delay("kv_inject")
         self._pool = paged.inject_blocks(
             self._pool, np.asarray(blocks[:nb], np.int32), q.payload["kv"]
+        )
+        self._child_span(
+            q.trace, "kv_inject", t_inj0,
+            request_id=q.rid, blocks=nb, prompt_tokens=p,
         )
         first = int(q.payload["first_token"])
         now = time.perf_counter()
@@ -1157,7 +1254,7 @@ class ServingEngine:
             request_id=q.rid, prompt=q.prompt, max_new=q.max_new,
             blocks=blocks, hit_tokens=0, prefill_pos=p,
             t_submit=q.t_submit, t_admit=now, deadline_at=q.deadline_at,
-            decoding=True, generated=[first], t_first=now,
+            decoding=True, generated=[first], t_first=now, trace=q.trace,
         )
         # the injected prefix is as matchable as a locally-computed one —
         # future affinity-routed requests hit it without another transfer
@@ -1169,6 +1266,9 @@ class ServingEngine:
             done.append(self._terminate(b, "length"))
 
     def _prefill_tick(self) -> list[dict]:
+        from automodel_tpu.resilience.fault_injection import active_injector
+
+        inj = active_injector()
         done: list[dict] = []
         chunk_len = self.config.prefill_chunk
         pad = self.gen_config.pad_token_id
@@ -1180,6 +1280,9 @@ class ServingEngine:
             real = min(chunk_len, p - start)
             ids = np.full((chunk_len,), pad, np.int32)
             ids[:real] = slot.prompt[start : start + real]
+            t_chunk0 = time.perf_counter()
+            if inj is not None:
+                inj.maybe_trace_delay("prefill")
             if self.collect_program_costs and "chunk_prefill" not in self.program_costs:
                 self._record_cost(
                     "chunk_prefill", self._chunk,
@@ -1202,6 +1305,12 @@ class ServingEngine:
                     jnp.asarray(self._tables[b]), jnp.asarray(ids),
                     jnp.int32(start), jnp.int32(real),
                 )
+            # one span per chunk: a single long prompt's prefill shows as a
+            # chunk train, and a stall inside one chunk names its offset
+            self._child_span(
+                slot.trace, "prefill", t_chunk0,
+                request_id=slot.request_id, pos=start, tokens=real,
+            )
             slot.prefill_pos = start + real
             self._lengths[b] = slot.prefill_pos
             if slot.prefill_pos < p:
@@ -1228,6 +1337,9 @@ class ServingEngine:
                     "first_token": first,
                     "prompt_len": p,
                     "kv": {"k": k, "v": v},
+                    # host-side only: the /prefill handler parents its
+                    # kv_send span under this request's root
+                    "trace": slot.trace,
                 })
                 done.append(self._terminate(b, "prefilled"))
                 continue
@@ -1244,6 +1356,13 @@ class ServingEngine:
     def _decode_tick(self) -> list[dict]:
         if not self._active.any():
             return []
+        from automodel_tpu.resilience.fault_injection import active_injector
+
+        inj = active_injector()
+        if inj is not None:
+            # lands inside every traced request's decode window (t_first →
+            # terminal), so the delay attributes to the decode stage
+            inj.maybe_trace_delay("decode")
         if self._spec_enabled:
             return self._spec_decode_tick()
         params = self.auto.params
@@ -1291,10 +1410,12 @@ class ServingEngine:
         cur = jnp.asarray(self._cur)
         active = jnp.asarray(self._active)
         step = jnp.int32(self._step_counter)
+        t_propose0 = time.perf_counter()
         drafts, draft_logits, self._draft_pool = self._propose(
             self.draft_auto.params, self._draft_pool,
             tables, lengths, cur, active, self._base_key, step,
         )
+        t_verify0 = time.perf_counter()
         if self.collect_program_costs and "spec_verify" not in self.program_costs:
             self._record_cost(
                 "spec_verify", self._verify,
@@ -1307,12 +1428,25 @@ class ServingEngine:
         )
         tokens = np.asarray(jax.device_get(tokens))
         n_commit = np.asarray(jax.device_get(n_commit))
+        t_wave_end = time.perf_counter()
         self.first_decode_done = True
         self.spec_rounds += 1  # one propose+verify round per WAVE, not per slot
         done: list[dict] = []
         for b, slot in enumerate(self._slots):
             if slot is None or not self._active[b]:
                 continue
+            # per-wave propose/verify spans on every traced slot the wave
+            # served: the whole wave's wall time IS where this request's
+            # time went (the calls are batched over the wave)
+            self._child_span(
+                slot.trace, "spec_propose", t_propose0, t_verify0,
+                request_id=slot.request_id, k=k,
+            )
+            self._child_span(
+                slot.trace, "spec_verify", t_verify0, t_wave_end,
+                request_id=slot.request_id,
+                accepted=int(n_commit[b]) - 1,
+            )
             n = int(n_commit[b])
             accepted = n - 1
             slot.spec_proposed += k
@@ -1377,7 +1511,7 @@ class ServingEngine:
             "reason": reason,
             "step": self._step_counter,
             "requests_failed": affected,
-            "ts": time.time(),
+            "ts": self._wall_ts(),
         }
         if detail:
             rec["detail"] = detail
@@ -1553,17 +1687,18 @@ class ServingEngine:
             r for r in out if r.get("completion_reason") in ("stop", "length")
         ]
         gen = sum(r["n_generated"] for r in completions)
-        ttfts = sorted(
+        from automodel_tpu.telemetry.report import percentile
+
+        ttfts = [
             r["ttft_s"] for r in completions if isinstance(r.get("ttft_s"), float)
-        )
-        pct = lambda q: ttfts[min(int(q * len(ttfts)), len(ttfts) - 1)] if ttfts else None
+        ]
         stats = {
             "requests": len(completions),
             "gen_tokens": gen,
             "wall_s": dt,
             "sustained_tokens_per_s": gen / dt if dt > 0 else 0.0,
-            "ttft_p50_s": pct(0.50),
-            "ttft_p99_s": pct(0.99),
+            "ttft_p50_s": percentile(ttfts, 0.50),
+            "ttft_p99_s": percentile(ttfts, 0.99),
             "block_occupancy_peak": round(occ_peak, 4),
             "queue_depth_peak": q_peak,
             "prefix_cache": dict(self.pool.counters),

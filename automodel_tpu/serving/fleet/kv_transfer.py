@@ -178,6 +178,11 @@ def send_kv(
     must carry ``handoff_id``/``prompt_len``/``first_token`` and the
     sender's pool ``geometry``. → the receiver's ack dict; raises
     :class:`KVTransferError` when the transfer or validation failed."""
+    from automodel_tpu.resilience.fault_injection import active_injector
+
+    inj = active_injector()
+    if inj is not None:
+        inj.maybe_trace_delay("kv_send")
     try:
         with socket.create_connection(addr, timeout=timeout_s) as sock:
             _write_frame(sock, dict(meta), flatten_kv(kv))
@@ -250,14 +255,29 @@ class KVTransferServer:
         max_pending: int = 32,
         ttl_s: float = 120.0,
         max_frame_bytes: Optional[int] = None,
+        tracer: Any = None,
     ):
         self.expected = {k: expected_geometry[k] for k in GEOMETRY_KEYS}
         self.store = store or HandoffStore(max_pending=max_pending, ttl_s=ttl_s)
         self.max_frame_bytes = max_frame_bytes
+        # request tracing: when the sender's AKV1 header carries a
+        # `traceparent`, the receive (frame read + validation + store.put)
+        # is recorded as a kv_receive span on THIS replica's tracer,
+        # parented under the sender's kv_send span — the transfer leaves
+        # evidence on both sides of the wire
+        self.tracer = tracer
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                t0 = time.perf_counter()
+                from automodel_tpu.resilience.fault_injection import (
+                    active_injector,
+                )
+
+                inj = active_injector()
+                if inj is not None:
+                    inj.maybe_trace_delay("kv_receive")
                 try:
                     header, arrays = _read_frame(
                         self.request, max_frame_bytes=outer.max_frame_bytes
@@ -271,6 +291,7 @@ class KVTransferServer:
                     return
                 err = outer._validate(header, arrays)
                 if err is not None:
+                    outer._record_receive(header, t0, error=err[:200])
                     _write_response(self.request, {"ok": False, "error": err})
                     return
                 outer.store.put(str(header["handoff_id"]), {
@@ -280,6 +301,10 @@ class KVTransferServer:
                     },
                     "kv": unflatten_kv(arrays),
                 })
+                outer._record_receive(
+                    header, t0,
+                    bytes=sum(a.nbytes for a in arrays.values()),
+                )
                 _write_response(
                     self.request, {"ok": True, "handoff_id": header["handoff_id"]}
                 )
@@ -292,6 +317,23 @@ class KVTransferServer:
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="kv-transfer", daemon=True
         )
+
+    def _record_receive(self, header: dict, t0: float, **attrs) -> None:
+        """kv_receive span for a frame whose header carried a traceparent
+        (sampled-out or untraced sends record nothing)."""
+        if self.tracer is None:
+            return
+        parent = self.tracer.parse(header.get("traceparent"))
+        if parent is None:
+            return
+        try:
+            self.tracer.record(
+                self.tracer.start(parent=parent), "kv_receive", t0,
+                request_id=header.get("request_id"),
+                handoff_id=header.get("handoff_id"), **attrs,
+            )
+        except Exception:  # telemetry must never break the transfer
+            pass
 
     def _validate(self, header: dict, arrays: dict) -> Optional[str]:
         if "handoff_id" not in header:

@@ -66,6 +66,17 @@ class ReplicaUnreachable(RuntimeError):
     always retriable — the request never reached a scheduler."""
 
 
+def _trace_headers(ctx) -> Optional[dict]:
+    """traceparent header for a forward, or None when untraced. Lazy
+    import: the tracing module is stdlib-only, but the router's import
+    graph stays as small as it was."""
+    if ctx is None:
+        return None
+    from automodel_tpu.telemetry.tracing import to_traceparent
+
+    return {"traceparent": to_traceparent(ctx)}
+
+
 @dataclasses.dataclass(frozen=True)
 class ReplicaSpec:
     """One static ``fleet.replicas:`` entry."""
@@ -188,16 +199,21 @@ class _Replica:
 
 
 def _http_json(
-    url: str, obj: Optional[dict], timeout_s: float
+    url: str,
+    obj: Optional[dict],
+    timeout_s: float,
+    headers: Optional[dict] = None,
 ) -> tuple[int, dict]:
     """One GET (obj None) or POST (obj) → (status, parsed body). HTTP error
     statuses return normally (the body carries the replica's structured
-    rejection); TCP-level failures raise :class:`ReplicaUnreachable`."""
+    rejection); TCP-level failures raise :class:`ReplicaUnreachable`.
+    ``headers`` adds to the defaults (the tracing ``traceparent`` rides
+    here)."""
     data = None if obj is None else json.dumps(obj).encode()
-    req = urllib.request.Request(
-        url, data=data,
-        headers={} if data is None else {"Content-Type": "application/json"},
-    )
+    hdrs = {} if data is None else {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
+    req = urllib.request.Request(url, data=data, headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             return resp.status, json.loads(resp.read() or b"{}")
@@ -223,10 +239,14 @@ class RouterMetrics:
         )
 
         self.registry = MetricsRegistry()
+        # outcome label (ok / retried / unroutable / the terminal
+        # completion_reason, e.g. timeout or shed): retries and failure
+        # classes are visible at scrape time, not just in the JSONL
         self.requests = self.registry.labeled_counter(
             "automodel_route_requests",
-            "Requests routed to a terminal response, by replica",
-            "replica",
+            "Requests routed to a terminal response, by replica and outcome "
+            "(ok | retried | unroutable | terminal completion_reason)",
+            ("replica", "outcome"),
         )
         self.prefix_hits = self.registry.counter(
             "automodel_route_prefix_hits",
@@ -253,11 +273,29 @@ class RouterMetrics:
             "automodel_route_replicas_ready",
             "Ready replicas in the registry right now",
         )
-        self.latency = self.registry.histogram(
+        self.latency = self.registry.labeled_histogram(
             "automodel_route_request_seconds",
-            "Router-observed request latency (submit to terminal response)",
+            "Router-observed request latency (submit to terminal response), "
+            "by outcome",
+            "outcome",
             buckets=LATENCY_BUCKETS,
         )
+        # per-stage latency from the router's trace spans (placement /
+        # prefill_rpc / forward / probe_sweep) — the router-front mirror of
+        # the replicas' automodel_serve_stage_seconds
+        self.stage_seconds = self.registry.labeled_histogram(
+            "automodel_route_stage_seconds",
+            "Per-stage latency from router trace spans, by stage name",
+            "stage",
+            buckets=LATENCY_BUCKETS,
+        )
+
+    def observe_stage(self, stage: str, duration_s: float) -> None:
+        """Tracer ``observe`` hook — every emitted router span lands in the
+        per-stage histogram."""
+        if duration_s < 0:
+            return
+        self.stage_seconds.observe(stage, duration_s)
 
 
 class Router:
@@ -270,11 +308,23 @@ class Router:
         config: FleetConfig,
         tokenizer: Any = None,
         on_record: Optional[Callable[[dict], None]] = None,
+        tracer: Any = None,
     ):
         self.config = config
         self.tokenizer = tokenizer
         self.on_record = on_record
         self.metrics = RouterMetrics()
+        # request tracing: the router MINTS the trace for each request
+        # (unless the client already sent a traceparent) and propagates it
+        # on every forward — spans ride on_record like route_request records
+        self.tracer = tracer
+        if tracer is not None and tracer.observe is None:
+            tracer.observe = self.metrics.observe_stage
+        # one wall anchor per process (shared with the tracer when there is
+        # one): record timestamps are monotonic-derived, never raw wall
+        from automodel_tpu.telemetry.tracing import WallAnchor
+
+        self._clock = tracer.clock if tracer is not None else WallAnchor()
         self._lock = threading.Lock()
         self._replicas: dict[str, _Replica] = {}
         for spec in config.replicas:
@@ -340,6 +390,7 @@ class Router:
         a large fleet's sweep (and the synchronous ``start()``) would take
         O(N × timeout) — instead the whole sweep is bounded at roughly one
         probe timeout."""
+        t_probe0 = time.perf_counter()
         if self.config.dns:
             self._resolve_dns()
         with self._lock:
@@ -354,9 +405,17 @@ class Router:
             t.start()
         for t in threads:
             t.join()
-        self.metrics.replicas_ready.set(
-            sum(1 for r in reps if r.ready)
-        )
+        ready = sum(1 for r in reps if r.ready)
+        self.metrics.replicas_ready.set(ready)
+        if self.tracer is not None:
+            # probe sweeps are router-lifecycle work, not request work:
+            # each sweep is its own single-span trace (sampled like any
+            # root), so sweep latency shows up in the stage histogram and
+            # the span JSONL without polluting request waterfalls
+            self.tracer.record(
+                self.tracer.start(), "probe_sweep", t_probe0,
+                replicas=len(reps), ready=ready,
+            )
 
     def _probe_replica(self, rep: "_Replica") -> None:
         alive, ready, stats = False, False, rep.stats
@@ -513,6 +572,9 @@ class Router:
         except ValueError:
             return None
 
+    def _wall_ts(self) -> float:
+        return round(self._clock.wall(), 6)
+
     def _emit(self, rec: dict) -> None:
         if self.on_record is not None:
             try:
@@ -535,7 +597,22 @@ class Router:
         rid = str(req.get("id")) if req.get("id") is not None else (
             f"route-{next(self._ids)}"
         )
+        # trace root: continue the client's trace when a traceparent came in
+        # (HTTP header, stashed into the body by the front), mint otherwise
+        # — the router is where fleet traces are born
+        tr = self.tracer
+        client_tp = req.pop("traceparent", None)
+        root = tr.start(parent=tr.parse(client_tp)) if tr is not None else None
+
+        def _finish_span(outcome: str, **attrs) -> None:
+            if tr is not None:
+                tr.record(
+                    root, "route", t0,
+                    request_id=rid, outcome=outcome, **attrs,
+                )
+
         if self.draining:
+            _finish_span("draining")
             return 503, {
                 "error": "router is draining — retry against another router",
                 "retriable": True, "reason": "draining", "id": rid,
@@ -562,8 +639,23 @@ class Router:
             self.config.request_timeout_s,
             float(req.get("timeout_s") or 300.0) + 30.0,
         )
+        from automodel_tpu.resilience.fault_injection import active_injector
+
+        inj = active_injector()
         while retries <= self.config.retry_budget:
+            t_place0 = time.perf_counter()
+            if inj is not None:
+                inj.maybe_trace_delay("placement")
             rep, match = self.place_decode(chains, exclude=tried)
+            if tr is not None and rep is not None:
+                # the placement decision, incl. WHY: affinity (and how deep
+                # the match) vs pure load — one span per retry attempt
+                tr.child(
+                    root, "placement", t_place0,
+                    request_id=rid, attempt=retries, replica=rep.name,
+                    policy="affinity" if match > 0 else "load",
+                    prefix_match_blocks=match,
+                )
             if rep is None:
                 break
             fwd = {k: v for k, v in req.items() if k != "prompt_ids"}
@@ -601,6 +693,8 @@ class Router:
                 if pre is not None:
                     handoff_id = uuid.uuid4().hex
                     host = urllib.parse.urlsplit(rep.url).hostname
+                    pre_ctx = tr.start(parent=root) if tr is not None else None
+                    t_pre0 = time.perf_counter()
                     try:
                         code, body = _http_json(
                             pre.url + "/prefill",
@@ -612,14 +706,27 @@ class Router:
                                 },
                             },
                             fwd_timeout,
+                            headers=_trace_headers(pre_ctx),
                         )
                     except ReplicaUnreachable as e:
+                        if tr is not None:
+                            tr.record(
+                                pre_ctx, "prefill_rpc", t_pre0,
+                                request_id=rid, replica=pre.name,
+                                attempt=retries, error="unreachable",
+                            )
                         self._mark_down(pre)
                         tried_prefill.add(pre.name)
                         retries += 1
                         self._count_retry()
                         last_error = f"prefill replica unreachable: {e}"
                         continue
+                    if tr is not None:
+                        tr.record(
+                            pre_ctx, "prefill_rpc", t_pre0,
+                            request_id=rid, replica=pre.name,
+                            attempt=retries, status=code,
+                        )
                     if code != 200 or not body.get("ok"):
                         last_error = (
                             f"prefill on {pre.name} failed: "
@@ -645,9 +752,15 @@ class Router:
                         # terminal prefill failure (client budget expiry,
                         # bad request): one route_request record per
                         # terminal outcome — this path counts too
-                        self.metrics.requests.inc(pre.name)
+                        outcome = str(
+                            body.get("completion_reason") or "prefill_failed"
+                        )
+                        self.metrics.requests.inc((pre.name, outcome))
                         self.metrics.latency.observe(
-                            time.perf_counter() - t0
+                            outcome, time.perf_counter() - t0
+                        )
+                        _finish_span(
+                            outcome, replica=pre.name, attempt=retries
                         )
                         self._emit({
                             "event": "route_request",
@@ -662,7 +775,7 @@ class Router:
                             ),
                             "status": code,
                             "route_s": round(time.perf_counter() - t0, 6),
-                            "ts": time.time(),
+                            "ts": self._wall_ts(),
                         })
                         return code, {**body, "id": rid}
                     fwd["handoff_id"] = handoff_id
@@ -670,20 +783,39 @@ class Router:
                     self.metrics.handoffs.inc()
                     with self._lock:
                         self.handoffs_total += 1
+            fwd_ctx = tr.start(parent=root) if tr is not None else None
+            t_fwd0 = time.perf_counter()
+            if inj is not None:
+                inj.maybe_trace_delay("forward")
             try:
                 code, body = _http_json(
-                    rep.url + "/generate", fwd, fwd_timeout
+                    rep.url + "/generate", fwd, fwd_timeout,
+                    headers=_trace_headers(fwd_ctx),
                 )
             except ReplicaUnreachable as e:
                 # TCP-level death: the replica never answered — always
                 # retriable, and the registry marks it down until a probe
                 # sees it healthy again
+                if tr is not None:
+                    tr.record(
+                        fwd_ctx, "forward", t_fwd0,
+                        request_id=rid, replica=rep.name,
+                        attempt=retries, error="unreachable",
+                    )
                 self._mark_down(rep)
                 tried.add(rep.name)
                 retries += 1
                 self._count_retry()
                 last_error = f"replica {rep.name} unreachable: {e}"
                 continue
+            if tr is not None:
+                # one forward span per retry attempt — the retry trail is
+                # readable off the waterfall, not just the retries counter
+                tr.record(
+                    fwd_ctx, "forward", t_fwd0,
+                    request_id=rid, replica=rep.name,
+                    attempt=retries, status=code,
+                )
             # 503 = shed/draining/engine down; 409 = the claimed handoff
             # never arrived or expired on that decode replica — both
             # resubmit elsewhere (the next round redoes prefill+transfer)
@@ -702,8 +834,15 @@ class Router:
                 self.metrics.prefix_hits.inc()
                 with self._lock:
                     self.prefix_hits_total += 1
-            self.metrics.requests.inc(rep.name)
-            self.metrics.latency.observe(time.perf_counter() - t0)
+            if code == 200:
+                outcome = "ok" if retries == 0 else "retried"
+            else:
+                outcome = str(
+                    body.get("completion_reason")
+                    or body.get("reason") or f"http_{code}"
+                )
+            self.metrics.requests.inc((rep.name, outcome))
+            self.metrics.latency.observe(outcome, time.perf_counter() - t0)
             if code == 200:
                 with self._lock:
                     self.completed_total += 1
@@ -714,6 +853,10 @@ class Router:
                 "prefix_match_blocks": match,
                 "prefill_replica": used_prefill,
             }
+            _finish_span(
+                outcome, replica=rep.name, attempt=retries,
+                completion_reason=body.get("completion_reason"),
+            )
             self._emit({
                 "event": "route_request",
                 "request_id": rid,
@@ -726,14 +869,22 @@ class Router:
                 "n_generated": body.get("n_generated"),
                 "status": code,
                 "route_s": round(time.perf_counter() - t0, 6),
-                "ts": time.time(),
+                "ts": self._wall_ts(),
             })
             return code, body
         # exhausted: budget spent or nothing to route to — an explicit
         # retriable answer, never a silent drop
         self.metrics.unroutable.inc()
+        self.metrics.requests.inc(
+            (rep.name if rep is not None else "none", "unroutable")
+        )
+        self.metrics.latency.observe("unroutable", time.perf_counter() - t0)
         with self._lock:
             self.unroutable_total += 1
+        _finish_span(
+            "unroutable",
+            replica=rep.name if rep is not None else None, attempt=retries,
+        )
         self._emit({
             "event": "route_request",
             "request_id": rid,
@@ -743,7 +894,7 @@ class Router:
             "completion_reason": "unroutable",
             "status": 503,
             "route_s": round(time.perf_counter() - t0, 6),
-            "ts": time.time(),
+            "ts": self._wall_ts(),
         })
         return 503, {
             "error": (
@@ -819,6 +970,8 @@ class Router:
         }
         t0 = time.perf_counter()
 
+        durations: list[Optional[float]] = [None] * len(arrivals)
+
         def worker(i: int, offset: float, ids, max_new) -> None:
             delay = offset - (time.perf_counter() - t0)
             if delay > 0:
@@ -826,7 +979,9 @@ class Router:
             body = {"prompt_ids": list(ids), "id": f"bench-{i}"}
             if max_new is not None:
                 body["max_new_tokens"] = int(max_new)
+            t_req = time.perf_counter()
             results[i] = self.handle_generate(body)
+            durations[i] = time.perf_counter() - t_req
 
         threads = [
             threading.Thread(target=worker, args=(i, off, ids, mn), daemon=True)
@@ -845,6 +1000,9 @@ class Router:
         ]
         gen = sum(int(b.get("n_generated") or 0) for b in completions)
         routed = len(completions)
+        from automodel_tpu.telemetry.report import percentile
+
+        route_durs = [d for d in durations if d is not None]
         stats = {
             "requests": routed,
             "gen_tokens": gen,
@@ -857,6 +1015,10 @@ class Router:
                 (self.prefix_hits_total - req0["hits"]) / len(arrivals)
                 if arrivals else 0.0
             ),
+            # shared linear-interpolation percentile (telemetry/report.py)
+            # — the same rule every other p50/p99 in the tree uses
+            "route_p50_s": percentile(route_durs, 0.50),
+            "route_p99_s": percentile(route_durs, 0.99),
             "failed_requests": len(arrivals) - routed,
         }
         return out, stats
@@ -920,6 +1082,11 @@ def serve_router_http(
                     raise ValueError("request body is not a JSON object")
             except (ValueError, TypeError) as e:
                 return self._json(400, {"error": str(e)})
+            # a client-sent traceparent continues the client's trace (the
+            # body-field form also works for tests/curl without headers)
+            tp = self.headers.get("traceparent")
+            if tp is not None and "traceparent" not in req:
+                req["traceparent"] = tp
             code, body = router.handle_generate(req)
             self._json(code, body, retry_after=code == 503)
 
@@ -959,7 +1126,19 @@ def main(cfg: Any) -> int:
 
         metric_logger = MetricLogger(logging_section["metrics_path"])
         on_record = metric_logger.log
-    router = Router(fcfg, tokenizer=tokenizer, on_record=on_record)
+    # request tracing: the router is where fleet traces are minted; spans
+    # ride the same metrics JSONL as route_request records
+    import os as os_mod
+
+    from automodel_tpu.telemetry.tracing import Tracer, TracingConfig
+
+    tracing_cfg = TracingConfig.from_dict(dict(cfg.get("tracing", {}) or {}))
+    tracer = Tracer.from_config(
+        tracing_cfg, process=f"router-{os_mod.getpid()}", emit=on_record
+    )
+    router = Router(
+        fcfg, tokenizer=tokenizer, on_record=on_record, tracer=tracer
+    )
     router.start()
     server = serve_router_http(router, fcfg.port, host=fcfg.host)
 

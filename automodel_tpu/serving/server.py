@@ -150,10 +150,13 @@ class _EngineLoop:
         req: dict,
         timeout_s: float,
         submit: Optional[Any] = None,
+        trace: Optional[Any] = None,
     ) -> dict:
         """``submit`` (optional, called under the lock) replaces the plain
         ``engine.submit`` — the /prefill and KV-handoff paths enqueue
-        through their own entry points but share this wait machinery."""
+        through their own entry points but share this wait machinery.
+        ``trace`` is the propagated traceparent context (the engine mints
+        its root span as a child of it)."""
         from automodel_tpu.serving.engine import QueueFull
 
         ev = threading.Event()
@@ -169,6 +172,7 @@ class _EngineLoop:
                         max_new_tokens=req.get("max_new_tokens"),
                         deadline_s=req.get("deadline_s"),
                         max_queue_wait_s=req.get("max_queue_wait_s"),
+                        trace=trace,
                     )
             except QueueFull:
                 # the HTTP front sheds immediately — a blocked handler
@@ -354,6 +358,17 @@ def serve_http(
                 raise ValueError("request body is not a JSON object")
             return req
 
+        def _trace_ctx(self, req: dict):
+            """Propagated trace context: the W3C ``traceparent`` HTTP
+            header (the router sets it), with a body field fallback for
+            bare-bones clients. None = this engine roots a new trace."""
+            tracer = getattr(engine, "tracer", None)
+            if tracer is None:
+                return None
+            return tracer.parse(
+                self.headers.get("traceparent") or req.get("traceparent")
+            )
+
         def _prefill(self):
             """Disaggregated fleet: run chunked prefill ONLY, then stream
             the finished KV block rows to the decode replica named in
@@ -375,12 +390,14 @@ def serve_http(
                         "error": "prefill needs transfer.{host,port,handoff_id}"
                     })
                 ids = _encode_prompt(req, tokenizer)
+                ctx = self._trace_ctx(req)
                 rec = loop.submit_blocking(
                     ids, req, timeout_s=float(req.get("timeout_s", 300.0)),
                     submit=lambda: engine.submit(
                         ids, prefill_only=True,
                         deadline_s=req.get("deadline_s"),
                         max_queue_wait_s=req.get("max_queue_wait_s"),
+                        trace=ctx,
                     ),
                 )
             except (ValueError, TypeError) as e:
@@ -430,14 +447,39 @@ def serve_http(
                 "first_token": payload["first_token"],
                 "geometry": engine.kv_geometry(),
             }
+            # tracing: the KV handoff is its own stage — kv_send here,
+            # parented under this request's prefill-side root; the context
+            # rides the AKV1 header so the receiver's kv_receive span joins
+            # the same trace
+            tracer = getattr(engine, "tracer", None)
+            root = payload.get("trace")
+            send_ctx = None
+            if tracer is not None and tracer.active(root):
+                from automodel_tpu.telemetry.tracing import to_traceparent
+
+                send_ctx = tracer.start(parent=root)
+                meta["traceparent"] = to_traceparent(send_ctx)
+            t_send0 = time.perf_counter()
             try:
                 send_kv(
                     (str(transfer["host"]), int(transfer["port"])),
                     meta, payload["kv"],
                 )
             except KVTransferError as e:
+                if send_ctx is not None:
+                    tracer.record(
+                        send_ctx, "kv_send", t_send0,
+                        request_id=rec["request_id"], error=str(e)[:200],
+                    )
                 return self._json(
                     502, {"ok": False, "error": str(e), "retriable": True}
+                )
+            if send_ctx is not None:
+                tracer.record(
+                    send_ctx, "kv_send", t_send0,
+                    request_id=rec["request_id"],
+                    handoff_id=meta["handoff_id"],
+                    prompt_tokens=payload["prompt_len"],
                 )
             return self._json(200, {
                 "ok": True,
@@ -458,6 +500,7 @@ def serve_http(
             try:
                 req = self._read_req()
                 ids = _encode_prompt(req, tokenizer)
+                ctx = self._trace_ctx(req)
                 submit = None
                 if req.get("handoff_id") is not None:
                     # disaggregated decode: claim the transferred prefill
@@ -481,10 +524,11 @@ def serve_http(
                         max_new_tokens=req.get("max_new_tokens"),
                         deadline_s=req.get("deadline_s"),
                         max_queue_wait_s=req.get("max_queue_wait_s"),
+                        trace=ctx,
                     )
                 rec = loop.submit_blocking(
                     ids, req, timeout_s=float(req.get("timeout_s", 300.0)),
-                    submit=submit,
+                    submit=submit, trace=ctx,
                 )
             except (ValueError, TypeError) as e:
                 return self._json(400, {"error": str(e)})
@@ -598,8 +642,20 @@ def main(cfg: Any) -> int:
             rec.pop("tokens", None)  # completions don't belong in metrics
             metric_logger.log(rec)
 
+    # request tracing (telemetry/tracing.py): spans ride the same metrics
+    # JSONL as serve_request records — no metrics_path means no span sink,
+    # so tracing silently has nowhere to write (documented)
+    from automodel_tpu.telemetry.tracing import Tracer, TracingConfig
+
+    tracing_cfg = TracingConfig.from_dict(dict(cfg.get("tracing", {}) or {}))
+    tracer = Tracer.from_config(
+        tracing_cfg,
+        process=f"serve-{serve_cfg.role}-{os.getpid()}",
+        emit=on_record,
+    )
+
     engine = ServingEngine(
-        auto, serve_cfg, gen_cfg, on_record=on_record
+        auto, serve_cfg, gen_cfg, on_record=on_record, tracer=tracer
     )
 
     # disaggregated fleet: a decode-role replica listens for prefill→decode
@@ -615,6 +671,7 @@ def main(cfg: Any) -> int:
             engine.kv_geometry(), host=ktc.host, port=ktc.port,
             max_pending=ktc.max_pending, ttl_s=ktc.ttl_s,
             max_frame_bytes=engine.kv_frame_bytes_bound(),
+            tracer=engine.tracer,
         ).start()
         engine.kv_transfer_port = kv_server.port
         logger.info("KV-transfer listener on port %d", kv_server.port)
@@ -762,6 +819,10 @@ def _serve_stdin(engine, tokenizer, serve_cfg) -> int:
                 raise ValueError("request line is not a JSON object")
             rid = req.get("id")
             ids = _encode_prompt(req, tokenizer)
+            ctx = (
+                engine.tracer.parse(req.get("traceparent"))
+                if engine.tracer is not None else None
+            )
             while True:
                 try:
                     engine.submit(
@@ -770,6 +831,7 @@ def _serve_stdin(engine, tokenizer, serve_cfg) -> int:
                         max_new_tokens=req.get("max_new_tokens"),
                         deadline_s=req.get("deadline_s"),
                         max_queue_wait_s=req.get("max_queue_wait_s"),
+                        trace=ctx,
                     )
                     break
                 except QueueFull:

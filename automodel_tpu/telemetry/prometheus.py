@@ -122,40 +122,61 @@ def _label_value(v: str) -> str:
 
 
 class _Labeled(_Metric):
-    """One metric name fanned out over ONE label (e.g. the fleet router's
-    ``automodel_route_requests_total{replica="r0"}``). Child values are
-    created on first touch and render as one sample line per label value.
-    Mutations take a per-metric lock: unlike the scalar float updates,
-    inserting a NEW label key (a replica joining via DNS) during a
-    concurrent /metrics render would die with "dictionary changed size
-    during iteration"."""
+    """One metric name fanned out over one or more labels (e.g. the fleet
+    router's ``automodel_route_requests_total{replica="r0",outcome="ok"}``).
+    Child values are created on first touch and render as one sample line
+    per label-value tuple. Mutations take a per-metric lock: unlike the
+    scalar float updates, inserting a NEW label key (a replica joining via
+    DNS) during a concurrent /metrics render would die with "dictionary
+    changed size during iteration"."""
 
-    def __init__(self, name: str, help_text: str, label: str):
+    def __init__(self, name: str, help_text: str, label):
         super().__init__(name, help_text)
-        if not _NAME_RE.match(label):
-            raise ValueError(f"invalid prometheus label name {label!r}")
-        self.label = label
-        self.values: dict[str, float] = {}
+        labels = (label,) if isinstance(label, str) else tuple(label)
+        for l in labels:
+            if not _NAME_RE.match(l):
+                raise ValueError(f"invalid prometheus label name {l!r}")
+        if not labels:
+            raise ValueError(f"labeled metric {name}: no labels")
+        self.labels = labels
+        self.values: dict[tuple, float] = {}
         self._lock = threading.Lock()
+
+    def _key(self, label_value) -> tuple:
+        vals = (
+            (label_value,)
+            if isinstance(label_value, str) else tuple(label_value)
+        )
+        if len(vals) != len(self.labels):
+            raise ValueError(
+                f"metric {self.name} takes {len(self.labels)} label "
+                f"value(s) {self.labels}, got {vals!r}"
+            )
+        return tuple(_label_value(v) for v in vals)
+
+    def _label_str(self, key: tuple) -> str:
+        return ",".join(
+            f'{l}="{v}"' for l, v in zip(self.labels, key)
+        )
 
     def _lines(self, suffix: str) -> list[str]:
         with self._lock:
             items = sorted(self.values.items())
         return [
-            f'{self.name}{suffix}{{{self.label}="{lv}"}} {_fmt(v)}'
-            for lv, v in items
+            f"{self.name}{suffix}{{{self._label_str(k)}}} {_fmt(v)}"
+            for k, v in items
         ]
 
 
 class LabeledCounter(_Labeled):
     kind = "counter"
 
-    def inc(self, label_value: str, v: float = 1.0) -> None:
+    def inc(self, label_value, v: float = 1.0) -> None:
         if v < 0:
             raise ValueError(f"counter {self.name}: negative increment {v}")
-        lv = _label_value(label_value)
+        key = self._key(label_value)
         with self._lock:
-            self.values[lv] = self.values.get(lv, 0.0) + v
+            self.values[key] = self.values.get(key, 0.0) + v
 
     def render(self) -> list[str]:
         return self._lines("_total")
@@ -168,12 +189,76 @@ class LabeledCounter(_Labeled):
 class LabeledGauge(_Labeled):
     kind = "gauge"
 
-    def set(self, label_value: str, v: float) -> None:
+    def set(self, label_value, v: float) -> None:
         with self._lock:
-            self.values[_label_value(label_value)] = float(v)
+            self.values[self._key(label_value)] = float(v)
 
     def render(self) -> list[str]:
         return self._lines("")
+
+    @property
+    def render_name(self) -> str:
+        return self.name
+
+
+class LabeledHistogram(_Labeled):
+    """One histogram per label-value tuple under a shared bucket layout —
+    e.g. the per-stage latency histograms
+    (``automodel_serve_stage_seconds_bucket{stage="prefill",le=...}``)
+    that make a stage regression visible at scrape time, not just in the
+    span JSONL."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        label,
+        buckets: Sequence[float] = LATENCY_BUCKETS,
+    ):
+        super().__init__(name, help_text, label)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError(f"histogram {name}: empty buckets")
+        self.buckets = bs
+        self.children: dict[tuple, Histogram] = {}
+
+    def observe(self, label_value, v: float) -> None:
+        key = self._key(label_value)
+        with self._lock:
+            child = self.children.get(key)
+            if child is None:
+                child = self.children[key] = Histogram(
+                    self.name, self.help, buckets=self.buckets
+                )
+            child.observe(v)
+
+    def child_sum(self, label_value) -> float:
+        """Observed-value sum for one label tuple (0.0 when untouched)."""
+        with self._lock:
+            child = self.children.get(self._key(label_value))
+            return child.sum if child is not None else 0.0
+
+    def render(self) -> list[str]:
+        with self._lock:
+            items = sorted(self.children.items())
+        lines: list[str] = []
+        for key, child in items:
+            labels = self._label_str(key)
+            cum = 0
+            for b, c in zip(child.buckets, child.counts):
+                cum += c
+                lines.append(
+                    f'{self.name}_bucket{{{labels},le="{_fmt(b)}"}} {cum}'
+                )
+            lines.append(
+                f'{self.name}_bucket{{{labels},le="+Inf"}} '
+                f"{cum + child.inf_count}"
+            )
+            lines.append(f"{self.name}_sum{{{labels}}} {_fmt(child.sum)}")
+            lines.append(f"{self.name}_count{{{labels}}} {child.count}")
+        return lines
 
     @property
     def render_name(self) -> str:
@@ -254,9 +339,21 @@ class MetricsRegistry:
         with self.lock:
             return self._register(LabeledCounter(name, help_text, label))  # type: ignore[return-value]
 
-    def labeled_gauge(self, name: str, help_text: str, label: str) -> LabeledGauge:
+    def labeled_gauge(self, name: str, help_text: str, label) -> LabeledGauge:
         with self.lock:
             return self._register(LabeledGauge(name, help_text, label))  # type: ignore[return-value]
+
+    def labeled_histogram(
+        self,
+        name: str,
+        help_text: str,
+        label,
+        buckets: Sequence[float] = LATENCY_BUCKETS,
+    ) -> LabeledHistogram:
+        with self.lock:
+            return self._register(
+                LabeledHistogram(name, help_text, label, buckets)
+            )  # type: ignore[return-value]
 
     def histogram(
         self, name: str, help_text: str, buckets: Sequence[float] = LATENCY_BUCKETS
@@ -395,6 +492,15 @@ class ServingMetrics:
             "automodel_serve_spec_accept_rate",
             "Engine-lifetime draft acceptance rate (0 until a round runs)",
         )
+        # request tracing (telemetry/tracing.py): per-stage latency — one
+        # histogram per span stage (queue/admission/prefill/kv_inject/
+        # decode/...), observed per emitted span so a stage regression
+        # shows at scrape time, not only in the span JSONL
+        self.stage_seconds = r.labeled_histogram(
+            "automodel_serve_stage_seconds",
+            "Per-stage latency from request trace spans, by stage name",
+            "stage",
+        )
         self._pool_counters = {
             key: r.counter(f"automodel_serve_block_{key}", help_text)
             for key, help_text in (
@@ -419,6 +525,15 @@ class ServingMetrics:
                 self.queue_wait.observe(rec["queue_s"])
             self.completed.inc()
             self.gen_tokens.inc(rec.get("n_generated", 0) or 0)
+
+    def observe_stage(self, stage: str, duration_s: float) -> None:
+        """Per-span stage observation (the engine's Tracer ``observe``
+        hook). Negative durations are a clock bug the JSONL lint flags —
+        they must not also poison the histogram sum. The labeled histogram
+        takes its own per-metric lock."""
+        if duration_s < 0:
+            return
+        self.stage_seconds.observe(stage, duration_s)
 
     def observe_failure(self, reason: str) -> None:
         """Per-termination observation for a request that did NOT complete

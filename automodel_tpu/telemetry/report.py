@@ -18,8 +18,26 @@ foreign writer produced the file.
 from __future__ import annotations
 
 import json
+import math
 import sys
-from typing import Any, Optional
+from typing import Any, Iterable, Optional
+
+
+def percentile(values: Iterable[float], q: float) -> Optional[float]:
+    """Linear-interpolation percentile (numpy's default method), shared by
+    every quantile consumer in the tree — engine/router workload stats, the
+    bench serving legs, and the report summaries — so a p50/p99 means the
+    same thing everywhere. ``q`` in [0, 1]; → None on an empty input."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return None
+    if not (0.0 <= q <= 1.0):
+        raise ValueError(f"percentile q={q} (want 0..1)")
+    pos = q * (len(vals) - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(vals) - 1)
+    frac = pos - lo
+    return vals[lo] * (1.0 - frac) + vals[hi] * frac
 
 # keys whose presence implies a numeric (or null-with-marker) value
 _NUMERIC_KEYS = (
@@ -107,7 +125,32 @@ _NUMERIC_KEYS = (
     "kernel_tflops",
     "kernel_mfu_measured_pct",
     "kernel_bench_winners",
+    # request tracing (telemetry/tracing.py `span` events)
+    "duration_s",
 )
+
+# keys that are wall-time durations and can never legitimately be negative:
+# a negative value means mixed clocks (a wall-clock timestamp subtracted
+# from a monotonic one) — exactly the corruption the per-process WallAnchor
+# exists to prevent, so --strict flags it
+_DURATION_KEYS = (
+    "duration_s",
+    "queue_s",
+    "ttft_s",
+    "route_s",
+    "step_time_s",
+    "compile_time_s",
+    "drain_duration_s",
+    "host_input_wait_s",
+    "recompile_secs",
+)
+
+# a span record must carry these to be assemblable by `automodel_tpu trace`
+# — ONE schema, owned by the tracing module (its read_span_records applies
+# the same keys); the string ids here, the numeric keys checked separately
+from automodel_tpu.telemetry.tracing import SPAN_REQUIRED_KEYS as _SPAN_KEYS
+
+_SPAN_REQUIRED = tuple(k for k in _SPAN_KEYS if k not in ("duration_s", "ts"))
 
 
 def _strict_loads(line: str) -> Any:
@@ -181,6 +224,27 @@ def lint_metrics_jsonl(path: str) -> tuple[list[dict], list[str]]:
                 problems.append(f"line {i}: {k} is not numeric: {rec[k]!r}")
             if k in rec and rec[k] is None and not rec.get(f"{k}_nonfinite"):
                 problems.append(f"line {i}: {k} is null without a {k}_nonfinite marker")
+        for k in _DURATION_KEYS:
+            v = rec.get(k)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) and v < 0:
+                problems.append(
+                    f"line {i}: {k} is negative ({v}) — durations are "
+                    "monotonic differences and cannot go backwards; a "
+                    "negative value means mixed wall/monotonic clocks"
+                )
+        if rec.get("event") == "span":
+            missing = [
+                k for k in _SPAN_REQUIRED
+                if not isinstance(rec.get(k), str) or not rec.get(k)
+            ]
+            if missing:
+                problems.append(f"line {i}: span record missing {missing}")
+            if not isinstance(rec.get("duration_s"), (int, float)):
+                problems.append(f"line {i}: span record has no duration_s")
+            # "ts" absence is already flagged for every record above; a
+            # non-numeric one would break assembly ordering too
+            if "ts" in rec and not isinstance(rec.get("ts"), (int, float)):
+                problems.append(f"line {i}: span ts is not numeric")
     return records, problems
 
 
@@ -296,7 +360,8 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
             if isinstance(r.get("ttft_s"), (int, float))
         )
         if ttfts:
-            out["serve_ttft_p50_s"] = ttfts[len(ttfts) // 2]
+            out["serve_ttft_p50_s"] = percentile(ttfts, 0.50)
+            out["serve_ttft_p99_s"] = percentile(ttfts, 0.99)
             out["serve_ttft_max_s"] = ttfts[-1]
         occ = [
             r["block_occupancy"] for r in serves
@@ -362,6 +427,37 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
         handoffs = sum(1 for r in routes if r.get("disaggregated"))
         if handoffs:
             out["route_kv_handoffs"] = handoffs
+    spans = [r for r in records if r.get("event") == "span"]
+    if spans:
+        # request-tracing rollups: per-stage p50/p99 so "where did the time
+        # go" reads off the same summary as throughput. Orphan adjudication
+        # across PROCESSES belongs to `automodel_tpu trace` (it sees every
+        # file); here the count covers only this one file's spans, so a
+        # per-process file legitimately shows cross-process parents as
+        # orphans — surfaced as data, not flagged as a problem.
+        out["span_records"] = len(spans)
+        out["span_traces"] = len({
+            r["trace_id"] for r in spans if isinstance(r.get("trace_id"), str)
+        })
+        ids = {r.get("span_id") for r in spans}
+        out["span_orphans_in_file"] = sum(
+            1 for r in spans
+            if r.get("parent_id") and r["parent_id"] not in ids
+        )
+        by_stage: dict[str, list[float]] = {}
+        for r in spans:
+            stage, dur = r.get("stage"), r.get("duration_s")
+            if isinstance(stage, str) and isinstance(dur, (int, float)):
+                by_stage.setdefault(stage, []).append(float(dur))
+        if by_stage:
+            out["span_stages"] = {
+                stage: {
+                    "count": len(durs),
+                    "p50_s": round(percentile(durs, 0.50), 6),
+                    "p99_s": round(percentile(durs, 0.99), 6),
+                }
+                for stage, durs in sorted(by_stage.items())
+            }
     stalls = [r for r in records if r.get("event") == "serve_engine_event"]
     if stalls:
         out["serve_engine_events"] = [

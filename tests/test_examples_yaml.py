@@ -201,6 +201,13 @@ def test_example_yaml_parses_and_dry_instantiates(path):
 
         MetricsServerConfig.from_dict(ms)
 
+    # tracing: → TracingConfig (request tracing on the serve/route CLIs)
+    trc = _section(cfg, "tracing")
+    if trc is not None:
+        from automodel_tpu.telemetry.tracing import TracingConfig
+
+        TracingConfig.from_dict(trc)
+
     # launcher sections → SlurmConfig / K8sConfig
     sl = _section(cfg, "slurm")
     if sl is not None:
@@ -270,6 +277,14 @@ def test_config_dataclasses_reject_unknown_keys():
         FleetConfig.from_dict({"replicas": [{"url": "http://x", "role": "router"}]})
     with pytest.raises(ValueError):
         FleetConfig.from_dict({"retry_budget": -1})
+    from automodel_tpu.telemetry.tracing import TracingConfig
+
+    with pytest.raises(TypeError):
+        TracingConfig.from_dict({"sample_ratee": 0.5})
+    with pytest.raises(ValueError):
+        TracingConfig.from_dict({"sample_rate": 1.5})
+    assert TracingConfig.from_dict(None).enabled is True
+    assert TracingConfig.from_dict({"enabled": False}).enabled is False
     from automodel_tpu.data.prefetch import PrefetchConfig
 
     with pytest.raises(TypeError):

@@ -558,7 +558,7 @@ def test_generation_engine_program_costs():
 # -- /metrics ------------------------------------------------------------------
 
 _SAMPLE_RE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_=\".+-]*\})? "
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_=\",.+-]*\})? "
     r"(NaN|[-+]?Inf|[-+]?[0-9.eE+-]+)$"
 )
 

@@ -1,0 +1,659 @@
+"""End-to-end request tracing (telemetry/tracing.py): traceparent
+format/parse, sampling, the monotonic-clock + wall-anchor rule, the
+assembler (out-of-order spans, clock-skewed hosts, orphan/partial traces),
+report lint/rollups, the shared percentile helper, engine/router span
+instrumentation, the trace_delay fault-injection attribution proof, and
+the acceptance e2e: a routed disaggregated request (router + prefill
+replica + decode replica) assembling into ONE waterfall from three
+per-process JSONL files via `automodel_tpu trace` with zero orphans. All
+CPU-fast, tier-1."""
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+import jax
+
+from automodel_tpu.auto_model import AutoModel
+from automodel_tpu.generation.engine import GenerationConfig
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.resilience.fault_injection import activate
+from automodel_tpu.serving.engine import ServeConfig, ServingEngine, StallConfig
+from automodel_tpu.telemetry.report import (
+    lint_metrics_jsonl,
+    percentile,
+    summarize_metrics,
+)
+from automodel_tpu.telemetry.tracing import (
+    SpanContext,
+    Tracer,
+    TracingConfig,
+    assemble_traces,
+    chrome_trace,
+    main as trace_main,
+    parse_traceparent,
+    read_span_records,
+    render_report,
+    to_traceparent,
+)
+
+FP32 = BackendConfig(attn="sdpa", param_dtype="float32", compute_dtype="float32")
+
+
+def _tiny_auto(seed=0):
+    from automodel_tpu.models.llama import LlamaForCausalLM
+
+    model = LlamaForCausalLM(
+        TransformerConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+            num_heads=4, num_kv_heads=2, head_dim=8,
+        ),
+        FP32,
+    )
+    return AutoModel(
+        model=model, params=model.init(jax.random.key(seed)),
+        adapter=None, mesh_ctx=None,
+    )
+
+
+def _engine(records, process="engine", sample_rate=1.0, **over):
+    over.setdefault("watchdog", StallConfig(enabled=False))
+    tracer = Tracer(process, emit=records.append, sample_rate=sample_rate)
+    return ServingEngine(
+        _tiny_auto(),
+        ServeConfig(
+            slots=2, block_size=4, num_blocks=32, prefill_chunk=4,
+            max_seq_len=48, **over,
+        ),
+        GenerationConfig(max_new_tokens=6, greedy=True),
+        on_record=records.append,
+        tracer=tracer,
+    )
+
+
+def _spans(records):
+    return [r for r in records if r.get("event") == "span"]
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# traceparent + config + tracer units
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip_and_rejection():
+    tr = Tracer("p", emit=lambda r: None)
+    ctx = tr.start()
+    h = to_traceparent(ctx)
+    assert h.startswith("00-") and h.endswith("-01") and len(h) == 55
+    back = parse_traceparent(h)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    # the unsampled flag round-trips too
+    un = SpanContext(ctx.trace_id, ctx.span_id, sampled=False)
+    assert parse_traceparent(to_traceparent(un)).sampled is False
+    # malformed headers degrade to None, never raise
+    for bad in (
+        None, 42, "", "garbage", "00-short-short-01",
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # forbidden version
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "00-" + "g" * 32 + "-" + "b" * 16 + "-01",  # non-hex
+    ):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_tracing_config_strict():
+    assert TracingConfig.from_dict(None) == TracingConfig()
+    assert TracingConfig.from_dict({"sample_rate": 0.25}).sample_rate == 0.25
+    with pytest.raises(TypeError):
+        TracingConfig.from_dict({"enabledd": True})
+    with pytest.raises(ValueError):
+        TracingConfig(sample_rate=-0.1)
+    # from_config: disabled section or no emit sink -> None (tracing off)
+    assert Tracer.from_config(
+        TracingConfig(enabled=False), "p", emit=lambda r: None
+    ) is None
+    assert Tracer.from_config(TracingConfig(), "p", emit=None) is None
+    assert Tracer.from_config(TracingConfig(), "p", emit=lambda r: None) is not None
+
+
+def test_tracer_sampling_and_child_inheritance():
+    recs = []
+    never = Tracer("p", emit=recs.append, sample_rate=0.0)
+    root = never.start()
+    assert root.sampled is False
+    never.record(root, "route", time.perf_counter())
+    never.child(root, "forward", time.perf_counter())
+    assert recs == []
+    # children inherit the root's sampling decision, both ways
+    always = Tracer("p", emit=recs.append, sample_rate=1.0)
+    on = always.start()
+    assert on.sampled
+    assert always.start(parent=root).sampled is False
+    assert always.start(parent=on).sampled is True
+    assert always.start(parent=on).trace_id == on.trace_id
+    # a disabled tracer (no emit) never samples
+    off = Tracer("p", emit=None)
+    assert off.start().sampled is False
+
+
+def test_tracer_span_record_schema_and_observe_hook():
+    recs, observed = [], []
+    tr = Tracer("procX", emit=recs.append, observe=lambda s, d: observed.append((s, d)))
+    root = tr.start()
+    t0 = time.perf_counter()
+    time.sleep(0.005)
+    tr.record(root, "serve", t0, request_id="r9")
+    (rec,) = recs
+    assert rec["event"] == "span" and rec["stage"] == "serve"
+    assert rec["process"] == "procX" and rec["request_id"] == "r9"
+    assert rec["duration_s"] >= 0.005
+    assert "parent_id" not in rec  # roots carry no parent
+    # ts is the anchored wall at span START: anchor + t0
+    assert rec["ts"] == pytest.approx(tr.clock.offset + t0, abs=1e-4)
+    assert observed == [("serve", rec["duration_s"])]
+    # the span context manager records on exceptions too
+    with pytest.raises(RuntimeError):
+        with tr.span(root, "forward", replica="r0"):
+            raise RuntimeError("boom")
+    assert recs[-1]["stage"] == "forward" and recs[-1]["parent_id"] == root.span_id
+
+
+def test_percentile_linear_interpolation():
+    assert percentile([], 0.5) is None
+    assert percentile([5.0], 0.99) == 5.0
+    assert percentile([1, 2, 3, 4], 0.5) == 2.5
+    assert percentile([1, 2, 3, 4], 0.25) == 1.75
+    assert percentile([4, 1, 3, 2], 1.0) == 4.0  # unsorted input is fine
+    assert percentile([1, 2, 3, 4], 0.0) == 1.0
+    assert percentile(range(101), 0.99) == pytest.approx(99.0)
+    with pytest.raises(ValueError):
+        percentile([1.0], 1.5)
+
+
+# ---------------------------------------------------------------------------
+# assembler
+# ---------------------------------------------------------------------------
+
+
+def _mk_span(trace_id, span_id, stage, ts, dur, parent=None, process="p"):
+    s = {
+        "event": "span", "trace_id": trace_id, "span_id": span_id,
+        "stage": stage, "ts": ts, "duration_s": dur, "process": process,
+    }
+    if parent:
+        s["parent_id"] = parent
+    return s
+
+
+def test_assemble_out_of_order_spans():
+    tid = "t" * 32
+    spans = [
+        _mk_span(tid, "a" * 16, "route", 100.0, 1.0),
+        _mk_span(tid, "b" * 16, "forward", 100.2, 0.7, parent="a" * 16),
+        _mk_span(tid, "c" * 16, "serve", 100.3, 0.5, parent="b" * 16),
+        _mk_span(tid, "d" * 16, "queue", 100.3, 0.1, parent="c" * 16),
+    ]
+    rng = random.Random(7)
+    rng.shuffle(spans)
+    (trace,) = assemble_traces(spans)
+    assert [s["stage"] for s in trace["spans"]] == [
+        "route", "forward", "serve", "queue"
+    ]
+    assert [s["depth"] for s in trace["spans"]] == [0, 1, 2, 3]
+    assert trace["orphans"] == [] and not trace["partial"]
+    assert trace["duration_s"] == pytest.approx(1.0)
+
+
+def test_assemble_clock_skewed_hosts():
+    """Process B's wall clock is 5 s behind: its child spans appear to
+    start before their parent. Assembly shifts B by exactly the violation
+    and reports it — within-process layout is untouched."""
+    tid = "s" * 32
+    spans = [
+        _mk_span(tid, "a" * 16, "route", 1000.0, 0.5, process="router"),
+        _mk_span(
+            tid, "b" * 16, "serve", 995.1, 0.2, parent="a" * 16,
+            process="replica",
+        ),
+        _mk_span(
+            tid, "c" * 16, "queue", 995.1, 0.05, parent="b" * 16,
+            process="replica",
+        ),
+    ]
+    (trace,) = assemble_traces(spans)
+    assert trace["skew_s"]["replica"] == pytest.approx(4.9, abs=1e-6)
+    by_stage = {s["stage"]: s for s in trace["spans"]}
+    # the corrected child starts inside its parent's window
+    assert by_stage["serve"]["t0_s"] >= by_stage["route"]["t0_s"]
+    assert by_stage["serve"]["t0_s"] <= 0.5
+    # relative layout within "replica" preserved (queue starts with serve)
+    assert by_stage["queue"]["t0_s"] == pytest.approx(by_stage["serve"]["t0_s"])
+    # rendering mentions the correction
+    assert "clock-skew correction" in render_report([trace], ["x"], [])
+
+
+def test_assemble_orphans_and_partial_reported_not_dropped():
+    tid = "o" * 32
+    spans = [
+        _mk_span(tid, "a" * 16, "route", 10.0, 1.0),
+        _mk_span(tid, "z" * 16, "kv_receive", 10.5, 0.1, parent="9" * 16),
+    ]
+    (trace,) = assemble_traces(spans)
+    assert len(trace["orphans"]) == 1
+    assert len(trace["spans"]) == 2  # the orphan is rendered, not dropped
+    assert any(s.get("orphan") for s in trace["spans"])
+    assert not trace["partial"]  # a root exists
+    report = render_report([trace], ["x"], [])
+    assert "orphan" in report
+    # a trace with NO root at all is partial
+    (p,) = assemble_traces(
+        [_mk_span("q" * 32, "b" * 16, "serve", 5.0, 0.3, parent="8" * 16)]
+    )
+    assert p["partial"] and len(p["orphans"]) == 1
+    assert "partial trace" in render_report([p], ["x"], [])
+
+
+def test_chrome_trace_loads_through_profiling_tooling(tmp_path):
+    tid = "c" * 32
+    spans = [
+        _mk_span(tid, "a" * 16, "route", 50.0, 0.4, process="router"),
+        _mk_span(
+            tid, "b" * 16, "serve", 50.1, 0.2, parent="a" * 16,
+            process="replica",
+        ),
+    ]
+    doc = chrome_trace(assemble_traces(spans))
+    path = tmp_path / "req.trace.json"
+    path.write_text(json.dumps(doc))
+    from automodel_tpu.telemetry.profiling.trace import load_trace_events
+
+    events = load_trace_events(path)
+    xs = [e for e in events if e.get("ph") == "X"]
+    ms = [e for e in events if e.get("ph") == "M"]
+    assert {e["name"] for e in xs} == {"route", "serve"}
+    assert {e["args"]["name"] for e in ms} == {"router", "replica"}
+    # ts/dur in microseconds, child offset preserved
+    serve = next(e for e in xs if e["name"] == "serve")
+    assert serve["ts"] == pytest.approx(0.1 * 1e6, rel=1e-3)
+    assert serve["dur"] == pytest.approx(0.2 * 1e6, rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# report lint + rollups
+# ---------------------------------------------------------------------------
+
+
+def test_report_lints_span_schema_and_negative_durations(tmp_path):
+    path = _write_jsonl(tmp_path / "m.jsonl", [
+        {"event": "span", "trace_id": "t" * 32, "span_id": "a" * 16,
+         "stage": "queue", "ts": 1.0, "duration_s": 0.1},
+        {"event": "span", "ts": 2.0, "duration_s": 0.1},  # missing ids
+        {"event": "span", "trace_id": "t" * 32, "span_id": "b" * 16,
+         "stage": "decode", "ts": 3.0},  # no duration
+        {"event": "serve_request", "ts": 4.0, "queue_s": -0.5,
+         "completion_reason": "stop"},  # mixed-clock negative duration
+    ])
+    records, problems = lint_metrics_jsonl(path)
+    assert len(records) == 4
+    assert any("span record missing" in p for p in problems)
+    assert any("no duration_s" in p for p in problems)
+    assert any("queue_s is negative" in p for p in problems)
+    # a clean span-bearing file lints clean
+    clean = _write_jsonl(tmp_path / "clean.jsonl", [
+        {"event": "span", "trace_id": "t" * 32, "span_id": "a" * 16,
+         "stage": "queue", "ts": 1.0, "duration_s": 0.1},
+    ])
+    _, ok_problems = lint_metrics_jsonl(clean)
+    assert ok_problems == []
+
+
+def test_report_span_stage_rollups_use_shared_percentile():
+    tid = "r" * 32
+    records = [
+        _mk_span(tid, f"{i:016x}", "prefill", 1.0 + i, float(i + 1))
+        for i in range(4)  # durations 1, 2, 3, 4
+    ]
+    records.append(
+        _mk_span(tid, "e" * 16, "decode", 9.0, 0.5, parent="missing-parent")
+    )
+    out = summarize_metrics(records)
+    assert out["span_records"] == 5
+    assert out["span_traces"] == 1
+    assert out["span_orphans_in_file"] == 1
+    st = out["span_stages"]
+    assert st["prefill"]["count"] == 4
+    assert st["prefill"]["p50_s"] == pytest.approx(percentile([1, 2, 3, 4], 0.5))
+    assert st["prefill"]["p99_s"] == pytest.approx(percentile([1, 2, 3, 4], 0.99))
+    assert st["decode"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# engine instrumentation
+# ---------------------------------------------------------------------------
+
+
+def test_engine_spans_cover_request_stages(tmp_path):
+    records = []
+    eng = _engine(records)
+    rid = eng.submit(list(range(1, 12)))
+    out = eng.run()
+    assert out[0]["completion_reason"] in ("stop", "length")
+    spans = _spans(records)
+    stages = [s["stage"] for s in spans]
+    for stage in ("queue", "admission", "prefill", "decode", "serve"):
+        assert stage in stages, stages
+    assert stages.count("prefill") == 3  # 11 tokens / chunk 4
+    assert len({s["trace_id"] for s in spans}) == 1
+    root = next(s for s in spans if s["stage"] == "serve")
+    assert "parent_id" not in root  # engine front minted the trace
+    assert root["request_id"] == rid and root["completion_reason"]
+    children = [s for s in spans if s["stage"] != "serve"]
+    assert all(c["parent_id"] == root["span_id"] for c in children)
+    # every span's ts/duration is coherent: no negatives, all durations
+    # bounded by the root's window
+    assert all(s["duration_s"] >= 0 for s in spans)
+    (trace,) = assemble_traces(spans)
+    assert trace["orphans"] == [] and not trace["partial"]
+    # the per-stage /metrics histogram observed every stage
+    rendered = eng.metrics.registry.render()
+    for stage in ("queue", "admission", "prefill", "decode", "serve"):
+        assert f'automodel_serve_stage_seconds_count{{stage="{stage}"}}' in rendered
+    from tests.test_profiling import _lint_exposition
+
+    _lint_exposition(rendered)
+    # the emitted JSONL passes the strict lint
+    path = _write_jsonl(tmp_path / "serve.jsonl", records)
+    _, problems = lint_metrics_jsonl(path)
+    assert problems == [], problems
+
+
+def test_engine_honors_unsampled_propagated_context():
+    records = []
+    eng = _engine(records)
+    parent = SpanContext("f" * 32, "1" * 16, sampled=False)
+    eng.submit([1, 2, 3, 4, 5], trace=parent)
+    eng.run()
+    assert _spans(records) == []  # propagated no-sample is honored
+    # a sampled parent joins its trace and parents the engine root
+    parent_on = SpanContext("d" * 32, "2" * 16, sampled=True)
+    eng.submit([1, 2, 3, 4, 5], trace=parent_on)
+    eng.run()
+    spans = _spans(records)
+    assert spans and all(s["trace_id"] == "d" * 32 for s in spans)
+    root = next(s for s in spans if s["stage"] == "serve")
+    assert root["parent_id"] == "2" * 16
+
+
+def test_engine_rejection_paths_leave_spans():
+    records = []
+    eng = _engine(records)
+    eng.submit([1, 2, 3], max_queue_wait_s=1e-9)
+    time.sleep(0.002)
+    out = eng.step()
+    assert out and out[0]["completion_reason"] == "timeout"
+    spans = _spans(records)
+    root = next(s for s in spans if s["stage"] == "serve")
+    assert root["completion_reason"] == "timeout"
+    assert any(s["stage"] == "queue" for s in spans)
+
+
+def test_trace_delay_attributed_to_injected_stage():
+    """The acceptance knob: an injected prefill delay must land on the
+    prefill span (waterfall) and the prefill stage histogram (/metrics) —
+    and NOT on decode."""
+    delay_s = 0.05
+    warm = []
+    eng = _engine(warm)
+    # warm-up request OUTSIDE the injection window: the first decode call
+    # pays the jit compile, which must not masquerade as stage time
+    eng.submit([7, 8, 9], max_new_tokens=2)
+    eng.run()
+    records = []
+    eng.tracer.emit = records.append
+    eng.on_record = records.append
+    h = eng.metrics.stage_seconds
+    prefill_sum0 = h.child_sum("prefill")
+    decode_sum0 = h.child_sum("decode")
+    try:
+        activate({"trace_delay_stage": "prefill", "trace_delay_ms": delay_s * 1000})
+        eng.submit(list(range(1, 6)), max_new_tokens=3)  # 5 tokens -> 2 chunks
+        eng.run()
+    finally:
+        activate(None)
+    spans = _spans(records)
+    prefills = [s for s in spans if s["stage"] == "prefill"]
+    decodes = [s for s in spans if s["stage"] == "decode"]
+    assert prefills and decodes
+    assert all(s["duration_s"] >= delay_s for s in prefills)
+    assert all(s["duration_s"] < delay_s for s in decodes)
+    # /metrics: the injected time shows in the prefill histogram sum only
+    assert h.child_sum("prefill") - prefill_sum0 >= delay_s * len(prefills)
+    assert h.child_sum("decode") - decode_sum0 < delay_s
+    # and the assembled waterfall charges prefill, not decode
+    (trace,) = assemble_traces(spans)
+    by_stage = {}
+    for s in trace["spans"]:
+        by_stage.setdefault(s["stage"], 0.0)
+        by_stage[s["stage"]] += s["duration_s"]
+    assert by_stage["prefill"] > by_stage["decode"]
+
+
+def test_engine_record_ts_is_monotonic_anchored():
+    """Satellite: serve_request `ts` comes from one wall anchor + the
+    monotonic clock, consistent with the span timestamps beside it."""
+    records = []
+    eng = _engine(records)
+    eng.submit([1, 2, 3, 4], max_new_tokens=2)
+    eng.run()
+    reqs = [r for r in records if r.get("event") == "serve_request"]
+    spans = _spans(records)
+    assert reqs and spans
+    # both derive from the same anchor: the terminal record's ts must be
+    # >= every span's start and within a second of the root's end
+    root = next(s for s in spans if s["stage"] == "serve")
+    assert reqs[0]["ts"] >= root["ts"]
+    assert reqs[0]["ts"] - (root["ts"] + root["duration_s"]) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: routed disaggregated request, three processes' JSONLs
+# ---------------------------------------------------------------------------
+
+
+def _http_replica(engine):
+    from automodel_tpu.serving.server import serve_http
+
+    engine.submit([1], max_new_tokens=2)
+    engine.run()  # warm: compiles done, first_decode_done -> /readyz true
+    server, loop = serve_http(engine, None, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, loop
+
+
+def test_routed_disaggregated_request_assembles_one_waterfall(tmp_path, capsys):
+    """The ISSUE acceptance: router + prefill replica + decode replica,
+    one routed request; the three components' JSONLs join under ONE
+    trace_id via `automodel_tpu trace` with every stage span present and
+    zero orphans."""
+    from automodel_tpu.serving.fleet.kv_transfer import KVTransferServer
+    from automodel_tpu.serving.fleet.router import FleetConfig, Router
+    from automodel_tpu.serving.server import serve_http
+
+    pre_recs, dec_recs, route_recs = [], [], []
+    pre = _engine(pre_recs, process="serve-prefill", role="prefill")
+    dec = _engine(dec_recs, process="serve-decode", role="decode")
+    pre_front = _http_replica(pre)
+    dec.submit([1], max_new_tokens=2)
+    dec.run()
+    kvs = KVTransferServer(dec.kv_geometry(), port=0, tracer=dec.tracer).start()
+    dec.kv_transfer_port = kvs.port
+    dec_server, dec_loop = serve_http(dec, None, port=0, kv_store=kvs.store)
+    threading.Thread(target=dec_server.serve_forever, daemon=True).start()
+    router = Router(
+        FleetConfig.from_dict({
+            "replicas": [
+                {"url": f"http://127.0.0.1:{pre_front[0].server_address[1]}",
+                 "name": "pre0"},
+                {"url": f"http://127.0.0.1:{dec_server.server_address[1]}",
+                 "name": "dec0"},
+            ],
+            "block_size": 4, "probe_interval_s": 30.0,
+            "request_timeout_s": 120.0,
+        }),
+        on_record=route_recs.append,
+        tracer=Tracer("router", emit=route_recs.append, sample_rate=1.0),
+    ).start()
+    try:
+        prompt = list(range(1, 14))
+        code, body = router.handle_generate(
+            {"prompt_ids": prompt, "max_new_tokens": 6, "id": "x"}
+        )
+        assert code == 200, body
+        assert body["route"]["prefill_replica"] == "pre0"
+        assert body["route"]["replica"] == "dec0"
+    finally:
+        router.close()
+        for server, loop in (pre_front, (dec_server, dec_loop)):
+            server.shutdown()
+            server.server_close()
+            loop.close()
+        kvs.close()
+
+    files = [
+        _write_jsonl(tmp_path / "router.jsonl", route_recs),
+        _write_jsonl(tmp_path / "prefill.jsonl", pre_recs),
+        _write_jsonl(tmp_path / "decode.jsonl", dec_recs),
+    ]
+    spans, problems = read_span_records(files)
+    assert problems == [], problems
+    traces = assemble_traces(spans)
+    # the routed request's trace is the one with a `route` root; the
+    # warm-up requests and probe sweeps have their own trace ids
+    routed = [
+        t for t in traces
+        if any(s["stage"] == "route" for s in t["roots"])
+    ]
+    assert len(routed) == 1
+    t = routed[0]
+    assert t["orphans"] == [], t["orphans"]
+    assert not t["partial"]
+    stages = [s["stage"] for s in t["spans"]]
+    for stage in (
+        "route", "placement", "prefill_rpc", "forward",  # router
+        "kv_send", "kv_receive",  # the AKV1 handoff, both sides
+        "serve", "queue", "admission", "prefill",  # prefill replica
+        "kv_inject", "decode",  # decode replica
+    ):
+        assert stage in stages, (stage, stages)
+    assert stages.count("serve") == 2  # one root per replica touched
+    assert set(t["processes"]) == {"router", "serve-prefill", "serve-decode"}
+    # every span of the request shares ONE trace id end-to-end
+    assert len({s["trace_id"] for s in t["spans"]}) == 1
+
+    # the CLI assembles the same three files: markdown + chrome json
+    chrome_path = tmp_path / "req.trace.json"
+    rc = trace_main([*files, "--chrome", str(chrome_path),
+                     "--trace-id", t["trace_id"][:8]])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert t["trace_id"] in out
+    assert "kv_send" in out and "decode" in out
+    assert "orphan" not in out.split("## trace")[1]
+    doc = json.loads(chrome_path.read_text())
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert {"route", "kv_send", "kv_receive", "decode"} <= names
+
+    # router /metrics: outcome-labelled request counter + stage histograms
+    rendered = router.metrics.registry.render()
+    assert (
+        'automodel_route_requests_total{replica="dec0",outcome="ok"} 1'
+        in rendered
+    )
+    assert 'automodel_route_request_seconds_bucket{outcome="ok",le=' in rendered
+    assert 'automodel_route_stage_seconds_count{stage="forward"}' in rendered
+    assert 'automodel_route_stage_seconds_count{stage="placement"}' in rendered
+    from tests.test_profiling import _lint_exposition
+
+    _lint_exposition(rendered)
+
+    # each per-process file passes report --strict on its own (orphans
+    # across files are summary data there, not problems)
+    for path in files:
+        _, lint_problems = lint_metrics_jsonl(path)
+        assert lint_problems == [], (path, lint_problems)
+
+
+def test_trace_cli_usage_and_empty_input(tmp_path, capsys):
+    assert trace_main([]) == 2
+    assert trace_main(["-h"]) == 0
+    empty = _write_jsonl(tmp_path / "empty.jsonl", [{"ts": 1.0, "loss": 2.0}])
+    assert trace_main([empty]) == 1
+    err = capsys.readouterr().err
+    assert "no span records" in err
+
+
+def test_router_retry_spans_and_outcome_labels():
+    """A dead replica's attempts leave placement+forward spans per attempt
+    and the terminal counter lands on outcome=retried."""
+    from automodel_tpu.serving.fleet.router import FleetConfig, Router
+
+    recs = []
+    live_records = []
+    live = _engine(live_records, process="serve-live")
+    front = _http_replica(live)
+    router = Router(
+        FleetConfig.from_dict({
+            "replicas": [
+                # port 9 (discard) — guaranteed unreachable
+                {"url": "http://127.0.0.1:9", "name": "dead"},
+                {"url": f"http://127.0.0.1:{front[0].server_address[1]}",
+                 "name": "live"},
+            ],
+            "block_size": 4, "probe_interval_s": 30.0, "retry_budget": 3,
+            "request_timeout_s": 60.0,
+        }),
+        on_record=recs.append,
+        tracer=Tracer("router", emit=recs.append, sample_rate=1.0),
+    )
+    # mark both ready WITHOUT probing (the dead one stays "ready" so
+    # placement can pick it and the retry path fires)
+    with router._lock:
+        for rep in router._replicas.values():
+            rep.alive = rep.ready = True
+    try:
+        code, body = router.handle_generate(
+            {"prompt_ids": [1, 2, 3, 4], "max_new_tokens": 3, "id": "rr"}
+        )
+        assert code == 200
+    finally:
+        router.close()
+        front[0].shutdown()
+        front[0].server_close()
+        front[1].close()
+    spans = _spans(recs)
+    forwards = [s for s in spans if s["stage"] == "forward"]
+    if body["route"]["retries"]:  # p2c picked the dead one first
+        assert any(s.get("error") == "unreachable" for s in forwards)
+        assert len(forwards) == body["route"]["retries"] + 1
+        outcome = "retried"
+    else:
+        outcome = "ok"
+    root = next(s for s in spans if s["stage"] == "route")
+    assert root["outcome"] == outcome
+    rendered = router.metrics.registry.render()
+    assert (
+        f'automodel_route_requests_total{{replica="live",outcome="{outcome}"}} 1'
+        in rendered
+    )

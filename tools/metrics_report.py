@@ -3,8 +3,10 @@
 
 Thin CLI wrapper over automodel_tpu/telemetry/report.py (which bench.py and
 `automodel_tpu report` also use): strict-JSON schema lint (bare NaN/Infinity
-tokens, null-without-marker, step monotonicity) plus a tps/step-time/loss
-summary table.
+tokens, null-without-marker, step monotonicity, request-tracing span schema
+and negative durations) plus a tps/step-time/loss summary table with
+per-stage span p50/p99 rollups. To JOIN span records across multiple
+processes' files into per-request waterfalls, use `automodel_tpu trace`.
 
     python tools/metrics_report.py train_metrics.jsonl [--strict]
 
