@@ -156,6 +156,14 @@ class Checkpointer:
         # recipes point this at telemetry.record_step so integrity events
         # (fallbacks, failed verifications) land in the flight recorder
         self.event_hook: Optional[Callable[[dict], None]] = None
+        # recipes point this at the goodput ledger: (kind, seconds, step)
+        # per operation — "ckpt_save" (sync write / async staging),
+        # "ckpt_drain" (async drain + commit), "ckpt_restore" (load)
+        self.timing_hook: Optional[Callable[..., None]] = None
+        # drain seconds spent INSIDE the current save() call (its internal
+        # wait() for the previous async save) — subtracted so one wall-clock
+        # second is never reported as both save and drain
+        self._inner_drain_s = 0.0
         # multi-host commit discipline: the recipe points this at the
         # distributed guard's timed barrier so NO host writes the manifest
         # until EVERY host's save drained — a straggling or dead peer
@@ -173,6 +181,13 @@ class Checkpointer:
             except Exception:  # telemetry must never break checkpointing
                 pass
 
+    def _timing(self, kind: str, seconds: float, step: Optional[int] = None) -> None:
+        if self.timing_hook is not None:
+            try:
+                self.timing_hook(kind, seconds, step=step)
+            except Exception:  # telemetry must never break checkpointing
+                pass
+
     def wait(self) -> None:
         """Block until any in-flight async save finishes (the reference gates
         the next optimizer step on staging, train_ft.py:1336), then COMMIT it
@@ -186,28 +201,37 @@ class Checkpointer:
         recorder, and the next cadence save tries again — a flaky remote
         store costs one checkpoint, not the whole run."""
         pending, self._pending_commit = self._pending_commit, None
-        if self._async is not None:
-            try:
-                self._async.wait_until_finished()
-            except Exception as e:
-                if pending is None:
-                    raise  # no save in flight: this is not a drain failure
-                logger.error(
-                    "async checkpoint save to %s FAILED (%r); dir left "
-                    "uncommitted — resume will skip it, next cadence save "
-                    "retries", pending[0], e,
-                )
-                self._event({
-                    "event": "async_save_failed", "dir": str(pending[0]),
-                    "error": repr(e), "ts": time.time(),
-                })
-                # the dir never committed: a best-mark waiting on it must
-                # die with it, or BEST.json would name an unrestorable tree
-                if self._pending_best is not None and self._pending_best[0] == pending[0]:
-                    self._pending_best = None
-                return
-        if pending is not None:
-            self._commit(*pending)
+        t0 = time.perf_counter()
+        try:
+            if self._async is not None:
+                try:
+                    self._async.wait_until_finished()
+                except Exception as e:
+                    if pending is None:
+                        raise  # no save in flight: this is not a drain failure
+                    logger.error(
+                        "async checkpoint save to %s FAILED (%r); dir left "
+                        "uncommitted — resume will skip it, next cadence save "
+                        "retries", pending[0], e,
+                    )
+                    self._event({
+                        "event": "async_save_failed", "dir": str(pending[0]),
+                        "error": repr(e), "ts": time.time(),
+                    })
+                    # the dir never committed: a best-mark waiting on it must
+                    # die with it, or BEST.json would name an unrestorable tree
+                    if self._pending_best is not None and self._pending_best[0] == pending[0]:
+                        self._pending_best = None
+                    return
+            if pending is not None:
+                self._commit(*pending)
+        finally:
+            if pending is not None:
+                # only a drain that had a commit to finish gets a timing
+                # stamp — an idle wait() is a no-op, not a segment
+                dt = time.perf_counter() - t0
+                self._inner_drain_s += dt
+                self._timing("ckpt_drain", dt, step=pending[2])
 
     def _commit(
         self, out: Path, epoch: int, step: int, layout_markers: Optional[dict]
@@ -303,6 +327,8 @@ class Checkpointer:
         hf_meta: dict | None = None,  # {"hf_config": dict, "source_dir": str}
         layout_markers: dict[str, str] | None = None,
     ) -> Path:
+        t_save = time.perf_counter()
+        self._inner_drain_s = 0.0
         out = self.step_dir(epoch, step)
         out.mkdir(parents=True, exist_ok=True)
         if layout_markers:
@@ -357,6 +383,14 @@ class Checkpointer:
             _orbax_save_sync((out / "state").absolute(), state)
             self._commit(out, epoch, step, layout_markers)
         self._prune(protect={out.resolve()})
+        # the internal wait() above already reported the PREVIOUS save's
+        # drain as ckpt_drain — subtract it so save/drain never double-bill
+        # the same wall-clock second
+        self._timing(
+            "ckpt_save",
+            max(time.perf_counter() - t_save - self._inner_drain_s, 0.0),
+            step=step,
+        )
         return out
 
     def _prune(self, protect: set[Path] | None = None) -> None:
@@ -436,6 +470,7 @@ class Checkpointer:
         checkpoints saved STRICTLY BEFORE that optimizer step — the
         non-finite rollback policy uses it because a cadence save at (or
         after) the diverged step already contains the poisoned params."""
+        t_load = time.perf_counter()
         if path is not None:
             d = self._verify_for_load(Path(path))
         else:
@@ -462,6 +497,12 @@ class Checkpointer:
                 d,
             )
         state = _orbax_restore((d / "state").absolute(), abstract_state)
+        key = _dir_key(d)
+        self._timing(
+            "ckpt_restore",
+            time.perf_counter() - t_load,
+            step=key[1] if key else None,
+        )
         return state, extra
 
     def _verify_for_load(self, d: Path) -> Path:

@@ -26,6 +26,7 @@ def _usage() -> str:
         "       automodel_tpu route -c config.yaml [--dotted.key=value ...]  (fleet router over N serve replicas: fleet.replicas/fleet.dns; prefix-affinity + retry; same HTTP front contract)\n"
         "       automodel_tpu profile -c config.yaml [--profiling.mode=train|generate] [--dotted.key=value ...]\n"
         "       automodel_tpu report <train_metrics.jsonl> [--strict]\n"
+        "       automodel_tpu goodput <run-dir | goodput.jsonl> [--json]  (wall-clock decomposition of a training run across restart attempts; joins flight-recorder hang/desync evidence)\n"
         "       automodel_tpu trace <metrics.jsonl> [...] [--chrome out.json] [--md out.md] [--trace-id PREFIX]  (join multi-process span JSONLs into per-request waterfalls)\n"
         "       automodel_tpu verify-ckpt <ckpt_dir> [--no-checksums] [--json]"
     )
@@ -60,6 +61,13 @@ def main(argv: list[str] | None = None) -> int:
         from automodel_tpu.telemetry.report import main as report_main
 
         return report_main(argv[1:])
+    # `goodput` rolls a run dir's goodput.jsonl into a per-attempt +
+    # whole-run wall-clock decomposition (telemetry/goodput.py) — no
+    # config, no device runtime
+    if argv and argv[0] == "goodput":
+        from automodel_tpu.telemetry.goodput import main as goodput_main
+
+        return goodput_main(argv[1:])
     # `trace` assembles span records from N per-process metrics JSONLs into
     # per-request waterfalls (markdown + Chrome-trace JSON) —
     # telemetry/tracing.py. No config, no device runtime.

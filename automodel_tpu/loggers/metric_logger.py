@@ -136,19 +136,31 @@ def _definite(v: Any) -> tuple[Any, bool]:
 class MetricLogger:
     """Append-only JSONL metrics file; one record per call. ``sinks`` fan
     the same record out to wandb / MLflow style loggers (anything with
-    ``.log(dict, step)``)."""
+    ``.log(dict, step)``). ``envelope`` keys (the goodput ledger's
+    ``attempt_id``/``restart_count``) are stamped onto every record so a
+    preempted-and-requeued run's appended records stay joinable and
+    orderable per attempt; a caller's explicit key always wins."""
 
-    def __init__(self, path: str, wandb_run: Any = None, sinks: Any = None):
+    def __init__(
+        self,
+        path: str,
+        wandb_run: Any = None,
+        sinks: Any = None,
+        envelope: dict[str, Any] | None = None,
+    ):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.path.touch()  # the file exists even before the first record
         self.wandb_run = wandb_run
         self.sinks = list(sinks or [])
+        self.envelope = dict(envelope or {})
 
     def log(self, metrics: dict[str, Any], step: int | None = None) -> None:
         rec = {k: _to_scalar(v) for k, v in metrics.items()}
         if step is not None:
             rec.setdefault("step", step)
+        for k, v in self.envelope.items():
+            rec.setdefault(k, v)
         jsonl_rec: dict[str, Any] = {}
         for k, v in rec.items():
             cv, bad = _definite(v)

@@ -73,6 +73,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
     # -- setup --------------------------------------------------------------
     def setup(self) -> None:
         cfg = self.cfg
+        # goodput ledger epoch: attempt wall clock starts HERE, so model
+        # build / mesh / data setup land in the `startup` segment
+        self._setup_t0 = time.time()
         setup_logging()
         self.rng = StatefulRNG(seed=cfg.get("seed", 42))
 
@@ -338,10 +341,32 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             from automodel_tpu.loggers.mlflow_utils import MLflowLogger
 
             sinks.append(MLflowLogger(**dict(log_cfg.get("mlflow") or {})))
+        metrics_path = Path(
+            log_cfg.get("metrics_path", str(self.output_dir / "train_metrics.jsonl"))
+        )
+
+        # goodput run ledger (telemetry/goodput.py): the append-only
+        # goodput.jsonl segment log beside the metrics JSONL (an explicit
+        # logging.metrics_path wins, like the flight recorder), chained
+        # across restart attempts — a new attempt record is written HERE
+        # (closing a SIGKILL'd predecessor's tail), and its
+        # attempt_id/restart_count envelope stamps every metrics record +
+        # the flight-recorder fingerprint below
+        from automodel_tpu.telemetry.goodput import GoodputLedger
+
+        self.ledger = GoodputLedger(
+            metrics_path.parent / "goodput.jsonl",
+            t_start=self._setup_t0,
+            enabled=bool(tcfg.get("enabled", True))
+            and bool(tcfg.get("goodput", True)),
+        )
         self.metric_logger = MetricLogger(
-            log_cfg.get("metrics_path", str(self.output_dir / "train_metrics.jsonl")),
+            str(metrics_path),
             wandb_run=wandb_run,
             sinks=sinks,
+            # attempt identity on every record: `report`/`goodput` join and
+            # order a requeued run's appended records deterministically
+            envelope=self.ledger.envelope if self.ledger.enabled else None,
         )
 
         # telemetry: anomaly flags ride the jitted step (train_step.py);
@@ -350,9 +375,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # default — no `telemetry:` section required.
         from automodel_tpu.telemetry import Telemetry, build_fingerprint
 
+        fingerprint = build_fingerprint(cfg.to_dict(), self.mesh_ctx)
+        if self.ledger.enabled:
+            # the flight-recorder dump must name the attempt it belongs to
+            fingerprint["attempt"] = dict(self.ledger.envelope)
         self.telemetry = Telemetry.from_config(
             cfg.get("telemetry"),
-            fingerprint=build_fingerprint(cfg.to_dict(), self.mesh_ctx),
+            fingerprint=fingerprint,
             default_recorder_path=str(
                 self.metric_logger.path.parent / "flight_recorder.json"
             ),
@@ -454,6 +483,10 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         self._last_val_metric: Optional[float] = None
         if self.checkpointer is not None:
             self.checkpointer.event_hook = self.telemetry.record_step
+            # save/drain/restore wall time → goodput segments + the
+            # ckpt_save_s/ckpt_drain_s/ckpt_restore_s stamps on the next
+            # log record (+ /metrics histograms via the exporter)
+            self.checkpointer.timing_hook = self.ledger.on_ckpt_timing
             # multi-host: at SIGTERM time drop a marker into the shared
             # checkpoint root so peer hosts dying of broken collectives
             # exit with the requeue code too (cli/app.py checks it)
@@ -481,6 +514,14 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         )
         if self.checkpointer and self.checkpointer.has_checkpoint():
             self._restore()
+            # chain the ledger: the previous attempt's step time past the
+            # step we actually resumed from is preemption-lost work
+            self.ledger.on_resume(int(self.state.step))
+        elif self.ledger.restart_count > 0:
+            # a restarted attempt with NOTHING to resume from (killed
+            # before any commit): the predecessor's entire stepped
+            # progress is preemption-lost, not committed work
+            self.ledger.on_resume(0)
 
     def _guard_event(self, rec: dict) -> None:
         """Anomaly evidence (desync, hang, trace_capture) goes to every
@@ -904,6 +945,28 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     with self.guard.phase("checkpoint"):
                         self.checkpointer.close()
             finally:
+                # the end-of-loop/emergency save and final drain stamped
+                # ckpt timings AFTER the last log record — flush them (and
+                # any boundary time with no following record) as one
+                # closing `goodput_tail` record so the JSONL totals and
+                # /metrics histograms cover the whole run (BEFORE the
+                # scrape server below shuts down, or the final save's
+                # observations would never be scrapeable)
+                tail = self.ledger.pop_pending()
+                excluded = getattr(self, "_tail_excluded_s", 0.0)
+                if excluded > 0:
+                    tail["window_excluded_s"] = round(excluded, 6)
+                    self._tail_excluded_s = 0.0
+                if tail:
+                    try:
+                        self.metric_logger.log(
+                            {"event": "goodput_tail", **tail},
+                            step=self.step_scheduler.step,
+                        )
+                    except Exception:  # accounting is best-effort at exit
+                        pass
+                    if self._prom is not None:
+                        self._prom.update(tail)
                 # even when the final drain raises: a live watchdog thread
                 # in an embedding process (tests, notebooks) would fire
                 # minutes later and os._exit it
@@ -912,6 +975,15 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.step_scheduler.restore_signal_handlers()
                 if self._prom_server is not None:
                     self._prom_server.shutdown()
+                # close the goodput attempt LAST — the final drain above is
+                # the last accounted segment. A hard kill skips this close;
+                # the next attempt (or the CLI) infers the tail instead.
+                import sys as _sys
+
+                self.ledger.close(
+                    reason="preempted" if res.preempted
+                    else ("crash" if _sys.exc_info()[0] is not None else "exit")
+                )
         if res.preempted:
             # run-LOCAL committed dir only: latest_dir()'s restore_from
             # bootstrap fallback must not make a nothing-committed run look
@@ -954,6 +1026,12 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # poisoned params — never roll back INTO the blast radius
         self._restore(before_step=fail_step)
         ckpt_step = self.step_scheduler.step
+        # goodput: the step time spent on (ckpt_step, fail_step] is about to
+        # be re-done — reclassified as rollback_discard in the run ledger
+        # (getattr: unit tests drive _rollback on a bare recipe object)
+        led = getattr(self, "ledger", None)
+        if led is not None:
+            led.on_rollback(fail_step, ckpt_step)
         dl = self.dataloader
         ga = self.step_scheduler.grad_acc_steps
         nb = len(dl)
@@ -1043,13 +1121,40 @@ class TrainFinetuneRecipeForNextTokenPrediction:
         # stack + H2D when sync; a queue pop when prefetched) — the per-log-
         # window decomposition key that makes the overlap visible
         input_wait_window = 0.0
+        # wall time spent INSIDE val/ckpt boundaries since the last log
+        # record: the windows restart after those pauses, so without this
+        # stamp the boundary time vanishes from every record — surfaced as
+        # `window_excluded_s` on the NEXT record so records sum to wall
+        # clock (the invariant the goodput ledger needs)
+        excluded_window = 0.0
+        # boundary time accumulated after the LAST log record of the run
+        # rides the end-of-run `goodput_tail` record instead of vanishing
+        self._tail_excluded_s = 0.0
+        # everything before the first batch was setup: close the ledger's
+        # `startup` segment (idempotent across rollback restarts)
+        self.ledger.loop_started()
         t_window = time.perf_counter()
+
+        def flush_window_to_ledger(at_step: int) -> None:
+            """Close a partial throughput window (log_every > 1, or the
+            loop tail) into the ledger before a boundary reset discards
+            it. Log barriers compute their own dt for the JSONL record
+            and call ledger.window directly."""
+            if steps_window:
+                self.ledger.window(
+                    time.perf_counter() - t_window, input_wait_window,
+                    steps_window, at_step,
+                )
         while True:
             t_input = time.perf_counter()
             tel.timers("data_wait").start()
             try:
                 group = next(it)
             except StopIteration:
+                # the scheduler consumed (and collated) one more batch
+                # before noticing the epoch/max_steps budget — that tail
+                # fetch is input wait like any other, not idle
+                input_wait_window += time.perf_counter() - t_input
                 break
             tel.timers("data_wait").stop()
             if isinstance(group, PreparedBatch):
@@ -1112,6 +1217,9 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 metrics["compile_time_s"] = time.perf_counter() - t_window
                 host_rec["compile_time_s"] = metrics["compile_time_s"]
                 host_rec["loss"] = float(metrics["loss"])
+                self.ledger.compile_window(
+                    metrics["compile_time_s"], input_wait_window, step=step_no
+                )
                 # discard step 1's timer entries and compile events BEFORE
                 # any enrich: the initial XLA compile is already reported as
                 # compile_time_s, and must appear neither as this record's
@@ -1120,6 +1228,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 if tel.compile_bridge is not None:
                     tel.compile_bridge.drain()
                 if self.step_scheduler.is_log_step:
+                    metrics.update(self.ledger.pop_pending())
                     metrics = tel.enrich(step_no, metrics)
                     metrics = self.guard.on_log(
                         step_no, metrics, params=self.state.params
@@ -1127,6 +1236,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     self.metric_logger.log(metrics, step=int(metrics["step"]))
                     if self._prom is not None:
                         self._prom.update(metrics)
+                        self._prom.update_goodput(self.ledger.snapshot())
                     last = metrics
                 tel.record_step(host_rec)
                 first_step = False
@@ -1154,6 +1264,13 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 if res.rollbacks:
                     metrics["rollbacks_total"] = res.rollbacks
                 metrics = self._fold_mfu(metrics)
+                # goodput: one closed window = a `step` + `input_wait`
+                # segment pair summing to the window's wall clock
+                self.ledger.window(dt, input_wait_window, steps_window, step_no)
+                metrics.update(self.ledger.pop_pending())
+                if excluded_window > 0:
+                    metrics["window_excluded_s"] = round(excluded_window, 6)
+                    excluded_window = 0.0
                 metrics = tel.enrich(step_no, metrics)
                 # the log step is already a device barrier: liveness +
                 # cross-host consensus + straggler attribution ride it
@@ -1163,6 +1280,7 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 self.metric_logger.log(metrics, step=int(metrics["step"]))
                 if self._prom is not None:
                     self._prom.update(metrics)
+                    self._prom.update_goodput(self.ledger.snapshot())
                 last = metrics
                 host_rec.update(
                     {
@@ -1183,6 +1301,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
             if self.step_scheduler.is_val_step and (
                 self.val_dataloader is not None or gen_active
             ):
+                flush_window_to_ledger(step_no)
+                t_boundary = time.perf_counter()
                 # same early resolution as the ckpt block below: under
                 # lag-1 detection a diverged step N would otherwise run a
                 # full eval pass on NaN params and log a garbage val record
@@ -1194,7 +1314,8 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 # full passes): the watchdog's eval grace covers them
                 with self.guard.phase("eval"):
                     if self.val_dataloader is not None:
-                        val = self.run_validation()
+                        with self.ledger.segment("eval", step=step_no):
+                            val = self.run_validation()
                         # compile events during validation (eval_step's first
                         # compile) belong to the val record, not the next
                         # train window's `recompiles`
@@ -1208,17 +1329,22 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                     # (generation: section); compiles + wall time land
                     # OUTSIDE the training windows (the reset below), like
                     # validation itself
-                    self._log_eval_generation()
+                    if gen_active:
+                        with self.ledger.segment("generation", step=step_no):
+                            self._log_eval_generation()
                 if tel.compile_bridge is not None:
                     tel.compile_bridge.drain()
                 # val/generation wall time must not read as a slow step
                 # (triggered profiler) any more than it reads as train
                 # throughput (the window reset below)
                 tel.skip_next_interval()
+                excluded_window += time.perf_counter() - t_boundary
                 tokens_window = steps_window = 0
                 input_wait_window = 0.0
                 t_window = time.perf_counter()
             if self.step_scheduler.is_ckpt_step:
+                flush_window_to_ledger(step_no)
+                t_boundary = time.perf_counter()
                 # resolve THIS step's flag before persisting: a cadence save
                 # at the diverged step would commit the poisoned params as
                 # the newest checkpoint (integrity checks can't see NaN) and
@@ -1235,9 +1361,22 @@ class TrainFinetuneRecipeForNextTokenPrediction:
                 with self.guard.phase("checkpoint"):
                     self.save_checkpoint()
                 tel.skip_next_interval()
+                excluded_window += time.perf_counter() - t_boundary
                 tokens_window = steps_window = 0
                 input_wait_window = 0.0
                 t_window = time.perf_counter()
+        # the tail window (steps since the last log barrier) would vanish
+        # from the ledger at loop exit — close it like any other window;
+        # a stepless tail still carries the final StopIteration fetch
+        if steps_window:
+            flush_window_to_ledger(self.step_scheduler.step)
+        elif input_wait_window > 0:
+            self.ledger.add(
+                "input_wait", input_wait_window, step=self.step_scheduler.step
+            )
+        # boundary time with no following log record: surfaced on the
+        # end-of-run goodput_tail record (records must sum to wall clock)
+        self._tail_excluded_s = excluded_window
         # a non-finite flag from the final step must still be enforced
         if res.config.enabled:
             self._check_prev_nonfinite(res)

@@ -58,6 +58,10 @@ class TelemetryConfig:
     anomaly_flags: bool = True
     memory_every_steps: int = 50
     census_top_k: int = 8
+    # run-ledger goodput accounting (telemetry/goodput.py): the append-only
+    # goodput.jsonl segment log in the run's output_dir, chained across
+    # restart attempts. Built by the recipe (it owns output_dir), gated here
+    goodput: bool = True
     flight_recorder_steps: int = 16
     flight_recorder_path: str = "flight_recorder.json"
     compile_events: bool = True

@@ -639,6 +639,22 @@ _TRAIN_CUMULATIVE = {
         "XLA recompiles after the initial step",
     ),
 }
+# checkpoint-timing record keys → histogram (name, help) — the goodput
+# ledger stamps these on the log record after each operation
+_TRAIN_CKPT_HISTOGRAMS = {
+    "ckpt_save_s": (
+        "automodel_train_ckpt_save_seconds",
+        "Checkpoint save wall time (sync write or async staging), per save",
+    ),
+    "ckpt_restore_s": (
+        "automodel_train_ckpt_restore_seconds",
+        "Checkpoint restore wall time, per load",
+    ),
+    "ckpt_drain_s": (
+        "automodel_train_ckpt_drain_seconds",
+        "Async checkpoint drain + commit wall time, per drained save",
+    ),
+}
 _TRAIN_EVENT_COUNTERS = {
     "hang": ("automodel_train_hang_events", "Watchdog hang detections"),
     "desync": ("automodel_train_desync_events", "Cross-host desync detections"),
@@ -668,6 +684,21 @@ class TrainMetricsExporter:
         self._events = {
             k: r.counter(*spec) for k, spec in _TRAIN_EVENT_COUNTERS.items()
         }
+        self._ckpt_hists = {
+            k: r.histogram(*spec) for k, spec in _TRAIN_CKPT_HISTOGRAMS.items()
+        }
+        # goodput run ledger (telemetry/goodput.py): live goodput fraction +
+        # net per-segment wall-clock totals for THIS attempt
+        self._goodput_fraction = r.gauge(
+            "automodel_train_goodput_fraction",
+            "Productive step seconds / attempt wall clock so far "
+            "(goodput ledger, net of rollback-discarded work)",
+        )
+        self._goodput_seconds = r.labeled_gauge(
+            "automodel_train_goodput_seconds",
+            "Attempt wall clock accounted to each goodput segment so far",
+            "segment",
+        )
 
     def update(self, record: dict) -> None:
         with self.registry.lock:
@@ -682,6 +713,22 @@ class TrainMetricsExporter:
                         c.inc(v)
                     else:
                         c.set_total(v)
+            for k, h in self._ckpt_hists.items():
+                v = record.get(k)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    h.observe(v)
+
+    def update_goodput(self, snapshot: dict) -> None:
+        """Fold a ``GoodputLedger.snapshot()`` (called at each log barrier;
+        the labeled gauge takes its own per-metric lock)."""
+        frac = snapshot.get("goodput_fraction")
+        segments = snapshot.get("segments") or {}
+        with self.registry.lock:
+            if isinstance(frac, (int, float)):
+                self._goodput_fraction.set(frac)
+        for kind, seconds in segments.items():
+            if isinstance(seconds, (int, float)):
+                self._goodput_seconds.set(kind, max(float(seconds), 0.0))
 
     def event(self, name: str) -> None:
         c = self._events.get(name)

@@ -127,6 +127,14 @@ _NUMERIC_KEYS = (
     "kernel_bench_winners",
     # request tracing (telemetry/tracing.py `span` events)
     "duration_s",
+    # goodput run ledger (telemetry/goodput.py): attempt envelope + the
+    # checkpoint-timing stamps on the record AFTER each operation + the
+    # boundary time the amortized windows exclude
+    "restart_count",
+    "ckpt_save_s",
+    "ckpt_restore_s",
+    "ckpt_drain_s",
+    "window_excluded_s",
 )
 
 # keys that are wall-time durations and can never legitimately be negative:
@@ -143,6 +151,10 @@ _DURATION_KEYS = (
     "drain_duration_s",
     "host_input_wait_s",
     "recompile_secs",
+    "ckpt_save_s",
+    "ckpt_restore_s",
+    "ckpt_drain_s",
+    "window_excluded_s",
 )
 
 # a span record must carry these to be assemblable by `automodel_tpu trace`
@@ -172,6 +184,7 @@ def lint_metrics_jsonl(path: str) -> tuple[list[dict], list[str]]:
         return [], [f"cannot read {path}: {e}"]
     last_step: Optional[int] = None
     pending_resume = None  # True = bare marker; int = resumed_from_step
+    last_restart: Optional[int] = None
     for i, line in enumerate(lines, 1):
         if not line.strip():
             continue
@@ -192,6 +205,18 @@ def lint_metrics_jsonl(path: str) -> tuple[list[dict], list[str]]:
             # corruption later in a resumed file slip past --strict
             rf = rec.get("resumed_from_step")
             pending_resume = rf if isinstance(rf, int) else True
+        rc = rec.get("restart_count")
+        if isinstance(rc, int) and not isinstance(rc, bool):
+            # the attempt envelope is append-only across requeues: within
+            # one file restart_count may only grow — a regression means two
+            # runs interleaved into one file, or corruption
+            if last_restart is not None and rc < last_restart:
+                problems.append(
+                    f"line {i}: restart_count went backwards "
+                    f"({last_restart} -> {rc}) — attempts are append-only; "
+                    "a regression means interleaved runs or corruption"
+                )
+            last_restart = rc
         step = rec.get("step")
         if step is not None:
             if not isinstance(step, int):
@@ -298,6 +323,25 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
         vals = [r[key] for r in records if isinstance(r.get(key), (int, float))]
         if vals:
             out[f"{key}_mean"] = sum(vals) / len(vals)
+    # goodput envelope + checkpoint-timing rollups: how many attempts this
+    # file spans and what the checkpoint machinery cost in wall clock
+    # (whole-run segment decomposition lives in `automodel_tpu goodput`)
+    attempt_ids = [
+        r["attempt_id"] for r in records if isinstance(r.get("attempt_id"), str)
+    ]
+    if attempt_ids:
+        out["attempts"] = len(dict.fromkeys(attempt_ids))
+        rcs = [
+            r["restart_count"] for r in records
+            if isinstance(r.get("restart_count"), int)
+            and not isinstance(r.get("restart_count"), bool)
+        ]
+        if rcs:
+            out["restart_count_max"] = max(rcs)
+    for key in ("ckpt_save_s", "ckpt_restore_s", "ckpt_drain_s", "window_excluded_s"):
+        vals = [r[key] for r in records if isinstance(r.get(key), (int, float))]
+        if vals:
+            out[f"{key}_total"] = round(sum(vals), 6)
     costs = [r for r in records if r.get("event") == "cost_attribution"]
     if costs:
         out["cost_programs"] = [

@@ -1,0 +1,72 @@
+"""docs/observability.md's key glossary must cover every metrics-JSONL key
+the recipes emit — the test_perf_docs.py verbatim-guard pattern applied to
+the glossary.
+
+The linter's key lists (telemetry/report.py `_NUMERIC_KEYS` /
+`_DURATION_KEYS`) are the canonical registry of emitted keys: every PR
+that teaches a recipe a new JSONL key must add it there for `report
+--strict` to accept it, so gating the glossary on the same lists means a
+key can never ship linted-but-undocumented. The goodput segment taxonomy
+and the attempt-envelope keys are pinned the same way.
+"""
+
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# envelope / marker keys the recipes emit that are not numeric-linted
+_EXTRA_KEYS = (
+    "attempt_id",
+    "restart_count",
+    "completion_reason",
+    "retriable",
+    "trace_id",
+    "span_id",
+    "parent_id",
+    "stage",
+    "nonfinite",
+    "val_loss",
+    "steps_spanned",
+)
+
+
+def _doc():
+    return open(os.path.join(REPO, "docs", "observability.md")).read()
+
+
+def test_every_linted_jsonl_key_has_a_glossary_row():
+    from automodel_tpu.telemetry.report import _DURATION_KEYS, _NUMERIC_KEYS
+
+    doc = _doc()
+    missing = sorted(
+        k
+        for k in set(_NUMERIC_KEYS) | set(_DURATION_KEYS) | set(_EXTRA_KEYS)
+        if f"`{k}`" not in doc
+    )
+    assert not missing, (
+        "docs/observability.md glossary is missing rows for these "
+        f"metrics-JSONL keys (add a `key` row): {missing}"
+    )
+
+
+def test_goodput_segment_taxonomy_is_documented():
+    from automodel_tpu.telemetry.goodput import SEGMENT_KINDS
+
+    doc = _doc()
+    missing = sorted(k for k in SEGMENT_KINDS if f"`{k}`" not in doc)
+    assert not missing, (
+        "docs/observability.md Goodput section is missing segment rows: "
+        f"{missing}"
+    )
+    # the rollup-only residual is part of the taxonomy too
+    assert "`unattributed`" in doc
+
+
+def test_goodput_metrics_exporter_names_are_documented():
+    doc = _doc()
+    for name in (
+        "automodel_train_goodput_fraction",
+        "automodel_train_goodput_seconds",
+        "automodel_train_ckpt_{save,restore,drain}_seconds",
+    ):
+        assert name in doc, f"/metrics glossary missing {name}"
