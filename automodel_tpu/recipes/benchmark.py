@@ -166,6 +166,13 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
         # → null values WITH a recorded reason, never a silent 0.0.
         with self.guard.phase("eval"):
             result.update(self._serving_leg())
+        # hierarchical-KV-cache A/B sub-leg (serving.kv_spill:): a prefill-
+        # heavy shared-prefix schedule with a deliberately undersized pool,
+        # replayed spill-on vs spill-off — the reload-vs-recompute crossover
+        # measured on identical arrivals. Gated on serving.kv_spill.enabled;
+        # degrades null-with-reason like every other leg.
+        with self.guard.phase("eval"):
+            result.update(self._spill_leg())
         # routed fleet sub-leg (serving/fleet/): the SAME Poisson arrivals
         # replayed through a router over >= 2 local replicas — the
         # routed-vs-single A/B that prices the fleet tier. Gated on a
@@ -417,6 +424,186 @@ class BenchmarkingRecipeForNextTokenPrediction(TrainFinetuneRecipeForNextTokenPr
             out["serve_draft_tps"] = None
             out["serve_spec_failure"] = "speculative decoding disabled"
         return out
+
+    def _spill_arrivals(self, scfg, prefix_blocks: int, groups: int,
+                        repeats: int) -> list:
+        """The spill A/B's prefill-heavy workload: ``groups`` long shared
+        prefixes (``prefix_blocks`` full blocks each), each re-arriving
+        ``repeats`` times with a fresh one-block suffix, interleaved
+        round-robin with Poisson gaps so every return to a group happens
+        AFTER the other groups' prompts churned the pool. Derived from
+        seed 2 — spill-on and spill-off replay exactly this list."""
+        bs = scfg.block_size
+        vocab = int(self.model.config.vocab_size)
+        rng = np.random.default_rng(2)
+        prefixes = [
+            rng.integers(1, vocab, size=prefix_blocks * bs).tolist()
+            for _ in range(groups)
+        ]
+        n = groups * repeats
+        gaps = rng.exponential(1.0 / max(scfg.bench_rate, 1e-6), size=n)
+        offsets = np.cumsum(gaps) - gaps[0]
+        out = []
+        for i in range(n):
+            g = i % groups  # round-robin: maximal churn between repeats
+            suffix = rng.integers(1, vocab, size=bs).tolist()
+            out.append((float(offsets[i]), prefixes[g] + suffix, 4))
+        return out
+
+    def _spill_leg(self) -> dict:
+        """→ {serve_spill_tokens_per_s, serve_spill_ttft_p50_s,
+        serve_effective_hit_rate, serve_spill_reloads, serve_spill_ab,
+        serve_spill_failure}. Both engines run non-speculative (spill and
+        speculative are mutually exclusive) and share one undersized pool
+        geometry, so the only difference between the legs is whether an
+        evicted prefix reloads from host RAM or re-prefills."""
+        nulls = {
+            "serve_spill_tokens_per_s": None,
+            "serve_spill_ttft_p50_s": None,
+            "serve_effective_hit_rate": None,
+            "serve_spill_reloads": None,
+        }
+        section = self.cfg.get("serving")
+        if section is None:
+            return {**nulls, "serve_spill_failure": "no serving: section in config"}
+        if self.peft_config is not None:
+            return {
+                **nulls,
+                "serve_spill_failure": (
+                    "serving with peft adapters is not supported (merge first)"
+                ),
+            }
+        import dataclasses as _dc
+
+        on_engine = off_engine = None
+        try:
+            from automodel_tpu.generation.engine import GenerationConfig
+            from automodel_tpu.serving.engine import ServeConfig, ServingEngine
+
+            scfg = ServeConfig.from_dict(dict(section or {}))
+            if not scfg.kv_spill.enabled:
+                return {
+                    **nulls,
+                    "serve_spill_failure": "serving.kv_spill disabled",
+                }
+            gcfg = getattr(self, "_gen_section", None)
+            gen_cfg = GenerationConfig.from_dict(
+                {
+                    k: v
+                    for k, v in dict(gcfg or {}).items()
+                    if k not in ("prompts", "prompt_ids", "tokenizer", "enabled")
+                }
+            )
+            # pool sized to hold roughly ONE group's working set: returning
+            # to any group after the round-robin forces the eviction the
+            # hierarchy exists to absorb. serial slots keep the churn
+            # deterministic-ish (one admission at a time).
+            prefix_blocks, groups, repeats = 12, 3, 3
+            per_req = prefix_blocks + 2  # suffix block + decode spill-over
+            num_blocks = per_req + 4
+            base = _dc.replace(
+                scfg, slots=1, num_blocks=num_blocks,
+                max_seq_len=max(
+                    scfg.max_seq_len, num_blocks * scfg.block_size
+                ),
+                speculative=_dc.replace(
+                    scfg.speculative, enabled=False, draft=None
+                ),
+            )
+            arrivals = self._spill_arrivals(
+                base, prefix_blocks, groups, repeats
+            )
+            auto = self.auto
+            params0 = auto.params
+            auto.params = self.state.params
+            try:
+                legs = {}
+                for name, enabled in (("on", True), ("off", False)):
+                    cfg_leg = _dc.replace(
+                        base,
+                        kv_spill=_dc.replace(scfg.kv_spill, enabled=enabled),
+                    )
+                    eng = ServingEngine(auto, cfg_leg, gen_cfg)
+                    if name == "on":
+                        on_engine = eng
+                    else:
+                        off_engine = eng
+                    # warm: compile chunk prefill + decode outside the window
+                    eng.submit(arrivals[0][1][: base.block_size], max_new_tokens=2)
+                    eng.run()
+                    if enabled:
+                        # also warm the spill→reload cycle (bucketed
+                        # extract + inject programs): park a prefix, churn
+                        # it out of HBM, re-serve it — the A/B measures the
+                        # hierarchy, not its one-time XLA compiles
+                        warm = arrivals[0][1]
+                        churn_len = min(
+                            (num_blocks - 1) * base.block_size,
+                            base.max_seq_len,
+                        ) - 2
+                        churn = (list(arrivals[1][1]) * 2)[:churn_len]
+                        for p in (warm, churn, warm):
+                            eng.submit(p, max_new_tokens=2)
+                            eng.run()
+                    eng.pool.clear_prefix_cache()
+                    # warm-up traffic must not pollute the reported
+                    # ledgers; zeroed TOGETHER (pool + tier) so the
+                    # cross-tier invariants stay consistent
+                    for d in [eng.pool.counters] + (
+                        [eng.pool.spill.counters]
+                        if eng.pool.spill is not None else []
+                    ):
+                        for key in d:
+                            d[key] = 0
+                    _, stats = eng.run_workload(arrivals)
+                    eng.pool.check_invariants()
+                    legs[name] = stats
+                    eng.release_pools()
+            finally:
+                auto.params = params0
+        except Exception as e:
+            return {**nulls, "serve_spill_failure": f"{type(e).__name__}: {e}"}
+        finally:
+            for obj in (on_engine, off_engine):
+                if obj is not None:
+                    obj.release_pools()
+
+        def _rates(stats):
+            c = stats["prefix_cache"]
+            hit, miss = c["prefix_hit_tokens"], c["prefix_miss_tokens"]
+            rate = hit / (hit + miss) if hit + miss else None
+            return rate, c
+
+        on_rate, on_c = _rates(legs["on"])
+        off_rate, _ = _rates(legs["off"])
+        on_tps = legs["on"]["sustained_tokens_per_s"]
+        off_tps = legs["off"]["sustained_tokens_per_s"]
+        return {
+            "serve_spill_tokens_per_s": round(on_tps, 2),
+            "serve_spill_ttft_p50_s": round(legs["on"]["ttft_p50_s"], 6),
+            "serve_effective_hit_rate": (
+                round(on_rate, 4) if on_rate is not None else None
+            ),
+            "serve_spill_reloads": on_c["spill_reloads"],
+            "serve_spill_ab": {
+                "spill_on_tokens_per_s": round(on_tps, 2),
+                "spill_off_tokens_per_s": round(off_tps, 2),
+                "spill_on_ttft_p50_s": round(legs["on"]["ttft_p50_s"], 6),
+                "spill_off_ttft_p50_s": round(legs["off"]["ttft_p50_s"], 6),
+                "effective_hit_rate_on": (
+                    round(on_rate, 4) if on_rate is not None else None
+                ),
+                "effective_hit_rate_off": (
+                    round(off_rate, 4) if off_rate is not None else None
+                ),
+                "spilled_blocks": on_c["spilled_blocks"],
+                "reloaded_blocks": on_c["spill_reloaded_blocks"],
+                "speedup": (
+                    round(on_tps / off_tps, 3) if off_tps > 0 else None
+                ),
+            },
+            "serve_spill_failure": None,
+        }
 
     def _fleet_leg(self, single_tps) -> dict:
         """→ {serve_fleet_tokens_per_s, serve_route_prefix_hit_rate,

@@ -29,6 +29,17 @@ admit/finish schedule against them):
 - free/LRU blocks have ref == 0; freeing a ref-0 block raises (double
   free), as does freeing scratch;
 - ``counters`` account allocations/frees/hits/evictions exactly.
+
+**Host spill tier** (``serving.kv_spill:``, docs/serving.md "Hierarchical
+KV cache"): when a zero-ref prefix block is evicted from the LRU, the
+engine's spill hook copies its rows device→host into a bounded
+:class:`HostSpillTier` keyed by the SAME chain hash the prefix cache used
+— an evicted prefix is then a host-RAM reload (``paged.inject_blocks``,
+the disagg-handoff seam) instead of a full re-prefill. The tier is an
+opaque byte store to this module (payloads are whatever
+``paged.extract_blocks`` returned — pool-native bytes, so reload is
+bit-identical to recompute by construction); its byte accounting and
+counters are audited by ``check_invariants`` alongside the pool's.
 """
 
 from __future__ import annotations
@@ -86,6 +97,93 @@ def blocks_needed(total_tokens: int, block_size: int, write_overhang: int = 0) -
     return -(-(int(total_tokens) + int(write_overhang)) // int(block_size))
 
 
+class HostSpillTier:
+    """Bounded host-RAM parking lot for evicted prefix blocks.
+
+    One entry per chain hash, holding the opaque per-block KV payload the
+    engine extracted at eviction time (pool-native bytes: int8 values +
+    fp32 scales for int8 pools, bf16 rows otherwise). LRU within the byte
+    budget: a ``put`` past ``max_bytes`` evicts the least recently touched
+    entries; a payload larger than the whole budget is rejected (counted,
+    never stored). ``get`` refreshes recency and leaves the entry resident
+    — the tier is a cache, not a queue: one spilled prefix can serve many
+    reloads across its lifetime."""
+
+    def __init__(self, max_bytes: int):
+        if max_bytes < 1:
+            raise ValueError(f"HostSpillTier(max_bytes={max_bytes})")
+        self.max_bytes = int(max_bytes)
+        self.bytes = 0
+        self._entries: "OrderedDict[int, tuple[int, object]]" = OrderedDict()
+        self.counters = {
+            "spill_puts": 0,  # blocks copied in (overwrites included)
+            "spill_gets": 0,  # reload lookups that hit
+            "spill_evicted": 0,  # entries dropped to fit the byte budget
+            "spill_rejected": 0,  # payloads larger than the whole budget
+        }
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._entries
+
+    def put(self, h: int, payload: object, nbytes: int) -> bool:
+        """Park one evicted block's rows under its chain hash. → False when
+        the payload alone exceeds the byte budget (rejected, counted)."""
+        nbytes = int(nbytes)
+        if nbytes > self.max_bytes:
+            self.counters["spill_rejected"] += 1
+            return False
+        old = self._entries.pop(h, None)
+        if old is not None:
+            self.bytes -= old[0]
+        while self.bytes + nbytes > self.max_bytes:
+            _, (evicted_bytes, _) = self._entries.popitem(last=False)
+            self.bytes -= evicted_bytes
+            self.counters["spill_evicted"] += 1
+        self._entries[h] = (nbytes, payload)
+        self.bytes += nbytes
+        self.counters["spill_puts"] += 1
+        return True
+
+    def get(self, h: int):
+        """→ the parked payload (recency refreshed), or None on a miss."""
+        entry = self._entries.get(h)
+        if entry is None:
+            return None
+        self._entries.move_to_end(h)
+        self.counters["spill_gets"] += 1
+        return entry[1]
+
+    def chain_hashes(self) -> list[int]:
+        """Resident chain hashes, most recently touched first — the order
+        ``hot_prefixes`` advertisement wants (the MRU end is farthest from
+        eviction, so advertising it promises affinity the tier will keep)."""
+        return list(reversed(self._entries))
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.bytes = 0
+
+    def check_invariants(self) -> None:
+        if self.bytes != sum(n for n, _ in self._entries.values()):
+            raise BlockPoolError(
+                f"host spill tier byte ledger desynced: {self.bytes} != "
+                f"sum of entry sizes"
+            )
+        if self.bytes > self.max_bytes:
+            raise BlockPoolError(
+                f"host spill tier over budget: {self.bytes} > {self.max_bytes}"
+            )
+        if any(v < 0 for v in self.counters.values()):
+            raise BlockPoolError(f"negative spill counter: {self.counters}")
+        if self.counters["spill_puts"] < len(self._entries):
+            raise BlockPoolError(
+                "host spill tier holds more entries than were ever put"
+            )
+
+
 class BlockPool:
     def __init__(
         self, num_blocks: int, block_size: int, prefix_cache: bool = True
@@ -105,14 +203,38 @@ class BlockPool:
         self._cached: dict[int, int] = {}  # chain hash -> block id
         self._hash_of: dict[int, int] = {}  # block id -> chain hash
         self._lru: "OrderedDict[int, int]" = OrderedDict()  # hash -> ref-0 bid
+        # host spill tier (attached by the engine when serving.kv_spill is
+        # enabled) + the eviction hook that feeds it: called with a list of
+        # (chain_hash, block_id) pairs BEFORE allocate() returns the evicted
+        # blocks, while their device rows are still intact
+        self.spill: Optional[HostSpillTier] = None
+        self.on_evict = None
         self.counters = {
             "allocated": 0,
             "freed": 0,
             "prefix_hits": 0,  # requests that matched >= 1 block
             "prefix_blocks_reused": 0,
             "prefix_tokens_reused": 0,
+            # token-weighted prefix accounting: matchable prompt tokens
+            # served from cache (resident hit, host-tier reload, or peer
+            # fetch) vs recomputed — the request-count `prefix_hits` above
+            # overstates 1-block matches; effective hit rate is
+            # hit_tokens / (hit_tokens + miss_tokens)
+            "prefix_hit_tokens": 0,
+            "prefix_miss_tokens": 0,
             "evictions": 0,
             "failed_allocs": 0,
+            # hierarchical tier traffic (docs/serving.md "Hierarchical KV
+            # cache"): blocks spilled device→host at eviction, blocks
+            # reloaded host→device at admission, reload admissions, peer
+            # blocks fetched over /kv_fetch, and failed peer fetches (each
+            # one fell back to local recompute)
+            "spilled_blocks": 0,
+            "spill_reloaded_blocks": 0,
+            "spill_reloads": 0,
+            "peer_fetch_blocks": 0,
+            "peer_fetches": 0,
+            "peer_fetch_failures": 0,
         }
 
     # -- capacity -------------------------------------------------------------
@@ -183,35 +305,67 @@ class BlockPool:
                 self._hash_of[bid] = h
             parent = h
 
+    def cached_block(self, h: int) -> Optional[int]:
+        """Block id currently caching chain hash ``h`` (resident tier only,
+        no refcount taken) — the engine's /kv_fetch handler peeks with this
+        to extract a peer-requested block without admitting anything."""
+        return self._cached.get(int(h))
+
     def cached_chain_hashes(self, limit: Optional[int] = None) -> list[int]:
         """The chain hashes this pool's prefix cache can currently serve —
         what a replica advertises over /stats (``hot_prefixes``) for the
-        fleet router's affinity placement. ``limit`` bounds the
-        advertisement by eviction distance: chains whose blocks are
-        REFERENCED right now cannot be evicted at all and always advertise;
-        the remaining budget fills from the most recently parked end of the
-        LRU — the parked-longest entries are the next evicted, so
-        advertising them would promise affinity the pool is about to
-        break."""
+        fleet router's affinity placement and for peer /kv_fetch. ``limit``
+        bounds the advertisement by eviction distance: chains whose blocks
+        are REFERENCED right now cannot be evicted at all and always
+        advertise; the remaining budget fills from the most recently parked
+        end of the LRU — the parked-longest entries are the next evicted,
+        so advertising them would promise affinity the pool is about to
+        break. With a host spill tier attached, its resident chains (MRU
+        first) fill any leftover budget: a spilled prefix is still
+        servable — by reload locally, by /kv_fetch to a peer."""
         pinned = [h for h in self._cached if h not in self._lru]
         parked = list(self._lru)
+        seen = set(pinned) | set(parked)
+        spilled = (
+            [h for h in self.spill.chain_hashes() if h not in seen]
+            if self.spill is not None
+            else []
+        )
         if limit is None:
-            return pinned + parked
+            return pinned + parked + spilled
         n = int(limit)
         room = max(n - len(pinned), 0)
-        return (pinned + (parked[-room:] if room else []))[:n]
+        out = (pinned + (parked[-room:] if room else []))[:n]
+        return out + spilled[: n - len(out)]
+
+    def note_prefix_tokens(self, hit_tokens: int, miss_tokens: int) -> None:
+        """Token-weighted prefix accounting, stamped ONCE per admission by
+        the engine AFTER spill-reload/peer-fetch resolution (the pool alone
+        cannot know how many missed tokens the hierarchy recovered):
+        ``hit_tokens`` = matchable prompt tokens served from any tier,
+        ``miss_tokens`` = matchable tokens that recompute."""
+        if hit_tokens < 0 or miss_tokens < 0:
+            raise ValueError(
+                f"note_prefix_tokens({hit_tokens}, {miss_tokens})"
+            )
+        self.counters["prefix_hit_tokens"] += int(hit_tokens)
+        self.counters["prefix_miss_tokens"] += int(miss_tokens)
 
     def clear_prefix_cache(self) -> None:
         """Forget every cached prefix — the serving engine calls this when
         it rebuilds after a stalled/failed program, because the pool's K/V
         contents can no longer be trusted. Ref-0 parked blocks return to
         the free list; a registered block still referenced by a live
-        sequence merely loses its hash mapping and frees normally later."""
+        sequence merely loses its hash mapping and frees normally later.
+        The host spill tier is dropped too: its payloads were extracted
+        from the pool this rebuild just declared untrusted."""
         for bid in self._lru.values():
             self._free.append(bid)
         self._lru.clear()
         self._cached.clear()
         self._hash_of.clear()
+        if self.spill is not None:
+            self.spill.clear()
 
     # -- allocate / free ------------------------------------------------------
     def allocate(self, n: int) -> Optional[list[int]]:
@@ -224,6 +378,7 @@ class BlockPool:
             self.counters["failed_allocs"] += 1
             return None
         out: list[int] = []
+        evicted: list[tuple[int, int]] = []
         for _ in range(n):
             if self._free:
                 bid = self._free.pop()
@@ -232,8 +387,26 @@ class BlockPool:
                 del self._cached[h]
                 del self._hash_of[bid]
                 self.counters["evictions"] += 1
+                evicted.append((h, bid))
             self._ref[bid] = 1
             out.append(bid)
+        if evicted and self.on_evict is not None:
+            # spill hook: the engine copies the evicted blocks' rows
+            # device→host in one bucketed batch. The blocks are already
+            # handed out above, but nothing writes them until this
+            # allocate()'s caller injects/prefills — extraction here is
+            # strictly before any overwrite. A spill failure loses cached
+            # bytes, never correctness, so it must not fail the allocation.
+            try:
+                self.on_evict(evicted)
+            except Exception:  # pragma: no cover - defensive
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "KV spill hook failed; %d evicted blocks not spilled",
+                    len(evicted),
+                    exc_info=True,
+                )
         self.counters["allocated"] += n
         return out
 
@@ -276,3 +449,23 @@ class BlockPool:
         for h in self._lru:
             if h not in self._cached:
                 raise BlockPoolError("LRU entry not in prefix cache")
+        for key in ("prefix_hit_tokens", "prefix_miss_tokens"):
+            if self.counters[key] < 0:
+                raise BlockPoolError(f"negative counter {key}")
+        if self.counters["spill_reloaded_blocks"] < self.counters["spill_reloads"]:
+            raise BlockPoolError(
+                "spill_reloads admissions exceed spill_reloaded_blocks — "
+                "every reload admission moves >= 1 block"
+            )
+        if self.spill is not None:
+            self.spill.check_invariants()
+            if self.counters["spilled_blocks"] != self.spill.counters["spill_puts"]:
+                raise BlockPoolError(
+                    f"spill ledger desynced: pool spilled "
+                    f"{self.counters['spilled_blocks']} blocks but the host "
+                    f"tier recorded {self.spill.counters['spill_puts']} puts"
+                )
+            if self.counters["spill_reloaded_blocks"] > self.spill.counters["spill_gets"]:
+                raise BlockPoolError(
+                    "more blocks reloaded than the host tier ever served"
+                )

@@ -73,7 +73,12 @@ from automodel_tpu.generation.engine import (
 )
 from automodel_tpu.generation.sampling import sample
 from automodel_tpu.serving import paged
-from automodel_tpu.serving.block_pool import BlockPool, blocks_needed
+from automodel_tpu.serving.block_pool import (
+    BlockPool,
+    HostSpillTier,
+    blocks_needed,
+    prompt_chain,
+)
 from automodel_tpu.telemetry.tracing import SpanContext, Tracer, WallAnchor
 from automodel_tpu.training.rng import sampling_key
 
@@ -210,6 +215,40 @@ class KVTransferConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class KVSpillConfig:
+    """The ``serving.kv_spill:`` section — the hierarchical KV cache
+    (docs/serving.md "Hierarchical KV cache"). When enabled, prefix blocks
+    evicted from the HBM pool's LRU spill device→host into a bounded
+    host-RAM tier keyed by the same chain hashes the prefix cache uses;
+    an admission whose prefix extends past resident blocks reloads the
+    spilled rows through ``paged.inject_blocks`` instead of re-prefilling
+    (greedy output bit-identical). ``peer_fetch`` extends the hierarchy
+    fleet-wide: a router-hinted replica pulls missing prefix blocks from
+    the peer that advertises them over AKV1 ``kv_fetch``, falling back to
+    local recompute on any failure within the request's deadline."""
+
+    enabled: bool = False
+    max_host_mb: float = 256.0  # host tier budget (LRU beyond this)
+    peer_fetch: bool = True  # honor router kv_peer hints via /kv_fetch
+    fetch_timeout_s: float = 5.0  # per-fetch cap (also clamped to deadline)
+
+    def __post_init__(self):
+        if self.max_host_mb <= 0:
+            raise ValueError(
+                f"serving.kv_spill.max_host_mb={self.max_host_mb} (want > 0)"
+            )
+        if self.fetch_timeout_s <= 0:
+            raise ValueError(
+                f"serving.kv_spill.fetch_timeout_s={self.fetch_timeout_s} "
+                "(want > 0)"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "KVSpillConfig":
+        return _cfg_dict(cls, d, "serving.kv_spill")
+
+
+@dataclasses.dataclass(frozen=True)
 class SpeculativeConfig:
     """The ``serving.speculative:`` section — draft-and-verify speculative
     decoding (Leviathan et al. 2023). A small draft model proposes ``k``
@@ -278,6 +317,7 @@ class ServeConfig:
     kv_transfer: KVTransferConfig = dataclasses.field(
         default_factory=KVTransferConfig
     )
+    kv_spill: KVSpillConfig = dataclasses.field(default_factory=KVSpillConfig)
 
     def __post_init__(self):
         if self.slots < 1 or self.block_size < 1 or self.prefill_chunk < 1:
@@ -321,6 +361,7 @@ class ServeConfig:
             ("watchdog", StallConfig),
             ("speculative", SpeculativeConfig),
             ("kv_transfer", KVTransferConfig),
+            ("kv_spill", KVSpillConfig),
         ):
             v = d.get(key)
             if v is not None and not isinstance(v, sub):
@@ -356,6 +397,10 @@ class _Queued:
     # disaggregated fleet (docs/serving.md "Fleet"):
     prefill_only: bool = False  # prefill-role replica: extract KV, no decode
     payload: Optional[dict] = None  # decode-role replica: injected prompt KV
+    # hierarchical KV cache: router hint naming the peer replica whose
+    # prefix cache covers this prompt ({"host": ..., "port": ...}) — the
+    # admission path /kv_fetch-es missing blocks from it, best-effort
+    kv_peer: Optional[dict] = None
     # request tracing: this request's ROOT span context on this process
     # (child of the router's forward span when one propagated in)
     trace: Optional[SpanContext] = None
@@ -436,6 +481,21 @@ class ServingEngine:
         self.decode_backend = self._resolve_decode_backend()
         spec = self.config.speculative
         self._spec_enabled = bool(spec.enabled)
+        sp = self.config.kv_spill
+        if sp.enabled and self._spec_enabled:
+            # same reason submit_prefilled refuses spec engines: a reloaded
+            # prefix fills only the TARGET pool — the draft's parallel pool
+            # would miss the prompt KV and proposals would attend garbage
+            raise ValueError(
+                "serving.kv_spill cannot be enabled together with "
+                "serving.speculative: the draft pool has no spill tier, so "
+                "a reloaded prefix would leave it without the prompt KV"
+            )
+        if sp.enabled:
+            self.pool.spill = HostSpillTier(
+                max(int(sp.max_host_mb * 1024 * 1024), 1)
+            )
+            self.pool.on_evict = self._spill_evicted
         self.draft_auto = None
         if self._spec_enabled:
             from automodel_tpu.generation.engine import (
@@ -763,6 +823,7 @@ class ServingEngine:
         max_queue_wait_s: Optional[float] = None,
         prefill_only: bool = False,
         trace: Optional[SpanContext] = None,
+        kv_peer: Optional[dict] = None,
         _payload: Optional[dict] = None,
     ) -> str:
         prompt = [int(t) for t in prompt_ids]
@@ -815,6 +876,7 @@ class ServingEngine:
             deadline_at=now + ddl if ddl and ddl > 0 else None,
             queue_deadline_at=now + qw if qw and qw > 0 else None,
             prefill_only=prefill_only, payload=_payload, trace=root,
+            kv_peer=kv_peer if kv_peer else None,
         )
         if self.draining:
             # no terminal record here (mirror of the shed seam): the
@@ -861,6 +923,153 @@ class ServingEngine:
         """Cached chain heads advertised via /stats for the fleet router's
         prefix-affinity placement."""
         return self.pool.cached_chain_hashes(self.config.hot_prefix_advertise)
+
+    # -- hierarchical KV cache (docs/serving.md "Hierarchical KV cache") ------
+    def _spill_evicted(self, evicted: list) -> None:
+        """BlockPool eviction hook: copy the evicted prefix blocks' rows
+        device→host into the spill tier, keyed by chain hash. Runs inside
+        ``allocate()`` — strictly before the caller can overwrite the
+        blocks it was handed. ONE gather + device sync per eviction event
+        (the host round trip, not the bytes, dominates on small pools),
+        padded to a power-of-two block count so the arbitrary batch sizes
+        churn cost at most log2(pool) compiled programs."""
+        tier = self.pool.spill
+        if tier is None:
+            return
+        bids = [bid for _, bid in evicted]
+        pad = paged.bucket_blocks(len(bids))
+        k, v = paged.extract_blocks(
+            self._pool, bids + [bids[-1]] * (pad - len(bids))
+        )
+        payloads = paged.split_kv_blocks({"k": k, "v": v})[: len(bids)]
+        for (h, _), payload in zip(evicted, payloads):
+            if tier.put(h, payload, paged.kv_nbytes(payload)):
+                self.pool.counters["spilled_blocks"] += 1
+
+    def fetch_prefix_blocks(self, chain_hashes: Sequence[int]):
+        """Serve a peer replica's ``/kv_fetch``: the longest leading run of
+        ``chain_hashes`` this replica can source — resident prefix-cache
+        blocks extract device→host, spilled blocks come straight from the
+        host tier. → ``(n, kv dict | None)``. Caller holds the scheduler
+        lock (the server front wraps this in ``loop.lock``)."""
+        tier = self.pool.spill
+        pieces: list[dict] = []
+        for h in chain_hashes:
+            bid = self.pool.cached_block(int(h))
+            if bid is not None:
+                k, v = paged.extract_blocks(self._pool, [bid])
+                pieces.append({"k": k, "v": v})
+                continue
+            p = tier.get(int(h)) if tier is not None else None
+            if p is None:
+                break
+            pieces.append(p)
+        if not pieces:
+            return 0, None
+        return len(pieces), paged.concat_kv_blocks(pieces)
+
+    def _resolve_hierarchy(
+        self, q: _Queued, hits: list, hit_tokens: int, fresh: list
+    ) -> int:
+        """Admission-time resolution of a prefix match that ends short of
+        the prompt's full chain: reload spilled blocks from the host tier,
+        then (router-hinted) fetch the remainder from the peer that
+        advertises it, and scatter everything into the leading ``fresh``
+        blocks through ``inject_blocks`` — the exact seam disagg handoff
+        uses, so greedy output is bit-identical to recompute.
+        Every failure degrades to recompute; nothing here can fail the
+        request short of the injection itself. → the updated hit_tokens
+        (prefill resumes past everything served from any tier)."""
+        sp = self.config.kv_spill
+        tier = self.pool.spill
+        if not sp.enabled or tier is None or not fresh:
+            return hit_tokens
+        bs = self.config.block_size
+        chain = prompt_chain(q.prompt, bs)
+        k = len(hits)
+        if k >= len(chain):
+            return hit_tokens
+        t0 = time.perf_counter()
+        pieces: list[dict] = []
+        reloaded = 0
+        for h in chain[k:]:
+            if reloaded >= len(fresh):
+                break
+            p = tier.get(h)
+            if p is None:
+                break
+            pieces.append(p)
+            reloaded += 1
+        fetched = 0
+        want = chain[k + reloaded :]
+        if (
+            want
+            and sp.peer_fetch
+            and q.kv_peer is not None
+            and k + reloaded + len(want) <= k + len(fresh)
+        ):
+            timeout = sp.fetch_timeout_s
+            if q.deadline_at is not None:
+                timeout = min(timeout, q.deadline_at - time.perf_counter())
+            if timeout > 0:
+                tf0 = time.perf_counter()
+                try:
+                    from automodel_tpu.serving.fleet.kv_transfer import fetch_kv
+
+                    n, kv = fetch_kv(
+                        (str(q.kv_peer["host"]), int(q.kv_peer["port"])),
+                        want, self.kv_geometry(), timeout_s=timeout,
+                    )
+                    if n and kv is not None:
+                        pieces.append(kv)
+                        fetched = n
+                        self.pool.counters["peer_fetch_blocks"] += n
+                    self.pool.counters["peer_fetches"] += 1
+                    self._child_span(
+                        q.trace, "kv_fetch", tf0,
+                        request_id=q.rid, blocks=fetched,
+                    )
+                except Exception as e:
+                    # the fallback ladder's last rung: any fetch failure —
+                    # refused, timed out, died mid-stream — recomputes
+                    # locally within the request's original deadline
+                    self.pool.counters["peer_fetch_failures"] += 1
+                    logger.warning(
+                        "peer KV fetch from %s failed (%s: %s); "
+                        "recomputing locally",
+                        q.kv_peer, type(e).__name__, e,
+                    )
+                    self._child_span(
+                        q.trace, "kv_fetch", tf0,
+                        request_id=q.rid, blocks=0, error=type(e).__name__,
+                    )
+        total = reloaded + fetched
+        if not total:
+            return hit_tokens
+        from automodel_tpu.resilience.fault_injection import active_injector
+
+        inj = active_injector()
+        if inj is not None:
+            inj.maybe_trace_delay("kv_inject")
+        # ONE scatter, padded to a power-of-two block count aimed at the
+        # scratch block: reload/fetch run lengths are arbitrary, and an
+        # exact-length inject compiles per distinct length (the handoff
+        # path's documented compile churn) — bucketing bounds a reload's
+        # worst-case TTFT to log2(pool) one-time compiles
+        pad = paged.bucket_blocks(total)
+        table = list(fresh[:total]) + [0] * (pad - total)
+        self._pool = paged.inject_blocks(
+            self._pool, np.asarray(table, np.int32),
+            paged.pad_kv_blocks(paged.concat_kv_blocks(pieces), pad),
+        )
+        if reloaded:
+            self.pool.counters["spill_reloads"] += 1
+            self.pool.counters["spill_reloaded_blocks"] += reloaded
+        self._child_span(
+            q.trace, "kv_reload", t0, request_id=q.rid,
+            blocks=total, reloaded=reloaded, fetched=fetched,
+        )
+        return hit_tokens + total * bs
 
     def pop_prefill_payload(self, request_id: str) -> dict:
         """Claim the extracted KV payload of a completed prefill-only
@@ -1178,6 +1387,18 @@ class ServingEngine:
                 if q.payload is not None:
                     self._bind_injected_slot(b, q, blocks, done)
                 else:
+                    hit_tokens = self._resolve_hierarchy(
+                        q, hits, hit_tokens, fresh
+                    )
+                    # token-weighted prefix accounting, stamped once per
+                    # admission AFTER the hierarchy resolved: hit = matchable
+                    # prompt tokens served from ANY tier, miss = matchable
+                    # tokens about to recompute
+                    bs = self.config.block_size
+                    matchable = max(len(q.prompt) - 1, 0) // bs * bs
+                    self.pool.note_prefix_tokens(
+                        hit_tokens, max(matchable - hit_tokens, 0)
+                    )
                     self._bind_slot(b, q, blocks, hit_tokens)
                 # queue wait and admission (prefix match + whole-budget
                 # block allocation + slot bind) as sibling stages under the

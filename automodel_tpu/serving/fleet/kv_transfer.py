@@ -27,6 +27,18 @@ The receiver replies ``u32 len | JSON {"ok": true}`` (or ``{"ok": false,
 decode replica really holds the bytes — the router's follow-up
 POST /generate with the handoff id can never race an in-flight transfer.
 
+**Prefix fetch (``op: kv_fetch``)** generalizes the same listener from a
+disagg handoff sink into a prefix-sharing fabric (docs/serving.md
+"Hierarchical KV cache"): a requester sends an array-less AKV1 frame whose
+header carries ``op: "kv_fetch"``, the prompt's chain hashes, and its pool
+geometry; the serving replica looks the hashes up in its OWN prefix cache
++ host spill tier (an engine-backed ``fetch_handler``) and answers with a
+FULL AKV1 frame — ``{"ok": true, "blocks": n, ...}`` plus the block-row
+arrays for the longest consecutive run it holds from hash 0. Geometry
+mismatch, a missing handler, or zero matching blocks all answer loudly in
+the response header; any transport death raises on the requester, whose
+fallback is always local recompute.
+
 This module imports no jax: numpy (+ ml_dtypes for bf16) only, so the
 router and tests can exercise the wire format without a device runtime.
 """
@@ -40,7 +52,7 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Optional, Sequence
 
 import numpy as np
 
@@ -197,6 +209,58 @@ def send_kv(
     return resp
 
 
+def fetch_kv(
+    addr: tuple[str, int],
+    chain_hashes: Sequence[int],
+    geometry: dict,
+    timeout_s: float = 5.0,
+    max_frame_bytes: Optional[int] = None,
+    traceparent: Optional[str] = None,
+) -> tuple[int, Optional[dict]]:
+    """Ask the peer at ``addr`` for the prefix blocks named by
+    ``chain_hashes`` (consecutive chain order, hash 0 first). → ``(blocks,
+    kv)`` — the longest consecutive run the peer holds and its rows
+    (``(0, None)`` when it holds nothing). Raises :class:`KVTransferError`
+    on transport death, a refused request, or a malformed reply; the
+    caller's fallback is always local recompute."""
+    from automodel_tpu.resilience.fault_injection import active_injector
+
+    inj = active_injector()
+    if inj is not None:
+        inj.maybe_trace_delay("kv_fetch")
+    header = {
+        "op": "kv_fetch",
+        "chain_hashes": [int(h) for h in chain_hashes],
+        "geometry": {k: geometry[k] for k in GEOMETRY_KEYS},
+    }
+    if traceparent:
+        header["traceparent"] = traceparent
+    try:
+        with socket.create_connection(addr, timeout=timeout_s) as sock:
+            _write_frame(sock, header, [])
+            resp, arrays = _read_frame(sock, max_frame_bytes=max_frame_bytes)
+    except (OSError, ValueError) as e:
+        raise KVTransferError(f"KV fetch from {addr} failed: {e}") from e
+    if not resp.get("ok"):
+        raise KVTransferError(
+            f"peer at {addr} refused the prefix fetch: "
+            f"{resp.get('error', 'unknown error')}"
+        )
+    n = resp.get("blocks")
+    if not isinstance(n, int) or n < 0 or n > len(chain_hashes):
+        raise KVTransferError(f"peer at {addr} claims a bad block count {n!r}")
+    if n == 0:
+        return 0, None
+    kv = unflatten_kv(arrays)
+    for key, arr in arrays.items():
+        if int(arr.shape[1]) != n:
+            raise KVTransferError(
+                f"fetch reply array {key} carries {arr.shape[1]} blocks "
+                f"but the header claims {n}"
+            )
+    return n, kv
+
+
 class HandoffStore:
     """Bounded host-side parking lot for received payloads between the
     transfer landing and the router's POST /generate claiming it. TTL +
@@ -256,10 +320,18 @@ class KVTransferServer:
         ttl_s: float = 120.0,
         max_frame_bytes: Optional[int] = None,
         tracer: Any = None,
+        fetch_handler: Any = None,
     ):
         self.expected = {k: expected_geometry[k] for k in GEOMETRY_KEYS}
         self.store = store or HandoffStore(max_pending=max_pending, ttl_s=ttl_s)
         self.max_frame_bytes = max_frame_bytes
+        # prefix-fetch lookup: ``fetch_handler(chain_hashes) -> (n, kv)``
+        # returning the longest consecutive run of blocks this replica holds
+        # (resident prefix cache or host spill tier) for the hashes, as one
+        # ``{"k": ..., "v": ...}`` inject payload. Settable after
+        # construction (the serving front wires it once the engine lock
+        # exists); None = this listener serves handoffs only.
+        self.fetch_handler = fetch_handler
         # request tracing: when the sender's AKV1 header carries a
         # `traceparent`, the receive (frame read + validation + store.put)
         # is recorded as a kv_receive span on THIS replica's tracer,
@@ -288,6 +360,9 @@ class KVTransferServer:
                         _write_response(self.request, {"ok": False, "error": str(e)})
                     except OSError:
                         pass
+                    return
+                if header.get("op") == "kv_fetch":
+                    outer._handle_fetch(self.request, header, t0)
                     return
                 err = outer._validate(header, arrays)
                 if err is not None:
@@ -318,9 +393,55 @@ class KVTransferServer:
             target=self._server.serve_forever, name="kv-transfer", daemon=True
         )
 
+    def _handle_fetch(self, sock, header: dict, t0: float) -> None:
+        """Answer one ``op: kv_fetch`` request with a full AKV1 frame —
+        the longest consecutive run of requested prefix blocks this
+        replica's cache hierarchy holds (``blocks: 0`` + no arrays on a
+        clean miss)."""
+
+        def refuse(error: str) -> None:
+            logger.warning("refusing KV fetch: %s", error)
+            self._record_span("kv_fetch", header, t0, error=error[:200])
+            try:
+                _write_frame(sock, {"ok": False, "error": error}, [])
+            except OSError:
+                pass
+
+        if self.fetch_handler is None:
+            return refuse("this replica serves no prefix fetches")
+        geom = header.get("geometry") or {}
+        got = {k: geom.get(k) for k in GEOMETRY_KEYS}
+        if got != self.expected:
+            return refuse(
+                f"pool geometry mismatch: requester {got} != holder "
+                f"{self.expected} — fetched rows would scatter corrupt"
+            )
+        hashes = header.get("chain_hashes")
+        if not isinstance(hashes, list) or not all(
+            isinstance(h, int) for h in hashes
+        ):
+            return refuse(f"bad chain_hashes {type(hashes).__name__}")
+        try:
+            n, kv = self.fetch_handler(hashes)
+        except Exception as e:  # the lookup must never kill the listener
+            logger.warning("KV fetch handler failed", exc_info=True)
+            return refuse(f"fetch handler failed: {e}")
+        arrays = flatten_kv(kv) if n else []
+        self._record_span(
+            "kv_fetch", header, t0,
+            blocks=int(n), bytes=sum(a.nbytes for _, a in arrays),
+        )
+        try:
+            _write_frame(sock, {"ok": True, "blocks": int(n)}, arrays)
+        except OSError as e:
+            logger.warning("KV fetch reply failed mid-frame: %s", e)
+
     def _record_receive(self, header: dict, t0: float, **attrs) -> None:
         """kv_receive span for a frame whose header carried a traceparent
         (sampled-out or untraced sends record nothing)."""
+        self._record_span("kv_receive", header, t0, **attrs)
+
+    def _record_span(self, stage: str, header: dict, t0: float, **attrs) -> None:
         if self.tracer is None:
             return
         parent = self.tracer.parse(header.get("traceparent"))
@@ -328,7 +449,7 @@ class KVTransferServer:
             return
         try:
             self.tracer.record(
-                self.tracer.start(parent=parent), "kv_receive", t0,
+                self.tracer.start(parent=parent), stage, t0,
                 request_id=header.get("request_id"),
                 handoff_id=header.get("handoff_id"), **attrs,
             )

@@ -348,6 +348,7 @@ class Router:
         self.prefix_hits_total = 0
         self.unroutable_total = 0
         self.handoffs_total = 0
+        self.peer_hints_total = 0  # forwarded kv_peer prefix-fetch hints
         self._warned_block_size: set[str] = set()
 
     # -- registry / probing ---------------------------------------------------
@@ -545,6 +546,39 @@ class Router:
         cands = self._candidates(exclude or set(), "prefill")
         return min(cands, key=lambda r: r.load) if cands else None
 
+    def _peer_hint(
+        self, chains: Sequence[int], rep: _Replica, match: int, exclude: set
+    ) -> Optional[dict]:
+        """Prefix-fetch hint for an affinity miss (docs/serving.md
+        "Hierarchical KV cache"): when another ready replica advertises a
+        DEEPER consecutive chain match than the chosen one AND runs a KV
+        listener, the chosen replica can ``/kv_fetch`` the missing prefix
+        blocks from it instead of re-prefilling. The hint is best-effort —
+        the replica recomputes the chains itself (hashing is deterministic
+        cross-process) and falls back to local prefill on any fetch
+        failure. → ``{"host", "port"}`` or None.
+
+        ``exclude`` should name only replicas whose KV listener is
+        suspect (e.g. a failed transfer target) — NOT every replica a
+        retry skipped: a shedding replica (503, queue full) refuses new
+        decodes but its listener still serves prefix reads, and that
+        shed-then-retry hop is exactly when the hint earns its keep
+        (placement lands on a cold replica while the hot one stays the
+        source of truth). Dead replicas drop out via ``ready``."""
+        if not chains:
+            return None
+        best, peer = match, None
+        for r in self._candidates(exclude | {rep.name}, "decode"):
+            if not r.kv_port:
+                continue
+            m = self._match_blocks(r, chains)
+            if m > best:
+                best, peer = m, r
+        if peer is None:
+            return None
+        host = urllib.parse.urlsplit(peer.url).hostname
+        return {"host": host, "port": int(peer.kv_port)}
+
     def _disaggregate_active(self) -> bool:
         if self.config.disaggregate is False:
             return False
@@ -626,6 +660,7 @@ class Router:
             self.requests_total += 1
         tried: set = set()
         tried_prefill: set = set()
+        kv_suspect: set = set()  # replicas whose KV LISTENER failed us
         retries = 0
         last_error = "no ready decode-capable replica"
         rep = None
@@ -670,6 +705,12 @@ class Router:
                 fwd.pop("prompt", None)
                 fwd["prompt_ids"] = ids
             fwd["id"] = rid
+            if self.config.affinity:
+                hint = self._peer_hint(chains, rep, match, kv_suspect)
+                if hint is not None:
+                    fwd["kv_peer"] = hint
+                    with self._lock:
+                        self.peer_hints_total += 1
             used_prefill = None
             if (
                 ids is not None
@@ -741,6 +782,7 @@ class Router:
                             # — exclude the decode replica and keep the
                             # prefill pool intact
                             tried.add(rep.name)
+                            kv_suspect.add(rep.name)
                             retries += 1
                             self._count_retry()
                             continue
@@ -942,6 +984,7 @@ class Router:
                 "prefix_hits_total": self.prefix_hits_total,
                 "unroutable_total": self.unroutable_total,
                 "kv_handoffs_total": self.handoffs_total,
+                "kv_peer_hints_total": self.peer_hints_total,
                 "disaggregated": self._disaggregate_active_unlocked(),
                 "draining": self.draining,
             }
@@ -1003,6 +1046,15 @@ class Router:
         from automodel_tpu.telemetry.report import percentile
 
         route_durs = [d for d in durations if d is not None]
+        # token-weighted hit rate: prompt tokens served from a replica's
+        # cache hierarchy over all prompt tokens routed — the per-request
+        # `prefix_hits` counter overstates 1-block matches
+        hit_toks = sum(
+            int(b.get("prefix_hit_tokens") or 0) for b in completions
+        )
+        prompt_toks = sum(
+            int(b.get("prompt_tokens") or 0) for b in completions
+        )
         stats = {
             "requests": routed,
             "gen_tokens": gen,
@@ -1012,9 +1064,10 @@ class Router:
             "prefix_hits": self.prefix_hits_total - req0["hits"],
             "kv_handoffs": self.handoffs_total - req0["handoffs"],
             "prefix_hit_rate": (
-                (self.prefix_hits_total - req0["hits"]) / len(arrivals)
-                if arrivals else 0.0
+                hit_toks / prompt_toks if prompt_toks else 0.0
             ),
+            "prefix_hit_tokens": hit_toks,
+            "prompt_tokens": prompt_toks,
             # shared linear-interpolation percentile (telemetry/report.py)
             # — the same rule every other p50/p99 in the tree uses
             "route_p50_s": percentile(route_durs, 0.50),

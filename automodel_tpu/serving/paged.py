@@ -247,6 +247,94 @@ def inject_blocks(pool: PagedKV, blocks, kv: dict) -> PagedKV:
     return fn(pool, table, kv["k"], kv["v"])
 
 
+# -- host-side KV payload surgery (spill tier + /kv_fetch) --------------------
+# Numpy-only helpers over the ``{"k": rows|(values, scales), "v": ...}``
+# payload shape ``extract_blocks``/``inject_blocks`` speak: the host spill
+# tier parks ONE block per chain hash, and reload/peer-fetch re-assembles a
+# consecutive run back into one inject — so payloads need slicing and
+# concatenation along the block axis (axis 1) without touching a device.
+
+
+def split_kv_blocks(kv: dict) -> list[dict]:
+    """One payload per block: ``[L, nb, ...]`` arrays → nb ``[L, 1, ...]``
+    payloads (copies, so a parked block never pins the whole extract)."""
+    import numpy as np
+
+    def slice_side(s, i):
+        if isinstance(s, tuple):
+            return tuple(np.ascontiguousarray(a[:, i : i + 1]) for a in s)
+        return np.ascontiguousarray(s[:, i : i + 1])
+
+    first = kv["k"][0] if isinstance(kv["k"], tuple) else kv["k"]
+    return [
+        {"k": slice_side(kv["k"], i), "v": slice_side(kv["v"], i)}
+        for i in range(int(first.shape[1]))
+    ]
+
+
+def concat_kv_blocks(payloads: list[dict]) -> dict:
+    """Inverse of :func:`split_kv_blocks`: re-assemble consecutive
+    single-block payloads into one ``[L, nb, ...]`` inject payload."""
+    import numpy as np
+
+    if not payloads:
+        raise ValueError("concat_kv_blocks: empty payload list")
+
+    def cat_side(name):
+        first = payloads[0][name]
+        if isinstance(first, tuple):
+            return tuple(
+                np.concatenate([p[name][j] for p in payloads], axis=1)
+                for j in range(len(first))
+            )
+        return np.concatenate([p[name] for p in payloads], axis=1)
+
+    return {"k": cat_side("k"), "v": cat_side("v")}
+
+
+def bucket_blocks(n: int) -> int:
+    """Next power of two ≥ n — the block-count buckets the spill/reload
+    paths pad to. Extract/inject compile one XLA program per distinct
+    block count; eviction batches and reload runs have arbitrary sizes, so
+    unbucketed calls would compile (and on a busy host, stall TTFT) per
+    novel size. Buckets bound the program count to log2(pool)."""
+    if n < 1:
+        raise ValueError(f"bucket_blocks({n})")
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_kv_blocks(kv: dict, nb: int) -> dict:
+    """Pad a payload out to ``nb`` blocks by repeating its last block row.
+    The caller aims the padding rows at the scratch block (id 0), whose
+    contents are junk by contract — so a bucketed inject is bit-identical
+    to an exact one everywhere that is ever attended."""
+    import numpy as np
+
+    def pad_side(s):
+        if isinstance(s, tuple):
+            return tuple(pad_side(a) for a in s)
+        short = nb - int(s.shape[1])
+        if short <= 0:
+            return s
+        reps = np.repeat(s[:, -1:], short, axis=1)
+        return np.concatenate([s, reps], axis=1)
+
+    return {"k": pad_side(kv["k"]), "v": pad_side(kv["v"])}
+
+
+def kv_nbytes(kv: dict) -> int:
+    """Host bytes a payload occupies (scales included) — the spill tier's
+    budget currency."""
+    total = 0
+    for side in (kv["k"], kv["v"]):
+        for arr in side if isinstance(side, tuple) else (side,):
+            total += int(arr.nbytes)
+    return total
+
+
 # -- forward cores -----------------------------------------------------------
 
 

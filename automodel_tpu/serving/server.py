@@ -167,12 +167,14 @@ class _EngineLoop:
                 if submit is not None:
                     rid = submit()
                 else:
+                    kvp = req.get("kv_peer")  # router prefix-fetch hint
                     rid = self.engine.submit(
                         prompt_ids,
                         max_new_tokens=req.get("max_new_tokens"),
                         deadline_s=req.get("deadline_s"),
                         max_queue_wait_s=req.get("max_queue_wait_s"),
                         trace=trace,
+                        kv_peer=kvp if isinstance(kvp, dict) else None,
                     )
             except QueueFull:
                 # the HTTP front sheds immediately — a blocked handler
@@ -349,6 +351,16 @@ def serve_http(
                     "kv_transfer_port": engine.kv_transfer_port,
                     "kv_injected_total": engine.kv_injected_total,
                     "hot_prefixes": engine.hot_prefixes(),
+                    # hierarchical KV cache: host-tier occupancy (null when
+                    # serving.kv_spill is off; counters ride "allocator")
+                    "spill_bytes": (
+                        engine.pool.spill.bytes
+                        if engine.pool.spill is not None else None
+                    ),
+                    "spill_entries": (
+                        len(engine.pool.spill)
+                        if engine.pool.spill is not None else None
+                    ),
                 })
 
         def _read_req(self) -> dict:
@@ -658,12 +670,17 @@ def main(cfg: Any) -> int:
         auto, serve_cfg, gen_cfg, on_record=on_record, tracer=tracer
     )
 
-    # disaggregated fleet: a decode-role replica listens for prefill→decode
-    # KV handoffs (serving.kv_transfer.enabled: null = auto-on for role
-    # decode); the bound port is advertised to the router via /stats
+    # fleet KV listener: a decode-role replica listens for prefill→decode
+    # handoffs, and a spill-enabled replica listens for peer /kv_fetch
+    # (serving.kv_transfer.enabled: null = auto-on for either role); the
+    # bound port is advertised to the router via /stats
     kv_server = None
     ktc = serve_cfg.kv_transfer
-    kv_on = ktc.enabled if ktc.enabled is not None else serve_cfg.role == "decode"
+    kv_on = (
+        ktc.enabled
+        if ktc.enabled is not None
+        else (serve_cfg.role == "decode" or serve_cfg.kv_spill.enabled)
+    )
     if kv_on:
         from automodel_tpu.serving.fleet.kv_transfer import KVTransferServer
 
@@ -709,6 +726,7 @@ def main(cfg: Any) -> int:
             return _serve_http_forever(
                 engine, tokenizer, http_section, serve_cfg,
                 kv_store=kv_server.store if kv_server is not None else None,
+                kv_server=kv_server,
             )
         return _serve_stdin(engine, tokenizer, serve_cfg)
     finally:
@@ -720,7 +738,7 @@ def main(cfg: Any) -> int:
 
 
 def _serve_http_forever(
-    engine, tokenizer, http_section, serve_cfg, kv_store=None
+    engine, tokenizer, http_section, serve_cfg, kv_store=None, kv_server=None
 ) -> int:
     port = int(http_section["port"])
     host = str(http_section.get("host", "127.0.0.1"))
@@ -730,6 +748,15 @@ def _serve_http_forever(
     server, loop = serve_http(
         engine, tokenizer, port, host=host, kv_store=kv_store
     )
+    if kv_server is not None and serve_cfg.kv_spill.enabled:
+        # peer /kv_fetch answers from the engine's pools, so the handler
+        # must serialize with the scheduler: wired here — after the loop
+        # (and its lock) exist — rather than at listener construction
+        def _serve_fetch(chain_hashes):
+            with loop.lock:
+                return engine.fetch_prefix_blocks(chain_hashes)
+
+        kv_server.fetch_handler = _serve_fetch
     state = {"rc": 0}
 
     def _drain_then_stop():

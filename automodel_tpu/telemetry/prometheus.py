@@ -501,6 +501,16 @@ class ServingMetrics:
             "Per-stage latency from request trace spans, by stage name",
             "stage",
         )
+        # host spill tier occupancy (serving.kv_spill:) — gauges because the
+        # tier's own LRU both grows and shrinks it
+        self.spill_bytes = r.gauge(
+            "automodel_serve_spill_bytes",
+            "Host spill tier resident bytes (0 when serving.kv_spill is off)",
+        )
+        self.spill_entries = r.gauge(
+            "automodel_serve_spill_entries",
+            "Prefix blocks resident in the host spill tier",
+        )
         self._pool_counters = {
             key: r.counter(f"automodel_serve_block_{key}", help_text)
             for key, help_text in (
@@ -511,6 +521,16 @@ class ServingMetrics:
                 ("prefix_hits", "Requests that matched >= 1 cached prefix block"),
                 ("prefix_blocks_reused", "Prefix-cache blocks reused by admissions"),
                 ("prefix_tokens_reused", "Prompt tokens served from the prefix cache"),
+                # hierarchical KV cache (serving.kv_spill:) — token-weighted
+                # hit accounting + host-tier / peer-fetch traffic
+                ("prefix_hit_tokens", "Matchable prompt tokens served from any cache tier"),
+                ("prefix_miss_tokens", "Matchable prompt tokens that recomputed"),
+                ("spilled_blocks", "Evicted prefix blocks copied device->host into the spill tier"),
+                ("spill_reloaded_blocks", "Spilled blocks reloaded host->device at admission"),
+                ("spill_reloads", "Admissions that reloaded >= 1 spilled block"),
+                ("peer_fetch_blocks", "Prefix blocks fetched from a peer replica over /kv_fetch"),
+                ("peer_fetches", "Completed peer /kv_fetch RPCs"),
+                ("peer_fetch_failures", "Peer /kv_fetch attempts that fell back to local recompute"),
             )
         }
 
@@ -573,6 +593,9 @@ class ServingMetrics:
             )
             for key, counter in self._pool_counters.items():
                 counter.set_total(engine.pool.counters.get(key, 0))
+            tier = getattr(engine.pool, "spill", None)
+            self.spill_bytes.set(float(tier.bytes) if tier is not None else 0.0)
+            self.spill_entries.set(float(len(tier)) if tier is not None else 0.0)
             proposed = getattr(engine, "spec_proposed_total", 0)
             accepted = getattr(engine, "spec_accepted_total", 0)
             self.spec_accepted.set_total(accepted)

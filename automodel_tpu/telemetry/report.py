@@ -66,6 +66,7 @@ _NUMERIC_KEYS = (
     "queue_depth",
     "block_occupancy",
     "prefix_hit_tokens",
+    "prefix_miss_tokens",
     "serve_tokens_per_s",
     "serve_ttft_p50_s",
     "serve_ttft_p99_s",
@@ -92,6 +93,14 @@ _NUMERIC_KEYS = (
     "serve_fleet_replicas",
     "serve_fleet_requests",
     "serve_fleet_kv_handoffs",
+    # hierarchical KV cache (serving.kv_spill:): the spill A/B bench
+    # sub-leg's aggregate keys — spill-on throughput/ttft on the replayed
+    # arrival schedule, the token-weighted effective hit rate, and how many
+    # admissions reloaded spilled blocks
+    "serve_spill_tokens_per_s",
+    "serve_spill_ttft_p50_s",
+    "serve_effective_hit_rate",
+    "serve_spill_reloads",
     # distributed guard (watchdog liveness, consensus/straggler attribution)
     "heartbeat_age_s",
     "deadline_s",
@@ -546,6 +555,10 @@ _BENCH_LEGS = (
     # section / any failure records its reason, never a silent null/zero
     ("serve_fleet_tokens_per_s", "serve_fleet_failure"),
     ("serve_route_prefix_hit_rate", "serve_fleet_failure"),
+    # hierarchical-KV-cache A/B sub-leg (spill-on vs spill-off on the same
+    # arrival schedule): a null throughput or hit rate must name why
+    ("serve_spill_tokens_per_s", "serve_spill_failure"),
+    ("serve_effective_hit_rate", "serve_spill_failure"),
     # input-pipeline A/B sub-leg (sync vs prefetch under an injected collate
     # delay): a null speedup must name why — never read as "measured zero"
     ("input_pipeline_speedup", "input_pipeline_failure"),
@@ -554,7 +567,11 @@ _BENCH_LEGS = (
 # legs where a hard 0.0 IS a measurement (an accept rate of zero means the
 # draft never matched — real data, unlike a 0.0 MFU which means never-ran;
 # a 0.0 prefix-hit rate means the workload shared no prefixes — also real)
-_ZERO_VALID_LEGS = frozenset({"serve_accept_rate", "serve_route_prefix_hit_rate"})
+_ZERO_VALID_LEGS = frozenset({
+    "serve_accept_rate",
+    "serve_route_prefix_hit_rate",
+    "serve_effective_hit_rate",
+})
 
 
 def validate_bench_result(result: dict[str, Any]) -> list[str]:

@@ -147,15 +147,20 @@ def test_example_yaml_parses_and_dry_instantiates(path):
             assert get("hf_config") or get("pretrained_model_name_or_path"), (
                 f"{path}: serving.speculative.draft is not a model section"
             )
-        from automodel_tpu.serving.engine import KVTransferConfig
+        from automodel_tpu.serving.engine import (
+            KVSpillConfig,
+            KVTransferConfig,
+        )
 
         assert isinstance(sc.kv_transfer, KVTransferConfig)
+        assert isinstance(sc.kv_spill, KVSpillConfig)
         for key, sub in (
             ("limits", LimitsConfig),
             ("drain", DrainConfig),
             ("watchdog", StallConfig),
             ("speculative", SpeculativeConfig),
             ("kv_transfer", KVTransferConfig),
+            ("kv_spill", KVSpillConfig),
         ):
             if srv.get(key) is not None:
                 sub.from_dict(dict(srv[key]))
@@ -267,6 +272,12 @@ def test_config_dataclasses_reject_unknown_keys():
         ServeConfig.from_dict({"role": "router"})
     with pytest.raises(TypeError):
         ServeConfig.from_dict({"kv_transfer": {"portt": 1}})
+    with pytest.raises(TypeError):
+        ServeConfig.from_dict({"kv_spill": {"max_host_mbb": 1}})
+    with pytest.raises(ValueError):
+        ServeConfig.from_dict(
+            {"kv_spill": {"enabled": True, "max_host_mb": 0}}
+        )
     from automodel_tpu.serving.fleet.router import FleetConfig
 
     with pytest.raises(TypeError):

@@ -827,3 +827,284 @@ def test_kv_transfer_refuses_oversize_and_lying_frames():
         assert len(srv.store) == 0
     finally:
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# hierarchical KV cache: peer prefix fetch (op: kv_fetch)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_fetch_wire_roundtrip_and_refusals():
+    """The /kv_fetch op at the wire level against a stub handler: block
+    rows come back byte-identical, a clean miss is (0, None) not an
+    error, and a geometry mismatch or unwired handler refuses loudly."""
+    from automodel_tpu.serving.fleet.kv_transfer import (
+        KVTransferError,
+        KVTransferServer,
+        fetch_kv,
+    )
+
+    geom = {
+        "layers": 1, "block_size": 4, "num_kv_heads": 1, "head_dim": 2,
+        "kv_cache_dtype": "bf16",
+    }
+    rows = {
+        "k": np.arange(16, dtype=np.float32).reshape(1, 2, 4, 1, 2),
+        "v": -np.arange(16, dtype=np.float32).reshape(1, 2, 4, 1, 2),
+    }
+    seen = []
+
+    def handler(hashes):
+        seen.append(list(hashes))
+        return 2, rows
+
+    srv = KVTransferServer(geom, port=0, fetch_handler=handler).start()
+    try:
+        n, kv = fetch_kv(("127.0.0.1", srv.port), [11, 22], geom)
+        assert n == 2 and seen == [[11, 22]]
+        for side in ("k", "v"):
+            assert kv[side].tobytes() == rows[side].tobytes()
+            assert kv[side].dtype == rows[side].dtype
+        with pytest.raises(KVTransferError, match="geometry mismatch"):
+            fetch_kv(("127.0.0.1", srv.port), [11],
+                     {**geom, "head_dim": 999})
+        srv.fetch_handler = lambda hashes: (0, None)
+        assert fetch_kv(("127.0.0.1", srv.port), [11], geom) == (0, None)
+        srv.fetch_handler = None
+        with pytest.raises(KVTransferError, match="no prefix fetches"):
+            fetch_kv(("127.0.0.1", srv.port), [11], geom)
+    finally:
+        srv.close()
+
+
+def test_router_peer_hint_deeper_holder_wins():
+    """_peer_hint forwards {host, port} only when another ready replica
+    advertises a STRICTLY deeper consecutive match AND runs a KV
+    listener; a KV-suspect replica never serves hints."""
+    prompt = list(range(1, 14))
+    chains = prompt_chain(prompt, 4)
+    chosen = _rep("chosen", hot=chains[:1], load=0)
+    deep = _rep("deep", hot=chains, load=5)
+    deep.kv_port = 8200
+    router = _fake_router([chosen, deep])
+    assert router._peer_hint(chains, chosen, 1, set()) == {
+        "host": "fake", "port": 8200,
+    }
+    # nobody deeper than the chosen replica's own match -> no hint
+    assert router._peer_hint(chains, chosen, len(chains), set()) is None
+    # a suspect KV listener (failed transfer target) never serves hints
+    assert router._peer_hint(chains, chosen, 1, {"deep"}) is None
+    # equal depth is not worth a fetch, nor is an empty chain
+    equal = _rep("equal", hot=chains[:1], load=0)
+    equal.kv_port = 8201
+    assert _fake_router([chosen, equal])._peer_hint(
+        chains, chosen, 1, set()
+    ) is None
+    assert router._peer_hint([], chosen, 0, set()) is None
+    # no KV listener advertised -> no hint
+    deep.kv_port = None
+    assert router._peer_hint(chains, chosen, 1, set()) is None
+
+
+@pytest.mark.parametrize("dtype", ["bf16", "int8"])
+def test_peer_prefix_fetch_bit_identity(dtype):
+    """A prefix first seen on engine A is served to cold engine B over a
+    real /kv_fetch socket: B's greedy tokens are bit-identical to A's
+    full recompute, the fetch is accounted token-weighted, and a repeat
+    on B hits locally (the injected prefix registered)."""
+    from automodel_tpu.serving.engine import KVSpillConfig
+    from automodel_tpu.serving.fleet.kv_transfer import KVTransferServer
+
+    spill = KVSpillConfig(enabled=True, max_host_mb=4.0)
+    a = _engine(kv_cache_dtype=dtype, kv_spill=spill)
+    prompt = list(range(1, 14))  # 13 tokens -> 3-block chain, 12 matchable
+    rid = a.submit(prompt, max_new_tokens=6)
+    ref = {r["request_id"]: r for r in a.run()}[rid]
+    lock = threading.Lock()
+
+    def handler(hashes):
+        with lock:
+            return a.fetch_prefix_blocks(hashes)
+
+    srv = KVTransferServer(
+        a.kv_geometry(), port=0, fetch_handler=handler,
+        max_frame_bytes=a.kv_frame_bytes_bound(),
+    ).start()
+    b = _engine(kv_cache_dtype=dtype, kv_spill=spill)
+    try:
+        rb = b.submit(
+            prompt, max_new_tokens=6,
+            kv_peer={"host": "127.0.0.1", "port": srv.port},
+        )
+        rec = {r["request_id"]: r for r in b.run()}[rb]
+        assert rec["tokens"] == ref["tokens"]
+        assert rec["completion_reason"] == ref["completion_reason"]
+        c = b.pool.counters
+        assert c["peer_fetches"] == 1
+        assert c["peer_fetch_blocks"] == 3
+        assert c["peer_fetch_failures"] == 0
+        assert rec["prefix_hit_tokens"] == 12
+        b.pool.check_invariants()
+        # the fetched prefix registered locally: a repeat needs no peer
+        r2 = b.submit(prompt, max_new_tokens=6)
+        rec2 = {r["request_id"]: r for r in b.run()}[r2]
+        assert rec2["tokens"] == ref["tokens"]
+        assert rec2["prefix_hit_tokens"] == 12
+        assert b.pool.counters["peer_fetches"] == 1  # unchanged
+        b.pool.check_invariants()
+    finally:
+        srv.close()
+
+
+def test_peer_fetch_mid_stream_death_recomputes():
+    """Chaos rung of the fallback ladder: the peer dies mid-reply (and
+    later refuses connections outright) — every request still completes
+    via local recompute with identical greedy output and the failures
+    accounted, never a hang or a wrong answer."""
+    import socket
+
+    from automodel_tpu.serving.engine import KVSpillConfig
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def _die_mid_frame():
+        conn, _ = lsock.accept()
+        conn.recv(64)               # start reading the request...
+        conn.sendall(b"AKV1\x00\x02")  # ...begin a reply frame, then vanish
+        conn.close()
+
+    t = threading.Thread(target=_die_mid_frame, daemon=True)
+    t.start()
+    eng = _engine(
+        kv_spill=KVSpillConfig(enabled=True, max_host_mb=4.0,
+                               fetch_timeout_s=10.0)
+    )
+    prompt = list(range(1, 14))
+    rid = eng.submit(
+        prompt, max_new_tokens=6,
+        kv_peer={"host": "127.0.0.1", "port": port},
+    )
+    rec = {r["request_id"]: r for r in eng.run()}[rid]
+    t.join(timeout=10)
+    lsock.close()
+    assert rec["completion_reason"] in ("stop", "length")
+    assert rec["prefix_hit_tokens"] == 0  # nothing served from any tier
+    assert eng.pool.counters["peer_fetch_failures"] == 1
+    assert eng.pool.counters["peer_fetch_blocks"] == 0
+    eng.pool.check_invariants()
+    # same engine, recompute reference: clear every tier, re-serve
+    eng.pool.clear_prefix_cache()
+    r2 = eng.submit(prompt, max_new_tokens=6)
+    ref = {r["request_id"]: r for r in eng.run()}[r2]
+    assert rec["tokens"] == ref["tokens"]
+    # dead peer (connection refused): same ladder, second failure
+    eng.pool.clear_prefix_cache()
+    r3 = eng.submit(
+        prompt, max_new_tokens=6,
+        kv_peer={"host": "127.0.0.1", "port": port},
+    )
+    rec3 = {r["request_id"]: r for r in eng.run()}[r3]
+    assert rec3["tokens"] == ref["tokens"]
+    assert eng.pool.counters["peer_fetch_failures"] == 2
+    eng.pool.check_invariants()
+
+
+def _http_json_raw(port, path, payload=None, timeout=120.0):
+    import urllib.request
+
+    url = f"http://127.0.0.1:{port}{path}"
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _spawn_spill_replica(tmp_path, idx):
+    from tests.test_serving_chaos import _clean_env
+
+    worker = str(Path(__file__).resolve().parent / "resilience_worker.py")
+    cfg = {
+        "seed": 0,
+        "model": {
+            "hf_config": {
+                "architectures": ["LlamaForCausalLM"],
+                "model_type": "llama",
+                "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+                "num_hidden_layers": 2, "num_attention_heads": 4,
+                "num_key_value_heads": 2, "head_dim": 8,
+                "max_position_embeddings": 128,
+            },
+            "backend": {"attn": "sdpa", "param_dtype": "float32",
+                        "compute_dtype": "float32"},
+        },
+        "distributed": {"dp_shard": 1},
+        "generation": {"max_new_tokens": 6, "greedy": True},
+        "serving": {
+            "slots": 1, "block_size": 4, "num_blocks": 32,
+            "prefill_chunk": 4, "max_seq_len": 64,
+            "http": {"port": 0},
+            "watchdog": {"enabled": False},
+            # kv_spill auto-starts the KV listener (serving.kv_transfer
+            # enabled: null) and wires the engine-backed fetch handler
+            "kv_spill": {"enabled": True, "max_host_mb": 4.0},
+        },
+    }
+    cfg_path = tmp_path / f"spill_replica{idx}.yaml"
+    cfg_path.write_text(json.dumps(cfg))
+    return subprocess.Popen(
+        [sys.executable, worker, "serve", "-c", str(cfg_path)],
+        stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=_clean_env(),
+    )
+
+
+def test_peer_prefix_fetch_across_replica_processes(tmp_path):
+    """Acceptance (ISSUE 16): a prefix first seen on replica process A is
+    served to replica process B via /kv_fetch — two REAL serve
+    subprocesses, real sockets on both hops, greedy output bit-identical,
+    the fetch visible in B's /stats."""
+    from tests.test_serving_chaos import _replica_port
+
+    procs = [_spawn_spill_replica(tmp_path, i) for i in range(2)]
+    try:
+        ports = [_replica_port(p) for p in procs]
+        prompt = list(range(1, 14))  # 3-block chain, 12 matchable tokens
+        body_a = _http_json_raw(
+            ports[0], "/generate",
+            {"prompt_ids": prompt, "max_new_tokens": 6, "id": "a"},
+        )
+        assert body_a["completion_reason"] in ("stop", "length")
+        stats_a = _http_json_raw(ports[0], "/stats")
+        kv_port = stats_a["kv_transfer_port"]
+        assert kv_port, "spill-enabled replica must run a KV listener"
+        assert stats_a["spill_bytes"] is not None
+        body_b = _http_json_raw(
+            ports[1], "/generate",
+            {"prompt_ids": prompt, "max_new_tokens": 6, "id": "b",
+             "kv_peer": {"host": "127.0.0.1", "port": kv_port}},
+        )
+        assert body_b["tokens"] == body_a["tokens"]
+        assert body_b["completion_reason"] == body_a["completion_reason"]
+        assert body_b["prefix_hit_tokens"] == 12
+        alloc_b = _http_json_raw(ports[1], "/stats")["allocator"]
+        assert alloc_b["peer_fetches"] == 1
+        assert alloc_b["peer_fetch_blocks"] == 3
+        assert alloc_b["peer_fetch_failures"] == 0
+        assert alloc_b["prefix_hit_tokens"] == 12
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
