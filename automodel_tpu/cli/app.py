@@ -23,7 +23,8 @@ def _usage() -> str:
         "-c config.yaml [--dotted.key=value ...]\n"
         "       automodel_tpu generate -c config.yaml [--prompt '...'] [--dotted.key=value ...]\n"
         "       automodel_tpu serve -c config.yaml [--dotted.key=value ...]  (stdin-JSONL; serving.http.port for HTTP; GET /metrics /healthz /readyz; SIGTERM drains gracefully)\n"
-        "       automodel_tpu route -c config.yaml [--dotted.key=value ...]  (fleet router over N serve replicas: fleet.replicas/fleet.dns; prefix-affinity + retry; same HTTP front contract)\n"
+        "       automodel_tpu route -c config.yaml [--dotted.key=value ...]  (fleet router over N serve replicas: fleet.replicas/fleet.dns; prefix-affinity + retry; same HTTP front contract; slo: section arms burn-rate alerting)\n"
+        "       automodel_tpu fleet-status [-c config.yaml] [--router URL] [--watch] [--json]  (live per-replica health table: role/ready/queue/occupancy/hit-rate/accept-rate/firing SLOs, from the router's federated state or direct replica probes)\n"
         "       automodel_tpu profile -c config.yaml [--profiling.mode=train|generate] [--dotted.key=value ...]\n"
         "       automodel_tpu report <train_metrics.jsonl> [--strict]\n"
         "       automodel_tpu goodput <run-dir | goodput.jsonl> [--json]  (wall-clock decomposition of a training run across restart attempts; joins flight-recorder hang/desync evidence)\n"
@@ -110,6 +111,14 @@ def main(argv: list[str] | None = None) -> int:
 
         cfg = parse_args_and_load_config(argv[1:])
         return route_main(cfg)
+    # `fleet-status` renders the live per-replica health table (role,
+    # readiness, queue depth, occupancy, hit/accept rates, firing SLOs)
+    # from the router's federated /stats — or probes replicas directly
+    # when no router runs. Plain argparse, no config machinery, no jax.
+    if argv and argv[0] == "fleet-status":
+        from automodel_tpu.serving.fleet.status import main as status_main
+
+        return status_main(argv[1:])
     # `profile` opens a jax.profiler trace window around N steps of the
     # configured workload and GENERATES the PROFILE artifacts (structured
     # report.json + PROFILE.md) — telemetry/profiling/runner.py

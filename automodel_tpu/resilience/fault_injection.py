@@ -31,6 +31,11 @@ wrong, deterministically, on CPU, in tier-1. Four fault classes:
   check's detect-and-name-the-culprit path
 - ``straggle_host``/``straggle_ms`` — sleep ``straggle_ms`` per step on one
   host, driving the straggler-attribution metrics (``slowest_host``)
+- ``slo_breach_stage``/``slo_breach_ms``/``slo_breach_from_step``/
+  ``slo_breach_for_s`` — inflate the named serving stage by that many ms
+  from scheduler step N for a bounded wall-clock window, so the fleet SLO
+  engine's pending→firing→resolved lifecycle (telemetry/slo.py) is
+  drivable end-to-end in tier-1
 
 Activation: a ``fault_injection:`` YAML section (recipes call
 ``activate_from_config``) or the ``AUTOMODEL_FAULT_INJECTION`` env var
@@ -101,6 +106,17 @@ class FaultInjectionConfig:
     # histogram must charge the delay to exactly that stage
     trace_delay_stage: Optional[str] = None
     trace_delay_ms: float = 0.0
+    # SLO forced-breach knob (telemetry/slo.py e2e proof): inflate the
+    # named serving stage (prefill -> ttft, decode -> decode_tps) by
+    # slo_breach_ms per execution, starting at scheduler step
+    # slo_breach_from_step and lasting slo_breach_for_s of wall clock from
+    # the first inflated execution (None = forever). The bounded wall-clock
+    # window is what makes alert FIRE **and** RESOLVE drivable in one
+    # tier-1 process lifetime: steps race under load, wall time does not.
+    slo_breach_stage: Optional[str] = None
+    slo_breach_ms: float = 0.0
+    slo_breach_from_step: int = 0
+    slo_breach_for_s: Optional[float] = None
 
 
 def _process_index() -> int:
@@ -118,6 +134,9 @@ class FaultInjector:
         self._io_attempts: dict[str, int] = {}
         self._hung = False
         self._serve_hung = False
+        # slo_breach_for_s window bookkeeping (maybe_slo_breach)
+        self._breach_started_t: Optional[float] = None
+        self._breach_closed = False
 
     # -- step-loop hooks ----------------------------------------------------
     def maybe_die(self, step: int) -> None:
@@ -200,6 +219,38 @@ class FaultInjector:
 
             time.sleep(c.trace_delay_ms / 1000.0)
 
+    def maybe_slo_breach(self, stage: str, step: int) -> None:
+        """Inflate the named serving stage inside its breach window (called
+        where the engine executes prefill/decode, beside
+        ``maybe_trace_delay``). The delay is a GIL-releasing sleep — the
+        inflated latency is REAL at the request level, so the /metrics
+        histograms the SLO engine federates see it exactly like a slow
+        model would produce it."""
+        c = self.config
+        if c.slo_breach_stage != stage or c.slo_breach_ms <= 0:
+            return
+        if step < c.slo_breach_from_step:
+            return
+        import time
+
+        if c.slo_breach_for_s is not None:
+            if self._breach_started_t is None:
+                self._breach_started_t = time.monotonic()
+                logger.error(
+                    "fault injection: SLO breach window opened at serving "
+                    "step %d (+%.0fms per %s for %.1fs)",
+                    step, c.slo_breach_ms, stage, c.slo_breach_for_s,
+                )
+            elif time.monotonic() - self._breach_started_t >= c.slo_breach_for_s:
+                if not self._breach_closed:
+                    self._breach_closed = True
+                    logger.error(
+                        "fault injection: SLO breach window closed at "
+                        "serving step %d", step,
+                    )
+                return
+        time.sleep(c.slo_breach_ms / 1000.0)
+
     def maybe_straggle(self, step: int) -> None:
         c = self.config
         if c.straggle_host is None or c.straggle_ms <= 0:
@@ -280,6 +331,7 @@ def activate(config: FaultInjectionConfig | dict | None) -> Optional[FaultInject
         or config.serve_exception_at_step is not None
         or config.serve_exhaust_blocks_at_step is not None
         or (config.trace_delay_stage is not None and config.trace_delay_ms > 0)
+        or (config.slo_breach_stage is not None and config.slo_breach_ms > 0)
     )
     if not armed:
         # an empty `fault_injection: {}` section (the docs' example form)

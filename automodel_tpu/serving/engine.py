@@ -1504,6 +1504,7 @@ class ServingEngine:
             t_chunk0 = time.perf_counter()
             if inj is not None:
                 inj.maybe_trace_delay("prefill")
+                inj.maybe_slo_breach("prefill", self._step_counter)
             if self.collect_program_costs and "chunk_prefill" not in self.program_costs:
                 self._record_cost(
                     "chunk_prefill", self._chunk,
@@ -1584,6 +1585,7 @@ class ServingEngine:
             # lands inside every traced request's decode window (t_first →
             # terminal), so the delay attributes to the decode stage
             inj.maybe_trace_delay("decode")
+            inj.maybe_slo_breach("decode", self._step_counter)
         if self._spec_enabled:
             return self._spec_decode_tick()
         params = self.auto.params

@@ -228,6 +228,29 @@ def _http_json(
         raise ReplicaUnreachable(f"{url}: {e}") from e
 
 
+def _prefix_hit_rate(stats: dict) -> Optional[float]:
+    """Token-weighted prefix-hit rate from a replica's /stats allocator
+    counters (None until the replica saw matchable prompt tokens)."""
+    alloc = stats.get("allocator") or {}
+    hit = alloc.get("prefix_hit_tokens") or 0
+    miss = alloc.get("prefix_miss_tokens") or 0
+    return hit / (hit + miss) if (hit + miss) > 0 else None
+
+
+def _http_text(url: str, timeout_s: float) -> str:
+    """One GET → decoded body (the replica /metrics scrape — Prometheus
+    text, not JSON). TCP-level failures raise :class:`ReplicaUnreachable`;
+    a non-200 answer does too (there is no structured body to salvage)."""
+    req = urllib.request.Request(url)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            if resp.status != 200:
+                raise ReplicaUnreachable(f"{url}: HTTP {resp.status}")
+            return resp.read().decode("utf-8", errors="replace")
+    except (OSError, urllib.error.URLError, ValueError) as e:
+        raise ReplicaUnreachable(f"{url}: {e}") from e
+
+
 class RouterMetrics:
     """The router's /metrics surface (telemetry/prometheus.py registry):
     the ISSUE-named counters plus per-replica health gauges."""
@@ -309,6 +332,8 @@ class Router:
         tokenizer: Any = None,
         on_record: Optional[Callable[[dict], None]] = None,
         tracer: Any = None,
+        slo_config: Any = None,
+        flight_recorder: Any = None,
     ):
         self.config = config
         self.tokenizer = tokenizer
@@ -325,6 +350,28 @@ class Router:
         from automodel_tpu.telemetry.tracing import WallAnchor
 
         self._clock = tracer.clock if tracer is not None else WallAnchor()
+        # fleet health plane (telemetry/federation.py + slo.py): every
+        # probe sweep also scrapes each replica's /metrics, rolls the
+        # snapshots into fleet-level series, and (when an `slo:` section is
+        # configured) evaluates the burn-rate objectives against them
+        from automodel_tpu.telemetry.federation import Federation
+
+        retention = (
+            slo_config.retention_s if slo_config is not None else 900.0
+        )
+        self.federation = Federation(retention_s=retention)
+        self.slo = None
+        if slo_config is not None and slo_config.objectives:
+            from automodel_tpu.telemetry.slo import SLOEngine
+
+            self.slo = SLOEngine(
+                slo_config,
+                self.federation,
+                registry=self.metrics.registry,
+                emit=on_record,
+                flight_recorder=flight_recorder,
+                wall=self._clock.wall,
+            )
         self._lock = threading.Lock()
         self._replicas: dict[str, _Replica] = {}
         for spec in config.replicas:
@@ -408,6 +455,15 @@ class Router:
             t.join()
         ready = sum(1 for r in reps if r.ready)
         self.metrics.replicas_ready.set(ready)
+        # health plane tick: fold this sweep's scrapes into the fleet
+        # series, then judge the SLO objectives against them. Both are
+        # bounded host-side work; a bug in either must not kill probing.
+        try:
+            self.federation.roll(time.monotonic())
+            if self.slo is not None:
+                self.slo.evaluate(time.monotonic())
+        except Exception:
+            logger.exception("fleet health-plane tick failed")
         if self.tracer is not None:
             # probe sweeps are router-lifecycle work, not request work:
             # each sweep is its own single-span trace (sampled like any
@@ -434,6 +490,23 @@ class Router:
             ready = code == 200
         except ReplicaUnreachable:
             alive, ready = False, False
+        # fleet health plane: the /metrics scrape rides the same sweep — a
+        # replica that answers probes but whose scrape fails (or fails to
+        # parse) just drops out of this sweep's rollup; routing is
+        # unaffected
+        if alive:
+            try:
+                body = _http_text(
+                    rep.url + "/metrics", self.config.probe_timeout_s
+                )
+                self.federation.ingest(rep.name, body, time.monotonic())
+            except ReplicaUnreachable as e:
+                logger.warning("replica %s /metrics scrape failed: %s", rep.name, e)
+                self.federation.mark_down(rep.name)
+            except ValueError as e:  # ExpositionParseError — counted inside
+                logger.warning("replica %s /metrics unparseable: %s", rep.name, e)
+        else:
+            self.federation.mark_down(rep.name)
         with self._lock:
             rep.alive, rep.ready = alive, ready
             rep.last_probe_t = time.monotonic()
@@ -972,10 +1045,13 @@ class Router:
                     "shed_total": r.stats.get("shed_total"),
                     "hot_prefixes": len(r.hot),
                     "kv_transfer_port": r.kv_port,
+                    # fleet-status columns (serving/fleet/status.py)
+                    "spec_accept_rate": r.stats.get("spec_accept_rate"),
+                    "prefix_hit_rate": _prefix_hit_rate(r.stats),
                 }
                 for r in self._replicas.values()
             }
-            return {
+            out = {
                 "replicas": reps,
                 "replicas_ready": sum(1 for r in reps.values() if r["ready"]),
                 "requests_total": self.requests_total,
@@ -988,6 +1064,11 @@ class Router:
                 "disaggregated": self._disaggregate_active_unlocked(),
                 "draining": self.draining,
             }
+        out["federation"] = self.federation.status()
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
+            out["alerts_firing"] = self.slo.firing()
+        return out
 
     def _disaggregate_active_unlocked(self) -> bool:
         if self.config.disaggregate is False:
@@ -1102,7 +1183,14 @@ def serve_router_http(
             if self.path == "/metrics":
                 from automodel_tpu.telemetry.prometheus import CONTENT_TYPE
 
-                body = router.metrics.registry.render().encode()
+                # the router's own registry, then the federation block:
+                # every replica sample re-exported with a `replica` label
+                # plus the automodel_fleet_* aggregates (name sets are
+                # disjoint, so the concatenation stays one valid exposition)
+                body = (
+                    router.metrics.registry.render()
+                    + router.federation.render_federated()
+                ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(body)))
@@ -1189,8 +1277,31 @@ def main(cfg: Any) -> int:
     tracer = Tracer.from_config(
         tracing_cfg, process=f"router-{os_mod.getpid()}", emit=on_record
     )
+    # fleet health plane: a strict `slo:` section arms burn-rate alerting
+    # over the federated replica scrapes; alert transitions land in the
+    # metrics JSONL and a flight-recorder ring next to it
+    slo_cfg = None
+    slo_section = dict(cfg.get("slo", {}) or {})
+    if slo_section:
+        from automodel_tpu.telemetry.slo import SLOConfig
+
+        slo_cfg = SLOConfig.from_dict(slo_section)
+    flight_recorder = None
+    if slo_cfg is not None and logging_section.get("metrics_path"):
+        from pathlib import Path as _Path
+
+        from automodel_tpu.telemetry.flight_recorder import FlightRecorder
+
+        flight_recorder = FlightRecorder(
+            capacity=64,
+            path=str(
+                _Path(logging_section["metrics_path"]).parent
+                / "router_flight_recorder.json"
+            ),
+        )
     router = Router(
-        fcfg, tokenizer=tokenizer, on_record=on_record, tracer=tracer
+        fcfg, tokenizer=tokenizer, on_record=on_record, tracer=tracer,
+        slo_config=slo_cfg, flight_recorder=flight_recorder,
     )
     router.start()
     server = serve_router_http(router, fcfg.port, host=fcfg.host)

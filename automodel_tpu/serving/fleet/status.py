@@ -1,0 +1,242 @@
+"""`automodel_tpu fleet-status` — the live fleet-status surface.
+
+Renders the per-replica health table (role, readiness, queue depth, block
+occupancy, prefix-hit rate, speculative accept rate, firing SLOs) either
+point-in-time or live (``--watch``). Two sources, tried in this order:
+
+- **router mode** (``--router URL``, or the ``fleet.port`` of ``-c``):
+  one GET /stats against the router returns the federated view the probe
+  loop already maintains — per-replica load + the SLO engine's alert
+  states. This is the normal operator path.
+- **direct mode** (no router listening, or ``--direct``): the CLI probes
+  each ``fleet.replicas`` URL's /readyz + /stats itself. No SLO column —
+  objectives are judged by the router's health loop, not per replica.
+
+jax-free by construction (same rule as the router): importable and
+runnable on a laptop against a remote fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Optional
+
+from automodel_tpu.serving.fleet.router import (
+    FleetConfig,
+    ReplicaUnreachable,
+    _http_json,
+    _prefix_hit_rate,
+)
+
+_COLUMNS = (
+    "REPLICA", "ROLE", "READY", "QUEUE", "BUSY", "OCC", "HIT%", "ACC%",
+    "ALERTS",
+)
+
+
+def _fmt_pct(v: Optional[float]) -> str:
+    return "-" if v is None else f"{100.0 * v:.0f}%"
+
+
+def _fmt_num(v: Any) -> str:
+    return "-" if v is None else str(v)
+
+
+def _fmt_occ(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v:.2f}"
+
+
+def _router_snapshot(router_url: str, timeout_s: float) -> dict:
+    _, stats = _http_json(router_url + "/stats", None, timeout_s)
+    return stats
+
+
+def _direct_snapshot(fcfg: FleetConfig, timeout_s: float) -> dict:
+    """The router-/stats shape, assembled by probing replicas directly —
+    the table renderer sees one format either way."""
+    reps: dict[str, dict] = {}
+    for spec in fcfg.replicas:
+        name = spec.name or spec.url
+        row: dict[str, Any] = {
+            "url": spec.url, "role": spec.role or "mixed",
+            "alive": False, "ready": False,
+            "queue_depth": None, "busy_slots": None,
+            "block_occupancy": None, "prefix_hit_rate": None,
+            "spec_accept_rate": None, "shed_total": None,
+        }
+        try:
+            code, _ = _http_json(spec.url + "/readyz", None, timeout_s)
+            row["alive"] = True
+            row["ready"] = code == 200
+            _, stats = _http_json(spec.url + "/stats", None, timeout_s)
+            row.update({
+                "role": spec.role or stats.get("role") or row["role"],
+                "queue_depth": stats.get("queue_depth"),
+                "busy_slots": stats.get("busy_slots"),
+                "block_occupancy": stats.get("block_occupancy"),
+                "shed_total": stats.get("shed_total"),
+                "prefix_hit_rate": _prefix_hit_rate(stats),
+                "spec_accept_rate": stats.get("spec_accept_rate"),
+            })
+        except ReplicaUnreachable:
+            pass
+        reps[name] = row
+    return {
+        "replicas": reps,
+        "replicas_ready": sum(1 for r in reps.values() if r["ready"]),
+        "source": "direct",
+    }
+
+
+def _alerts_for(stats: dict) -> str:
+    slo = stats.get("slo")
+    if not slo:
+        return "-"
+    firing = sorted(
+        name for name, st in slo.items() if st.get("state") == "firing"
+    )
+    pending = sorted(
+        name for name, st in slo.items() if st.get("state") == "pending"
+    )
+    parts = [f"{n}!" for n in firing] + [f"{n}?" for n in pending]
+    return ",".join(parts) if parts else "ok"
+
+
+def render_table(stats: dict) -> str:
+    """The per-replica table + an SLO footer, as one printable block."""
+    rows = [list(_COLUMNS)]
+    alerts = _alerts_for(stats)
+    for name, r in sorted((stats.get("replicas") or {}).items()):
+        rows.append([
+            name,
+            str(r.get("role") or "-"),
+            "yes" if r.get("ready") else ("down" if not r.get("alive") else "no"),
+            _fmt_num(r.get("queue_depth")),
+            _fmt_num(r.get("busy_slots")),
+            _fmt_occ(r.get("block_occupancy")),
+            _fmt_pct(r.get("prefix_hit_rate")),
+            _fmt_pct(r.get("spec_accept_rate")),
+            alerts,
+        ])
+    widths = [max(len(row[i]) for row in rows) for i in range(len(_COLUMNS))]
+    lines = [
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in rows
+    ]
+    slo = stats.get("slo")
+    if slo:
+        lines.append("")
+        lines.append("SLO objectives:")
+        for name, st in sorted(slo.items()):
+            v = st.get("value")
+            th = st.get("threshold")
+            lines.append(
+                f"  {name:<24} {st.get('state', '?'):<9} "
+                f"value={'-' if v is None else f'{v:.4g}'} "
+                f"threshold={'-' if th is None else f'{th:.4g}'} "
+                f"fired={st.get('fired_count', 0)}"
+            )
+    ready = stats.get("replicas_ready")
+    total = len(stats.get("replicas") or {})
+    lines.append("")
+    lines.append(f"{ready}/{total} replicas ready")
+    return "\n".join(lines)
+
+
+def _load_fleet_config(path: str) -> FleetConfig:
+    from automodel_tpu.config.loader import load_yaml_config
+
+    cfg = load_yaml_config(path)
+    return FleetConfig.from_dict(dict(cfg.get("fleet", {}) or {}))
+
+
+def snapshot(
+    router_url: Optional[str],
+    fcfg: Optional[FleetConfig],
+    timeout_s: float,
+    direct: bool = False,
+) -> dict:
+    """One status snapshot: router /stats when a router answers, else a
+    direct replica sweep (the no-router path the docstring promises)."""
+    if router_url and not direct:
+        try:
+            stats = _router_snapshot(router_url, timeout_s)
+            stats["source"] = "router"
+            return stats
+        except ReplicaUnreachable:
+            if fcfg is None or not fcfg.replicas:
+                raise
+    if fcfg is None or not fcfg.replicas:
+        raise ReplicaUnreachable(
+            "no router answered and no fleet.replicas to probe directly"
+        )
+    return _direct_snapshot(fcfg, timeout_s)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="automodel_tpu fleet-status",
+        description="Per-replica fleet health table (router-federated or "
+        "probed directly).",
+    )
+    p.add_argument("-c", "--config", help="YAML with a fleet: section")
+    p.add_argument(
+        "--router",
+        help="router base URL (default: http://127.0.0.1:<fleet.port> "
+        "from -c)",
+    )
+    p.add_argument(
+        "--direct", action="store_true",
+        help="skip the router, probe fleet.replicas directly",
+    )
+    p.add_argument("--json", action="store_true", help="raw snapshot JSON")
+    p.add_argument(
+        "--watch", action="store_true", help="refresh every --interval s"
+    )
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument(
+        "--iterations", type=int, default=0,
+        help="with --watch: stop after N refreshes (0 = until ^C)",
+    )
+    p.add_argument("--timeout", type=float, default=3.0)
+    args = p.parse_args(argv)
+
+    fcfg = None
+    router_url = args.router
+    if args.config:
+        try:
+            fcfg = _load_fleet_config(args.config)
+        except (OSError, TypeError, ValueError) as e:
+            print(f"fleet-status: bad config {args.config}: {e}", file=sys.stderr)
+            return 2
+        if router_url is None and fcfg.port is not None:
+            router_url = f"http://{fcfg.host}:{fcfg.port}"
+    if router_url is None and fcfg is None:
+        print(
+            "fleet-status: need --router URL or -c config.yaml with a "
+            "fleet: section", file=sys.stderr,
+        )
+        return 2
+
+    n = 0
+    while True:
+        try:
+            stats = snapshot(router_url, fcfg, args.timeout, direct=args.direct)
+        except ReplicaUnreachable as e:
+            print(f"fleet-status: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(stats, indent=2, default=str))
+        else:
+            print(render_table(stats))
+        n += 1
+        if not args.watch or (args.iterations and n >= args.iterations):
+            return 0
+        print(f"--- refresh in {args.interval:g}s (^C to stop) ---")
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0

@@ -104,6 +104,103 @@ def _drain_exit_code(drain_cfg: Any) -> int:
     return REQUEUE_EXIT_CODE if under_launcher else 0
 
 
+# /stats key → the /metrics family carrying the same fact. The drift guard
+# (tests/test_fleet_health.py) walks this table both ways: every /stats key
+# must appear here, and every serve-family metric must be reachable from it
+# or listed in STATS_METRICS_ONLY. None marks info keys with no numeric
+# metric; a tuple means the stats value is the SUM of those families;
+# "allocator" fans out to automodel_serve_block_<counter-key> per entry.
+STATS_METRIC_EQUIV = {
+    "queue_depth": "automodel_serve_queue_depth",
+    "busy_slots": (
+        "automodel_serve_running_slots",
+        "automodel_serve_prefilling_slots",
+    ),
+    "completed_total": "automodel_serve_requests_completed",
+    "failed_total": "automodel_serve_requests_failed",
+    "shed_total": "automodel_serve_requests_shed",
+    "timeout_total": "automodel_serve_requests_timeout",
+    "stall_total": "automodel_serve_engine_stalls",
+    "error_total": "automodel_serve_engine_errors",
+    "draining": "automodel_serve_draining",
+    "drain_duration_s": "automodel_serve_drain_duration_seconds",
+    "block_occupancy": "automodel_serve_block_occupancy",
+    "blocks_in_use": "automodel_serve_blocks_in_use",
+    "allocator": "automodel_serve_block_*",
+    "decode_backend": None,
+    "kv_cache_dtype": None,
+    "spec_proposed_total": (
+        "automodel_serve_spec_accepted",
+        "automodel_serve_spec_rejected",
+    ),
+    "spec_accepted_total": "automodel_serve_spec_accepted",
+    "spec_accept_rate": "automodel_serve_spec_accept_rate",
+    "role": None,
+    "block_size": None,
+    "kv_transfer_port": None,
+    "kv_injected_total": "automodel_serve_kv_injected",
+    "hot_prefixes": None,
+    "spill_bytes": "automodel_serve_spill_bytes",
+    "spill_entries": "automodel_serve_spill_entries",
+}
+
+# Families deliberately absent from /stats: per-request distributions have
+# no single-number snapshot (histograms), and generated_tokens is observed
+# per completion record rather than tracked on the engine.
+STATS_METRICS_ONLY = (
+    "automodel_serve_ttft_seconds",
+    "automodel_serve_decode_tps",
+    "automodel_serve_queue_seconds",
+    "automodel_serve_stage_seconds",
+    "automodel_serve_generated_tokens",
+)
+
+
+def stats_snapshot(engine: Any) -> dict:
+    """The GET /stats body. Factored out of the handler so the drift guard
+    can build it against a bare engine; call under the engine-loop lock
+    when the scheduler is live."""
+    return {
+        "queue_depth": engine.queue_depth,
+        "busy_slots": engine.busy_slots,
+        "completed_total": engine.completed_total,
+        "failed_total": engine.failed_total,
+        "shed_total": engine.shed_total,
+        "timeout_total": engine.timeout_total,
+        "stall_total": engine.stall_total,
+        "error_total": engine.error_total,
+        "draining": engine.draining,
+        "drain_duration_s": engine.drain_duration_s,
+        "block_occupancy": engine.pool.occupancy(),
+        "blocks_in_use": engine.pool.in_use(),
+        "allocator": dict(engine.pool.counters),
+        "decode_backend": engine.decode_backend,
+        "kv_cache_dtype": engine.config.kv_cache_dtype,
+        "spec_proposed_total": engine.spec_proposed_total,
+        "spec_accepted_total": engine.spec_accepted_total,
+        "spec_accept_rate": engine.spec_accept_rate,
+        # fleet tier (serving/fleet/router.py probes these): role for pool
+        # membership, block_size so the router can refuse affinity on a
+        # geometry mismatch, hot_prefixes for prefix-affinity placement,
+        # kv_transfer_port for the prefill→decode handoff
+        "role": engine.config.role,
+        "block_size": engine.config.block_size,
+        "kv_transfer_port": engine.kv_transfer_port,
+        "kv_injected_total": engine.kv_injected_total,
+        "hot_prefixes": engine.hot_prefixes(),
+        # hierarchical KV cache: host-tier occupancy (null when
+        # serving.kv_spill is off; counters ride "allocator")
+        "spill_bytes": (
+            engine.pool.spill.bytes
+            if engine.pool.spill is not None else None
+        ),
+        "spill_entries": (
+            len(engine.pool.spill)
+            if engine.pool.spill is not None else None
+        ),
+    }
+
+
 _OK_REASONS = ("stop", "length")
 
 
@@ -323,45 +420,7 @@ def serve_http(
             if self.path != "/stats":
                 return self._json(404, {"error": f"unknown path {self.path}"})
             with loop.lock:
-                self._json(200, {
-                    "queue_depth": engine.queue_depth,
-                    "busy_slots": engine.busy_slots,
-                    "completed_total": engine.completed_total,
-                    "failed_total": engine.failed_total,
-                    "shed_total": engine.shed_total,
-                    "timeout_total": engine.timeout_total,
-                    "stall_total": engine.stall_total,
-                    "error_total": engine.error_total,
-                    "draining": engine.draining,
-                    "drain_duration_s": engine.drain_duration_s,
-                    "block_occupancy": engine.pool.occupancy(),
-                    "allocator": dict(engine.pool.counters),
-                    "decode_backend": engine.decode_backend,
-                    "kv_cache_dtype": engine.config.kv_cache_dtype,
-                    "spec_proposed_total": engine.spec_proposed_total,
-                    "spec_accepted_total": engine.spec_accepted_total,
-                    "spec_accept_rate": engine.spec_accept_rate,
-                    # fleet tier (serving/fleet/router.py probes these):
-                    # role for pool membership, block_size so the router can
-                    # refuse affinity on a geometry mismatch, hot_prefixes
-                    # for prefix-affinity placement, kv_transfer_port for
-                    # the prefill→decode handoff
-                    "role": engine.config.role,
-                    "block_size": engine.config.block_size,
-                    "kv_transfer_port": engine.kv_transfer_port,
-                    "kv_injected_total": engine.kv_injected_total,
-                    "hot_prefixes": engine.hot_prefixes(),
-                    # hierarchical KV cache: host-tier occupancy (null when
-                    # serving.kv_spill is off; counters ride "allocator")
-                    "spill_bytes": (
-                        engine.pool.spill.bytes
-                        if engine.pool.spill is not None else None
-                    ),
-                    "spill_entries": (
-                        len(engine.pool.spill)
-                        if engine.pool.spill is not None else None
-                    ),
-                })
+                self._json(200, stats_snapshot(engine))
 
         def _read_req(self) -> dict:
             n = int(self.headers.get("Content-Length", 0))

@@ -1,0 +1,631 @@
+"""Fleet health plane, scrape side: /metrics federation for the router.
+
+Three pieces, all dependency-free (stdlib only — the router process must
+stay jax-free and the container adds no prometheus client):
+
+- ``parse_exposition`` — the exact inverse of
+  ``prometheus.MetricsRegistry.render()`` (text format 0.0.4). Round-trip
+  pinned: ``render_exposition(parse_exposition(body)) == body`` for every
+  body our renderer can produce, and the parser additionally accepts the
+  escapes/timestamps third-party exporters emit.
+- ``SeriesRing`` — a bounded in-memory time series per (metric, labels,
+  sample-suffix): enough retention for the SLO engine's slow burn-rate
+  window, pruned on every append so memory is O(retention / scrape
+  interval) regardless of run length.
+- ``Federation`` — per-replica snapshots ingested on the router's probe
+  cadence, rolled into fleet-level series (counter/histogram sums, gauge
+  sum+max) and re-exported on the router's /metrics: every replica sample
+  with a ``replica`` label, plus ``automodel_fleet_*`` aggregates
+  (docs/observability.md "Fleet health plane" documents the name rule).
+
+The SLO engine (telemetry/slo.py) reads windowed increases off the fleet
+series; the ``fleet-status`` CLI reads the same parsed snapshots.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import threading
+from typing import Iterable, Optional
+
+from automodel_tpu.telemetry.prometheus import _fmt
+
+__all__ = [
+    "ParsedHistogram",
+    "ParsedMetric",
+    "ExpositionParseError",
+    "parse_exposition",
+    "render_exposition",
+    "SeriesRing",
+    "Federation",
+    "fleet_name",
+]
+
+
+class ExpositionParseError(ValueError):
+    """A line the exposition grammar does not admit (the scrape is
+    rejected whole: a half-parsed snapshot must never feed an SLO)."""
+
+
+@dataclasses.dataclass
+class ParsedHistogram:
+    """One histogram child (one label tuple): cumulative bucket counts in
+    ``le`` order, the ``+Inf`` count folded in as the last entry."""
+
+    buckets: list[tuple[float, float]] = dataclasses.field(default_factory=list)
+    sum: float = 0.0
+    count: float = 0.0
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Linear-interpolated quantile from cumulative buckets (the
+        standard histogram_quantile rule). None when empty."""
+        return _bucket_quantile(self.buckets, self.count, q)
+
+
+@dataclasses.dataclass
+class ParsedMetric:
+    """One metric family: scalar samples for counters/gauges/untyped,
+    histogram children for histograms. ``name`` is the FAMILY name — a
+    counter's ``_total`` suffix is stripped on parse and re-added on
+    render, mirroring prometheus.py's ``render_name``."""
+
+    name: str
+    kind: str = "untyped"  # counter | gauge | histogram | untyped
+    help: str = ""
+    # label tuple (sorted (label, value) pairs) -> value
+    samples: dict[tuple, float] = dataclasses.field(default_factory=dict)
+    histograms: dict[tuple, ParsedHistogram] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+def _bucket_quantile(
+    buckets: list[tuple[float, float]], count: float, q: float
+) -> Optional[float]:
+    if count <= 0 or not buckets:
+        return None
+    rank = q * count
+    prev_le, prev_cum = None, 0.0
+    for le, cum in buckets:
+        if cum >= rank:
+            if math.isinf(le):
+                # the spec rule: an observation past the last finite
+                # bucket reports that bucket's bound
+                return prev_le if prev_le is not None else le
+            if prev_le is None or cum == prev_cum:
+                return le
+            lo = prev_le
+            return lo + (le - lo) * (rank - prev_cum) / (cum - prev_cum)
+        prev_le, prev_cum = le, cum
+    return buckets[-1][0] if not math.isinf(buckets[-1][0]) else prev_le
+
+
+# -- parsing -------------------------------------------------------------------
+
+
+def _unescape(s: str) -> str:
+    out, i, n = [], 0, len(s)
+    while i < n:
+        c = s[i]
+        if c == "\\" and i + 1 < n:
+            nxt = s[i + 1]
+            if nxt == "n":
+                out.append("\n")
+            elif nxt in ("\\", '"'):
+                out.append(nxt)
+            else:  # unknown escape: keep verbatim (spec-compatible)
+                out.append(c)
+                out.append(nxt)
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_labels(s: str, line: str) -> dict[str, str]:
+    """``a="x",b="y"`` → dict, escape-aware (``\\"``, ``\\\\``, ``\\n``)."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(s)
+    while i < n:
+        eq = s.find("=", i)
+        if eq < 0 or eq + 1 >= n or s[eq + 1] != '"':
+            raise ExpositionParseError(f"bad label pair in: {line!r}")
+        name = s[i:eq].strip().lstrip(",").strip()
+        if not name:
+            raise ExpositionParseError(f"empty label name in: {line!r}")
+        j = eq + 2
+        buf = []
+        while j < n:
+            c = s[j]
+            if c == "\\" and j + 1 < n:
+                buf.append(c)
+                buf.append(s[j + 1])
+                j += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            j += 1
+        else:
+            raise ExpositionParseError(f"unterminated label value in: {line!r}")
+        labels[name] = _unescape("".join(buf))
+        i = j + 1
+        # optional comma (and the trailing-comma form some exporters emit)
+        while i < n and s[i] in ", ":
+            i += 1
+    return labels
+
+
+def _parse_value(tok: str, line: str) -> float:
+    try:
+        return float(tok)  # accepts NaN/+Inf/-Inf spellings directly
+    except ValueError:
+        raise ExpositionParseError(f"bad sample value {tok!r} in: {line!r}")
+
+
+_HISTO_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def parse_exposition(body: str) -> dict[str, ParsedMetric]:
+    """Prometheus text format 0.0.4 → ``{family name: ParsedMetric}``.
+
+    The inverse of ``MetricsRegistry.render()``: counter families lose
+    their ``_total`` suffix, histogram ``_bucket``/``_sum``/``_count``
+    samples fold back into per-label-tuple ``ParsedHistogram``s with the
+    cumulative counts kept cumulative. Unknown/untyped samples are kept as
+    gauges-without-a-kind so a third-party exposition still federates.
+    Sample timestamps (an optional trailing integer) are accepted and
+    dropped — the router stamps its own scrape time.
+    """
+    families: dict[str, ParsedMetric] = {}
+    # render_name -> family (counter HELP/TYPE lines carry `_total`)
+    by_render_name: dict[str, str] = {}
+
+    def family_for_sample(sample_name: str) -> tuple[ParsedMetric, str]:
+        """Resolve a sample line's name to (family, role) where role is
+        '' | 'bucket' | 'sum' | 'count'."""
+        for fam_name, fam in families.items():
+            if fam.kind == "histogram":
+                for suf in _HISTO_SUFFIXES:
+                    if sample_name == fam_name + suf:
+                        return fam, suf[1:]
+            elif fam.kind == "counter":
+                if sample_name == fam_name + "_total":
+                    return fam, ""
+            elif sample_name == fam_name:
+                return fam, ""
+        # untyped sample with no preceding TYPE header
+        fam = families.setdefault(sample_name, ParsedMetric(sample_name))
+        return fam, ""
+
+    for raw in body.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                render_name = parts[2]
+                rest = parts[3] if len(parts) > 3 else ""
+                if parts[1] == "TYPE":
+                    kind = rest.strip()
+                    fam_name = render_name
+                    if kind == "counter" and fam_name.endswith("_total"):
+                        fam_name = fam_name[: -len("_total")]
+                    fam = families.get(by_render_name.get(render_name, fam_name))
+                    if fam is None:
+                        fam = families.setdefault(
+                            fam_name, ParsedMetric(fam_name)
+                        )
+                    fam.kind = kind
+                    # re-key a family HELP created under the render name
+                    if fam.name != fam_name:
+                        families.pop(fam.name, None)
+                        fam.name = fam_name
+                        families[fam_name] = fam
+                    by_render_name[render_name] = fam_name
+                else:  # HELP — may precede TYPE; keyed by render name
+                    fam_name = by_render_name.get(render_name, render_name)
+                    fam = families.setdefault(
+                        fam_name, ParsedMetric(fam_name)
+                    )
+                    fam.help = _unescape(rest)
+                    by_render_name[render_name] = fam_name
+            continue  # other comments are legal and ignored
+        # sample line: name[{labels}] value [timestamp]
+        brace = line.find("{")
+        labels: dict[str, str] = {}
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                raise ExpositionParseError(f"unbalanced braces in: {line!r}")
+            name = line[:brace].strip()
+            labels = _parse_labels(line[brace + 1 : close], line)
+            rest = line[close + 1 :].split()
+        else:
+            toks = line.split()
+            if len(toks) < 2:
+                raise ExpositionParseError(f"sample without value: {line!r}")
+            name, rest = toks[0], toks[1:]
+        if not rest or len(rest) > 2:
+            raise ExpositionParseError(f"bad sample line: {line!r}")
+        value = _parse_value(rest[0], line)
+        fam, role = family_for_sample(name)
+        if role == "bucket":
+            le = labels.pop("le", None)
+            if le is None:
+                raise ExpositionParseError(f"bucket without le: {line!r}")
+            key = tuple(sorted(labels.items()))
+            h = fam.histograms.setdefault(key, ParsedHistogram())
+            h.buckets.append((_parse_value(le, line), value))
+        elif role == "sum":
+            key = tuple(sorted(labels.items()))
+            fam.histograms.setdefault(key, ParsedHistogram()).sum = value
+        elif role == "count":
+            key = tuple(sorted(labels.items()))
+            fam.histograms.setdefault(key, ParsedHistogram()).count = value
+        else:
+            fam.samples[tuple(sorted(labels.items()))] = value
+    for fam in families.values():
+        for h in fam.histograms.values():
+            h.buckets.sort(key=lambda b: b[0])
+    return families
+
+
+# -- canonical re-render (the round-trip pin) ----------------------------------
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(key: tuple) -> str:
+    return ",".join(f'{l}="{_escape_label_value(v)}"' for l, v in key)
+
+
+def _render_name(fam: ParsedMetric) -> str:
+    return fam.name + "_total" if fam.kind == "counter" else fam.name
+
+
+def render_exposition(families: dict[str, ParsedMetric]) -> str:
+    """Parsed families → the exact text ``MetricsRegistry.render()`` emits
+    for the same samples (sorted family order, HELP/TYPE headers, labeled
+    samples in sorted label order, ``_fmt`` number forms). This is the
+    round-trip pin AND how the router re-exports federated samples."""
+    out: list[str] = []
+    for name in sorted(families):
+        fam = families[name]
+        rn = _render_name(fam)
+        out.append(f"# HELP {rn} {_escape_help(fam.help)}")
+        out.append(f"# TYPE {rn} {fam.kind}")
+        suffix = "_total" if fam.kind == "counter" else ""
+        for key in sorted(fam.samples):
+            v = fam.samples[key]
+            if key:
+                out.append(f"{fam.name}{suffix}{{{_label_str(key)}}} {_fmt(v)}")
+            else:
+                out.append(f"{fam.name}{suffix} {_fmt(v)}")
+        for key in sorted(fam.histograms):
+            h = fam.histograms[key]
+            labels = _label_str(key)
+            for le, cum in h.buckets:
+                le_s = _fmt(le)
+                if key:
+                    out.append(
+                        f'{fam.name}_bucket{{{labels},le="{le_s}"}} {_fmt(cum)}'
+                    )
+                else:
+                    out.append(f'{fam.name}_bucket{{le="{le_s}"}} {_fmt(cum)}')
+            if key:
+                out.append(f"{fam.name}_sum{{{labels}}} {_fmt(h.sum)}")
+                out.append(f"{fam.name}_count{{{labels}}} {_fmt(h.count)}")
+            else:
+                out.append(f"{fam.name}_sum {_fmt(h.sum)}")
+                out.append(f"{fam.name}_count {_fmt(h.count)}")
+    return "\n".join(out) + "\n"
+
+
+# -- bounded time series -------------------------------------------------------
+
+
+class SeriesRing:
+    """Bounded (t, value) samples for ONE series. Retention is time-based:
+    every append prunes points older than ``retention_s`` behind the new
+    point, KEEPING one point at-or-before the horizon so a window that
+    starts between two scrapes still has its left endpoint."""
+
+    __slots__ = ("retention_s", "points")
+
+    def __init__(self, retention_s: float):
+        self.retention_s = float(retention_s)
+        self.points: collections.deque[tuple[float, float]] = collections.deque()
+
+    def append(self, t: float, v: float) -> None:
+        self.points.append((float(t), float(v)))
+        horizon = t - self.retention_s
+        while len(self.points) >= 2 and self.points[1][0] <= horizon:
+            self.points.popleft()
+
+    def latest(self) -> Optional[float]:
+        return self.points[-1][1] if self.points else None
+
+    def value_at(self, t: float) -> Optional[float]:
+        """Newest value at-or-before ``t`` (the window's left endpoint);
+        None when the ring has no point that old — the caller treats the
+        window as starting at the ring's oldest point."""
+        out = None
+        for pt, pv in self.points:
+            if pt <= t:
+                out = pv
+            else:
+                break
+        return out
+
+    def increase(self, window_s: float, now: float) -> Optional[float]:
+        """Counter increase over ``[now - window_s, now]``. Clamped at 0
+        (a replica restart resets its counters; a negative fleet delta is
+        a restart artifact, not a rate). None with < 2 points or when the
+        whole ring is newer than the window start AND shorter than the
+        window (not enough history to say anything)."""
+        if len(self.points) < 2:
+            return None
+        start = self.value_at(now - window_s)
+        if start is None:
+            start = self.points[0][1]
+        return max(0.0, self.points[-1][1] - start)
+
+
+# -- the federation itself -----------------------------------------------------
+
+
+def fleet_name(family: str) -> str:
+    """The aggregate-name rule (documented in docs/observability.md):
+    ``automodel_serve_x`` → ``automodel_fleet_serve_x``; a family without
+    the ``automodel_`` prefix gets ``automodel_fleet_`` prepended whole."""
+    if family.startswith("automodel_"):
+        return "automodel_fleet_" + family[len("automodel_") :]
+    return "automodel_fleet_" + family
+
+
+@dataclasses.dataclass
+class _ReplicaState:
+    snapshot: dict[str, ParsedMetric] = dataclasses.field(default_factory=dict)
+    last_scrape_t: Optional[float] = None
+    up: bool = False
+
+
+class Federation:
+    """Per-replica /metrics snapshots + fleet-level rolled series.
+
+    ``ingest`` stores a replica's parsed scrape; ``roll`` (once per probe
+    sweep, after every replica was visited) folds the CURRENT snapshots
+    into fleet aggregates and appends them to the rings the SLO engine
+    windows over. Replicas that are down simply drop out of the next roll
+    — their counters stop contributing increase, which is exactly the
+    semantics a fleet-level burn rate wants."""
+
+    def __init__(self, retention_s: float = 900.0):
+        self.retention_s = float(retention_s)
+        self._lock = threading.Lock()
+        self._replicas: dict[str, _ReplicaState] = {}
+        # (family, label-key, role) -> SeriesRing; role '' for scalars,
+        # ('bucket', le) / 'sum' / 'count' for histogram components
+        self._series: dict[tuple, SeriesRing] = {}
+        self._rolls = 0
+        self._scrape_errors = 0
+        self._last_roll_t: Optional[float] = None
+
+    # -- scrape side ---------------------------------------------------------
+    def ingest(self, replica: str, body: str, now: float) -> None:
+        """Parse + store one replica scrape. A malformed body marks the
+        replica down for this sweep (and counts a scrape error) instead of
+        poisoning the fleet series."""
+        try:
+            snapshot = parse_exposition(body)
+        except ExpositionParseError:
+            with self._lock:
+                self._scrape_errors += 1
+                st = self._replicas.setdefault(replica, _ReplicaState())
+                st.up = False
+            raise
+        with self._lock:
+            st = self._replicas.setdefault(replica, _ReplicaState())
+            st.snapshot = snapshot
+            st.last_scrape_t = now
+            st.up = True
+
+    def mark_down(self, replica: str) -> None:
+        with self._lock:
+            if replica in self._replicas:
+                self._replicas[replica].up = False
+            else:
+                self._replicas[replica] = _ReplicaState()
+            self._scrape_errors += 1
+
+    # -- roll: snapshots -> fleet series -------------------------------------
+    def _ring(self, key: tuple) -> SeriesRing:
+        ring = self._series.get(key)
+        if ring is None:
+            ring = self._series[key] = SeriesRing(self.retention_s)
+        return ring
+
+    def roll(self, now: float) -> None:
+        with self._lock:
+            agg = self._aggregate_locked()
+            for fam_name, fam in agg.items():
+                for key, v in fam.samples.items():
+                    self._ring((fam_name, key, "")).append(now, v)
+                for key, h in fam.histograms.items():
+                    for le, cum in h.buckets:
+                        self._ring(
+                            (fam_name, key, ("bucket", le))
+                        ).append(now, cum)
+                    self._ring((fam_name, key, "sum")).append(now, h.sum)
+                    self._ring((fam_name, key, "count")).append(now, h.count)
+            self._rolls += 1
+            self._last_roll_t = now
+
+    def _aggregate_locked(self) -> dict[str, ParsedMetric]:
+        """Fleet aggregates from the CURRENT up-replica snapshots:
+        counters + histogram components sum across replicas; gauges get a
+        sum AND a ``<name>_max`` companion (queue depth: total backlog vs
+        worst replica — both are autoscale inputs)."""
+        out: dict[str, ParsedMetric] = {}
+        ups = [
+            (name, st.snapshot)
+            for name, st in sorted(self._replicas.items())
+            if st.up
+        ]
+        for _, snapshot in ups:
+            for fam in snapshot.values():
+                fname = fleet_name(fam.name)
+                agg = out.get(fname)
+                if agg is None:
+                    agg = out[fname] = ParsedMetric(
+                        fname, kind=fam.kind, help=fam.help
+                    )
+                if fam.kind == "gauge":
+                    maxname = fname + "_max"
+                    mx = out.get(maxname)
+                    if mx is None:
+                        mx = out[maxname] = ParsedMetric(
+                            maxname, kind="gauge",
+                            help=fam.help + " (max over replicas)",
+                        )
+                for key, v in fam.samples.items():
+                    agg.samples[key] = agg.samples.get(key, 0.0) + v
+                    if fam.kind == "gauge":
+                        cur = mx.samples.get(key)
+                        mx.samples[key] = v if cur is None else max(cur, v)
+                for key, h in fam.histograms.items():
+                    ah = agg.histograms.get(key)
+                    if ah is None:
+                        ah = agg.histograms[key] = ParsedHistogram(
+                            buckets=list(h.buckets), sum=h.sum, count=h.count
+                        )
+                        continue
+                    merged = collections.OrderedDict(ah.buckets)
+                    for le, cum in h.buckets:
+                        merged[le] = merged.get(le, 0.0) + cum
+                    ah.buckets = sorted(merged.items(), key=lambda b: b[0])
+                    ah.sum += h.sum
+                    ah.count += h.count
+        return out
+
+    # -- reads ---------------------------------------------------------------
+    def replica_snapshots(self) -> dict[str, dict[str, ParsedMetric]]:
+        with self._lock:
+            return {
+                name: st.snapshot
+                for name, st in self._replicas.items()
+                if st.up
+            }
+
+    def latest(self, family: str, labels: tuple = ()) -> Optional[float]:
+        """Latest rolled fleet value for a scalar series (family is the
+        FLEET name, e.g. ``automodel_fleet_serve_queue_depth``)."""
+        with self._lock:
+            ring = self._series.get((family, tuple(sorted(labels)), ""))
+            return ring.latest() if ring is not None else None
+
+    def increase(
+        self, family: str, window_s: float, now: float, labels: tuple = ()
+    ) -> Optional[float]:
+        with self._lock:
+            ring = self._series.get((family, tuple(sorted(labels)), ""))
+            return (
+                ring.increase(window_s, now) if ring is not None else None
+            )
+
+    def histogram_increase(
+        self, family: str, window_s: float, now: float, labels: tuple = ()
+    ) -> Optional[ParsedHistogram]:
+        """Windowed histogram delta (cumulative bucket counts over the
+        window) — the input to a windowed quantile / threshold fraction."""
+        key = tuple(sorted(labels))
+        with self._lock:
+            count_ring = self._series.get((family, key, "count"))
+            if count_ring is None:
+                return None
+            count = count_ring.increase(window_s, now)
+            if count is None:
+                return None
+            sum_ring = self._series.get((family, key, "sum"))
+            s = sum_ring.increase(window_s, now) if sum_ring else 0.0
+            buckets = []
+            for (fam, k, role), ring in self._series.items():
+                if fam != family or k != key or not isinstance(role, tuple):
+                    continue
+                inc = ring.increase(window_s, now)
+                if inc is not None:
+                    buckets.append((role[1], inc))
+            buckets.sort(key=lambda b: b[0])
+            return ParsedHistogram(buckets=buckets, sum=s or 0.0, count=count)
+
+    def status(self) -> dict:
+        """Federation health for /stats + fleet-status."""
+        with self._lock:
+            return {
+                "replicas_scraped": sum(
+                    1 for st in self._replicas.values() if st.up
+                ),
+                "rolls": self._rolls,
+                "scrape_errors": self._scrape_errors,
+                "last_roll_t": self._last_roll_t,
+            }
+
+    # -- re-export -----------------------------------------------------------
+    def render_federated(self) -> str:
+        """The federation block of the router's /metrics: every replica
+        sample re-exported with a ``replica`` label (family names
+        unchanged — the glossary rows for the replica metrics keep
+        applying), then the fleet aggregates, then the federation's own
+        health gauges. Appended after the router registry's own render."""
+        merged: dict[str, ParsedMetric] = {}
+        with self._lock:
+            ups = [
+                (name, st.snapshot)
+                for name, st in sorted(self._replicas.items())
+                if st.up
+            ]
+            agg = self._aggregate_locked()
+            n_scraped = sum(1 for st in self._replicas.values() if st.up)
+            errors = self._scrape_errors
+        for rep, snapshot in ups:
+            for fam in snapshot.values():
+                out = merged.get(fam.name)
+                if out is None:
+                    out = merged[fam.name] = ParsedMetric(
+                        fam.name, kind=fam.kind, help=fam.help
+                    )
+                for key, v in fam.samples.items():
+                    out.samples[
+                        tuple(sorted(dict(key, replica=rep).items()))
+                    ] = v
+                for key, h in fam.histograms.items():
+                    out.histograms[
+                        tuple(sorted(dict(key, replica=rep).items()))
+                    ] = h
+        merged.update(agg)
+        health = ParsedMetric(
+            "automodel_fleet_replicas_scraped",
+            kind="gauge",
+            help="Replicas whose /metrics scrape succeeded last sweep",
+        )
+        health.samples[()] = float(n_scraped)
+        merged[health.name] = health
+        errs = ParsedMetric(
+            "automodel_fleet_scrape_errors",
+            kind="counter",
+            help="Replica /metrics scrapes that failed or failed to parse",
+        )
+        errs.samples[()] = float(errors)
+        merged[errs.name] = errs
+        return render_exposition(merged)

@@ -55,7 +55,9 @@ class _Metric:
         if not _NAME_RE.match(name):
             raise ValueError(f"invalid prometheus metric name {name!r}")
         self.name = name
-        self.help = help_text.replace("\n", " ")
+        # raw text; render() escapes per the exposition spec (the federation
+        # parser's round-trip surfaced the old lossy `\n -> space` rewrite)
+        self.help = help_text
 
 
 class Counter(_Metric):
@@ -362,12 +364,15 @@ class MetricsRegistry:
             return self._register(Histogram(name, help_text, buckets))  # type: ignore[return-value]
 
     def render(self) -> str:
-        """→ the full exposition body (text format 0.0.4)."""
+        """→ the full exposition body (text format 0.0.4). HELP text is
+        escaped per the spec (``\\`` → ``\\\\``, newline → ``\\n``) so the
+        federation parser (telemetry/federation.py) round-trips it exactly."""
         with self.lock:
             out: list[str] = []
             for name in sorted(self._metrics):
                 m = self._metrics[name]
-                out.append(f"# HELP {m.render_name} {m.help}")
+                help_text = m.help.replace("\\", "\\\\").replace("\n", "\\n")
+                out.append(f"# HELP {m.render_name} {help_text}")
                 out.append(f"# TYPE {m.render_name} {m.kind}")
                 out.extend(m.render())
             return "\n".join(out) + "\n"
@@ -511,6 +516,12 @@ class ServingMetrics:
             "automodel_serve_spill_entries",
             "Prefix blocks resident in the host spill tier",
         )
+        # disaggregated prefill→decode handoffs (the /stats front always
+        # reported this; the drift guard surfaced the missing metric)
+        self.kv_injected = r.counter(
+            "automodel_serve_kv_injected",
+            "Prefill→decode KV handoffs admitted into this pool",
+        )
         self._pool_counters = {
             key: r.counter(f"automodel_serve_block_{key}", help_text)
             for key, help_text in (
@@ -596,6 +607,7 @@ class ServingMetrics:
             tier = getattr(engine.pool, "spill", None)
             self.spill_bytes.set(float(tier.bytes) if tier is not None else 0.0)
             self.spill_entries.set(float(len(tier)) if tier is not None else 0.0)
+            self.kv_injected.set_total(getattr(engine, "kv_injected_total", 0))
             proposed = getattr(engine, "spec_proposed_total", 0)
             accepted = getattr(engine, "spec_accepted_total", 0)
             self.spec_accepted.set_total(accepted)

@@ -136,6 +136,12 @@ _NUMERIC_KEYS = (
     "kernel_bench_winners",
     # request tracing (telemetry/tracing.py `span` events)
     "duration_s",
+    # fleet health plane (telemetry/slo.py `slo_alert` events): the measured
+    # objective value + its threshold at each transition, and the firing
+    # dwell stamped on the resolved record
+    "slo_value",
+    "slo_threshold",
+    "slo_firing_s",
     # goodput run ledger (telemetry/goodput.py): attempt envelope + the
     # checkpoint-timing stamps on the record AFTER each operation + the
     # boundary time the amortized windows exclude
@@ -164,7 +170,12 @@ _DURATION_KEYS = (
     "ckpt_restore_s",
     "ckpt_drain_s",
     "window_excluded_s",
+    "slo_firing_s",
 )
+
+# the slo_alert state machine's legal states (telemetry/slo.py) — anything
+# else in a record means a foreign writer or corruption
+_SLO_STATES = ("pending", "firing", "resolved", "cleared")
 
 # a span record must carry these to be assemblable by `automodel_tpu trace`
 # — ONE schema, owned by the tracing module (its read_span_records applies
@@ -265,6 +276,14 @@ def lint_metrics_jsonl(path: str) -> tuple[list[dict], list[str]]:
                     f"line {i}: {k} is negative ({v}) — durations are "
                     "monotonic differences and cannot go backwards; a "
                     "negative value means mixed wall/monotonic clocks"
+                )
+        if rec.get("event") == "slo_alert":
+            if not isinstance(rec.get("slo"), str) or not rec.get("slo"):
+                problems.append(f"line {i}: slo_alert record has no slo name")
+            if rec.get("state") not in _SLO_STATES:
+                problems.append(
+                    f"line {i}: slo_alert state {rec.get('state')!r} not in "
+                    f"{'/'.join(_SLO_STATES)}"
                 )
         if rec.get("event") == "span":
             missing = [
@@ -511,6 +530,39 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
                 }
                 for stage, durs in sorted(by_stage.items())
             }
+    alerts = [r for r in records if r.get("event") == "slo_alert"]
+    if alerts:
+        # fleet health plane: SLO alerting is the headline of a run that had
+        # it — per-SLO fire counts, the firing wall-clock bill (summed off
+        # the slo_firing_s each resolved record carries), and any objective
+        # the file leaves pending/firing (breach outlived the run)
+        out["slo_alerts"] = len(alerts)
+        fired: dict[str, int] = {}
+        firing_s: dict[str, float] = {}
+        last_state: dict[str, str] = {}
+        for r in alerts:
+            name = r.get("slo")
+            if not isinstance(name, str) or not name:
+                continue
+            st = r.get("state")
+            if st == "firing":
+                fired[name] = fired.get(name, 0) + 1
+            fs = r.get("slo_firing_s")
+            if isinstance(fs, (int, float)) and not isinstance(fs, bool):
+                firing_s[name] = firing_s.get(name, 0.0) + float(fs)
+            if isinstance(st, str):
+                last_state[name] = st
+        if fired:
+            out["slo_fired"] = dict(sorted(fired.items()))
+        if firing_s:
+            out["slo_firing_s_total"] = {
+                k: round(v, 3) for k, v in sorted(firing_s.items())
+            }
+        unresolved = sorted(
+            n for n, st in last_state.items() if st in ("pending", "firing")
+        )
+        if unresolved:
+            out["slo_unresolved_at_exit"] = unresolved
     stalls = [r for r in records if r.get("event") == "serve_engine_event"]
     if stalls:
         out["serve_engine_events"] = [

@@ -1,0 +1,486 @@
+"""Fleet health plane, judgment side: SLO objectives + burn-rate alerting.
+
+A strict ``slo:`` YAML section defines objectives over the federated
+fleet series (telemetry/federation.py):
+
+    slo:
+      fast_window_s: 60.0     # the quick-to-fire / quick-to-clear window
+      slow_window_s: 300.0    # the flap damper — BOTH must breach to fire
+      for_s: 0.0              # pending dwell before a breach fires
+      resolve_s: 60.0         # breach-free time before firing resolves
+      alerts_path: null       # optional JSONL file sink for transitions
+      webhook_url: null       # optional HTTP POST sink (best-effort)
+      objectives:
+        - name: ttft_p99      # latency: histogram quantile vs threshold
+          kind: latency
+          metric: automodel_serve_ttft_seconds
+          q: 0.99
+          threshold_s: 2.0
+          burn_rate: 1.0
+        - name: shed_rate     # ratio: counter increase / counter increase
+          kind: ratio
+          numerator: [automodel_serve_requests_shed]
+          denominator: [automodel_serve_requests_completed,
+                        automodel_serve_requests_failed]
+          max_ratio: 0.05
+        - name: goodput_floor # gauge: latest value vs a bound
+          kind: gauge
+          metric: automodel_train_goodput_fraction
+          min_value: 0.8
+
+Burn-rate math (docs/observability.md "Fleet health plane"): a latency
+objective ``pXX < T`` grants an error budget of ``1 - q`` requests over
+``T``; the burn rate in a window is ``fraction_over_T / (1 - q)``, and the
+window breaches when that reaches ``burn_rate``. A ratio objective's
+budget is ``max_ratio`` and its burn is ``ratio / max_ratio``. An
+objective breaches only when BOTH windows burn — the fast window makes
+firing (and clearing) quick, the slow window keeps a transient spike from
+flapping the alert.
+
+Alert lifecycle: ok → pending (first breached evaluation) → firing (still
+breached ``for_s`` later) → resolved (breach-free for ``resolve_s``) →
+ok. A pending that clears before firing emits ``cleared``. Every
+transition lands as a ``slo_alert`` record in the metrics JSONL, the
+flight recorder, the optional file/webhook sinks, and flips the
+``automodel_alerts_firing{slo=...}`` gauge the fleet-status CLI reads.
+
+Objectives name REPLICA metric families (``automodel_serve_*``); the
+engine evaluates their fleet aggregates (``automodel_fleet_*``,
+federation's name rule) so one objective covers every replica at once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from typing import Any, Callable, Optional
+
+from automodel_tpu.telemetry.federation import (
+    Federation,
+    ParsedHistogram,
+    fleet_name,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["SLOObjective", "SLOConfig", "SLOEngine"]
+
+_KINDS = ("latency", "ratio", "gauge")
+
+
+def _names(v: Any) -> tuple[str, ...]:
+    if v is None:
+        return ()
+    if isinstance(v, str):
+        return (v,)
+    return tuple(str(x) for x in v)
+
+
+@dataclasses.dataclass
+class SLOObjective:
+    name: str
+    kind: str  # latency | ratio | gauge
+    # latency + gauge: the replica metric family the objective watches
+    metric: Optional[str] = None
+    # latency
+    q: float = 0.99
+    threshold_s: Optional[float] = None
+    burn_rate: float = 1.0  # fire at >= this multiple of the error budget
+    # ratio — lists of counter families, increases summed per window
+    numerator: Any = None
+    denominator: Any = None
+    max_ratio: Optional[float] = None
+    # gauge — bound(s) on the latest fleet value
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+    aggregate: str = "sum"  # which fleet series a gauge objective reads
+
+    def __post_init__(self):
+        if not self.name:
+            raise TypeError("slo objective: empty name")
+        if self.kind not in _KINDS:
+            raise TypeError(
+                f"slo objective {self.name}: kind must be one of {_KINDS}, "
+                f"got {self.kind!r}"
+            )
+        if self.kind == "latency":
+            if not self.metric or self.threshold_s is None:
+                raise TypeError(
+                    f"slo objective {self.name}: latency needs metric + threshold_s"
+                )
+            if not (0.0 < self.q < 1.0):
+                raise TypeError(
+                    f"slo objective {self.name}: q must be in (0, 1), got {self.q}"
+                )
+        elif self.kind == "ratio":
+            self.numerator = _names(self.numerator)
+            self.denominator = _names(self.denominator)
+            if not self.numerator or not self.denominator:
+                raise TypeError(
+                    f"slo objective {self.name}: ratio needs numerator + denominator"
+                )
+            if self.max_ratio is None or self.max_ratio <= 0:
+                raise TypeError(
+                    f"slo objective {self.name}: ratio needs max_ratio > 0"
+                )
+        else:  # gauge
+            if not self.metric:
+                raise TypeError(f"slo objective {self.name}: gauge needs metric")
+            if self.min_value is None and self.max_value is None:
+                raise TypeError(
+                    f"slo objective {self.name}: gauge needs min_value or max_value"
+                )
+        if self.aggregate not in ("sum", "max"):
+            raise TypeError(
+                f"slo objective {self.name}: aggregate must be sum|max, "
+                f"got {self.aggregate!r}"
+            )
+        if self.burn_rate <= 0:
+            raise TypeError(f"slo objective {self.name}: burn_rate must be > 0")
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SLOObjective":
+        d = dict(d or {})
+        d.pop("_target_", None)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise TypeError(f"unknown slo objective keys: {sorted(unknown)}")
+        return cls(**d)
+
+    @property
+    def threshold(self) -> Optional[float]:
+        """The scalar the alert record reports against ``slo_value``."""
+        if self.kind == "latency":
+            return self.threshold_s
+        if self.kind == "ratio":
+            return self.max_ratio
+        return self.min_value if self.min_value is not None else self.max_value
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """The ``slo:`` YAML section (strict: unknown keys raise)."""
+
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    for_s: float = 0.0
+    resolve_s: float = 60.0
+    alerts_path: Optional[str] = None
+    webhook_url: Optional[str] = None
+    objectives: list = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self.objectives = [
+            o if isinstance(o, SLOObjective) else SLOObjective.from_dict(o)
+            for o in (self.objectives or [])
+        ]
+        if self.fast_window_s <= 0 or self.slow_window_s <= 0:
+            raise TypeError("slo: windows must be > 0")
+        if self.slow_window_s < self.fast_window_s:
+            raise TypeError(
+                f"slo: slow_window_s ({self.slow_window_s}) must be >= "
+                f"fast_window_s ({self.fast_window_s})"
+            )
+        names = [o.name for o in self.objectives]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise TypeError(f"slo: duplicate objective names {sorted(dupes)}")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "SLOConfig":
+        d = dict(d or {})
+        d.pop("_target_", None)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise TypeError(f"unknown slo keys: {sorted(unknown)}")
+        return cls(**d)
+
+    @property
+    def retention_s(self) -> float:
+        """Ring retention the federation needs for the slow window (one
+        extra window of slack so the left endpoint always has a point)."""
+        return 2.0 * self.slow_window_s + 60.0
+
+
+def _fraction_over(h: ParsedHistogram, threshold: float) -> Optional[float]:
+    """Fraction of windowed observations over ``threshold``, linearly
+    interpolated inside the straddling bucket (same uniformity assumption
+    as histogram_quantile). None when the window saw nothing."""
+    if h.count <= 0 or not h.buckets:
+        return None
+    prev_le, prev_cum = 0.0, 0.0
+    cum_at = None
+    for le, cum in h.buckets:
+        if le >= threshold:
+            if le == threshold or le == prev_le:
+                cum_at = cum if le == threshold else prev_cum
+            else:
+                span = le - prev_le
+                frac = (threshold - prev_le) / span if span > 0 else 1.0
+                cum_at = prev_cum + (cum - prev_cum) * min(max(frac, 0.0), 1.0)
+            break
+        prev_le, prev_cum = le, cum
+    if cum_at is None:  # threshold beyond the last bucket bound
+        cum_at = h.buckets[-1][1]
+    return max(0.0, (h.count - cum_at) / h.count)
+
+
+@dataclasses.dataclass
+class _AlertState:
+    state: str = "ok"  # ok | pending | firing
+    pending_since: Optional[float] = None
+    firing_since: Optional[float] = None
+    last_bad: Optional[float] = None
+    last_value: Optional[float] = None
+    fired_count: int = 0
+
+
+class SLOEngine:
+    """Evaluates every objective against the federation's fleet series on
+    each call to ``evaluate`` (the router's probe sweep) and runs the
+    pending→firing→resolved state machine. All clocks are monotonic
+    (``now`` comes from the caller's probe loop); wall timestamps on the
+    emitted records come from ``wall`` (a WallAnchor-style callable) so
+    records obey the repo's no-raw-wall-clock rule."""
+
+    def __init__(
+        self,
+        config: SLOConfig,
+        federation: Federation,
+        registry=None,
+        emit: Optional[Callable[[dict], None]] = None,
+        flight_recorder=None,
+        wall: Optional[Callable[[], float]] = None,
+    ):
+        self.config = config
+        self.federation = federation
+        self._emit_cb = emit
+        self._flight_recorder = flight_recorder
+        self._wall = wall or time.time
+        self._states = {o.name: _AlertState() for o in config.objectives}
+        self.firing_gauge = None
+        self.value_gauge = None
+        self.transitions = None
+        if registry is not None:
+            self.firing_gauge = registry.labeled_gauge(
+                "automodel_alerts_firing",
+                "1 while the named SLO alert is firing",
+                "slo",
+            )
+            self.value_gauge = registry.labeled_gauge(
+                "automodel_slo_value",
+                "Last evaluated value of the named SLO objective "
+                "(fast-window quantile/ratio, or the gauge itself)",
+                "slo",
+            )
+            self.transitions = registry.labeled_counter(
+                "automodel_alerts_transitions",
+                "SLO alert state transitions, by objective and new state",
+                ("slo", "state"),
+            )
+            for o in config.objectives:
+                self.firing_gauge.set(o.name, 0.0)
+
+    # -- evaluation ----------------------------------------------------------
+    def _window_bad(
+        self, o: SLOObjective, window_s: float, now: float
+    ) -> tuple[bool, Optional[float]]:
+        """→ (window breached, reported value) for one window."""
+        fed = self.federation
+        if o.kind == "latency":
+            h = fed.histogram_increase(fleet_name(o.metric), window_s, now)
+            if h is None:
+                return False, None
+            frac = _fraction_over(h, o.threshold_s)
+            if frac is None:
+                return False, None
+            budget = max(1e-9, 1.0 - o.q)
+            return frac / budget >= o.burn_rate, h.quantile(o.q)
+        if o.kind == "ratio":
+            num = den = 0.0
+            saw = False
+            for fam in o.numerator:
+                inc = fed.increase(fleet_name(fam), window_s, now)
+                if inc is not None:
+                    num += inc
+                    saw = True
+            for fam in o.denominator:
+                inc = fed.increase(fleet_name(fam), window_s, now)
+                if inc is not None:
+                    den += inc
+                    saw = True
+            # the numerator counts against the denominator+numerator total
+            # (shed requests never reach "completed", so the natural YAML —
+            # shed / [completed, failed] — would divide by a total that
+            # excludes the bad events; fold them in here instead of asking
+            # every config to repeat the numerator)
+            total = den + num
+            if not saw or total <= 0:
+                return False, None
+            ratio = num / total
+            return ratio / o.max_ratio >= o.burn_rate, ratio
+        # gauge
+        family = fleet_name(o.metric)
+        if o.aggregate == "max":
+            family += "_max"
+        v = fed.latest(family)
+        if v is None:
+            return False, None
+        bad = (o.min_value is not None and v < o.min_value) or (
+            o.max_value is not None and v > o.max_value
+        )
+        return bad, v
+
+    def _breached(self, o: SLOObjective, now: float) -> tuple[bool, Optional[float]]:
+        c = self.config
+        fast_bad, fast_value = self._window_bad(o, c.fast_window_s, now)
+        if o.kind == "gauge":  # instantaneous — one reading, no windows
+            return fast_bad, fast_value
+        slow_bad, _ = self._window_bad(o, c.slow_window_s, now)
+        return fast_bad and slow_bad, fast_value
+
+    # -- state machine -------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> list[dict]:
+        """One evaluation sweep → the transition records it emitted."""
+        now = time.monotonic() if now is None else float(now)
+        c = self.config
+        out: list[dict] = []
+        for o in c.objectives:
+            st = self._states[o.name]
+            breached, value = self._breached(o, now)
+            if value is not None:
+                st.last_value = value
+                if self.value_gauge is not None:
+                    self.value_gauge.set(o.name, value)
+            if st.state == "ok":
+                if breached:
+                    st.state = "pending"
+                    st.pending_since = now
+                    st.last_bad = now
+                    out.append(self._transition(o, st, "pending", now))
+                    # a zero dwell fires on the SAME sweep — a breach that
+                    # already burned both windows needs no second look
+                    if now - st.pending_since >= c.for_s:
+                        st.state = "firing"
+                        st.firing_since = now
+                        st.fired_count += 1
+                        out.append(self._transition(o, st, "firing", now))
+            elif st.state == "pending":
+                if not breached:
+                    st.state = "ok"
+                    st.pending_since = None
+                    out.append(self._transition(o, st, "cleared", now))
+                else:
+                    st.last_bad = now
+                    if now - st.pending_since >= c.for_s:
+                        st.state = "firing"
+                        st.firing_since = now
+                        st.fired_count += 1
+                        out.append(self._transition(o, st, "firing", now))
+            elif st.state == "firing":
+                if breached:
+                    st.last_bad = now
+                elif now - (st.last_bad or now) >= c.resolve_s:
+                    rec = self._transition(
+                        o, st, "resolved", now,
+                        firing_s=now - (st.firing_since or now),
+                    )
+                    st.state = "ok"
+                    st.pending_since = st.firing_since = st.last_bad = None
+                    out.append(rec)
+        return out
+
+    def _transition(
+        self,
+        o: SLOObjective,
+        st: _AlertState,
+        state: str,
+        now: float,
+        firing_s: Optional[float] = None,
+    ) -> dict:
+        rec = {
+            "event": "slo_alert",
+            "slo": o.name,
+            "state": state,
+            "kind": o.kind,
+            "slo_value": st.last_value,
+            "slo_threshold": o.threshold,
+            "ts": round(self._wall(), 6),
+        }
+        if firing_s is not None:
+            rec["slo_firing_s"] = round(firing_s, 6)
+        if self.firing_gauge is not None:
+            if state == "firing":
+                self.firing_gauge.set(o.name, 1.0)
+            elif state in ("resolved", "cleared"):
+                self.firing_gauge.set(o.name, 0.0)
+        if self.transitions is not None:
+            self.transitions.inc((o.name, state))
+        logger.warning(
+            "slo_alert: %s -> %s (value=%s threshold=%s)",
+            o.name, state, rec["slo_value"], rec["slo_threshold"],
+        )
+        self._sink(rec)
+        return rec
+
+    def _sink(self, rec: dict) -> None:
+        if self._emit_cb is not None:
+            try:
+                self._emit_cb(dict(rec))
+            except Exception:
+                logger.exception("slo: on_record sink failed")
+        fr = self._flight_recorder
+        if fr is not None:
+            try:
+                fr.record(dict(rec))
+            except Exception:
+                logger.exception("slo: flight recorder sink failed")
+        if self.config.alerts_path:
+            try:
+                with open(self.config.alerts_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+            except OSError:
+                logger.exception("slo: alerts_path sink failed")
+        if self.config.webhook_url:
+            self._post_webhook(rec)
+
+    def _post_webhook(self, rec: dict) -> None:
+        """Best-effort POST — an unreachable webhook must never stall the
+        probe loop longer than its small timeout, or wedge alerting."""
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                self.config.webhook_url,
+                data=(json.dumps(rec) + "\n").encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=2.0):
+                pass
+        except Exception as e:
+            logger.warning("slo: webhook sink failed: %s", e)
+
+    # -- reads ---------------------------------------------------------------
+    def firing(self) -> list[str]:
+        return sorted(
+            name for name, st in self._states.items() if st.state == "firing"
+        )
+
+    def snapshot(self) -> dict:
+        """Per-objective state for the router's /stats (and from there the
+        fleet-status CLI)."""
+        return {
+            o.name: {
+                "state": self._states[o.name].state,
+                "kind": o.kind,
+                "value": self._states[o.name].last_value,
+                "threshold": o.threshold,
+                "fired_count": self._states[o.name].fired_count,
+            }
+            for o in self.config.objectives
+        }

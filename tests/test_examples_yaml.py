@@ -180,6 +180,28 @@ def test_example_yaml_parses_and_dry_instantiates(path):
                 "affinity could never hit"
             )
 
+    # slo: → SLOConfig (burn-rate alerting on the router; strict at both
+    # levels — section keys and per-objective keys)
+    slo = _section(cfg, "slo")
+    if slo is not None:
+        from automodel_tpu.telemetry.slo import SLOConfig, SLOObjective
+
+        sc = SLOConfig.from_dict(slo)
+        assert sc.objectives, f"{path}: slo: section with no objectives"
+        for o in sc.objectives:
+            assert isinstance(o, SLOObjective)
+            # objectives name REPLICA families; the engine watches their
+            # fleet aggregates — a name already carrying the fleet_ prefix
+            # would be double-derived and never match anything
+            for fam in (o.metric,) + tuple(o.numerator or ()) + tuple(
+                o.denominator or ()
+            ):
+                if fam:
+                    assert not fam.startswith("automodel_fleet_"), (
+                        f"{path}: slo objective {o.name} names the derived "
+                        f"fleet family {fam} — use the replica family"
+                    )
+
     # k8s_fleet: → K8sFleetConfig (router Deployment + replica StatefulSets)
     kf = _section(cfg, "k8s_fleet")
     if kf is not None:
@@ -288,6 +310,21 @@ def test_config_dataclasses_reject_unknown_keys():
         FleetConfig.from_dict({"replicas": [{"url": "http://x", "role": "router"}]})
     with pytest.raises(ValueError):
         FleetConfig.from_dict({"retry_budget": -1})
+    from automodel_tpu.telemetry.slo import SLOConfig
+
+    with pytest.raises(TypeError):
+        SLOConfig.from_dict({"fast_windoww_s": 5.0})
+    with pytest.raises(TypeError):  # strict through the objective list too
+        SLOConfig.from_dict(
+            {"objectives": [{"name": "x", "kind": "gauge",
+                             "metric": "m", "min_value": 1, "thresholdd": 2}]}
+        )
+    with pytest.raises(TypeError):  # latency without its threshold
+        SLOConfig.from_dict(
+            {"objectives": [{"name": "x", "kind": "latency", "metric": "m"}]}
+        )
+    with pytest.raises(TypeError):  # slow window must cover the fast one
+        SLOConfig.from_dict({"fast_window_s": 60.0, "slow_window_s": 10.0})
     from automodel_tpu.telemetry.tracing import TracingConfig
 
     with pytest.raises(TypeError):

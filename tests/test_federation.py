@@ -1,0 +1,276 @@
+"""Fleet health plane, scrape side (telemetry/federation.py): the
+exposition parser must be the EXACT inverse of the renderer (round-trip
+pinned byte-for-byte — a parser that drifts from prometheus.py silently
+corrupts every fleet rollup), the bounded rings must window correctly
+across replica restarts, and the fleet aggregates must equal hand-computed
+sums/maxes/bucket-merges of the per-replica scrapes.
+
+All jax-free: the federation runs inside the router process.
+"""
+
+import math
+
+import pytest
+
+from automodel_tpu.telemetry.federation import (
+    ExpositionParseError,
+    Federation,
+    ParsedMetric,
+    SeriesRing,
+    fleet_name,
+    parse_exposition,
+    render_exposition,
+)
+from automodel_tpu.telemetry.prometheus import MetricsRegistry
+
+
+def _full_registry() -> MetricsRegistry:
+    """One of everything the renderer can emit, including the awkward
+    cases: multi-label histograms, escaped label values, newline HELP,
+    NaN/Inf gauge values, float sample values."""
+    reg = MetricsRegistry()
+    c = reg.counter("automodel_test_things", "Things counted")
+    c.inc(3)
+    g = reg.gauge("automodel_test_level", 'A level with "quotes"\nand a newline')
+    g.set(0.25)
+    nan_g = reg.gauge("automodel_test_nan", "Goes non-finite")
+    nan_g.set(float("nan"))
+    inf_g = reg.gauge("automodel_test_inf", "Goes infinite")
+    inf_g.set(float("inf"))
+    h = reg.histogram(
+        "automodel_test_latency_seconds", "A latency", buckets=(0.1, 1.0)
+    )
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    # label names declared in sorted order: the parser canonicalizes label
+    # order, so byte-identity is only promised for sorted-label sources
+    # (all in-repo registries follow this convention)
+    lc = reg.labeled_counter(
+        "automodel_test_outcomes", "By replica and outcome",
+        ("outcome", "replica"),
+    )
+    lc.inc(("ok", "r0"), 2)
+    lc.inc(("shed", "r1"), 1)
+    lg = reg.labeled_gauge("automodel_test_up", "Per-replica up", "replica")
+    lg.set("r0", 1.0)
+    lg.set("r1", 0.0)
+    lh = reg.labeled_histogram(
+        "automodel_test_stage_seconds", "Per-stage latency",
+        ("role", "stage"), buckets=(0.01, 0.1),
+    )
+    lh.observe(("mixed", "prefill"), 0.005)
+    lh.observe(("mixed", "prefill"), 0.05)
+    lh.observe(("mixed", "decode"), 0.5)
+    return reg
+
+
+def test_round_trip_pins_renderer():
+    """render -> parse -> render must reproduce the body byte-for-byte:
+    THE invariant that lets the router re-export federated samples in the
+    same format it scraped."""
+    body = _full_registry().render()
+    families = parse_exposition(body)
+    assert render_exposition(families) == body
+
+
+def test_round_trip_is_idempotent_for_unsorted_labels():
+    """A foreign exposition with labels out of sorted order canonicalizes
+    on the first pass and is then stable."""
+    body = "\n".join([
+        "# TYPE foreign_outcomes_total counter",
+        'foreign_outcomes_total{replica="r0",outcome="ok"} 2',
+        "",
+    ])
+    once = render_exposition(parse_exposition(body))
+    assert 'foreign_outcomes_total{outcome="ok",replica="r0"} 2' in once
+    assert render_exposition(parse_exposition(once)) == once
+
+
+def test_parse_folds_families_and_values():
+    body = _full_registry().render()
+    fams = parse_exposition(body)
+    # counter family name loses its render-time _total suffix
+    assert fams["automodel_test_things"].kind == "counter"
+    assert fams["automodel_test_things"].samples[()] == 3.0
+    assert "automodel_test_things_total" not in fams
+    # non-finite values survive
+    assert math.isnan(fams["automodel_test_nan"].samples[()])
+    assert fams["automodel_test_inf"].samples[()] == math.inf
+    # escaped HELP text round-trips to the raw string
+    assert 'with "quotes"\nand a newline' in fams["automodel_test_level"].help
+    # labeled counter children keyed by sorted label tuples
+    lc = fams["automodel_test_outcomes"]
+    assert lc.samples[(("outcome", "ok"), ("replica", "r0"))] == 2.0
+    assert lc.samples[(("outcome", "shed"), ("replica", "r1"))] == 1.0
+    # histogram reassembled: cumulative buckets incl +Inf, sum, count
+    h = fams["automodel_test_latency_seconds"].histograms[()]
+    assert h.buckets == [(0.1, 1.0), (1.0, 2.0), (math.inf, 3.0)]
+    assert h.count == 3.0 and h.sum == pytest.approx(5.55)
+    # multi-label histogram: children keyed by the non-le labels
+    lh = fams["automodel_test_stage_seconds"]
+    pf = lh.histograms[(("role", "mixed"), ("stage", "prefill"))]
+    assert pf.count == 2.0
+    dec = lh.histograms[(("role", "mixed"), ("stage", "decode"))]
+    assert dec.count == 1.0
+    assert dec.buckets[-1] == (math.inf, 1.0)
+
+
+def test_parse_accepts_foreign_expositions():
+    """Third-party exporters emit things our renderer never does:
+    timestamps, HELP after TYPE, escaped label values, untyped samples,
+    stray comments — all legal format 0.0.4, all must federate."""
+    body = "\n".join([
+        "# scraped by something else",
+        "# TYPE foreign_requests_total counter",
+        "# HELP foreign_requests_total Requests with a \\n newline",
+        'foreign_requests_total{path="/a\\"b\\\\c"} 7 1712345678901',
+        "bare_untyped_sample 1.5",
+        "",
+        "# TYPE foreign_temp gauge",
+        "foreign_temp{host=\"h1\", zone=\"z\",} -3.25",
+    ])
+    fams = parse_exposition(body)
+    assert fams["foreign_requests"].kind == "counter"
+    assert fams["foreign_requests"].help == "Requests with a \n newline"
+    (key, value), = fams["foreign_requests"].samples.items()
+    assert dict(key)["path"] == '/a"b\\c'
+    assert value == 7.0
+    assert fams["bare_untyped_sample"].kind == "untyped"
+    assert fams["bare_untyped_sample"].samples[()] == 1.5
+    # trailing-comma label list parses
+    assert fams["foreign_temp"].samples[
+        (("host", "h1"), ("zone", "z"))
+    ] == -3.25
+
+
+@pytest.mark.parametrize("line", [
+    "no_value_here",
+    'bad_labels{a=x} 1',
+    'unterminated{a="x 1',
+    "too many value tokens 1 2 3",
+    "name{a=\"x\"} notanumber",
+])
+def test_parse_rejects_malformed_lines(line):
+    with pytest.raises(ExpositionParseError):
+        parse_exposition(line + "\n")
+
+
+def test_series_ring_retention_and_increase():
+    ring = SeriesRing(retention_s=10.0)
+    for t in range(0, 40, 5):
+        ring.append(float(t), float(t))  # value == its timestamp
+    # pruned, but ONE point at-or-before the horizon is kept so a window
+    # starting between scrapes still has its left endpoint
+    ts = [t for t, _ in ring.points]
+    assert ts[0] <= 35.0 - 10.0
+    assert ts[0] == 25.0 and ts[-1] == 35.0
+    assert ring.latest() == 35.0
+    assert ring.value_at(31.0) == 30.0
+    assert ring.increase(10.0, 35.0) == 10.0
+    # restart artifact: a counter reset reads as no increase, never negative
+    ring.append(36.0, 0.0)
+    assert ring.increase(10.0, 36.0) == 0.0
+    fresh = SeriesRing(10.0)
+    fresh.append(0.0, 5.0)
+    assert fresh.increase(10.0, 1.0) is None  # < 2 points: no claim
+
+
+def _replica_body(things, depth, lat_obs):
+    reg = MetricsRegistry()
+    reg.counter("automodel_serve_x", "Counted").inc(things)
+    reg.gauge("automodel_serve_queue_depth", "Depth").set(depth)
+    h = reg.histogram(
+        "automodel_serve_ttft_seconds", "TTFT", buckets=(0.1, 1.0)
+    )
+    for v in lat_obs:
+        h.observe(v)
+    return reg.render()
+
+
+def test_federation_rollup_matches_per_replica_scrapes():
+    fed = Federation(retention_s=60.0)
+    fed.ingest("r0", _replica_body(3, 1.5, [0.05, 0.5]), now=0.0)
+    fed.ingest("r1", _replica_body(4, 0.5, [5.0]), now=0.0)
+    fed.roll(0.0)
+
+    # counters sum; gauges sum AND carry a worst-replica _max companion
+    assert fed.latest("automodel_fleet_serve_x") == 7.0
+    assert fed.latest("automodel_fleet_serve_queue_depth") == 2.0
+    assert fed.latest("automodel_fleet_serve_queue_depth_max") == 1.5
+
+    body = fed.render_federated()
+    from tests.test_profiling import _lint_exposition
+
+    _lint_exposition(body)
+    # per-replica samples re-exported with an injected replica label,
+    # family names unchanged
+    assert 'automodel_serve_x_total{replica="r0"} 3' in body
+    assert 'automodel_serve_x_total{replica="r1"} 4' in body
+    assert 'automodel_serve_queue_depth{replica="r0"} 1.5' in body
+    # fleet aggregates under the name rule
+    assert "automodel_fleet_serve_x_total 7" in body
+    assert "automodel_fleet_serve_queue_depth 2" in body
+    assert "automodel_fleet_serve_queue_depth_max 1.5" in body
+    # histogram bucket-merge: per-le sums across replicas
+    assert 'automodel_fleet_serve_ttft_seconds_bucket{le="0.1"} 1' in body
+    assert 'automodel_fleet_serve_ttft_seconds_bucket{le="1"} 2' in body
+    assert 'automodel_fleet_serve_ttft_seconds_bucket{le="+Inf"} 3' in body
+    assert "automodel_fleet_serve_ttft_seconds_count 3" in body
+    assert "automodel_fleet_replicas_scraped 2" in body
+
+    # the federated block must stay disjoint from the router's own
+    # registry (names are appended after it on GET /metrics)
+    fams = parse_exposition(body)
+    assert "automodel_route_requests" not in fams
+
+    # a down replica drops out of the next roll (its counters stop
+    # contributing increase — exactly what a fleet burn rate wants)
+    fed.mark_down("r1")
+    fed.roll(1.0)
+    assert fed.latest("automodel_fleet_serve_x") == 3.0
+    assert fed.status()["replicas_scraped"] == 1
+    assert fed.status()["scrape_errors"] == 1
+    assert 'replica="r1"' not in fed.render_federated()
+
+
+def test_federation_windowed_increase_and_histogram():
+    fed = Federation(retention_s=60.0)
+    fed.ingest("r0", _replica_body(0, 0.0, []), now=0.0)
+    fed.roll(0.0)
+    fed.ingest("r0", _replica_body(5, 0.0, [0.05, 0.05, 5.0]), now=10.0)
+    fed.roll(10.0)
+    assert fed.increase("automodel_fleet_serve_x", 10.0, 10.0) == 5.0
+    h = fed.histogram_increase("automodel_fleet_serve_ttft_seconds", 10.0, 10.0)
+    assert h is not None and h.count == 3.0
+    # 2 of 3 windowed observations landed <= 0.1: the median reports the
+    # first bucket's bound, p99 reports the last finite bound
+    assert h.quantile(0.5) == 0.1
+    assert h.quantile(0.99) == 1.0
+    # no ring for a family nobody scraped
+    assert fed.increase("automodel_fleet_nope", 10.0, 10.0) is None
+    assert fed.histogram_increase("automodel_fleet_nope", 10.0, 10.0) is None
+
+
+def test_ingest_rejects_malformed_scrape_whole():
+    fed = Federation()
+    fed.ingest("r0", _replica_body(1, 0.0, []), now=0.0)
+    with pytest.raises(ExpositionParseError):
+        fed.ingest("r0", "good_line 1\nbad line {{{\n", now=1.0)
+    # the replica is down for this sweep; the error is counted; the OLD
+    # snapshot did not get half-replaced
+    assert fed.status()["replicas_scraped"] == 0
+    assert fed.status()["scrape_errors"] == 1
+    fed.roll(1.0)
+    assert fed.latest("automodel_fleet_serve_x") is None
+
+
+def test_fleet_name_rule():
+    assert fleet_name("automodel_serve_x") == "automodel_fleet_serve_x"
+    assert fleet_name("foreign_metric") == "automodel_fleet_foreign_metric"
+
+
+def test_render_exposition_escapes_label_values():
+    fam = ParsedMetric("automodel_test_esc", kind="gauge", help="h")
+    fam.samples[(("path", 'a"b\\c'),)] = 1.0
+    body = render_exposition({fam.name: fam})
+    assert parse_exposition(body)[fam.name].samples == fam.samples

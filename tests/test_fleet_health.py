@@ -1,0 +1,374 @@
+"""Fleet health plane, end to end.
+
+Part 1 — the /stats ↔ /metrics drift guard: serving/server.py's
+STATS_METRIC_EQUIV table is walked BOTH ways against a live engine, so a
+new /stats key without a metric (or a new serve metric without a /stats
+mirror or an explicit STATS_METRICS_ONLY entry) fails here instead of
+shipping as silent drift between the two surfaces.
+
+Part 2 — the acceptance e2e: router + 2 real replica subprocesses under
+Poisson load; a fault_injection prefill stall breaches exactly the
+targeted latency SLO (pending→firing, with the event record, the
+/metrics gauge, and the JSONL agreeing), recovery resolves it, the
+fleet-status surface shows both states, and the federation rollups match
+per-replica scrapes.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from automodel_tpu.resilience import fault_injection as fi
+from automodel_tpu.serving.server import (
+    STATS_METRIC_EQUIV,
+    STATS_METRICS_ONLY,
+    stats_snapshot,
+)
+from automodel_tpu.telemetry.federation import parse_exposition
+
+# ---------------------------------------------------------------------------
+# /stats <-> /metrics drift guard
+# ---------------------------------------------------------------------------
+
+
+def test_equiv_table_targets_exist_in_serving_registry():
+    """Structure only (jax-free): every family the table names must exist
+    in ServingMetrics, and every serve family must be reachable from the
+    table or listed in STATS_METRICS_ONLY."""
+    from automodel_tpu.telemetry.prometheus import ServingMetrics
+
+    fams = set(parse_exposition(ServingMetrics().registry.render()))
+    covered = set(STATS_METRICS_ONLY)
+    for target in STATS_METRIC_EQUIV.values():
+        if target is None:
+            continue
+        names = target if isinstance(target, tuple) else (target,)
+        for name in names:
+            if name == "automodel_serve_block_*":
+                covered.update(
+                    f for f in fams
+                    if f.startswith("automodel_serve_block_")
+                    and f != "automodel_serve_block_occupancy"
+                )
+                continue
+            assert name in fams, (
+                f"STATS_METRIC_EQUIV names {name} but ServingMetrics does "
+                "not register it"
+            )
+            covered.add(name)
+    orphans = sorted(
+        f for f in fams
+        if f.startswith("automodel_serve") and f not in covered
+    )
+    assert not orphans, (
+        "serve metric families with no /stats mirror — add them to "
+        f"STATS_METRIC_EQUIV or STATS_METRICS_ONLY: {orphans}"
+    )
+
+
+def _stat_num(v):
+    if v is None:
+        return 0.0
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    return float(v)
+
+
+def test_stats_snapshot_matches_metrics_on_live_engine():
+    """Numeric agreement: the /stats body and the synced /metrics scrape
+    must report the same numbers for every mapped key."""
+    pytest.importorskip("jax")
+    from tests.test_fleet import _engine
+
+    eng = _engine()
+    for i in range(3):
+        eng.submit([1, 2, 3, 4 + (i % 2)], max_new_tokens=4)
+    eng.run()
+    assert eng.completed_total >= 3
+
+    stats = stats_snapshot(eng)
+    assert set(stats) == set(STATS_METRIC_EQUIV), (
+        "stats_snapshot keys drifted from STATS_METRIC_EQUIV: "
+        f"only in stats: {sorted(set(stats) - set(STATS_METRIC_EQUIV))}, "
+        f"only in table: {sorted(set(STATS_METRIC_EQUIV) - set(stats))}"
+    )
+
+    eng.metrics.sync(eng)
+    fams = parse_exposition(eng.metrics.registry.render())
+    for key, target in STATS_METRIC_EQUIV.items():
+        if target is None:
+            continue  # info key: no numeric mirror
+        if target == "automodel_serve_block_*":
+            alloc = stats["allocator"]
+            metric_keys = {
+                f[len("automodel_serve_block_"):]
+                for f in fams
+                if f.startswith("automodel_serve_block_")
+                and f != "automodel_serve_block_occupancy"
+            }
+            assert set(alloc) == metric_keys, (
+                "allocator counter keys drifted between pool.counters and "
+                f"ServingMetrics: stats-only {sorted(set(alloc) - metric_keys)}, "
+                f"metrics-only {sorted(metric_keys - set(alloc))}"
+            )
+            for k, v in alloc.items():
+                got = fams[f"automodel_serve_block_{k}"].samples[()]
+                assert got == float(v), f"allocator[{k}]: stats {v} metrics {got}"
+            continue
+        names = target if isinstance(target, tuple) else (target,)
+        got = sum(fams[n].samples[()] for n in names)
+        want = _stat_num(stats[key])
+        assert got == pytest.approx(want), (
+            f"/stats {key}={want} but {'+'.join(names)}={got}"
+        )
+    # completed requests actually moved the counters (the comparison above
+    # was not all-zeros-equal-all-zeros)
+    assert fams["automodel_serve_requests_completed"].samples[()] >= 3
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: breach -> firing -> recovery -> resolved
+# ---------------------------------------------------------------------------
+
+
+def _spawn_breach_replica(tmp_path, idx, breach):
+    from tests.test_serving_chaos import _WORKER, _clean_env, _replica_cfg
+
+    cfg_path = tmp_path / f"replica{idx}.yaml"
+    cfg_path.write_text(json.dumps(_replica_cfg(tmp_path, idx)))
+    env = _clean_env()
+    if breach:
+        env[fi.ENV_VAR] = json.dumps(breach)
+    return subprocess.Popen(
+        [sys.executable, _WORKER, "serve", "-c", str(cfg_path)],
+        stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def _poisson_arrivals(rng, n, mean_gap_s, max_new):
+    arrivals, t = [], 0.0
+    for _ in range(n):
+        t += float(rng.exponential(mean_gap_s))
+        arrivals.append((
+            t,
+            rng.integers(1, 64, size=int(rng.integers(3, 9))).tolist(),
+            max_new,
+        ))
+    return arrivals
+
+
+def _wait_slo_state(router, name, want, timeout_s):
+    deadline = time.monotonic() + timeout_s
+    state = None
+    while time.monotonic() < deadline:
+        state = router.slo.snapshot()[name]["state"]
+        if state == want:
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"SLO {name} never reached {want!r} within {timeout_s}s "
+        f"(last state {state!r}, events so far logged by caller)"
+    )
+
+
+def test_fleet_health_e2e_breach_fires_and_resolves(tmp_path):
+    """ISSUE 17 acceptance: both replicas get a wall-clock-bounded
+    fault_injection prefill stall; under Poisson load the ttft objective
+    (and ONLY it) goes pending→firing; once the stall window expires and
+    healthy traffic flows, it resolves. Fleet-status renders both states,
+    the alert JSONL lints clean, and the fleet rollups equal per-replica
+    scrapes."""
+    pytest.importorskip("jax")
+    from automodel_tpu.loggers.metric_logger import MetricLogger
+    from automodel_tpu.serving.fleet.router import (
+        FleetConfig,
+        Router,
+        _http_text,
+        serve_router_http,
+    )
+    from automodel_tpu.serving.fleet.status import render_table, snapshot
+    from automodel_tpu.telemetry.report import (
+        lint_metrics_jsonl,
+        summarize_metrics,
+    )
+    from automodel_tpu.telemetry.slo import SLOConfig
+    from tests.test_profiling import _lint_exposition
+    from tests.test_serving_chaos import _replica_port
+
+    # the stall: +1s per prefill tick, armed once the scheduler passes the
+    # warm-up steps, expiring 6s of wall clock after it first bites
+    breach = {
+        "slo_breach_stage": "prefill",
+        "slo_breach_ms": 1000.0,
+        "slo_breach_from_step": 45,
+        "slo_breach_for_s": 6.0,
+    }
+    procs = [_spawn_breach_replica(tmp_path, i, breach) for i in range(2)]
+    router = None
+    front = None
+    try:
+        ports = [_replica_port(p) for p in procs]
+        metrics_path = tmp_path / "route_metrics.jsonl"
+        metric_logger = MetricLogger(str(metrics_path))
+        records = []
+        rec_lock = threading.Lock()
+
+        def on_record(rec):
+            with rec_lock:
+                records.append(rec)
+                metric_logger.log(rec)
+
+        slo_cfg = SLOConfig.from_dict({
+            "fast_window_s": 4.0, "slow_window_s": 10.0,
+            "for_s": 0.0, "resolve_s": 3.0,
+            "objectives": [
+                # the targeted objective: healthy tiny-model TTFT is far
+                # under 0.5s; every stalled prefill is >= 1s over it
+                {"name": "ttft_high", "kind": "latency",
+                 "metric": "automodel_serve_ttft_seconds",
+                 "q": 0.75, "threshold_s": 0.5},
+                # the control objective: must stay quiet throughout
+                {"name": "error_rate", "kind": "ratio",
+                 "numerator": ["automodel_serve_engine_errors"],
+                 "denominator": ["automodel_serve_requests_completed"],
+                 "max_ratio": 0.05},
+            ],
+        })
+        router = Router(
+            FleetConfig.from_dict({
+                "replicas": [
+                    {"url": f"http://127.0.0.1:{port}", "name": f"r{i}"}
+                    for i, port in enumerate(ports)
+                ],
+                "block_size": 4,
+                "probe_interval_s": 0.4,
+                "probe_timeout_s": 10.0,
+                "retry_budget": 2,
+                "request_timeout_s": 120.0,
+            }),
+            on_record=on_record,
+            slo_config=slo_cfg,
+        ).start()
+        assert router.ready()
+        assert router.slo is not None
+        front = serve_router_http(router, port=0)
+        threading.Thread(target=front.serve_forever, daemon=True).start()
+        router_url = f"http://127.0.0.1:{front.server_address[1]}"
+
+        # phase 1: Poisson load while the stall is live
+        rng = np.random.default_rng(17)
+        box = {}
+
+        def drive(key, arrivals):
+            box[key] = router.run_workload(arrivals)
+
+        w1 = threading.Thread(
+            target=drive, args=("p1", _poisson_arrivals(rng, 50, 0.1, 8)),
+            daemon=True,
+        )
+        w1.start()
+        _wait_slo_state(router, "ttft_high", "firing", timeout_s=120.0)
+
+        # exactly the targeted SLO is firing, on every surface at once
+        stats = router.stats()
+        assert stats["alerts_firing"] == ["ttft_high"]
+        assert stats["slo"]["error_rate"]["state"] == "ok"
+        body = _http_text(router_url + "/metrics", 10.0)
+        assert 'automodel_alerts_firing{slo="ttft_high"} 1' in body
+        assert 'automodel_alerts_firing{slo="error_rate"} 0' in body
+        with rec_lock:
+            alerts = [r for r in records if r.get("event") == "slo_alert"]
+        assert [a["state"] for a in alerts] == ["pending", "firing"]
+        assert all(a["slo"] == "ttft_high" for a in alerts)
+        # the live surface shows the firing alert against both replicas
+        snap = snapshot(router_url, None, timeout_s=10.0)
+        assert snap["source"] == "router"
+        table = render_table(snap)
+        assert "ttft_high!" in table and "firing" in table
+
+        # recovery: wait out the stall window, then healthy load. The
+        # breached observations age out of the fast window and the alert
+        # resolves after resolve_s
+        w1.join(timeout=240)
+        assert "p1" in box, "phase-1 workload did not finish"
+        drive("p2", _poisson_arrivals(rng, 20, 0.15, 8))
+        _wait_slo_state(router, "ttft_high", "ok", timeout_s=60.0)
+
+        stats = router.stats()
+        assert stats["alerts_firing"] == []
+        assert stats["slo"]["ttft_high"]["fired_count"] == 1
+        assert stats["slo"]["error_rate"]["fired_count"] == 0
+        body = _http_text(router_url + "/metrics", 10.0)
+        _lint_exposition(body)  # router registry + federation, one exposition
+        assert 'automodel_alerts_firing{slo="ttft_high"} 0' in body
+        with rec_lock:
+            states = [
+                r["state"] for r in records if r.get("event") == "slo_alert"
+            ]
+        assert states == ["pending", "firing", "resolved"]
+        table = render_table(snapshot(router_url, None, timeout_s=10.0))
+        assert "ok" in table and "ttft_high!" not in table
+        assert "2/2 replicas ready" in table
+
+        # zero lost requests while all this was going on
+        for key, n in (("p1", 50), ("p2", 20)):
+            _, wstats = box[key]
+            assert wstats["requests"] == n, (key, wstats)
+            assert wstats["failed_requests"] == 0, (key, wstats)
+
+        # federation rollups == per-replica scrapes (counters are stable
+        # with the load drained; one more sweep ingests the final values)
+        router.probe_once()
+        per_replica = [
+            parse_exposition(
+                _http_text(f"http://127.0.0.1:{port}/metrics", 10.0)
+            )
+            for port in ports
+        ]
+        want_completed = sum(
+            f["automodel_serve_requests_completed"].samples[()]
+            for f in per_replica
+        )
+        assert router.federation.latest(
+            "automodel_fleet_serve_requests_completed"
+        ) == want_completed
+        fed_fams = parse_exposition(router.federation.render_federated())
+        rollup = fed_fams["automodel_fleet_serve_requests_completed"]
+        assert rollup.samples[()] == want_completed
+        for i, fams in enumerate(per_replica):
+            key = (("replica", f"r{i}"),)
+            assert fed_fams["automodel_serve_requests_completed"].samples[
+                key
+            ] == fams["automodel_serve_requests_completed"].samples[()]
+
+        # the JSONL is the same story: lints clean, report sees one fired
+        # alert and nothing left open
+        metric_logger.close()
+        jrecords, problems = lint_metrics_jsonl(str(metrics_path))
+        assert problems == []
+        summary = summarize_metrics(jrecords)
+        assert summary["slo_fired"] == {"ttft_high": 1}
+        assert summary["slo_alerts"] == 3
+        assert summary["slo_firing_s_total"]["ttft_high"] > 0
+        # the unresolved list only appears when something is left open
+        assert "slo_unresolved_at_exit" not in summary
+    finally:
+        if front is not None:
+            front.shutdown()
+            front.server_close()
+        if router is not None:
+            router.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=30)

@@ -1,0 +1,385 @@
+"""Fleet health plane, alerting side (telemetry/slo.py): objective
+validation, burn-rate math against scripted federation timelines, the
+pending→firing→resolved state machine, the alert gauges/counters, and
+the file/webhook sinks. Plus the fleet-status rendering helpers.
+
+All evaluation is driven through a real Federation fed synthetic scrape
+bodies at scripted timestamps — no sleeping, no subprocesses; the wall
+clock is injected.
+"""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from automodel_tpu.telemetry.federation import Federation
+from automodel_tpu.telemetry.prometheus import MetricsRegistry
+from automodel_tpu.telemetry.slo import SLOConfig, SLOEngine, SLOObjective
+
+
+# -- objective / config validation --------------------------------------------
+
+
+def test_objective_from_dict_strict():
+    ob = SLOObjective.from_dict({
+        "name": "ttft_p99", "kind": "latency",
+        "metric": "automodel_serve_ttft_seconds",
+        "q": 0.99, "threshold_s": 0.5, "burn_rate": 2.0,
+    })
+    assert ob.threshold == 0.5
+    with pytest.raises(TypeError):
+        SLOObjective.from_dict({"name": "x", "kind": "latency",
+                                "metric": "m", "threshold_s": 1.0,
+                                "thresholdd": 2.0})
+    # kind-specific required fields
+    with pytest.raises(TypeError):
+        SLOObjective.from_dict({"name": "x", "kind": "latency", "metric": "m"})
+    with pytest.raises(TypeError):
+        SLOObjective.from_dict({"name": "x", "kind": "ratio",
+                                "numerator": ["a"], "denominator": ["b"]})
+    with pytest.raises(TypeError):
+        SLOObjective.from_dict({"name": "x", "kind": "gauge", "metric": "m"})
+    with pytest.raises(TypeError):
+        SLOObjective.from_dict({"name": "x", "kind": "nope", "metric": "m"})
+
+
+def test_config_from_dict_strict():
+    cfg = SLOConfig.from_dict({
+        "fast_window_s": 10.0, "slow_window_s": 30.0,
+        "objectives": [{"name": "q", "kind": "gauge",
+                        "metric": "automodel_serve_queue_depth",
+                        "max_value": 5.0}],
+    })
+    assert cfg.retention_s >= 2 * cfg.slow_window_s
+    with pytest.raises(TypeError):
+        SLOConfig.from_dict({"fast_window_s": 60.0, "slow_window_s": 30.0})
+    with pytest.raises(TypeError):
+        SLOConfig(objectives=[
+            {"name": "dup", "kind": "gauge", "metric": "m", "max_value": 1.0},
+            {"name": "dup", "kind": "gauge", "metric": "m", "max_value": 2.0},
+        ])
+
+
+# -- scripted-federation harness ----------------------------------------------
+
+
+def _body(*, ttft=(), completed=0, shed=0, depth=0.0):
+    reg = MetricsRegistry()
+    h = reg.histogram("automodel_serve_ttft_seconds", "TTFT",
+                      buckets=(0.05, 0.1, 0.5, 1.0))
+    for v in ttft:
+        h.observe(v)
+    reg.counter("automodel_serve_requests_completed", "Done").inc(completed)
+    reg.counter("automodel_serve_requests_shed", "Shed").inc(shed)
+    reg.gauge("automodel_serve_queue_depth", "Depth").set(depth)
+    return reg.render()
+
+
+class _Harness:
+    """Engine + federation with an injected, scripted wall clock."""
+
+    def __init__(self, cfg):
+        self.fed = Federation(retention_s=cfg.retention_s)
+        self.registry = MetricsRegistry()
+        self.events = []
+        self.now = 0.0
+        self.engine = SLOEngine(
+            cfg, self.fed, registry=self.registry,
+            emit=self.events.append, wall=lambda: self.now,
+        )
+
+    def step(self, now, **body_kw):
+        self.now = now
+        self.fed.ingest("r0", _body(**body_kw), now=now)
+        self.fed.roll(now)
+        self.engine.evaluate(now)
+
+    def gauge(self, slo):
+        for line in self.registry.render().splitlines():
+            if line.startswith(f'automodel_alerts_firing{{slo="{slo}"}}'):
+                return float(line.rsplit(" ", 1)[1])
+        raise AssertionError(f"no firing gauge for {slo}")
+
+
+def _cfg(**over):
+    kw = dict(
+        fast_window_s=10.0, slow_window_s=30.0, for_s=0.0, resolve_s=10.0,
+        objectives=[
+            {"name": "ttft_p50", "kind": "latency",
+             "metric": "automodel_serve_ttft_seconds",
+             "q": 0.5, "threshold_s": 0.2},
+            {"name": "shed_rate", "kind": "ratio",
+             "numerator": ["automodel_serve_requests_shed"],
+             "denominator": ["automodel_serve_requests_completed"],
+             "max_ratio": 0.1},
+            {"name": "depth_ceiling", "kind": "gauge",
+             "metric": "automodel_serve_queue_depth", "max_value": 5.0},
+        ],
+    )
+    kw.update(over)
+    return SLOConfig.from_dict(kw)
+
+
+def test_healthy_timeline_never_alerts():
+    h = _Harness(_cfg())
+    for i in range(8):
+        h.step(5.0 * i, ttft=[0.01] * (i + 1), completed=10 * (i + 1),
+               shed=0, depth=1.0)
+    assert not h.events
+    assert h.engine.firing() == []
+    for name in ("ttft_p50", "shed_rate", "depth_ceiling"):
+        assert h.gauge(name) == 0.0
+    snap = h.engine.snapshot()
+    assert set(snap) == {"ttft_p50", "shed_rate", "depth_ceiling"}
+    assert all(s["state"] == "ok" for s in snap.values())
+    assert all(s["fired_count"] == 0 for s in snap.values())
+
+
+def test_latency_breach_fires_exactly_one_slo_then_resolves():
+    good, bad = [0.01], [0.7]
+    h = _Harness(_cfg())
+    # warm-up: healthy traffic in BOTH windows
+    h.step(0.0, ttft=good * 5, completed=5)
+    h.step(5.0, ttft=good * 10, completed=10)
+    # breach: 40 of the 45 fast-window observations land over 0.2s; the
+    # fraction-over / error-budget burn crosses 1 in both windows
+    h.step(10.0, ttft=good * 10 + bad * 40, completed=50)
+    assert h.engine.firing() == ["ttft_p50"]
+    assert h.gauge("ttft_p50") == 1.0
+    assert h.gauge("shed_rate") == 0.0
+    assert h.gauge("depth_ceiling") == 0.0
+    fire_events = [e for e in h.events if e["state"] == "firing"]
+    assert len(fire_events) == 1
+    ev = fire_events[0]
+    assert ev["event"] == "slo_alert" and ev["slo"] == "ttft_p50"
+    assert ev["kind"] == "latency"
+    assert ev["slo_value"] > ev["slo_threshold"] == 0.2
+    # a trickle of healthy traffic that does NOT outweigh the bad window
+    # keeps it firing (last_bad advances)
+    h.step(14.0, ttft=good * 15 + bad * 40, completed=55)
+    assert h.engine.firing() == ["ttft_p50"]
+    # recovery: the bad observations age out of the fast window, but the
+    # alert holds through resolve_s from the last bad sweep (t=14)
+    h.step(20.0, ttft=good * 215 + bad * 40, completed=255)
+    assert h.engine.firing() == ["ttft_p50"]  # 20-14 < resolve_s=10
+    h.step(26.0, ttft=good * 415 + bad * 40, completed=455)
+    assert h.engine.firing() == []
+    assert h.gauge("ttft_p50") == 0.0
+    states = [e["state"] for e in h.events if e["slo"] == "ttft_p50"]
+    assert states == ["pending", "firing", "resolved"]
+    resolved = h.events[-1]
+    assert resolved["state"] == "resolved"
+    assert resolved["slo_firing_s"] == pytest.approx(16.0)  # fired at t=10
+
+
+def test_for_s_dwell_pending_then_firing_then_cleared():
+    h = _Harness(_cfg(for_s=8.0))
+    h.step(0.0, completed=5, depth=1.0)
+    h.step(5.0, completed=10, depth=9.0)  # gauge over max_value=5
+    assert h.engine.firing() == []
+    pend = [e for e in h.events if e["state"] == "pending"]
+    assert len(pend) == 1 and pend[0]["slo"] == "depth_ceiling"
+    assert h.gauge("depth_ceiling") == 0.0  # pending is not firing
+    # still breaching past the dwell -> firing
+    h.step(14.0, completed=20, depth=9.0)
+    assert h.engine.firing() == ["depth_ceiling"]
+    assert h.gauge("depth_ceiling") == 1.0
+    # a breach that recovers INSIDE the dwell clears without ever firing
+    h2 = _Harness(_cfg(for_s=8.0))
+    h2.step(0.0, completed=5, depth=1.0)
+    h2.step(5.0, completed=10, depth=9.0)
+    h2.step(10.0, completed=15, depth=1.0)  # back under before dwell ends
+    states = [e["state"] for e in h2.events if e["slo"] == "depth_ceiling"]
+    assert states == ["pending", "cleared"]
+    assert h2.engine.firing() == []
+    assert h2.engine.snapshot()["depth_ceiling"]["fired_count"] == 0
+
+
+def test_ratio_objective_burn():
+    h = _Harness(_cfg())
+    h.step(0.0, completed=10, shed=0)
+    h.step(5.0, completed=20, shed=0)
+    # 15 new completions, 15 shed: shed/(shed+completed) folds the
+    # numerator into the total -> 15/30 = 0.5 >> max_ratio 0.1
+    h.step(10.0, completed=35, shed=15)
+    assert h.engine.firing() == ["shed_rate"]
+    ev = [e for e in h.events if e["slo"] == "shed_rate"][-1]
+    assert ev["slo_value"] == pytest.approx(15.0 / 40.0)
+    assert ev["slo_threshold"] == 0.1
+
+
+def test_empty_window_is_healthy():
+    """No traffic (no increase in either window) must read as healthy,
+    not as a division-by-zero or a spurious alert."""
+    h = _Harness(_cfg())
+    h.step(0.0)
+    h.step(5.0)
+    h.step(10.0)
+    assert not h.events and h.engine.firing() == []
+
+
+def test_transitions_counter_and_value_gauge():
+    h = _Harness(_cfg())
+    h.step(0.0, completed=5, depth=1.0)
+    h.step(5.0, completed=10, depth=9.0)
+    body = h.registry.render()
+    assert ('automodel_alerts_transitions_total'
+            '{slo="depth_ceiling",state="pending"} 1') in body
+    assert ('automodel_alerts_transitions_total'
+            '{slo="depth_ceiling",state="firing"} 1') in body
+    assert 'automodel_slo_value{slo="depth_ceiling"} 9' in body
+
+
+def test_alerts_path_file_sink(tmp_path):
+    alerts = tmp_path / "alerts.jsonl"
+    h = _Harness(_cfg(alerts_path=str(alerts)))
+    h.step(0.0, completed=5, depth=1.0)
+    h.step(5.0, completed=10, depth=9.0)
+    h.step(20.0, completed=20, depth=1.0)
+    lines = [json.loads(l) for l in alerts.read_text().splitlines()]
+    assert [l["state"] for l in lines] == ["pending", "firing", "resolved"]
+    assert all(l["event"] == "slo_alert" for l in lines)
+    # the file sink and the emit sink carry identical records
+    assert lines == h.events
+
+
+def test_webhook_sink_posts_transitions():
+    posts = []
+
+    class _Hook(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            posts.append(json.loads(self.rfile.read(n)))
+            self.send_response(204)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Hook)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/alert"
+        h = _Harness(_cfg(webhook_url=url))
+        h.step(0.0, completed=5, depth=1.0)
+        h.step(5.0, completed=10, depth=9.0)
+        assert [p["state"] for p in posts] == ["pending", "firing"]
+        assert all(p["slo"] == "depth_ceiling" for p in posts)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(timeout=5)
+
+
+def test_slo_alert_events_lint_clean(tmp_path):
+    """Every record the engine emits must pass report --strict's linter —
+    the JSONL contract satellite, checked at the source."""
+    from automodel_tpu.telemetry.report import lint_metrics_jsonl
+
+    h = _Harness(_cfg(for_s=8.0))
+    h.step(0.0, completed=5, depth=1.0)
+    h.step(5.0, completed=10, depth=9.0)
+    h.step(14.0, completed=20, depth=9.0)
+    h.step(30.0, completed=30, depth=1.0)
+    assert [e["state"] for e in h.events] == ["pending", "firing", "resolved"]
+    path = tmp_path / "metrics.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in h.events))
+    records, problems = lint_metrics_jsonl(str(path))
+    assert problems == []
+    assert len(records) == 3
+
+
+def test_report_summarizes_slo_alerts():
+    from automodel_tpu.telemetry.report import summarize_metrics
+
+    h = _Harness(_cfg(for_s=8.0))
+    h.step(0.0, completed=5, depth=1.0)
+    h.step(5.0, completed=10, depth=9.0)
+    h.step(14.0, completed=20, depth=9.0)  # firing, never resolved
+    out = summarize_metrics(h.events)
+    assert out["slo_alerts"] == 2  # pending + firing
+    assert out["slo_fired"] == {"depth_ceiling": 1}
+    assert out["slo_unresolved_at_exit"] == ["depth_ceiling"]
+
+
+# -- fleet-status surface ------------------------------------------------------
+
+
+def test_fleet_status_render_table_and_alerts():
+    from automodel_tpu.serving.fleet.status import _alerts_for, render_table
+
+    stats = {
+        "replicas": {
+            "r0": {"role": "mixed", "alive": True, "ready": True,
+                   "queue_depth": 2, "busy_slots": 1,
+                   "block_occupancy": 0.25, "prefix_hit_rate": 0.5,
+                   "spec_accept_rate": None, "shed_total": 0},
+            "r1": {"role": "mixed", "alive": False, "ready": False,
+                   "queue_depth": None, "busy_slots": None,
+                   "block_occupancy": None, "prefix_hit_rate": None,
+                   "spec_accept_rate": None, "shed_total": None},
+        },
+        "replicas_ready": 1,
+        "slo": {
+            "ttft_p50": {"state": "firing", "kind": "latency",
+                         "value": 0.7, "threshold": 0.2, "fired_count": 1},
+            "shed_rate": {"state": "pending", "kind": "ratio",
+                          "value": 0.2, "threshold": 0.1, "fired_count": 0},
+            "depth_ceiling": {"state": "ok", "kind": "gauge",
+                              "value": 1.0, "threshold": 5.0,
+                              "fired_count": 0},
+        },
+    }
+    assert _alerts_for(stats) == "ttft_p50!,shed_rate?"
+    assert _alerts_for({"slo": {"x": {"state": "ok"}}}) == "ok"
+    assert _alerts_for({}) == "-"
+    table = render_table(stats)
+    assert "r0" in table and "r1" in table
+    assert "down" in table  # r1 not alive
+    assert "ttft_p50!,shed_rate?" in table
+    assert "1/2 replicas ready" in table
+    assert "firing" in table and "threshold=0.2" in table
+
+
+def test_fleet_status_direct_snapshot_against_live_replica():
+    """--direct mode probes replica /readyz + /stats itself (no router
+    required): point it at a one-replica in-process HTTP server."""
+    pytest.importorskip("jax")
+    from automodel_tpu.serving.fleet.router import FleetConfig
+    from automodel_tpu.serving.fleet.status import render_table, snapshot
+    from tests.test_fleet import _engine, _http_replica
+
+    eng = _engine()
+    server, loop = _http_replica(eng)
+    try:
+        port = server.server_address[1]
+        fcfg = FleetConfig.from_dict({
+            "replicas": [{"url": f"http://127.0.0.1:{port}", "name": "r0"}],
+            "block_size": 4,
+        })
+        snap = snapshot(None, fcfg, timeout_s=5.0, direct=True)
+        assert snap["source"] == "direct"
+        assert snap["replicas_ready"] == 1
+        row = snap["replicas"]["r0"]
+        assert row["alive"] and row["ready"]
+        assert row["queue_depth"] is not None
+        # an unreachable second replica renders as down, not a crash
+        fcfg2 = FleetConfig.from_dict({
+            "replicas": [
+                {"url": f"http://127.0.0.1:{port}", "name": "r0"},
+                {"url": "http://127.0.0.1:9", "name": "r1"},
+            ],
+            "block_size": 4, "probe_timeout_s": 0.5,
+        })
+        snap2 = snapshot(None, fcfg2, timeout_s=0.5, direct=True)
+        assert snap2["replicas_ready"] == 1
+        assert not snap2["replicas"]["r1"]["alive"]
+        table = render_table(snap2)
+        assert "down" in table and "1/2 replicas ready" in table
+    finally:
+        server.shutdown()
+        server.server_close()
+        loop.close()
