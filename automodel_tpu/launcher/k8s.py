@@ -396,3 +396,25 @@ def submit_fleet(
     if apply:
         subprocess.run(["kubectl", "apply", "-f", str(path)], check=True)
     return path
+
+
+def scale_fleet_role(
+    cfg: K8sFleetConfig, role: str, replicas: int, apply: bool = True
+) -> list[str]:
+    """Resize one role's StatefulSet (the autoscaler's k8s backend).
+
+    A scale-down removes the highest ordinal pod; its preStop/SIGTERM
+    path runs the serve front's drain, so the same retire semantics the
+    local backend gets from POST /retire arrive here via pod lifecycle.
+    Returns the kubectl argv (tests assert it without a cluster)."""
+    if role not in ("mixed", "prefill", "decode"):
+        raise ValueError(f"k8s_fleet: unknown role {role!r}")
+    if replicas < 0:
+        raise ValueError(f"k8s_fleet: replicas={replicas}")
+    argv = [
+        "kubectl", "scale", "statefulset", f"{cfg.name}-{role}",
+        f"--replicas={replicas}",
+    ]
+    if apply:
+        subprocess.run(argv, check=True)
+    return argv

@@ -36,6 +36,19 @@ wrong, deterministically, on CPU, in tier-1. Four fault classes:
   from scheduler step N for a bounded wall-clock window, so the fleet SLO
   engine's pending→firing→resolved lifecycle (telemetry/slo.py) is
   drivable end-to-end in tier-1
+- ``weights_stream_abort_after`` — a serving peer answering a warm-start
+  ``weights_fetch`` closes the connection after streaming that many leaves
+  (the peer "dies" mid-stream), so the joiner's truncated-frame detection
+  and cold-load fallback ladder are drivable in tier-1
+- ``kv_push_drop_ack`` — a migration target accepting a scale-down
+  ``kv_push`` closes the socket instead of acking (the survivor "dies"
+  mid-ship), so the retiring replica's degrade-to-plain-drain path and its
+  bounded exit deadline are drivable in tier-1
+- ``hf_load_delay_ms`` — sleep that long inside the cold model load, a
+  stand-in for the real HF checkpoint download/parse cost that is near
+  zero on the tiny test models, so peer warm-start's time_to_ready_s win
+  is measurable on CPU (the same role ``slow_collate_ms`` plays for the
+  input-pipeline overlap proof)
 
 Activation: a ``fault_injection:`` YAML section (recipes call
 ``activate_from_config``) or the ``AUTOMODEL_FAULT_INJECTION`` env var
@@ -117,6 +130,13 @@ class FaultInjectionConfig:
     slo_breach_ms: float = 0.0
     slo_breach_from_step: int = 0
     slo_breach_for_s: Optional[float] = None
+    # elastic-fleet chaos knobs (tests/test_fleet_elastic.py): a warm-start
+    # weights stream truncated after N leaves, a migration push dropped
+    # before its ack, and an injected cold-load cost so the warm-vs-cold
+    # time_to_ready_s A/B has a real delta on tiny CPU models
+    weights_stream_abort_after: Optional[int] = None
+    kv_push_drop_ack: bool = False
+    hf_load_delay_ms: float = 0.0
 
 
 def _process_index() -> int:
@@ -251,6 +271,33 @@ class FaultInjector:
                 return
         time.sleep(c.slo_breach_ms / 1000.0)
 
+    def should_abort_weights_stream(self, leaves_sent: int) -> bool:
+        """True when the warm-start weights stream should die after
+        ``leaves_sent`` leaves (checked between leaf writes in
+        ``KVTransferServer._handle_weights``)."""
+        c = self.config
+        return (
+            c.weights_stream_abort_after is not None
+            and leaves_sent >= c.weights_stream_abort_after
+        )
+
+    def should_drop_kv_push(self) -> bool:
+        """True when a migration target should close instead of acking an
+        accepted ``kv_push`` (the survivor dies mid-ship)."""
+        return self.config.kv_push_drop_ack
+
+    def maybe_hf_load_delay(self) -> None:
+        """Injected cold-load cost (called from the model-build path) —
+        the stand-in for real HF download/parse time on tiny test models."""
+        ms = self.config.hf_load_delay_ms
+        if ms > 0:
+            import time
+
+            logger.warning(
+                "fault injection: delaying cold model load by %.0fms", ms
+            )
+            time.sleep(ms / 1000.0)
+
     def maybe_straggle(self, step: int) -> None:
         c = self.config
         if c.straggle_host is None or c.straggle_ms <= 0:
@@ -332,6 +379,9 @@ def activate(config: FaultInjectionConfig | dict | None) -> Optional[FaultInject
         or config.serve_exhaust_blocks_at_step is not None
         or (config.trace_delay_stage is not None and config.trace_delay_ms > 0)
         or (config.slo_breach_stage is not None and config.slo_breach_ms > 0)
+        or config.weights_stream_abort_after is not None
+        or config.kv_push_drop_ack
+        or config.hf_load_delay_ms > 0
     )
     if not armed:
         # an empty `fault_injection: {}` section (the docs' example form)

@@ -249,6 +249,45 @@ class KVSpillConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class WarmStartConfig:
+    """The ``serving.warm_start:`` section — elastic-fleet peer warm-start
+    (docs/serving.md "Elastic fleet"). When a peer is named, a starting
+    replica builds its model STRUCTURALLY (shapes + sharding, seeded
+    params) and then streams the actual weights from that peer's AKV1
+    listener (``op: weights_fetch``) instead of paying the cold HF load,
+    validating the peer's param-tree signature (the PR 6 checkpoint guard)
+    against its own tree before swapping a single leaf. ANY failure —
+    transport death, refusal, digest mismatch — falls back to the cold
+    load path unchanged; warm-start is an optimization, never a
+    correctness dependency. The boot source actually taken is recorded as
+    ``boot_source`` (``cold_hf`` | ``peer_warm_start``) beside
+    ``time_to_ready_s`` on /stats and the metrics JSONL."""
+
+    peer_host: Optional[str] = None
+    peer_port: Optional[int] = None  # the peer's kv_transfer listener port
+    timeout_s: float = 60.0  # whole-tree stream budget
+
+    def __post_init__(self):
+        if (self.peer_host is None) != (self.peer_port is None):
+            raise ValueError(
+                "serving.warm_start needs BOTH peer_host and peer_port "
+                f"(got host={self.peer_host!r}, port={self.peer_port!r})"
+            )
+        if self.timeout_s <= 0:
+            raise ValueError(
+                f"serving.warm_start.timeout_s={self.timeout_s} (want > 0)"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self.peer_host is not None
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "WarmStartConfig":
+        return _cfg_dict(cls, d, "serving.warm_start")
+
+
+@dataclasses.dataclass(frozen=True)
 class SpeculativeConfig:
     """The ``serving.speculative:`` section — draft-and-verify speculative
     decoding (Leviathan et al. 2023). A small draft model proposes ``k``
@@ -318,6 +357,9 @@ class ServeConfig:
         default_factory=KVTransferConfig
     )
     kv_spill: KVSpillConfig = dataclasses.field(default_factory=KVSpillConfig)
+    warm_start: WarmStartConfig = dataclasses.field(
+        default_factory=WarmStartConfig
+    )
 
     def __post_init__(self):
         if self.slots < 1 or self.block_size < 1 or self.prefill_chunk < 1:
@@ -362,6 +404,7 @@ class ServeConfig:
             ("speculative", SpeculativeConfig),
             ("kv_transfer", KVTransferConfig),
             ("kv_spill", KVSpillConfig),
+            ("warm_start", WarmStartConfig),
         ):
             v = d.get(key)
             if v is not None and not isinstance(v, sub):
@@ -608,6 +651,13 @@ class ServingEngine:
         self.kv_injected_total = 0  # handoffs admitted into this pool
         self.first_decode_done = False  # readiness: first compiled decode
         self.last_step_t: Optional[float] = None  # monotonic, health age
+        # elastic-fleet boot provenance: the server front stamps boot_t
+        # (perf_counter at process start, BEFORE the model build — load
+        # time is the whole point of the measurement) and boot_source;
+        # note_ready() computes time_to_ready_s at first readiness
+        self.boot_t: Optional[float] = None
+        self.boot_source = "cold_hf"  # cold_hf | peer_warm_start
+        self.time_to_ready_s: Optional[float] = None
         # /metrics exposition (telemetry/prometheus.py): histograms are
         # observed per completion (cheap, python dict ops); gauges + pool
         # counters sync at scrape time so the scheduler loop pays nothing
@@ -737,6 +787,31 @@ class ServingEngine:
 
     def idle(self) -> bool:
         return not self._queue and self.busy_slots == 0
+
+    def note_ready(self) -> None:
+        """Stamp ``time_to_ready_s`` at this replica's FIRST readiness
+        (idempotent; called after warmup and from the /readyz handler so
+        warmup-disabled servers still stamp on their first true probe).
+        Emits one ``replica_ready`` record — the elastic fleet's
+        warm-vs-cold A/B number, labeled with the boot source taken."""
+        if (
+            self.time_to_ready_s is not None
+            or not self.first_decode_done
+            or self.boot_t is None
+        ):
+            return
+        self.time_to_ready_s = time.perf_counter() - self.boot_t
+        logger.info(
+            "replica ready in %.3fs (boot source: %s)",
+            self.time_to_ready_s, self.boot_source,
+        )
+        if self.on_record is not None:
+            self.on_record({
+                "event": "replica_ready",
+                "ts": self._wall_ts(),
+                "boot_source": self.boot_source,
+                "time_to_ready_s": round(self.time_to_ready_s, 6),
+            })
 
     # -- stall watchdog -------------------------------------------------------
     def start_watchdog(self, flight_recorder: Any = None,
@@ -967,6 +1042,63 @@ class ServingEngine:
         if not pieces:
             return 0, None
         return len(pieces), paged.concat_kv_blocks(pieces)
+
+    # -- elastic fleet (docs/serving.md "Elastic fleet") ----------------------
+    def export_hot_blocks(self, limit: Optional[int] = None):
+        """A retiring replica's migration export: up to ``limit`` hot
+        prefix blocks in EVICTION-DISTANCE order (pinned, then parked LRU
+        MRU-first, then spill-tier MRU-first — exactly the
+        ``cached_chain_hashes`` advertisement order, so the blocks most
+        worth keeping warm ship first if the deadline cuts the transfer
+        short). → ``(chain_hashes, kv | None)``. Caller holds the
+        scheduler lock."""
+        hashes = self.pool.cached_chain_hashes(
+            self.config.hot_prefix_advertise if limit is None else int(limit)
+        )
+        tier = self.pool.spill
+        out: list[int] = []
+        pieces: list[dict] = []
+        for h in hashes:
+            bid = self.pool.cached_block(int(h))
+            if bid is not None:
+                k, v = paged.extract_blocks(self._pool, [bid])
+                pieces.append({"k": k, "v": v})
+                out.append(int(h))
+                continue
+            p = tier.get(int(h)) if tier is not None else None
+            if p is not None:
+                pieces.append(p)
+                out.append(int(h))
+        if not pieces:
+            return [], None
+        return out, paged.concat_kv_blocks(pieces)
+
+    def receive_migrated_blocks(self, chain_hashes: Sequence[int], kv: dict) -> int:
+        """A survivor's migration sink (the AKV1 ``kv_push`` handler):
+        park the shipped block rows in the HOST SPILL TIER keyed by their
+        chain hashes — the next admission sharing the prefix reloads them
+        through the normal hierarchy seam, and ``cached_chain_hashes``
+        re-advertises them so router affinity follows the heat. Blocks
+        this replica already holds (resident or spilled) are skipped.
+        → the number of blocks accepted. Requires ``kv_spill.enabled``
+        (no tier → 0 accepted, a loud refusal upstream). Caller holds the
+        scheduler lock."""
+        tier = self.pool.spill
+        if tier is None:
+            return 0
+        payloads = paged.split_kv_blocks(kv)
+        accepted = 0
+        for h, payload in zip(chain_hashes, payloads):
+            h = int(h)
+            if self.pool.cached_block(h) is not None or tier.get(h) is not None:
+                continue
+            if tier.put(h, payload, paged.kv_nbytes(payload)):
+                # the spill ledger counts tier entries however they arrived
+                # (eviction or migration) — check_invariants pins
+                # spilled_blocks == spill_puts
+                self.pool.counters["spilled_blocks"] += 1
+                accepted += 1
+        return accepted
 
     def _resolve_hierarchy(
         self, q: _Queued, hits: list, hit_tokens: int, fresh: list

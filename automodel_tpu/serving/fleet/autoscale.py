@@ -1,0 +1,353 @@
+"""Closed-loop fleet autoscaling: federation signals → hysteresis → scale.
+
+The PR 17 health plane already federates every replica's /metrics into
+fleet series on the router (telemetry/federation.py) and evaluates SLO
+burn rates against them (telemetry/slo.py). This module closes the loop:
+a controller on the router consumes those EXISTING signals — fleet queue
+depth, windowed shed rate, block occupancy, firing SLOs — through a
+hysteresis/cooldown state machine and changes the replica count when the
+fleet is persistently over- or under-provisioned.
+
+The state machine (``Autoscaler.decide``, pure — unit-testable without a
+fleet):
+
+- **classification** — a signal snapshot is OVER when any scale-up
+  trigger trips (queue depth, shed rate, occupancy above their high-water
+  marks, or an SLO firing), UNDER when every scale-down condition holds
+  (queue + occupancy below their low-water marks, zero sheds in the
+  window, no SLO firing), HOLD otherwise. High != low water marks are the
+  first hysteresis band: a fleet sitting between them never oscillates.
+- **consecutive-evaluation debounce** — the second hysteresis stage: a
+  direction must classify identically for ``scale_up_consecutive`` /
+  ``scale_down_consecutive`` probe sweeps in a row before it acts. One
+  noisy sweep (a burst absorbed by the queue, a scrape gap) resets the
+  streak.
+- **cooldown** — after ANY scale event, ``cooldown_s`` of wall clock must
+  pass before the next one: a freshly spawned replica needs time to reach
+  ready and absorb load before the same signals can justify another step,
+  and a freshly retired one needs its load to redistribute. Streaks keep
+  accumulating during cooldown; action is what is deferred.
+
+Acting on a decision is the ROUTER's job (``Router._autoscale_tick``): it
+picks the scale-down victim (least-loaded ready replica) and the
+migration target, and executes through a :class:`ScaleBackend` —
+:class:`LocalProcessBackend` (spawn/retire local replica subprocesses;
+the CPU e2e harness) or :class:`K8sFleetBackend` (``kubectl scale`` on
+the role StatefulSets ``launcher/k8s.py`` renders). Every scale event
+emits one ``scale_event`` JSONL record (direction, trigger signal,
+replicas before/after) and bumps the ``automodel_route_autoscale_*``
+/metrics families.
+
+Scaling is always one replica per event: the cooldown makes the loop a
+damped integrator, and single steps keep a mis-tuned threshold from
+flapping the whole fleet at once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _cfg_dict(cls, d: Optional[dict], section: str):
+    d = dict(d or {})
+    d.pop("_target_", None)
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(d) - known
+    if unknown:
+        raise TypeError(f"unknown {section} keys: {sorted(unknown)}")
+    return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """The top-level ``autoscale:`` YAML section (lives beside ``fleet:``
+    in a router config). Thresholds are FLEET-MEAN per-ready-replica
+    values (a 3-replica fleet with 30 queued requests has queue depth 10),
+    so the same config works at any fleet size."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # scale-up triggers (any one trips an OVER classification)
+    queue_depth_high: float = 8.0  # fleet mean queued per ready replica
+    shed_rate_high: float = 0.5  # fleet sheds/second over window_s
+    occupancy_high: float = 0.92  # mean block-pool occupancy
+    slo_firing_scales_up: bool = True
+    # scale-down conditions (ALL must hold for an UNDER classification)
+    queue_depth_low: float = 0.5
+    occupancy_low: float = 0.35
+    # hysteresis: consecutive identical classifications before acting
+    scale_up_consecutive: int = 2
+    scale_down_consecutive: int = 5
+    cooldown_s: float = 30.0  # wall clock between scale events
+    window_s: float = 30.0  # shed-rate measurement window
+    # scale-down robustness: drain + hot-prefix migration semantics
+    migrate_on_scale_down: bool = True
+    retire_deadline_s: float = 30.0  # drain + migrate must fit inside this
+    # scale-up robustness: new replicas peer-warm-start when a serving
+    # peer advertises a KV listener (LocalProcessBackend honors this; on
+    # k8s the replica template's own serving.warm_start config decides)
+    warm_start: bool = True
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(f"autoscale.min_replicas={self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"autoscale.max_replicas={self.max_replicas} < "
+                f"min_replicas={self.min_replicas}"
+            )
+        if self.queue_depth_low >= self.queue_depth_high:
+            raise ValueError(
+                f"autoscale.queue_depth_low={self.queue_depth_low} must sit "
+                f"below queue_depth_high={self.queue_depth_high} — the gap "
+                "IS the hysteresis band"
+            )
+        if self.occupancy_low >= self.occupancy_high:
+            raise ValueError(
+                f"autoscale.occupancy_low={self.occupancy_low} must sit "
+                f"below occupancy_high={self.occupancy_high}"
+            )
+        if self.scale_up_consecutive < 1 or self.scale_down_consecutive < 1:
+            raise ValueError(
+                "autoscale.scale_up_consecutive/scale_down_consecutive "
+                "must be >= 1"
+            )
+        if self.cooldown_s < 0 or self.window_s <= 0:
+            raise ValueError(
+                f"autoscale: cooldown_s={self.cooldown_s} (want >= 0), "
+                f"window_s={self.window_s} (want > 0)"
+            )
+        if self.retire_deadline_s <= 0:
+            raise ValueError(
+                f"autoscale.retire_deadline_s={self.retire_deadline_s}"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "AutoscaleConfig":
+        return _cfg_dict(cls, d, "autoscale")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSignals:
+    """One probe sweep's signal snapshot, as fed to ``Autoscaler.decide``.
+    ``None`` means the federation has no data for that signal yet (cold
+    start, every replica down) — an unknown never triggers a scale."""
+
+    ready_replicas: int
+    queue_depth: Optional[float] = None  # fleet mean per ready replica
+    shed_rate: Optional[float] = None  # fleet sheds/second over window_s
+    occupancy: Optional[float] = None  # fleet mean block occupancy
+    slos_firing: int = 0
+
+
+class Autoscaler:
+    """The hysteresis/cooldown state machine. ``decide`` is the whole
+    behavior — pure in (signals, actual, now), with only the streak
+    counters and last-event stamp as state."""
+
+    def __init__(self, config: AutoscaleConfig):
+        self.config = config
+        self._over_streak = 0
+        self._under_streak = 0
+        self._last_event_t: Optional[float] = None
+        self.last_event: Optional[dict] = None  # fleet-status display
+        self.events_total = {"up": 0, "down": 0}
+
+    # -- classification (pure) ------------------------------------------------
+    def classify(self, s: FleetSignals) -> tuple[str, Optional[str]]:
+        """→ ("over"|"under"|"hold", trigger signal name). Unknown signals
+        (None) neither trip a trigger nor satisfy a scale-down condition."""
+        c = self.config
+        if s.ready_replicas < 1:
+            # an all-down fleet is an availability incident, not load:
+            # scaling on it would race replica startup/probe recovery
+            return "hold", None
+        if s.queue_depth is not None and s.queue_depth > c.queue_depth_high:
+            return "over", "queue_depth"
+        if s.shed_rate is not None and s.shed_rate > c.shed_rate_high:
+            return "over", "shed_rate"
+        if s.occupancy is not None and s.occupancy > c.occupancy_high:
+            return "over", "occupancy"
+        if c.slo_firing_scales_up and s.slos_firing > 0:
+            return "over", "slo_firing"
+        under = (
+            s.queue_depth is not None
+            and s.queue_depth < c.queue_depth_low
+            and s.occupancy is not None
+            and s.occupancy < c.occupancy_low
+            and (s.shed_rate is not None and s.shed_rate == 0.0)
+            and s.slos_firing == 0
+        )
+        return ("under", "idle") if under else ("hold", None)
+
+    # -- the state machine ----------------------------------------------------
+    def decide(
+        self, signals: FleetSignals, actual: int, now: float
+    ) -> tuple[Optional[str], Optional[str]]:
+        """One probe sweep's evaluation. → ``(direction, trigger)`` where
+        direction is ``"up"``/``"down"`` when a scale should happen NOW
+        and None otherwise. The caller MUST follow a non-None direction
+        with ``note_scaled`` once the action lands (that is what starts
+        the cooldown and resets the streaks)."""
+        c = self.config
+        if not c.enabled:
+            return None, None
+        state, trigger = self.classify(signals)
+        self._over_streak = self._over_streak + 1 if state == "over" else 0
+        self._under_streak = self._under_streak + 1 if state == "under" else 0
+        if (
+            self._last_event_t is not None
+            and now - self._last_event_t < c.cooldown_s
+        ):
+            return None, None  # streaks accumulate; action is deferred
+        if self._over_streak >= c.scale_up_consecutive:
+            if actual >= c.max_replicas:
+                return None, None  # at the ceiling: keep shedding loudly
+            return "up", trigger
+        if self._under_streak >= c.scale_down_consecutive:
+            if actual <= c.min_replicas:
+                return None, None
+            return "down", trigger
+        return None, None
+
+    def note_scaled(self, event: dict, now: float) -> None:
+        """Record a landed scale event: starts the cooldown, resets both
+        streaks, and keeps the event for fleet-status display."""
+        self._last_event_t = now
+        self._over_streak = 0
+        self._under_streak = 0
+        self.last_event = dict(event)
+        d = event.get("direction")
+        if d in self.events_total:
+            self.events_total[d] += 1
+
+    def status(self) -> dict:
+        """The /stats ``autoscale`` block (fleet-status renders it)."""
+        c = self.config
+        return {
+            "enabled": c.enabled,
+            "min_replicas": c.min_replicas,
+            "max_replicas": c.max_replicas,
+            "over_streak": self._over_streak,
+            "under_streak": self._under_streak,
+            "scale_ups": self.events_total["up"],
+            "scale_downs": self.events_total["down"],
+            "last_event": self.last_event,
+        }
+
+
+# -- backends ------------------------------------------------------------------
+
+
+class ScaleBackendError(RuntimeError):
+    """A backend action failed — the autoscaler logs, skips the event, and
+    re-evaluates at the next sweep (no cooldown is started)."""
+
+
+class LocalProcessBackend:
+    """Scale by spawning/retiring local replica subprocesses — the CPU
+    e2e harness's backend, and the reference for what any backend owes
+    the router:
+
+    - ``spawn(warm_peer)`` → ``(name, url)`` of a NEW replica already
+      listening (the callable owns process creation, port discovery, and
+      wiring ``serving.warm_start`` at the given ``{"host", "port"}``
+      peer when one is offered).
+    - ``retire(name, url, migrate, deadline_s)`` → POST /retire on the
+      victim (the serve front owns drain → migrate → exit from there).
+    """
+
+    registry_managed = True  # the router adds/removes what this spawns
+
+    def __init__(self, spawn: Any, retire: Any = None):
+        self._spawn = spawn
+        self._retire = retire
+
+    def spawn(self, warm_peer: Optional[dict]) -> tuple[str, str]:
+        try:
+            name, url = self._spawn(warm_peer)
+        except Exception as e:
+            raise ScaleBackendError(f"replica spawn failed: {e}") from e
+        return str(name), str(url)
+
+    def retire(
+        self, name: str, url: str, migrate: Optional[dict], deadline_s: float
+    ) -> None:
+        if self._retire is not None:
+            try:
+                self._retire(name, url, migrate, deadline_s)
+                return
+            except Exception as e:
+                raise ScaleBackendError(
+                    f"replica retire failed: {e}"
+                ) from e
+        # default: the serve front's own /retire endpoint
+        from automodel_tpu.serving.fleet.router import (  # lazy: no cycle
+            ReplicaUnreachable,
+            _http_json,
+        )
+
+        try:
+            code, body = _http_json(
+                url.rstrip("/") + "/retire",
+                {"migrate": migrate, "deadline_s": deadline_s},
+                timeout_s=5.0,
+            )
+        except ReplicaUnreachable as e:
+            raise ScaleBackendError(f"retire POST to {url} failed: {e}") from e
+        if code != 200:
+            raise ScaleBackendError(
+                f"{url} refused /retire ({code}): {body.get('error')}"
+            )
+
+
+class K8sFleetBackend:
+    """Scale a ``launcher/k8s.py`` fleet by resizing one role's
+    StatefulSet (``kubectl scale``). The k8s control plane owns pod
+    lifecycle: a scale-down removes the HIGHEST ordinal, whose preStop/
+    SIGTERM path runs the serve front's normal drain; the router observes
+    membership change through its DNS/probe sweep rather than through
+    add_replica/remove_replica, so ``spawn``/``retire`` here only change
+    the desired count."""
+
+    registry_managed = False  # membership arrives/leaves by probe sweep
+
+    def __init__(self, cfg: Any, role: str = "mixed", current: int = None):
+        self.cfg = cfg
+        self.role = role
+        # desired-count bookkeeping: kubectl is the source of truth, but
+        # the backend tracks what it last requested so consecutive events
+        # compose without a kubectl round trip per sweep
+        self.desired = int(
+            current if current is not None else getattr(cfg, role, 1)
+        )
+
+    def spawn(self, warm_peer: Optional[dict]) -> tuple[str, str]:
+        from automodel_tpu.launcher.k8s import scale_fleet_role
+
+        self.desired += 1
+        try:
+            scale_fleet_role(self.cfg, self.role, self.desired)
+        except Exception as e:
+            self.desired -= 1
+            raise ScaleBackendError(f"kubectl scale up failed: {e}") from e
+        # the pod joins through DNS discovery; there is no URL to return —
+        # the router treats an empty name as "membership arrives by probe"
+        return "", ""
+
+    def retire(
+        self, name: str, url: str, migrate: Optional[dict], deadline_s: float
+    ) -> None:
+        from automodel_tpu.launcher.k8s import scale_fleet_role
+
+        self.desired = max(self.desired - 1, 0)
+        try:
+            scale_fleet_role(self.cfg, self.role, self.desired)
+        except Exception as e:
+            self.desired += 1
+            raise ScaleBackendError(f"kubectl scale down failed: {e}") from e

@@ -39,6 +39,26 @@ mismatch, a missing handler, or zero matching blocks all answer loudly in
 the response header; any transport death raises on the requester, whose
 fallback is always local recompute.
 
+**Weights fetch (``op: weights_fetch``)** is the elastic-fleet warm-start
+path (docs/serving.md "Elastic fleet"): a JOINING replica asks a serving
+peer for its whole param tree instead of paying the cold HF load. The
+requester sends an array-less frame; the peer answers with a full AKV1
+frame whose header carries the param-tree SIGNATURE (the PR 6 checkpoint
+guard's ``{n_leaves, digest, entries}``) and whose arrays are the leaves,
+keyed by tree path, streamed ONE LEAF AT A TIME (the ``hf_io`` shard-by-
+shard idiom: peak host memory on the serving side is one leaf, not the
+model). The requester validates the digest against its OWN structurally
+built tree before swapping a single weight in; any failure — transport
+death, refusal, digest mismatch — raises, and the joiner's fallback ladder
+lands on the cold load it was trying to skip.
+
+**Prefix push (``op: kv_push``)** is the scale-down migration path: a
+RETIRING replica, drained, ships its hot prefix blocks (same chain-hash
+keys, eviction-distance order) to a survivor's listener as one full AKV1
+frame; the survivor parks whatever it can in its host spill tier and acks
+``{"ok": true, "blocks": accepted}``. Push failure never blocks
+retirement — the retiring side degrades to plain drain.
+
 This module imports no jax: numpy (+ ml_dtypes for bf16) only, so the
 router and tests can exercise the wire format without a device runtime.
 """
@@ -261,6 +281,88 @@ def fetch_kv(
     return n, kv
 
 
+def fetch_weights(
+    addr: tuple[str, int],
+    timeout_s: float = 60.0,
+    max_frame_bytes: Optional[int] = None,
+) -> tuple[dict, dict[str, np.ndarray]]:
+    """Ask the serving peer at ``addr`` for its whole param tree (the
+    warm-start path). → ``(signature, arrays)`` — the peer's param-tree
+    signature dict (``{n_leaves, digest, entries}``) and the leaves keyed
+    by tree path. Raises :class:`KVTransferError` on transport death, a
+    refusal, or a malformed reply; the caller's fallback ladder lands on
+    the cold HF load."""
+    from automodel_tpu.resilience.fault_injection import active_injector
+
+    inj = active_injector()
+    if inj is not None:
+        inj.maybe_trace_delay("weights_fetch")
+    try:
+        with socket.create_connection(addr, timeout=timeout_s) as sock:
+            _write_frame(sock, {"op": "weights_fetch"}, [])
+            resp, arrays = _read_frame(sock, max_frame_bytes=max_frame_bytes)
+    except (OSError, ValueError) as e:
+        raise KVTransferError(f"weights fetch from {addr} failed: {e}") from e
+    if not resp.get("ok"):
+        raise KVTransferError(
+            f"peer at {addr} refused the weights fetch: "
+            f"{resp.get('error', 'unknown error')}"
+        )
+    sig = resp.get("signature")
+    if not isinstance(sig, dict) or "digest" not in sig:
+        raise KVTransferError(
+            f"peer at {addr} sent no param-tree signature with its weights"
+        )
+    n = sig.get("n_leaves")
+    if isinstance(n, int) and n != len(arrays):
+        raise KVTransferError(
+            f"peer at {addr} signed {n} leaves but shipped {len(arrays)}"
+        )
+    return sig, arrays
+
+
+def push_kv(
+    addr: tuple[str, int],
+    chain_hashes: Sequence[int],
+    kv: dict,
+    geometry: dict,
+    timeout_s: float = 10.0,
+) -> int:
+    """Ship the prefix blocks named by ``chain_hashes`` (consecutive chain
+    order) to the survivor at ``addr`` — the scale-down migration path.
+    ``kv`` carries ``len(chain_hashes)`` block rows. → the number of
+    blocks the survivor accepted into its spill tier. Raises
+    :class:`KVTransferError` on transport death or refusal; the retiring
+    caller's fallback is plain drain, never a blocked exit."""
+    from automodel_tpu.resilience.fault_injection import active_injector
+
+    inj = active_injector()
+    if inj is not None:
+        inj.maybe_trace_delay("kv_push")
+    header = {
+        "op": "kv_push",
+        "chain_hashes": [int(h) for h in chain_hashes],
+        "geometry": {k: geometry[k] for k in GEOMETRY_KEYS},
+    }
+    try:
+        with socket.create_connection(addr, timeout=timeout_s) as sock:
+            _write_frame(sock, header, flatten_kv(kv))
+            resp = _read_response(sock)
+    except (OSError, ValueError) as e:
+        raise KVTransferError(f"KV push to {addr} failed: {e}") from e
+    if not resp.get("ok"):
+        raise KVTransferError(
+            f"survivor at {addr} refused the prefix push: "
+            f"{resp.get('error', 'unknown error')}"
+        )
+    n = resp.get("blocks")
+    if not isinstance(n, int) or n < 0 or n > len(chain_hashes):
+        raise KVTransferError(
+            f"survivor at {addr} claims a bad accepted count {n!r}"
+        )
+    return n
+
+
 class HandoffStore:
     """Bounded host-side parking lot for received payloads between the
     transfer landing and the router's POST /generate claiming it. TTL +
@@ -321,6 +423,8 @@ class KVTransferServer:
         max_frame_bytes: Optional[int] = None,
         tracer: Any = None,
         fetch_handler: Any = None,
+        weights_handler: Any = None,
+        push_handler: Any = None,
     ):
         self.expected = {k: expected_geometry[k] for k in GEOMETRY_KEYS}
         self.store = store or HandoffStore(max_pending=max_pending, ttl_s=ttl_s)
@@ -332,6 +436,14 @@ class KVTransferServer:
         # construction (the serving front wires it once the engine lock
         # exists); None = this listener serves handoffs only.
         self.fetch_handler = fetch_handler
+        # warm-start source: ``weights_handler() -> (signature, leaves)``
+        # where leaves is an ordered ``[(tree_path, array), ...]`` — the
+        # reply streams one leaf at a time so the serving side's peak host
+        # cost is a single leaf. None = this listener serves no weights.
+        self.weights_handler = weights_handler
+        # migration sink: ``push_handler(chain_hashes, kv) -> accepted`` —
+        # parks what it can in the spill tier. None = pushes are refused.
+        self.push_handler = push_handler
         # request tracing: when the sender's AKV1 header carries a
         # `traceparent`, the receive (frame read + validation + store.put)
         # is recorded as a kv_receive span on THIS replica's tracer,
@@ -363,6 +475,12 @@ class KVTransferServer:
                     return
                 if header.get("op") == "kv_fetch":
                     outer._handle_fetch(self.request, header, t0)
+                    return
+                if header.get("op") == "weights_fetch":
+                    outer._handle_weights(self.request, header, t0)
+                    return
+                if header.get("op") == "kv_push":
+                    outer._handle_push(self.request, header, arrays, t0)
                     return
                 err = outer._validate(header, arrays)
                 if err is not None:
@@ -435,6 +553,118 @@ class KVTransferServer:
             _write_frame(sock, {"ok": True, "blocks": int(n)}, arrays)
         except OSError as e:
             logger.warning("KV fetch reply failed mid-frame: %s", e)
+
+    def _handle_weights(self, sock, header: dict, t0: float) -> None:
+        """Answer one ``op: weights_fetch`` request: signature header, then
+        the param-tree leaves streamed one at a time (peak host cost on
+        this side is a single leaf, never the whole model)."""
+
+        def refuse(error: str) -> None:
+            logger.warning("refusing weights fetch: %s", error)
+            self._record_span("weights_fetch", header, t0, error=error[:200])
+            try:
+                _write_frame(sock, {"ok": False, "error": error}, [])
+            except OSError:
+                pass
+
+        if self.weights_handler is None:
+            return refuse("this replica serves no weights")
+        try:
+            signature, leaves = self.weights_handler()
+        except Exception as e:  # the source must never kill the listener
+            logger.warning("weights handler failed", exc_info=True)
+            return refuse(f"weights handler failed: {e}")
+        from automodel_tpu.resilience.fault_injection import active_injector
+
+        inj = active_injector()
+        specs = []
+        for key, leaf in leaves:
+            dtype = getattr(leaf, "dtype", None)
+            name = getattr(dtype, "name", None) or str(dtype)
+            specs.append({
+                "key": key,
+                "shape": [int(d) for d in leaf.shape],
+                "dtype": name,
+            })
+        hdr = json.dumps(
+            {"ok": True, "signature": signature, "arrays": specs}
+        ).encode()
+        total = 0
+        try:
+            sock.sendall(MAGIC + struct.pack("<I", len(hdr)) + hdr)
+            for sent, (key, leaf) in enumerate(leaves):
+                if inj is not None and inj.should_abort_weights_stream(sent):
+                    # chaos: the peer "dies" mid-stream — close without the
+                    # remaining leaves so the joiner sees a truncated frame
+                    logger.warning(
+                        "injected weights-stream abort after %d leaves", sent
+                    )
+                    return
+                raw = np.ascontiguousarray(np.asarray(leaf)).tobytes()
+                total += len(raw)
+                sock.sendall(struct.pack("<Q", len(raw)) + raw)
+        except OSError as e:
+            logger.warning("weights reply failed mid-stream: %s", e)
+            return
+        self._record_span(
+            "weights_fetch", header, t0, leaves=len(leaves), bytes=total
+        )
+
+    def _handle_push(
+        self, sock, header: dict, arrays: dict, t0: float
+    ) -> None:
+        """Park one ``op: kv_push`` migration frame in this replica's
+        spill tier and ack how many blocks were accepted."""
+
+        def refuse(error: str) -> None:
+            logger.warning("refusing KV push: %s", error)
+            self._record_span("kv_push", header, t0, error=error[:200])
+            try:
+                _write_response(sock, {"ok": False, "error": error})
+            except OSError:
+                pass
+
+        if self.push_handler is None:
+            return refuse("this replica accepts no prefix pushes")
+        geom = header.get("geometry") or {}
+        got = {k: geom.get(k) for k in GEOMETRY_KEYS}
+        if got != self.expected:
+            return refuse(
+                f"pool geometry mismatch: pusher {got} != receiver "
+                f"{self.expected} — migrated rows would reload corrupt"
+            )
+        hashes = header.get("chain_hashes")
+        if not isinstance(hashes, list) or not all(
+            isinstance(h, int) for h in hashes
+        ):
+            return refuse(f"bad chain_hashes {type(hashes).__name__}")
+        for key, arr in arrays.items():
+            if int(arr.shape[1]) != len(hashes):
+                return refuse(
+                    f"array {key} carries {arr.shape[1]} blocks for "
+                    f"{len(hashes)} chain hashes"
+                )
+        try:
+            accepted = int(self.push_handler(hashes, unflatten_kv(arrays)))
+        except Exception as e:  # the sink must never kill the listener
+            logger.warning("KV push handler failed", exc_info=True)
+            return refuse(f"push handler failed: {e}")
+        from automodel_tpu.resilience.fault_injection import active_injector
+
+        inj = active_injector()
+        if inj is not None and inj.should_drop_kv_push():
+            # chaos: the migration target "dies" before acking — close the
+            # socket so the retiring pusher sees a dead transfer
+            logger.warning("injected KV push drop before ack")
+            return
+        self._record_span(
+            "kv_push", header, t0, blocks=accepted,
+            bytes=sum(a.nbytes for a in arrays.values()),
+        )
+        try:
+            _write_response(sock, {"ok": True, "blocks": accepted})
+        except OSError as e:
+            logger.warning("KV push ack failed: %s", e)
 
     def _record_receive(self, header: dict, t0: float, **attrs) -> None:
         """kv_receive span for a frame whose header carried a traceparent

@@ -52,6 +52,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import uuid
+import zlib
 from typing import Any, Callable, Optional, Sequence
 
 from automodel_tpu.serving.block_pool import prompt_chain
@@ -119,6 +120,13 @@ class FleetConfig:
     block_size: int = 16  # MUST match the replicas' serving.block_size
     probe_interval_s: float = 2.0
     probe_timeout_s: float = 2.0
+    # probe backoff: a replica that failed this many CONSECUTIVE probes
+    # moves to an exponential schedule (doubling from probe_interval_s,
+    # bounded by probe_backoff_max_s, deterministically jittered) instead
+    # of costing a probe_timeout_s thread every sweep; first success snaps
+    # it back to every sweep
+    probe_backoff_after: int = 3
+    probe_backoff_max_s: float = 30.0
     request_timeout_s: float = 300.0
     retry_budget: int = 2  # resubmissions per request (0 = never retry)
     affinity: bool = True  # prefix-affinity placement (else pure load)
@@ -134,6 +142,16 @@ class FleetConfig:
             raise ValueError(f"fleet.retry_budget={self.retry_budget}")
         if self.block_size < 1:
             raise ValueError(f"fleet.block_size={self.block_size}")
+        if self.probe_backoff_after < 1:
+            raise ValueError(
+                f"fleet.probe_backoff_after={self.probe_backoff_after}"
+            )
+        if self.probe_backoff_max_s < self.probe_interval_s:
+            raise ValueError(
+                f"fleet.probe_backoff_max_s={self.probe_backoff_max_s} must "
+                f"be >= probe_interval_s={self.probe_interval_s} — a backoff "
+                "shorter than the sweep cadence is no backoff at all"
+            )
         if self.bench_replicas < 2:
             raise ValueError(
                 f"fleet.bench_replicas={self.bench_replicas} (want >= 2 — "
@@ -178,6 +196,10 @@ class _Replica:
     kv_port: Optional[int] = None
     block_size_ok: bool = True
     last_probe_t: Optional[float] = None
+    # probe-backoff state: failures since the last success, and (once past
+    # fleet.probe_backoff_after) the monotonic time the next probe is due
+    consecutive_failures: int = 0
+    next_probe_t: Optional[float] = None
 
     @property
     def name(self) -> str:
@@ -251,6 +273,24 @@ def _http_text(url: str, timeout_s: float) -> str:
         raise ReplicaUnreachable(f"{url}: {e}") from e
 
 
+def probe_backoff_s(
+    failures: int, after: int, base_s: float, max_s: float, salt: str = ""
+) -> float:
+    """Delay before the NEXT probe of a replica with ``failures``
+    consecutive probe failures. 0.0 below the ``after`` threshold (keep
+    probing every sweep — fast detection of a blip); past it, exponential
+    doubling from ``base_s`` bounded by ``max_s``, with ±25% deterministic
+    jitter (crc32 of salt+failures) so a rack of replicas that died
+    together does not re-probe in lockstep. Pure — the unit tests walk the
+    whole schedule without a fleet."""
+    if failures < after:
+        return 0.0
+    exp = min(failures - after, 16)  # bound the shift itself, not just
+    delay = min(base_s * (2.0**exp), max_s)  # the product: 2**1000 is inf
+    frac = (zlib.crc32(f"{salt}:{failures}".encode()) % 1000) / 1000.0
+    return min(delay * (0.75 + 0.5 * frac), max_s)
+
+
 class RouterMetrics:
     """The router's /metrics surface (telemetry/prometheus.py registry):
     the ISSUE-named counters plus per-replica health gauges."""
@@ -296,6 +336,19 @@ class RouterMetrics:
             "automodel_route_replicas_ready",
             "Ready replicas in the registry right now",
         )
+        # elastic fleet (serving/fleet/autoscale.py): target vs actual is
+        # the first thing to look at when a fleet feels the wrong size
+        self.autoscale_target = self.registry.gauge(
+            "automodel_route_autoscale_target_replicas",
+            "Replica count the autoscaler currently wants (0 until the "
+            "first tick; tracks actual between scale events)",
+        )
+        self.autoscale_events = self.registry.labeled_counter(
+            "automodel_route_autoscale_events",
+            "Scale events executed by the autoscaler, by direction "
+            "(up | down)",
+            "direction",
+        )
         self.latency = self.registry.labeled_histogram(
             "automodel_route_request_seconds",
             "Router-observed request latency (submit to terminal response), "
@@ -334,6 +387,8 @@ class Router:
         tracer: Any = None,
         slo_config: Any = None,
         flight_recorder: Any = None,
+        autoscale_config: Any = None,
+        scale_backend: Any = None,
     ):
         self.config = config
         self.tokenizer = tokenizer
@@ -397,6 +452,15 @@ class Router:
         self.handoffs_total = 0
         self.peer_hints_total = 0  # forwarded kv_peer prefix-fetch hints
         self._warned_block_size: set[str] = set()
+        # elastic fleet: the hysteresis state machine rides the probe
+        # sweep; the backend (local subprocesses or kubectl scale) is how
+        # decisions become replicas (serving/fleet/autoscale.py)
+        self.autoscaler = None
+        self.scale_backend = scale_backend
+        if autoscale_config is not None and autoscale_config.enabled:
+            from automodel_tpu.serving.fleet.autoscale import Autoscaler
+
+            self.autoscaler = Autoscaler(autoscale_config)
 
     # -- registry / probing ---------------------------------------------------
     def _resolve_dns(self) -> None:
@@ -441,8 +505,16 @@ class Router:
         t_probe0 = time.perf_counter()
         if self.config.dns:
             self._resolve_dns()
+        now = time.monotonic()
         with self._lock:
-            reps = list(self._replicas.values())
+            all_reps = list(self._replicas.values())
+            # probe backoff: replicas deep in consecutive failure are only
+            # due on their exponential schedule — the rest of the sweep
+            # stops paying a probe_timeout_s thread for every corpse
+            reps = [
+                r for r in all_reps
+                if r.next_probe_t is None or now >= r.next_probe_t
+            ]
         threads = [
             threading.Thread(
                 target=self._probe_replica, args=(rep,), daemon=True
@@ -453,7 +525,7 @@ class Router:
             t.start()
         for t in threads:
             t.join()
-        ready = sum(1 for r in reps if r.ready)
+        ready = sum(1 for r in all_reps if r.ready)
         self.metrics.replicas_ready.set(ready)
         # health plane tick: fold this sweep's scrapes into the fleet
         # series, then judge the SLO objectives against them. Both are
@@ -464,6 +536,14 @@ class Router:
                 self.slo.evaluate(time.monotonic())
         except Exception:
             logger.exception("fleet health-plane tick failed")
+        # elastic fleet: the autoscaler evaluates once per sweep, right
+        # after the federation rolled this sweep's scrapes in — its
+        # signals are at most one sweep stale. Its bugs must not kill
+        # probing either.
+        try:
+            self._autoscale_tick(time.monotonic())
+        except Exception:
+            logger.exception("autoscale tick failed")
         if self.tracer is not None:
             # probe sweeps are router-lifecycle work, not request work:
             # each sweep is its own single-span trace (sampled like any
@@ -510,6 +590,23 @@ class Router:
         with self._lock:
             rep.alive, rep.ready = alive, ready
             rep.last_probe_t = time.monotonic()
+            if alive:
+                # first success snaps a backed-off replica straight back
+                # to every-sweep probing — recovery is never rate-limited
+                rep.consecutive_failures = 0
+                rep.next_probe_t = None
+            else:
+                rep.consecutive_failures += 1
+                delay = probe_backoff_s(
+                    rep.consecutive_failures,
+                    self.config.probe_backoff_after,
+                    self.config.probe_interval_s,
+                    self.config.probe_backoff_max_s,
+                    salt=rep.name,
+                )
+                rep.next_probe_t = (
+                    time.monotonic() + delay if delay > 0 else None
+                )
             if alive:
                 rep.stats = stats
                 rep.role = rep.spec.role or stats.get("role") or rep.role
@@ -564,6 +661,173 @@ class Router:
             rep.alive = False
             rep.ready = False
         self.metrics.replica_up.set(rep.name, 0.0)
+
+    # -- elastic fleet (serving/fleet/autoscale.py, docs/serving.md) ----------
+    def add_replica(
+        self, name: str, url: str, role: Optional[str] = None
+    ) -> None:
+        """Join a replica at runtime (autoscaler spawn, tests). It becomes
+        routable at its first successful probe, not here."""
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            self._replicas[name] = _Replica(
+                spec=ReplicaSpec(url=url, name=name, role=role),
+                role=role or "mixed",
+            )
+
+    def remove_replica(self, name: str) -> None:
+        """Drop a replica from the registry (autoscaler retire). In-flight
+        forwards to it finish or fail retriable on their own."""
+        with self._lock:
+            self._replicas.pop(name, None)
+        self.metrics.replica_up.set(name, 0.0)
+        self.federation.mark_down(name)
+
+    def _kv_peer(self, exclude: frozenset = frozenset()) -> Optional[dict]:
+        """Least-loaded ready replica with a KV-transfer listener, as the
+        ``{"host", "port"}`` address warm-start and prefix migration both
+        take — or None when the fleet has nothing to stream from."""
+        with self._lock:
+            peers = [
+                r for r in self._replicas.values()
+                if r.ready and r.kv_port and r.name not in exclude
+            ]
+        if not peers:
+            return None
+        rep = min(peers, key=lambda r: r.load)
+        host = urllib.parse.urlsplit(rep.url).hostname or "127.0.0.1"
+        return {"host": host, "port": int(rep.kv_port)}
+
+    def _fleet_signals(self, now: float):
+        """This sweep's federation rollup as :class:`FleetSignals`. Fleet
+        gauges are SUMS across scraped replicas (federation.py), so the
+        per-replica means divide by the scraped count; unknowns stay None
+        and never trigger a scale."""
+        from automodel_tpu.serving.fleet.autoscale import FleetSignals
+
+        with self._lock:
+            ready = sum(1 for r in self._replicas.values() if r.ready)
+        fed = self.federation
+        window_s = self.autoscaler.config.window_s
+        n = int(fed.status().get("replicas_scraped") or 0) or max(ready, 1)
+        qd = fed.latest("automodel_fleet_serve_queue_depth")
+        occ = fed.latest("automodel_fleet_serve_block_occupancy")
+        shed = fed.increase(
+            "automodel_fleet_serve_requests_shed", window_s, now
+        )
+        return FleetSignals(
+            ready_replicas=ready,
+            queue_depth=None if qd is None else qd / n,
+            shed_rate=None if shed is None else shed / window_s,
+            occupancy=None if occ is None else occ / n,
+            slos_firing=(
+                len(self.slo.firing()) if self.slo is not None else 0
+            ),
+        )
+
+    def _autoscale_tick(self, now: float) -> None:
+        """One autoscaler evaluation, at the tail of each probe sweep."""
+        if self.autoscaler is None or self.draining:
+            return
+        cfg = self.autoscaler.config
+        # backfill the last scale-up's time_to_ready_s once the spawned
+        # replica reports it (/stats) — fleet-status shows the number the
+        # elastic fleet exists to improve
+        last = self.autoscaler.last_event
+        if (
+            last is not None
+            and last.get("direction") == "up"
+            and "time_to_ready_s" not in last
+        ):
+            with self._lock:
+                rep = self._replicas.get(last.get("replica"))
+                ttr = (
+                    rep.stats.get("time_to_ready_s")
+                    if rep is not None and rep.ready else None
+                )
+                if ttr is not None:
+                    last["time_to_ready_s"] = round(float(ttr), 6)
+                    last["boot_source"] = rep.stats.get("boot_source")
+        signals = self._fleet_signals(now)
+        with self._lock:
+            actual = len(self._replicas)
+        direction, trigger = self.autoscaler.decide(signals, actual, now)
+        if direction is None:
+            self.metrics.autoscale_target.set(actual)
+            return
+        try:
+            replica = (
+                self._scale_up(cfg) if direction == "up"
+                else self._scale_down(cfg)
+            )
+        except Exception as e:
+            # backend failure: no note_scaled, so no cooldown — the streak
+            # is still live and the next sweep retries
+            logger.warning("autoscale %s failed: %s", direction, e)
+            return
+        if replica is None:
+            return
+        with self._lock:
+            after = len(self._replicas)
+        event = {
+            "event": "scale_event",
+            "ts": self._wall_ts(),
+            "direction": direction,
+            "trigger": trigger,
+            "replica": replica,
+            "replicas_before": actual,
+            "replicas_after": after,
+        }
+        self.autoscaler.note_scaled(event, now)
+        self.metrics.autoscale_events.inc(direction)
+        self.metrics.autoscale_target.set(after)
+        self._emit(event)
+        logger.warning(
+            "autoscale %s (trigger=%s): %d -> %d replicas (%s)",
+            direction, trigger, actual, after, replica,
+        )
+
+    def _scale_up(self, cfg) -> Optional[str]:
+        if self.scale_backend is None:
+            logger.warning(
+                "autoscale: scale up wanted but no backend is configured "
+                "(k8s_fleet: section, or an injected LocalProcessBackend)"
+            )
+            return None
+        warm = self._kv_peer() if cfg.warm_start else None
+        name, url = self.scale_backend.spawn(warm)
+        if name and getattr(self.scale_backend, "registry_managed", True):
+            self.add_replica(name, url)
+        return name or "(dns)"  # k8s: membership arrives via DNS discovery
+
+    def _scale_down(self, cfg) -> Optional[str]:
+        if self.scale_backend is None:
+            logger.warning(
+                "autoscale: scale down wanted but no backend is configured"
+            )
+            return None
+        with self._lock:
+            victims = [
+                r for r in self._replicas.values()
+                if r.ready and r.decode_capable()
+            ]
+        if len(victims) <= cfg.min_replicas:
+            return None  # ready count sits at the floor even if the
+            # registry is larger (dead entries don't make retiring safe)
+        # least-loaded victim: fewest in-flight requests to drain, and the
+        # load it sheds redistributes most easily
+        victim = min(victims, key=lambda r: r.load)
+        migrate = (
+            self._kv_peer(exclude=frozenset({victim.name}))
+            if cfg.migrate_on_scale_down else None
+        )
+        self.scale_backend.retire(
+            victim.name, victim.url, migrate, cfg.retire_deadline_s
+        )
+        if getattr(self.scale_backend, "registry_managed", True):
+            self.remove_replica(victim.name)
+        return victim.name
 
     # -- placement ------------------------------------------------------------
     def _candidates(
@@ -1068,6 +1332,8 @@ class Router:
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
             out["alerts_firing"] = self.slo.firing()
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.status()
         return out
 
     def _disaggregate_active_unlocked(self) -> bool:
@@ -1299,9 +1565,41 @@ def main(cfg: Any) -> int:
                 / "router_flight_recorder.json"
             ),
         )
+    # elastic fleet: a strict `autoscale:` section arms the closed-loop
+    # controller; a `k8s_fleet:` section beside it gives it the kubectl
+    # backend (otherwise decisions log but cannot act — tests inject a
+    # LocalProcessBackend through the Router constructor instead)
+    autoscale_cfg = None
+    autoscale_section = dict(cfg.get("autoscale", {}) or {})
+    if autoscale_section:
+        from automodel_tpu.serving.fleet.autoscale import AutoscaleConfig
+
+        autoscale_cfg = AutoscaleConfig.from_dict(autoscale_section)
+    scale_backend = None
+    if autoscale_cfg is not None and autoscale_cfg.enabled:
+        k8s_section = dict(cfg.get("k8s_fleet", {}) or {})
+        if k8s_section:
+            from automodel_tpu.launcher.k8s import K8sFleetConfig
+            from automodel_tpu.serving.fleet.autoscale import K8sFleetBackend
+
+            k8s_section.pop("_target_", None)
+            known = {f.name for f in dataclasses.fields(K8sFleetConfig)}
+            unknown = set(k8s_section) - known
+            if unknown:
+                raise TypeError(f"unknown k8s_fleet keys: {sorted(unknown)}")
+            kcfg = K8sFleetConfig(**k8s_section)
+            role = "decode" if kcfg.mixed == 0 and kcfg.decode > 0 else "mixed"
+            scale_backend = K8sFleetBackend(kcfg, role=role)
+        else:
+            logger.warning(
+                "autoscale.enabled without a k8s_fleet: section — the "
+                "controller will evaluate and log decisions but has no "
+                "backend to act through"
+            )
     router = Router(
         fcfg, tokenizer=tokenizer, on_record=on_record, tracer=tracer,
         slo_config=slo_cfg, flight_recorder=flight_recorder,
+        autoscale_config=autoscale_cfg, scale_backend=scale_backend,
     )
     router.start()
     server = serve_router_http(router, fcfg.port, host=fcfg.host)

@@ -143,6 +143,26 @@ def render_table(stats: dict) -> str:
     total = len(stats.get("replicas") or {})
     lines.append("")
     lines.append(f"{ready}/{total} replicas ready")
+    asc = stats.get("autoscale")
+    if asc:
+        # elastic fleet footer: what the controller wants vs has, and the
+        # last thing it did (docs/serving.md "Elastic fleet")
+        lines.append(
+            f"autoscale: {total} replicas "
+            f"(bounds {asc.get('min_replicas')}..{asc.get('max_replicas')}), "
+            f"{asc.get('scale_ups', 0)} up / {asc.get('scale_downs', 0)} "
+            "down events"
+        )
+        last = asc.get("last_event")
+        if last:
+            ttr = last.get("time_to_ready_s")
+            lines.append(
+                f"  last scale: {last.get('direction')} "
+                f"(trigger={last.get('trigger')}) "
+                f"{last.get('replicas_before')} -> "
+                f"{last.get('replicas_after')} replicas"
+                + (f", time_to_ready={ttr:.2f}s" if ttr is not None else "")
+            )
     return "\n".join(lines)
 
 

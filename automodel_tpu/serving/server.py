@@ -142,6 +142,10 @@ STATS_METRIC_EQUIV = {
     "hot_prefixes": None,
     "spill_bytes": "automodel_serve_spill_bytes",
     "spill_entries": "automodel_serve_spill_entries",
+    # elastic fleet: boot provenance (time_to_ready_s is null until the
+    # first readiness; boot_source is an info string)
+    "time_to_ready_s": "automodel_serve_time_to_ready_seconds",
+    "boot_source": None,
 }
 
 # Families deliberately absent from /stats: per-request distributions have
@@ -198,6 +202,10 @@ def stats_snapshot(engine: Any) -> dict:
             len(engine.pool.spill)
             if engine.pool.spill is not None else None
         ),
+        # elastic fleet: which boot path this replica took and how long
+        # startup→first-readiness took (the warm-vs-cold A/B number)
+        "time_to_ready_s": engine.time_to_ready_s,
+        "boot_source": engine.boot_source,
     }
 
 
@@ -330,12 +338,16 @@ def serve_http(
     port: int,
     host: str = "127.0.0.1",
     kv_store: Any = None,
+    on_retire: Any = None,
 ):
     """→ (ThreadingHTTPServer, _EngineLoop), both started. The caller calls
     ``server.serve_forever()`` (CLI) or drives requests itself (tests) and
     shuts both down. ``kv_store`` (a fleet ``HandoffStore``) arms the
     disaggregated paths: POST /generate with a ``handoff_id`` claims a
-    transferred prefill payload from it."""
+    transferred prefill payload from it. ``on_retire(migrate, deadline_s)``
+    (optional, run on its own thread) arms POST /retire — the autoscaler's
+    scale-down entry point: drain, optionally migrate hot prefix blocks to
+    the survivor named in ``migrate``, then exit."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     loop = _EngineLoop(engine)
@@ -412,6 +424,10 @@ def serve_http(
                     and not engine.draining
                     and engine.first_decode_done
                 )
+                if ready:
+                    # idempotent time_to_ready_s stamp: warmup-disabled
+                    # servers reach readiness on their first true probe
+                    engine.note_ready()
                 return self._json(200 if ready else 503, {
                     "ready": ready,
                     "draining": engine.draining,
@@ -564,6 +580,41 @@ def serve_http(
         def do_POST(self):
             if self.path == "/prefill":
                 return self._prefill()
+            if self.path == "/retire":
+                # elastic fleet scale-down: ``{"migrate": {"host", "port"}
+                # | null, "deadline_s": s}``. Responds 200 IMMEDIATELY and
+                # runs drain → migrate → exit on a background thread — the
+                # autoscaler must not block a probe sweep on a drain, and
+                # the retiring process, not the caller, owns the deadline.
+                if on_retire is None:
+                    return self._json(400, {
+                        "error": "this server has no retire hook "
+                        "(the serve CLI front arms it)"
+                    })
+                try:
+                    req = self._read_req()
+                except (ValueError, TypeError) as e:
+                    return self._json(400, {"error": str(e)})
+                migrate = req.get("migrate")
+                if migrate is not None and not (
+                    isinstance(migrate, dict)
+                    and migrate.get("host")
+                    and migrate.get("port") is not None
+                ):
+                    return self._json(400, {
+                        "error": "migrate must be null or {host, port}"
+                    })
+                deadline_s = float(req.get("deadline_s", 30.0))
+                threading.Thread(
+                    target=on_retire, args=(migrate, deadline_s),
+                    name="serve-retire", daemon=True,
+                ).start()
+                return self._json(200, {
+                    "ok": True,
+                    "draining": True,
+                    "migrate": migrate is not None,
+                    "deadline_s": deadline_s,
+                })
             if self.path != "/generate":
                 return self._json(404, {"error": f"unknown path {self.path}"})
             from automodel_tpu.serving.engine import EngineDraining, QueueFull
@@ -677,6 +728,69 @@ def _warmup(engine: Any) -> None:
         logger.warning("serve warm-up request failed: %r", e)
 
 
+def _tree_path_name(path) -> str:
+    """The param-tree leaf naming rule — MUST match
+    ``checkpoint.checkpointer.param_tree_signature`` exactly, so signature
+    entries and wire-transferred leaves line up one-to-one."""
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    )
+
+
+def _warm_start_params(auto: Any, ws: Any) -> bool:
+    """Peer warm-start (docs/serving.md "Elastic fleet"): stream the whole
+    param tree from the serving peer named in ``serving.warm_start`` and
+    swap it under this replica's structurally built tree. The peer's
+    param-tree signature must digest-match this replica's own (the PR 6
+    checkpoint guard) BEFORE any leaf is swapped — a mismatch means the
+    architectures differ and cold load is the only correct path. → True
+    when the swap landed; False (after logging) on ANY failure, leaving
+    the cold-built params untouched."""
+    import jax
+
+    from automodel_tpu.checkpoint.checkpointer import param_tree_signature
+    from automodel_tpu.serving.fleet.kv_transfer import (
+        KVTransferError,
+        fetch_weights,
+    )
+
+    addr = (str(ws.peer_host), int(ws.peer_port))
+    t0 = time.perf_counter()
+    try:
+        expected = param_tree_signature(auto.params)
+        sig, arrays = fetch_weights(addr, timeout_s=ws.timeout_s)
+        if sig.get("digest") != expected["digest"]:
+            raise KVTransferError(
+                f"peer param-tree signature {sig.get('digest')!r} != this "
+                f"replica's {expected['digest']!r} — the peer serves a "
+                "different architecture/shape/dtype tree"
+            )
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(auto.params)
+        new_leaves = []
+        for path, leaf in leaves:
+            name = _tree_path_name(path)
+            arr = arrays.get(name)
+            if arr is None:
+                # digest match makes this unreachable short of a hostile
+                # peer — still a loud fallback, never a KeyError
+                raise KVTransferError(f"peer stream is missing leaf {name}")
+            new_leaves.append(jax.device_put(arr, leaf.sharding))
+        auto.params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        logger.info(
+            "peer warm-start from %s:%d landed %d leaves in %.3fs",
+            addr[0], addr[1], len(new_leaves), time.perf_counter() - t0,
+        )
+        return True
+    except Exception as e:
+        # the fallback ladder: ANY failure — refused, died mid-stream,
+        # signature mismatch — keeps the cold-built params
+        logger.warning(
+            "peer warm-start from %s:%d failed (%s: %s); cold load",
+            addr[0], addr[1], type(e).__name__, e,
+        )
+        return False
+
+
 def main(cfg: Any) -> int:
     """`automodel_tpu serve -c cfg.yaml` (stdin-JSONL, or HTTP when
     serving.http.port is set)."""
@@ -689,6 +803,9 @@ def main(cfg: Any) -> int:
     from automodel_tpu.serving.engine import ServeConfig, ServingEngine
 
     setup_logging()
+    # time_to_ready_s starts here — BEFORE the model build, because load
+    # time is exactly what peer warm-start exists to cut
+    t_boot = time.perf_counter()
     serve_section = dict(cfg.get("serving", {}) or {})
     http_section = dict(serve_section.get("http") or {})
     serve_cfg = ServeConfig.from_dict(serve_section)
@@ -700,6 +817,22 @@ def main(cfg: Any) -> int:
     )
 
     auto = build_auto_from_cfg(cfg)
+    # elastic-fleet boot ladder: peer warm-start when configured, cold HF
+    # otherwise (and as the fallback when any part of the fetch fails).
+    # The injected hf_load_delay_ms cold-load cost (fault_injection.py)
+    # applies ONLY on the cold path — it stands in for the real HF
+    # download/parse time a warm start skips, so the time_to_ready_s A/B
+    # is measurable on tiny CPU models.
+    boot_source = "cold_hf"
+    if serve_cfg.warm_start.enabled:
+        if _warm_start_params(auto, serve_cfg.warm_start):
+            boot_source = "peer_warm_start"
+    if boot_source == "cold_hf":
+        from automodel_tpu.resilience.fault_injection import active_injector
+
+        inj = active_injector()
+        if inj is not None:
+            inj.maybe_hf_load_delay()
     on_record = None
     metrics_path = (cfg.get("logging") or {}).get("metrics_path") if cfg.get("logging") else None
     metric_logger = None
@@ -728,6 +861,8 @@ def main(cfg: Any) -> int:
     engine = ServingEngine(
         auto, serve_cfg, gen_cfg, on_record=on_record, tracer=tracer
     )
+    engine.boot_t = t_boot
+    engine.boot_source = boot_source
 
     # fleet KV listener: a decode-role replica listens for prefill→decode
     # handoffs, and a spill-enabled replica listens for peer /kv_fetch
@@ -751,6 +886,27 @@ def main(cfg: Any) -> int:
         ).start()
         engine.kv_transfer_port = kv_server.port
         logger.info("KV-transfer listener on port %d", kv_server.port)
+
+        # warm-start source for joining replicas: serve this replica's
+        # param tree over ``op: weights_fetch``. Params are read-only once
+        # serving starts, so no scheduler lock is needed — the listener
+        # thread streams one host copy of one leaf at a time.
+        def _serve_weights():
+            import jax
+
+            from automodel_tpu.checkpoint.checkpointer import (
+                param_tree_signature,
+            )
+
+            sig = param_tree_signature(engine.auto.params)
+            leaves = jax.tree_util.tree_flatten_with_path(
+                engine.auto.params
+            )[0]
+            return sig, [
+                (_tree_path_name(path), leaf) for path, leaf in leaves
+            ]
+
+        kv_server.weights_handler = _serve_weights
 
     # stall-watchdog evidence routing: stacks + flight recorder land next
     # to the metrics JSONL when one is configured (same layout the training
@@ -796,6 +952,74 @@ def main(cfg: Any) -> int:
             metric_logger.close()
 
 
+def retire_sequence(engine, loop, migrate, deadline_s: float) -> str:
+    """Drain, then ship hot prefix blocks to the survivor — in that order,
+    all inside ``deadline_s``. Runs on the serve-retire thread; the caller
+    shuts the HTTP front down afterwards. Migration failure degrades to
+    plain drain; NOTHING here may block retirement past the deadline.
+
+    Returns the outcome record name (``migration_complete`` /
+    ``migration_failed`` / ``migration_skipped``) so callers and tests can
+    branch without re-parsing the JSONL.
+    """
+    t0 = time.monotonic()
+    deadline = t0 + max(float(deadline_s), 0.0)
+    engine.begin_drain()
+    # in-flight requests finish under the scheduler as usual; stop
+    # waiting at drain-completion, scheduler death, or the deadline
+    # (whichever is first) so migration still gets its window
+    while time.monotonic() < deadline:
+        if engine.drain_complete() or not loop.alive():
+            break
+        time.sleep(0.05)
+    migrated = 0
+    available = 0
+    error = None
+    if migrate is not None and loop.alive():
+        from automodel_tpu.serving.fleet.kv_transfer import (
+            KVTransferError,
+            push_kv,
+        )
+
+        try:
+            with loop.lock:
+                hashes, kv = engine.export_hot_blocks()
+            available = len(hashes)
+            if hashes:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise KVTransferError(
+                        "retire deadline expired before the prefix push"
+                    )
+                migrated = push_kv(
+                    (str(migrate["host"]), int(migrate["port"])),
+                    hashes, kv, engine.kv_geometry(),
+                    timeout_s=remaining,
+                )
+        except Exception as e:
+            error = f"{type(e).__name__}: {str(e)[:200]}"
+            logger.warning(
+                "scale-down prefix migration to %s failed (%s); "
+                "degrading to plain drain", migrate, error,
+            )
+    if migrate is None:
+        outcome = "migration_skipped"
+    elif error is not None:
+        outcome = "migration_failed"
+    else:
+        outcome = "migration_complete"
+    if engine.on_record is not None:
+        engine.on_record({
+            "event": outcome,
+            "ts": engine._wall_ts(),
+            "migrated_blocks": migrated,
+            "hot_blocks": available,
+            "retire_s": round(time.monotonic() - t0, 6),
+            **({"error": error} if error else {}),
+        })
+    return outcome
+
+
 def _serve_http_forever(
     engine, tokenizer, http_section, serve_cfg, kv_store=None, kv_server=None
 ) -> int:
@@ -804,8 +1028,17 @@ def _serve_http_forever(
     drain_cfg = serve_cfg.drain
     if http_section.get("warmup", True):
         _warmup(engine)
+        engine.note_ready()  # warmup flipped first_decode_done: stamp now
+    state = {"rc": 0}
+
+    def _retire(migrate, deadline_s: float):
+        retire_sequence(engine, loop, migrate, deadline_s)
+        state["rc"] = _drain_exit_code(drain_cfg)
+        server.shutdown()
+
     server, loop = serve_http(
-        engine, tokenizer, port, host=host, kv_store=kv_store
+        engine, tokenizer, port, host=host, kv_store=kv_store,
+        on_retire=_retire,
     )
     if kv_server is not None and serve_cfg.kv_spill.enabled:
         # peer /kv_fetch answers from the engine's pools, so the handler
@@ -816,7 +1049,14 @@ def _serve_http_forever(
                 return engine.fetch_prefix_blocks(chain_hashes)
 
         kv_server.fetch_handler = _serve_fetch
-    state = {"rc": 0}
+
+        # migration sink: a retiring peer's ``kv_push`` parks blocks in
+        # this replica's spill tier (same lock discipline as /kv_fetch)
+        def _serve_push(chain_hashes, kv):
+            with loop.lock:
+                return engine.receive_migrated_blocks(chain_hashes, kv)
+
+        kv_server.push_handler = _serve_push
 
     def _drain_then_stop():
         # begin_drain only flips flags (GIL-atomic stores the scheduler

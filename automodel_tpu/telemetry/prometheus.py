@@ -522,6 +522,14 @@ class ServingMetrics:
             "automodel_serve_kv_injected",
             "Prefill→decode KV handoffs admitted into this pool",
         )
+        # elastic fleet (serving.warm_start:): startup→first-readiness
+        # wall time — the peer-warm-start-vs-cold-load A/B number (0 until
+        # the replica's first readiness)
+        self.time_to_ready = r.gauge(
+            "automodel_serve_time_to_ready_seconds",
+            "Wall time from process start to first /readyz true "
+            "(0 until ready; boot source rides /stats boot_source)",
+        )
         self._pool_counters = {
             key: r.counter(f"automodel_serve_block_{key}", help_text)
             for key, help_text in (
@@ -608,6 +616,9 @@ class ServingMetrics:
             self.spill_bytes.set(float(tier.bytes) if tier is not None else 0.0)
             self.spill_entries.set(float(len(tier)) if tier is not None else 0.0)
             self.kv_injected.set_total(getattr(engine, "kv_injected_total", 0))
+            self.time_to_ready.set(
+                float(getattr(engine, "time_to_ready_s", None) or 0.0)
+            )
             proposed = getattr(engine, "spec_proposed_total", 0)
             accepted = getattr(engine, "spec_accepted_total", 0)
             self.spec_accepted.set_total(accepted)

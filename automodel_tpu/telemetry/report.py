@@ -150,6 +150,15 @@ _NUMERIC_KEYS = (
     "ckpt_restore_s",
     "ckpt_drain_s",
     "window_excluded_s",
+    # elastic fleet (serving/fleet/autoscale.py): `scale_event` envelopes,
+    # the `replica_ready` boot stamp, and the retiring replica's
+    # `migration_*` outcome records
+    "time_to_ready_s",
+    "replicas_before",
+    "replicas_after",
+    "migrated_blocks",
+    "hot_blocks",
+    "retire_s",
 )
 
 # keys that are wall-time durations and can never legitimately be negative:
@@ -171,6 +180,8 @@ _DURATION_KEYS = (
     "ckpt_drain_s",
     "window_excluded_s",
     "slo_firing_s",
+    "time_to_ready_s",
+    "retire_s",
 )
 
 # the slo_alert state machine's legal states (telemetry/slo.py) — anything
@@ -563,6 +574,66 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
         )
         if unresolved:
             out["slo_unresolved_at_exit"] = unresolved
+    scales = [r for r in records if r.get("event") == "scale_event"]
+    if scales:
+        # elastic fleet: every scale event with its trigger and size step,
+        # in file order — the autoscaler's whole story reads off the
+        # summary, including how fast each spawned replica came up
+        out["scale_events"] = [
+            {
+                "direction": r.get("direction"),
+                "trigger": r.get("trigger"),
+                "replicas_before": r.get("replicas_before"),
+                "replicas_after": r.get("replicas_after"),
+            }
+            for r in scales
+        ]
+        out["scale_ups"] = sum(
+            1 for r in scales if r.get("direction") == "up"
+        )
+        out["scale_downs"] = sum(
+            1 for r in scales if r.get("direction") == "down"
+        )
+    boots = [r for r in records if r.get("event") == "replica_ready"]
+    if boots:
+        # time-to-ready by boot source: the warm-start vs cold-load A/B is
+        # exactly these two buckets side by side
+        by_src: dict[str, list[float]] = {}
+        for r in boots:
+            src = r.get("boot_source")
+            ttr = r.get("time_to_ready_s")
+            if isinstance(src, str) and isinstance(ttr, (int, float)):
+                by_src.setdefault(src, []).append(float(ttr))
+        out["replica_boots"] = {
+            src: {
+                "count": len(ts),
+                "time_to_ready_p50_s": round(percentile(ts, 0.50), 6),
+                "max_s": round(max(ts), 6),
+            }
+            for src, ts in sorted(by_src.items())
+        }
+    migrations = [
+        r for r in records
+        if r.get("event") in (
+            "migration_complete", "migration_failed", "migration_skipped"
+        )
+    ]
+    if migrations:
+        out["prefix_migrations"] = {
+            "complete": sum(
+                1 for r in migrations
+                if r["event"] == "migration_complete"
+            ),
+            "failed": sum(
+                1 for r in migrations if r["event"] == "migration_failed"
+            ),
+            "skipped": sum(
+                1 for r in migrations if r["event"] == "migration_skipped"
+            ),
+            "migrated_blocks": sum(
+                int(r.get("migrated_blocks") or 0) for r in migrations
+            ),
+        }
     stalls = [r for r in records if r.get("event") == "serve_engine_event"]
     if stalls:
         out["serve_engine_events"] = [

@@ -33,6 +33,31 @@ except RuntimeError:  # pragma: no cover - cpu always present
 
 import pytest  # noqa: E402
 
+# per-test wall budget for the tier-1 (non-slow) suite: the whole suite
+# must fit a 870s standalone single-CPU window, so one runaway non-slow
+# test is a CI outage, not a slow test. Anything that legitimately needs
+# longer belongs behind `-m slow` (multi-subprocess elasticity e2es are).
+TIER1_TEST_BUDGET_S = float(os.environ.get("AUTOMODEL_TEST_BUDGET_S", "180"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if (
+        report.when == "call"
+        and report.passed
+        and item.get_closest_marker("slow") is None
+        and report.duration > TIER1_TEST_BUDGET_S
+    ):
+        report.outcome = "failed"
+        report.longrepr = (
+            f"{item.nodeid} took {report.duration:.1f}s — over the "
+            f"{TIER1_TEST_BUDGET_S:.0f}s tier-1 per-test budget "
+            "(AUTOMODEL_TEST_BUDGET_S). Mark it @pytest.mark.slow or make "
+            "it fit: the whole non-slow suite must fit one 870s window."
+        )
+
 
 @pytest.fixture(scope="session")
 def devices8():

@@ -150,10 +150,12 @@ def test_example_yaml_parses_and_dry_instantiates(path):
         from automodel_tpu.serving.engine import (
             KVSpillConfig,
             KVTransferConfig,
+            WarmStartConfig,
         )
 
         assert isinstance(sc.kv_transfer, KVTransferConfig)
         assert isinstance(sc.kv_spill, KVSpillConfig)
+        assert isinstance(sc.warm_start, WarmStartConfig)
         for key, sub in (
             ("limits", LimitsConfig),
             ("drain", DrainConfig),
@@ -161,6 +163,7 @@ def test_example_yaml_parses_and_dry_instantiates(path):
             ("speculative", SpeculativeConfig),
             ("kv_transfer", KVTransferConfig),
             ("kv_spill", KVSpillConfig),
+            ("warm_start", WarmStartConfig),
         ):
             if srv.get(key) is not None:
                 sub.from_dict(dict(srv[key]))
@@ -208,6 +211,19 @@ def test_example_yaml_parses_and_dry_instantiates(path):
         from automodel_tpu.launcher.k8s import K8sFleetConfig
 
         K8sFleetConfig(**kf)
+
+    # autoscale: → AutoscaleConfig (closed-loop elasticity on the router;
+    # strict, and the hysteresis bands must be well-ordered)
+    asc = _section(cfg, "autoscale")
+    if asc is not None:
+        from automodel_tpu.serving.fleet.autoscale import AutoscaleConfig
+
+        ac = AutoscaleConfig.from_dict(asc)
+        assert ac.max_replicas >= ac.min_replicas
+        if srv is not None:
+            # a retiring replica must fit its drain inside the retire
+            # deadline or migration can never run
+            assert ac.retire_deadline_s > 0
 
     # profiling: → ProfilingConfig (+ nested triggered: sub-section)
     prof = _section(cfg, "profiling")
@@ -300,10 +316,30 @@ def test_config_dataclasses_reject_unknown_keys():
         ServeConfig.from_dict(
             {"kv_spill": {"enabled": True, "max_host_mb": 0}}
         )
+    with pytest.raises(TypeError):
+        ServeConfig.from_dict({"warm_start": {"peer_hostt": "x"}})
+    with pytest.raises(ValueError):  # host without port is half an address
+        ServeConfig.from_dict({"warm_start": {"peer_host": "127.0.0.1"}})
+    from automodel_tpu.serving.fleet.autoscale import AutoscaleConfig
+
+    with pytest.raises(TypeError):
+        AutoscaleConfig.from_dict({"max_replicass": 3})
+    with pytest.raises(ValueError):  # bands must leave a hysteresis gap
+        AutoscaleConfig.from_dict(
+            {"queue_depth_low": 9.0, "queue_depth_high": 8.0}
+        )
+    with pytest.raises(ValueError):
+        AutoscaleConfig.from_dict({"min_replicas": 3, "max_replicas": 2})
+    with pytest.raises(ValueError):
+        AutoscaleConfig.from_dict({"scale_up_consecutive": 0})
     from automodel_tpu.serving.fleet.router import FleetConfig
 
     with pytest.raises(TypeError):
         FleetConfig.from_dict({"replicass": []})
+    with pytest.raises(ValueError):  # backoff shorter than the sweep
+        FleetConfig.from_dict(
+            {"probe_interval_s": 5.0, "probe_backoff_max_s": 1.0}
+        )
     with pytest.raises(TypeError):
         FleetConfig.from_dict({"replicas": [{"url": "http://x", "rol": "mixed"}]})
     with pytest.raises(ValueError):

@@ -1066,6 +1066,8 @@ def _spawn_spill_replica(tmp_path, idx):
     )
 
 
+@pytest.mark.slow  # two replica subprocess boots: well over the tier-1
+# per-test budget on a contended 1-CPU box (conftest enforces it)
 def test_peer_prefix_fetch_across_replica_processes(tmp_path):
     """Acceptance (ISSUE 16): a prefix first seen on replica process A is
     served to replica process B via /kv_fetch — two REAL serve

@@ -1,0 +1,1054 @@
+"""Elastic-fleet tests (ISSUE 18): the autoscaler hysteresis state
+machine, probe-sweep exponential backoff, the AKV1 ``weights_fetch`` /
+``kv_push`` ops, peer warm-start with cold fallback, scale-down drain +
+prefix migration, the closed router loop over in-process replicas, the
+scale backends, and the report/fleet-status surfaces. Every chaos path
+(peer dies mid-weights-stream, migration target dies mid-ship) is driven
+in-process through the fault-injection knobs — tier-1. The two slow tests
+at the bottom are the subprocess acceptance e2es (warm-vs-cold A/B and
+the full scale-up → scale-down → migrate loop over real replica
+processes)."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from automodel_tpu.resilience import fault_injection as fi
+from automodel_tpu.serving.engine import KVSpillConfig, WarmStartConfig
+from automodel_tpu.serving.fleet.autoscale import (
+    AutoscaleConfig,
+    Autoscaler,
+    FleetSignals,
+    K8sFleetBackend,
+    LocalProcessBackend,
+    ScaleBackendError,
+)
+from automodel_tpu.serving.fleet.kv_transfer import (
+    KVTransferError,
+    KVTransferServer,
+    fetch_weights,
+    push_kv,
+)
+from automodel_tpu.serving.fleet.router import (
+    FleetConfig,
+    Router,
+    probe_backoff_s,
+)
+from tests.test_fleet import _engine, _http_replica, _tiny_auto
+
+# a valid AKV1 geometry for listeners that only serve weights (the
+# weights op never touches the pool, but the header schema is shared)
+_GEOM = {
+    "layers": 2, "block_size": 4, "num_kv_heads": 2, "head_dim": 8,
+    "kv_cache_dtype": "float32",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    yield
+    fi.activate(None)
+
+
+def _close_front(server, loop):
+    try:
+        server.shutdown()
+        server.server_close()
+    except OSError:
+        pass
+    loop.close()
+
+
+def _post_json(port, path, payload):
+    """POST returning (status, body) — HTTP error statuses return
+    normally (urllib raises on them; the retire tests need the body)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# probe backoff (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_probe_backoff_schedule():
+    """Below the threshold every sweep probes (0.0); past it the delay
+    doubles from base_s, jittered ±25%, capped at max_s — and never
+    overflows at absurd failure counts."""
+    for f in range(3):
+        assert probe_backoff_s(f, after=3, base_s=2.0, max_s=30.0) == 0.0
+    prev_raw = None
+    for f in range(3, 12):
+        raw = min(2.0 * 2 ** (f - 3), 30.0)
+        delay = probe_backoff_s(f, after=3, base_s=2.0, max_s=30.0, salt="r0")
+        assert 0.75 * raw - 1e-9 <= delay <= min(1.25 * raw, 30.0) + 1e-9
+        if prev_raw is not None:
+            assert raw >= prev_raw  # the raw schedule is monotone
+        prev_raw = raw
+    # deterministic per (salt, failures); different salts decorrelate the
+    # fleet (that is the whole point of the jitter)
+    assert probe_backoff_s(5, 3, 2.0, 30.0, "a") == probe_backoff_s(
+        5, 3, 2.0, 30.0, "a"
+    )
+    assert any(
+        probe_backoff_s(f, 3, 2.0, 30.0, "a")
+        != probe_backoff_s(f, 3, 2.0, 30.0, "b")
+        for f in range(3, 10)
+    )
+    assert probe_backoff_s(10_000, 3, 2.0, 30.0) <= 30.0
+
+
+def test_probe_backoff_gates_router_sweeps_and_resets_on_success():
+    """A dead replica is probed every sweep until probe_backoff_after
+    failures, then skipped until its next_probe_t; forcing the clock past
+    it probes again. (The instant reset on success is exercised by every
+    fleet test that probes a live replica: consecutive_failures == 0.)"""
+    router = Router(FleetConfig.from_dict({
+        "replicas": [{"url": "http://127.0.0.1:9", "name": "dead"}],
+        "block_size": 4, "probe_interval_s": 5.0,
+        "probe_backoff_after": 3, "probe_backoff_max_s": 60.0,
+    }))
+    try:
+        rep = router._replicas["dead"]
+        for want in (1, 2, 3):
+            router.probe_once()
+            assert rep.consecutive_failures == want
+        assert rep.next_probe_t is not None  # backed off
+        due_at = rep.next_probe_t
+        assert due_at > time.monotonic()  # in the future
+        assert due_at < time.monotonic() + 5.0 * 1.25 + 1e-6
+        router.probe_once()  # not due: skipped, failure count unchanged
+        assert rep.consecutive_failures == 3
+        assert rep.next_probe_t == due_at
+        rep.next_probe_t = time.monotonic() - 1.0  # force due
+        router.probe_once()
+        assert rep.consecutive_failures == 4
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler state machine (tentpole, pure)
+# ---------------------------------------------------------------------------
+
+
+def _asc(**over):
+    d = {
+        "enabled": True, "min_replicas": 1, "max_replicas": 4,
+        "scale_up_consecutive": 2, "scale_down_consecutive": 3,
+        "cooldown_s": 100.0, "window_s": 10.0,
+    }
+    d.update(over)
+    return Autoscaler(AutoscaleConfig.from_dict(d))
+
+
+_OVER = FleetSignals(ready_replicas=2, queue_depth=50.0)
+_IDLE = FleetSignals(
+    ready_replicas=2, queue_depth=0.0, shed_rate=0.0, occupancy=0.0
+)
+_MID = FleetSignals(
+    ready_replicas=2, queue_depth=1.0, shed_rate=0.0, occupancy=0.5
+)
+
+
+def test_autoscaler_disabled_never_scales():
+    a = _asc(enabled=False)
+    for t in range(10):
+        assert a.decide(_OVER, 1, float(t)) == (None, None)
+
+
+def test_autoscaler_classify_triggers():
+    a = _asc()
+    base = dict(ready_replicas=2, queue_depth=1.0, shed_rate=0.0,
+                occupancy=0.5)
+    assert a.classify(FleetSignals(**{**base, "queue_depth": 50.0})) == (
+        "over", "queue_depth")
+    assert a.classify(FleetSignals(**{**base, "shed_rate": 2.0})) == (
+        "over", "shed_rate")
+    assert a.classify(FleetSignals(**{**base, "occupancy": 0.99})) == (
+        "over", "occupancy")
+    assert a.classify(FleetSignals(**base, slos_firing=1)) == (
+        "over", "slo_firing")
+    assert a.classify(FleetSignals(**base)) == ("hold", None)
+    assert a.classify(_IDLE) == ("under", "idle")
+    # unknown signals neither trigger nor count as quiet
+    assert a.classify(FleetSignals(ready_replicas=2)) == ("hold", None)
+    assert a.classify(FleetSignals(
+        ready_replicas=2, queue_depth=0.0, occupancy=0.0, shed_rate=None,
+    )) == ("hold", None)
+    # an all-down fleet is an availability incident, not a load signal
+    assert a.classify(FleetSignals(ready_replicas=0, queue_depth=99.0)) == (
+        "hold", None)
+    # SLO firing can be opted out of the up-triggers
+    a2 = _asc(slo_firing_scales_up=False)
+    assert a2.classify(FleetSignals(**base, slos_firing=3)) == ("hold", None)
+
+
+def test_autoscaler_debounce_cooldown_and_clamps():
+    a = _asc()
+    assert a.decide(_OVER, 2, 0.0) == (None, None)  # streak 1 of 2
+    assert a.decide(_OVER, 2, 1.0) == ("up", "queue_depth")
+    a.note_scaled({"direction": "up"}, 1.0)
+    # cooldown defers action; streaks keep accumulating underneath
+    assert a.decide(_OVER, 3, 2.0) == (None, None)
+    assert a.decide(_OVER, 3, 50.0) == (None, None)
+    assert a.decide(_OVER, 3, 102.0) == ("up", "queue_depth")
+    # at the ceiling: keep shedding loudly, never exceed max
+    assert a.decide(_OVER, 4, 103.0) == (None, None)
+    # scale-down debounce + floor clamp
+    b = _asc(cooldown_s=0.0)
+    for t in range(2):
+        assert b.decide(_IDLE, 2, float(t)) == (None, None)
+    assert b.decide(_IDLE, 2, 2.0) == ("down", "idle")
+    b.note_scaled({"direction": "down"}, 2.0)
+    for t in range(3, 7):
+        assert b.decide(_IDLE, 1, float(t)) == (None, None)  # at the floor
+    assert b.events_total == {"up": 0, "down": 1}
+
+
+def test_autoscaler_noisy_sweep_resets_streak():
+    a = _asc()
+    assert a.decide(_OVER, 2, 0.0) == (None, None)
+    assert a.decide(_MID, 2, 1.0) == (None, None)  # noise: streak resets
+    assert a.decide(_OVER, 2, 2.0) == (None, None)  # back to 1 of 2
+    assert a.decide(_OVER, 2, 3.0) == ("up", "queue_depth")
+    st = a.status()
+    assert st["over_streak"] == 2 and st["scale_ups"] == 0
+
+
+# ---------------------------------------------------------------------------
+# AKV1 weights_fetch + peer warm-start (tentpole pillar a)
+# ---------------------------------------------------------------------------
+
+
+def _weights_handler(auto):
+    from automodel_tpu.checkpoint.checkpointer import param_tree_signature
+    from automodel_tpu.serving.server import _tree_path_name
+
+    def handler():
+        sig = param_tree_signature(auto.params)
+        leaves = jax.tree_util.tree_flatten_with_path(auto.params)[0]
+        return sig, [(_tree_path_name(p), leaf) for p, leaf in leaves]
+
+    return handler
+
+
+def test_weights_fetch_round_trip_and_refusal():
+    from automodel_tpu.checkpoint.checkpointer import param_tree_signature
+
+    auto = _tiny_auto(seed=0)
+    srv = KVTransferServer(
+        _GEOM, port=0, weights_handler=_weights_handler(auto)
+    ).start()
+    try:
+        sig, arrays = fetch_weights(("127.0.0.1", srv.port), timeout_s=30.0)
+        expected = param_tree_signature(auto.params)
+        assert sig["digest"] == expected["digest"]
+        leaves = jax.tree_util.tree_flatten_with_path(auto.params)[0]
+        assert len(arrays) == len(leaves)
+        from automodel_tpu.serving.server import _tree_path_name
+
+        for path, leaf in leaves:
+            got = arrays[_tree_path_name(path)]
+            assert np.array_equal(got, np.asarray(leaf))
+    finally:
+        srv.close()
+    # a listener with no weights handler refuses loudly
+    srv2 = KVTransferServer(_GEOM, port=0).start()
+    try:
+        with pytest.raises(KVTransferError, match="no weights"):
+            fetch_weights(("127.0.0.1", srv2.port), timeout_s=10.0)
+    finally:
+        srv2.close()
+
+
+def test_weights_stream_abort_chaos_raises():
+    """The chaos knob truncates the stream after N leaves — the fetching
+    side must die with a transport error, not return a partial tree."""
+    auto = _tiny_auto(seed=0)
+    srv = KVTransferServer(
+        _GEOM, port=0, weights_handler=_weights_handler(auto)
+    ).start()
+    try:
+        fi.activate({"weights_stream_abort_after": 1})
+        with pytest.raises(KVTransferError):
+            fetch_weights(("127.0.0.1", srv.port), timeout_s=10.0)
+    finally:
+        srv.close()
+
+
+def test_warm_start_params_success_and_cold_fallbacks():
+    """seed-1 replica streams seed-0 weights (same architecture → same
+    signature, different values → the swap is observable); every failure
+    mode — dead peer, tampered signature, mid-stream death — returns
+    False and leaves the cold-built params untouched."""
+    from automodel_tpu.serving.server import _warm_start_params
+
+    peer = _tiny_auto(seed=0)
+    srv = KVTransferServer(
+        _GEOM, port=0, weights_handler=_weights_handler(peer)
+    ).start()
+    try:
+        auto = _tiny_auto(seed=1)
+        peer_leaves = jax.tree_util.tree_leaves(peer.params)
+        before = [np.asarray(x).copy() for x in
+                  jax.tree_util.tree_leaves(auto.params)]
+        assert any(
+            not np.array_equal(b, np.asarray(p))
+            for b, p in zip(before, peer_leaves)
+        ), "seeds 0 and 1 must differ for this test to prove anything"
+        ws = WarmStartConfig(
+            peer_host="127.0.0.1", peer_port=srv.port, timeout_s=30.0
+        )
+        assert _warm_start_params(auto, ws) is True
+        for mine, theirs in zip(
+            jax.tree_util.tree_leaves(auto.params), peer_leaves
+        ):
+            assert np.array_equal(np.asarray(mine), np.asarray(theirs))
+
+        # fallback 1: peer unreachable
+        auto2 = _tiny_auto(seed=1)
+        dead = WarmStartConfig(
+            peer_host="127.0.0.1", peer_port=9, timeout_s=2.0
+        )
+        assert _warm_start_params(auto2, dead) is False
+        for mine, b in zip(jax.tree_util.tree_leaves(auto2.params), before):
+            assert np.array_equal(np.asarray(mine), b)
+
+        # fallback 2: peer dies mid-stream (the chaos path the slow e2e
+        # also covers across processes)
+        fi.activate({"weights_stream_abort_after": 1})
+        auto3 = _tiny_auto(seed=1)
+        assert _warm_start_params(auto3, ws) is False
+        for mine, b in zip(jax.tree_util.tree_leaves(auto3.params), before):
+            assert np.array_equal(np.asarray(mine), b)
+        fi.activate(None)
+    finally:
+        srv.close()
+
+    # fallback 3: signature mismatch — the peer serves a different tree
+    def tampered():
+        sig, leaves = _weights_handler(peer)()
+        return {**sig, "digest": "not-my-architecture"}, leaves
+
+    srv2 = KVTransferServer(_GEOM, port=0, weights_handler=tampered).start()
+    try:
+        auto4 = _tiny_auto(seed=1)
+        ws2 = WarmStartConfig(
+            peer_host="127.0.0.1", peer_port=srv2.port, timeout_s=30.0
+        )
+        assert _warm_start_params(auto4, ws2) is False
+    finally:
+        srv2.close()
+
+
+# ---------------------------------------------------------------------------
+# AKV1 kv_push + prefix migration (tentpole pillar b)
+# ---------------------------------------------------------------------------
+
+
+def _spill_engine():
+    return _engine(kv_spill=KVSpillConfig(enabled=True, max_host_mb=4.0))
+
+
+def test_kv_push_migrates_prefix_and_preserves_hits():
+    """Engine A's hot blocks pushed to engine B's spill tier: B replays
+    the prompt with a full prefix hit and bit-identical greedy tokens —
+    the token-weighted hit rate survives the migration."""
+    from automodel_tpu.serving.server import stats_snapshot
+
+    eng_a = _spill_engine()
+    rec_a = []
+    eng_a.on_record = rec_a.append
+    prompt = list(range(1, 14))  # 3 full blocks, 12 matchable tokens
+    eng_a.submit(prompt, max_new_tokens=6)
+    eng_a.run()
+    hashes, kv = eng_a.export_hot_blocks()
+    assert len(hashes) == 3 and kv is not None
+
+    eng_b = _spill_engine()
+    target = KVTransferServer(
+        eng_b.kv_geometry(), port=0,
+        push_handler=eng_b.receive_migrated_blocks,
+    ).start()
+    try:
+        accepted = push_kv(
+            ("127.0.0.1", target.port), hashes, kv, eng_a.kv_geometry()
+        )
+        assert accepted == 3
+        eng_b.pool.check_invariants()
+        # a second identical push is a no-op (B already holds every block)
+        assert push_kv(
+            ("127.0.0.1", target.port), hashes, kv, eng_a.kv_geometry()
+        ) == 0
+        rec_b = []
+        eng_b.on_record = rec_b.append
+        eng_b.submit(prompt, max_new_tokens=6)
+        eng_b.run()
+        assert rec_b[-1]["tokens"] == rec_a[-1]["tokens"]
+        alloc = stats_snapshot(eng_b)["allocator"]
+        assert alloc["prefix_hit_tokens"] == 12
+        # geometry mismatch refuses before any row lands
+        bad = dict(eng_a.kv_geometry(), block_size=8)
+        with pytest.raises(KVTransferError, match="geometry"):
+            push_kv(("127.0.0.1", target.port), hashes, kv, bad)
+        # chaos: the target "dies" before acking — the pusher sees a
+        # transport error, never a silent partial success
+        fi.activate({"kv_push_drop_ack": True})
+        with pytest.raises(KVTransferError):
+            push_kv(("127.0.0.1", target.port), hashes, kv,
+                    eng_a.kv_geometry())
+    finally:
+        target.close()
+
+
+def test_retire_sequence_outcomes_and_deadline():
+    """The scale-down orchestration: drain → export → push → one outcome
+    record. Skipped without a target, complete with one, failed (within
+    the deadline, degrading to plain drain) when the target is dead or
+    dies mid-ship."""
+    from automodel_tpu.serving.server import retire_sequence
+
+    eng = _spill_engine()
+    records = []
+    eng.on_record = records.append
+    server, loop = _http_replica(eng)
+    try:
+        prompt = list(range(1, 14))
+        code, _ = _post_json(
+            server.server_address[1], "/generate",
+            {"prompt_ids": prompt, "max_new_tokens": 6, "id": "seed"},
+        )
+        assert code == 200
+        # no target → plain drain, migration_skipped
+        assert retire_sequence(eng, loop, None, 5.0) == "migration_skipped"
+        skipped = [r for r in records if r["event"] == "migration_skipped"]
+        assert skipped and skipped[0]["migrated_blocks"] == 0
+
+        eng_b = _spill_engine()
+        target = KVTransferServer(
+            eng_b.kv_geometry(), port=0,
+            push_handler=eng_b.receive_migrated_blocks,
+        ).start()
+        try:
+            out = retire_sequence(
+                eng, loop, {"host": "127.0.0.1", "port": target.port}, 10.0
+            )
+            assert out == "migration_complete"
+            done = [r for r in records if r["event"] == "migration_complete"]
+            assert done[0]["migrated_blocks"] == 3
+            assert done[0]["hot_blocks"] == 3
+            assert 0 <= done[0]["retire_s"] < 10.0
+        finally:
+            target.close()
+    finally:
+        _close_front(server, loop)
+
+    # failure paths get a fresh engine (the one above is drained)
+    eng2 = _spill_engine()
+    records2 = []
+    eng2.on_record = records2.append
+    server2, loop2 = _http_replica(eng2)
+    try:
+        code, _ = _post_json(
+            server2.server_address[1], "/generate",
+            {"prompt_ids": list(range(1, 14)), "max_new_tokens": 6,
+             "id": "seed2"},
+        )
+        assert code == 200
+        t0 = time.monotonic()
+        out = retire_sequence(
+            eng2, loop2, {"host": "127.0.0.1", "port": 9}, 5.0
+        )
+        assert out == "migration_failed"
+        assert time.monotonic() - t0 < 5.0 + 2.0  # never past the deadline
+        failed = [r for r in records2 if r["event"] == "migration_failed"]
+        assert failed and "error" in failed[0]
+
+        # chaos: target accepts the stream then dies before acking
+        eng_c = _spill_engine()
+        target = KVTransferServer(
+            eng_c.kv_geometry(), port=0,
+            push_handler=eng_c.receive_migrated_blocks,
+        ).start()
+        try:
+            fi.activate({"kv_push_drop_ack": True})
+            out = retire_sequence(
+                eng2, loop2, {"host": "127.0.0.1", "port": target.port}, 5.0
+            )
+            assert out == "migration_failed"
+        finally:
+            target.close()
+    finally:
+        _close_front(server2, loop2)
+
+
+def test_retire_endpoint_http():
+    """POST /retire: 400 without a hook or with a malformed migrate body,
+    200 + immediate return with one (the drain runs on its own thread)."""
+    from automodel_tpu.serving.server import serve_http
+
+    eng = _engine()
+    server, loop = serve_http(eng, None, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        code, body = _post_json(
+            server.server_address[1], "/retire", {"deadline_s": 5.0}
+        )
+        assert code == 400 and "retire hook" in body["error"]
+    finally:
+        _close_front(server, loop)
+
+    eng2 = _engine()
+    called = threading.Event()
+    seen = {}
+
+    def on_retire(migrate, deadline_s):
+        seen.update({"migrate": migrate, "deadline_s": deadline_s})
+        called.set()
+
+    server2, loop2 = serve_http(eng2, None, port=0, on_retire=on_retire)
+    threading.Thread(target=server2.serve_forever, daemon=True).start()
+    try:
+        port = server2.server_address[1]
+        code, body = _post_json(
+            port, "/retire", {"migrate": {"host": "h"}, "deadline_s": 5.0}
+        )
+        assert code == 400  # migrate must be null or {host, port}
+        code, body = _post_json(
+            port, "/retire",
+            {"migrate": {"host": "127.0.0.1", "port": 1}, "deadline_s": 7.0},
+        )
+        assert code == 200 and body["draining"] and body["migrate"]
+        assert called.wait(timeout=10)
+        assert seen == {
+            "migrate": {"host": "127.0.0.1", "port": 1}, "deadline_s": 7.0,
+        }
+    finally:
+        _close_front(server2, loop2)
+
+
+# ---------------------------------------------------------------------------
+# the closed loop: router + backend over in-process replicas
+# ---------------------------------------------------------------------------
+
+
+def test_router_closed_loop_scale_up_backfill_and_scale_down():
+    """Deterministic signal injection through the REAL control path:
+    probe sweep → decide → LocalProcessBackend spawn/retire → registry +
+    metrics + scale_event records + time_to_ready backfill. (The signal
+    rollup itself is covered by _fleet_signals federation tests and
+    test_fleet_health.)"""
+    from automodel_tpu.serving.fleet.status import render_table
+
+    engines = [_engine()]
+    fronts = [_http_replica(engines[0])]
+    spawned_fronts = []
+
+    def spawn(warm_peer):
+        eng = _engine()
+        # the serve CLI front stamps boot_t before the model build; an
+        # in-process replica must do it itself for note_ready to measure
+        eng.boot_t = time.perf_counter()
+        front = _http_replica(eng)
+        spawned_fronts.append(front)
+        engines.append(eng)
+        name = f"auto{len(spawned_fronts)}"
+        return name, f"http://127.0.0.1:{front[0].server_address[1]}"
+
+    retired = []
+    backend = LocalProcessBackend(
+        spawn,
+        retire=lambda name, url, migrate, dl: retired.append(
+            (name, migrate, dl)
+        ),
+    )
+    records = []
+    router = Router(
+        FleetConfig.from_dict({
+            "replicas": [{
+                "url": f"http://127.0.0.1:{fronts[0][0].server_address[1]}",
+                "name": "r0",
+            }],
+            "block_size": 4, "probe_interval_s": 30.0,
+        }),
+        on_record=records.append,
+        autoscale_config=AutoscaleConfig.from_dict({
+            "enabled": True, "min_replicas": 1, "max_replicas": 2,
+            "scale_up_consecutive": 1, "scale_down_consecutive": 2,
+            "cooldown_s": 0.0, "window_s": 5.0,
+        }),
+        scale_backend=backend,
+    )
+    try:
+        router.probe_once()
+        assert len(router._replicas) == 1
+        router._fleet_signals = lambda now: FleetSignals(
+            ready_replicas=1, queue_depth=99.0
+        )
+        router.probe_once()
+        assert len(router._replicas) == 2  # spawned + registered
+        ups = [r for r in records if r.get("event") == "scale_event"]
+        assert len(ups) == 1
+        assert ups[0]["direction"] == "up"
+        assert ups[0]["trigger"] == "queue_depth"
+        assert ups[0]["replicas_before"] == 1
+        assert ups[0]["replicas_after"] == 2
+        # hold band: next sweep probes the new replica ready and backfills
+        # the event with its measured time_to_ready_s + boot_source
+        router._fleet_signals = lambda now: _MID
+        router.probe_once()
+        router.probe_once()
+        last = router.autoscaler.last_event
+        assert last["time_to_ready_s"] is not None
+        assert last["boot_source"] == "cold_hf"
+        stats = router.stats()
+        assert stats["autoscale"]["scale_ups"] == 1
+        rendered = router.metrics.registry.render()
+        assert "automodel_route_autoscale_target_replicas 2" in rendered
+        assert (
+            'automodel_route_autoscale_events_total{direction="up"} 1'
+            in rendered
+        )
+        # persistent idle → debounced scale-down through the backend's
+        # retire; the registry shrinks back to the floor
+        router._fleet_signals = lambda now: FleetSignals(
+            ready_replicas=2, queue_depth=0.0, shed_rate=0.0, occupancy=0.0
+        )
+        router.probe_once()
+        router.probe_once()
+        assert len(router._replicas) == 1
+        assert len(retired) == 1
+        name, migrate, deadline = retired[0]
+        assert migrate is None  # no peer advertises a KV listener here
+        assert deadline == pytest.approx(30.0)
+        downs = [
+            r for r in records
+            if r.get("event") == "scale_event" and r["direction"] == "down"
+        ]
+        assert len(downs) == 1 and downs[0]["trigger"] == "idle"
+        # fleet-status renders the controller state (satellite 6)
+        table = render_table(router.stats())
+        assert "autoscale: 1 replicas (bounds 1..2), 1 up / 1 down" in table
+        assert "last scale: down (trigger=idle) 2 -> 1 replicas" in table
+    finally:
+        router.close()
+        for server, loop in fronts + spawned_fronts:
+            _close_front(server, loop)
+
+
+def test_router_backend_failure_keeps_streak_and_retries():
+    """A backend that throws must NOT start the cooldown — the streak
+    stays live and the very next sweep retries the scale."""
+    engines = [_engine()]
+    front = _http_replica(engines[0])
+    spawned = []
+    attempts = {"n": 0}
+
+    def flaky_spawn(warm_peer):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise RuntimeError("no capacity")
+        eng = _engine()
+        f = _http_replica(eng)
+        spawned.append(f)
+        engines.append(eng)
+        return "auto1", f"http://127.0.0.1:{f[0].server_address[1]}"
+
+    records = []
+    router = Router(
+        FleetConfig.from_dict({
+            "replicas": [{
+                "url": f"http://127.0.0.1:{front[0].server_address[1]}",
+                "name": "r0",
+            }],
+            "block_size": 4, "probe_interval_s": 30.0,
+        }),
+        on_record=records.append,
+        autoscale_config=AutoscaleConfig.from_dict({
+            "enabled": True, "max_replicas": 2,
+            "scale_up_consecutive": 1, "cooldown_s": 300.0,
+        }),
+        scale_backend=LocalProcessBackend(flaky_spawn),
+    )
+    try:
+        router._fleet_signals = lambda now: FleetSignals(
+            ready_replicas=1, queue_depth=99.0
+        )
+        router.probe_once()  # spawn raises → no event, no cooldown
+        assert len(router._replicas) == 1
+        assert not [r for r in records if r.get("event") == "scale_event"]
+        assert router.autoscaler._over_streak >= 1
+        router.probe_once()  # retry lands despite the long cooldown_s
+        assert len(router._replicas) == 2
+        assert attempts["n"] == 2
+        assert [
+            r for r in records if r.get("event") == "scale_event"
+        ][0]["direction"] == "up"
+    finally:
+        router.close()
+        for server, loop in [front] + spawned:
+            _close_front(server, loop)
+
+
+# ---------------------------------------------------------------------------
+# backends
+# ---------------------------------------------------------------------------
+
+
+def test_scale_fleet_role_argv_and_validation():
+    import types
+
+    from automodel_tpu.launcher.k8s import scale_fleet_role
+
+    cfg = types.SimpleNamespace(name="myfleet")
+    argv = scale_fleet_role(cfg, "decode", 3, apply=False)
+    assert argv == [
+        "kubectl", "scale", "statefulset", "myfleet-decode", "--replicas=3",
+    ]
+    with pytest.raises(ValueError):
+        scale_fleet_role(cfg, "router", 1, apply=False)
+    with pytest.raises(ValueError):
+        scale_fleet_role(cfg, "mixed", -1, apply=False)
+
+
+def test_k8s_backend_desired_bookkeeping(monkeypatch):
+    import types
+
+    import automodel_tpu.launcher.k8s as k8s_mod
+
+    calls = []
+    monkeypatch.setattr(
+        k8s_mod, "scale_fleet_role",
+        lambda cfg, role, n, apply=True: calls.append((role, n)),
+    )
+    cfg = types.SimpleNamespace(name="f", mixed=2)
+    be = K8sFleetBackend(cfg, role="mixed")
+    assert be.desired == 2 and be.registry_managed is False
+    name, url = be.spawn(None)
+    assert (name, url) == ("", "")  # membership arrives via DNS probe
+    assert be.desired == 3 and calls[-1] == ("mixed", 3)
+    be.retire("f-mixed-2", "http://x", None, 30.0)
+    assert be.desired == 2 and calls[-1] == ("mixed", 2)
+
+    # kubectl failure rolls the desired count back and surfaces loudly
+    def boom(cfg, role, n, apply=True):
+        raise RuntimeError("kubectl: connection refused")
+
+    monkeypatch.setattr(k8s_mod, "scale_fleet_role", boom)
+    with pytest.raises(ScaleBackendError):
+        be.spawn(None)
+    assert be.desired == 2
+    with pytest.raises(ScaleBackendError):
+        be.retire("f-mixed-1", "http://x", None, 30.0)
+    assert be.desired == 2
+
+
+# ---------------------------------------------------------------------------
+# report + observability surfaces (satellites 2, 6)
+# ---------------------------------------------------------------------------
+
+
+def test_report_strict_accepts_and_summarizes_elastic_records(tmp_path):
+    from automodel_tpu.telemetry.report import (
+        lint_metrics_jsonl,
+        summarize_metrics,
+    )
+
+    rows = [
+        {"event": "replica_ready", "ts": 10.0, "boot_source": "cold_hf",
+         "time_to_ready_s": 42.5},
+        {"event": "replica_ready", "ts": 11.0,
+         "boot_source": "peer_warm_start", "time_to_ready_s": 7.25},
+        {"event": "scale_event", "ts": 20.0, "direction": "up",
+         "trigger": "queue_depth", "replica": "auto1",
+         "replicas_before": 1, "replicas_after": 2},
+        {"event": "scale_event", "ts": 90.0, "direction": "down",
+         "trigger": "idle", "replica": "r0",
+         "replicas_before": 2, "replicas_after": 1},
+        {"event": "migration_complete", "ts": 91.0, "migrated_blocks": 3,
+         "hot_blocks": 3, "retire_s": 1.5},
+        {"event": "migration_failed", "ts": 95.0, "migrated_blocks": 0,
+         "hot_blocks": 2, "retire_s": 5.0, "error": "KVTransferError: x"},
+    ]
+    path = tmp_path / "metrics.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    records, problems = lint_metrics_jsonl(str(path))
+    assert problems == []
+    s = summarize_metrics(records)
+    assert s["scale_ups"] == 1 and s["scale_downs"] == 1
+    assert s["scale_events"] == [
+        {"direction": "up", "trigger": "queue_depth",
+         "replicas_before": 1, "replicas_after": 2},
+        {"direction": "down", "trigger": "idle",
+         "replicas_before": 2, "replicas_after": 1},
+    ]
+    assert s["replica_boots"]["cold_hf"]["count"] == 1
+    assert s["replica_boots"]["peer_warm_start"][
+        "time_to_ready_p50_s"] == pytest.approx(7.25)
+    assert s["prefix_migrations"] == {
+        "complete": 1, "failed": 1, "skipped": 0, "migrated_blocks": 3,
+    }
+
+
+def test_fleet_status_renders_autoscale_footer():
+    from automodel_tpu.serving.fleet.status import render_table
+
+    stats = {
+        "replicas": {}, "replicas_ready": 0,
+        "autoscale": {
+            "enabled": True, "min_replicas": 1, "max_replicas": 4,
+            "over_streak": 0, "under_streak": 0,
+            "scale_ups": 2, "scale_downs": 1,
+            "last_event": {
+                "direction": "up", "trigger": "shed_rate",
+                "replicas_before": 2, "replicas_after": 3,
+                "time_to_ready_s": 12.339,
+            },
+        },
+    }
+    out = render_table(stats)
+    assert "autoscale: 0 replicas (bounds 1..4), 2 up / 1 down events" in out
+    assert (
+        "last scale: up (trigger=shed_rate) 2 -> 3 replicas, "
+        "time_to_ready=12.34s" in out
+    )
+
+
+# ---------------------------------------------------------------------------
+# slow subprocess acceptance e2es
+# ---------------------------------------------------------------------------
+
+
+def _spawn_elastic_replica(tmp_path, idx, serving_extra=None, inject=None):
+    from tests.test_serving_chaos import _WORKER, _clean_env
+
+    cfg = {
+        "seed": 0,
+        "model": {
+            "hf_config": {
+                "architectures": ["LlamaForCausalLM"],
+                "model_type": "llama",
+                "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+                "num_hidden_layers": 2, "num_attention_heads": 4,
+                "num_key_value_heads": 2, "head_dim": 8,
+                "max_position_embeddings": 128,
+            },
+            "backend": {"attn": "sdpa", "param_dtype": "float32",
+                        "compute_dtype": "float32"},
+        },
+        "distributed": {"dp_shard": 1},
+        "generation": {"max_new_tokens": 6, "greedy": True},
+        "serving": {
+            "slots": 2, "block_size": 4, "num_blocks": 32,
+            "prefill_chunk": 4, "max_seq_len": 64,
+            "http": {"port": 0},
+            "watchdog": {"enabled": False},
+            "kv_spill": {"enabled": True, "max_host_mb": 4.0},
+            **(serving_extra or {}),
+        },
+    }
+    cfg_path = tmp_path / f"elastic_replica{idx}.yaml"
+    cfg_path.write_text(json.dumps(cfg))
+    env = _clean_env()
+    if inject:
+        env[fi.ENV_VAR] = json.dumps(inject)
+    return subprocess.Popen(
+        [sys.executable, _WORKER, "serve", "-c", str(cfg_path)],
+        stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def _kill_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10)
+
+
+@pytest.mark.slow  # three replica subprocess boots
+def test_warm_start_faster_than_cold_with_identical_outputs(tmp_path):
+    """Acceptance A/B: with an injected HF-load delay, a peer-warm-started
+    replica reaches ready measurably faster than a cold one (the delay is
+    on the cold path it skips), reports boot_source=peer_warm_start, and
+    serves bit-identical greedy tokens."""
+    from tests.test_fleet import _http_json_raw
+    from tests.test_serving_chaos import _replica_port
+
+    peer = _spawn_elastic_replica(tmp_path, 0)
+    procs = [peer]
+    try:
+        port_peer = _replica_port(peer)
+        kv_port = _http_json_raw(port_peer, "/stats")["kv_transfer_port"]
+        assert kv_port
+        delay = {"hf_load_delay_ms": 6000.0}
+        warm = _spawn_elastic_replica(
+            tmp_path, 1,
+            serving_extra={"warm_start": {
+                "peer_host": "127.0.0.1", "peer_port": int(kv_port),
+                "timeout_s": 120.0,
+            }},
+            inject=delay,
+        )
+        cold = _spawn_elastic_replica(tmp_path, 2, inject=delay)
+        procs += [warm, cold]
+        port_warm = _replica_port(warm)
+        port_cold = _replica_port(cold)
+        s_warm = _http_json_raw(port_warm, "/stats")
+        s_cold = _http_json_raw(port_cold, "/stats")
+        assert s_warm["boot_source"] == "peer_warm_start"
+        assert s_cold["boot_source"] == "cold_hf"
+        assert s_warm["time_to_ready_s"] is not None
+        assert s_cold["time_to_ready_s"] is not None
+        # the warm replica skipped the injected 6s cold-load delay; leave
+        # half of it as margin against CPU compile-time noise
+        assert s_warm["time_to_ready_s"] < s_cold["time_to_ready_s"] - 3.0
+        prompt = list(range(1, 14))
+        body_w = _http_json_raw(
+            port_warm, "/generate",
+            {"prompt_ids": prompt, "max_new_tokens": 6, "id": "w"},
+        )
+        body_c = _http_json_raw(
+            port_cold, "/generate",
+            {"prompt_ids": prompt, "max_new_tokens": 6, "id": "c"},
+        )
+        assert body_w["tokens"] == body_c["tokens"]
+        assert body_w["completion_reason"] == body_c["completion_reason"]
+    finally:
+        _kill_all(procs)
+
+
+@pytest.mark.slow  # two replica subprocess boots, one spawned mid-test
+def test_elastic_fleet_e2e_scale_up_migrate_down(tmp_path):
+    """The full loop over real processes: overload → the router spawns a
+    warm-started replica through LocalProcessBackend; idle → the victim
+    drains, ships its hot prefix to the survivor over kv_push, and exits
+    0; every request gets a terminal answer and the migrated prefix is
+    hot on the survivor (full token-weighted hit, identical tokens)."""
+    from tests.test_fleet import _http_json_raw
+    from tests.test_serving_chaos import _replica_port
+
+    first = _spawn_elastic_replica(tmp_path, 0)
+    procs = {"r0": first}
+    ports = {}
+
+    def spawn(warm_peer):
+        idx = 1 + len(ports)
+        extra = {}
+        if warm_peer is not None:
+            extra["warm_start"] = {
+                "peer_host": warm_peer["host"],
+                "peer_port": int(warm_peer["port"]),
+                "timeout_s": 120.0,
+            }
+        p = _spawn_elastic_replica(tmp_path, idx, serving_extra=extra)
+        name = f"auto{idx}"
+        procs[name] = p
+        port = _replica_port(p)
+        ports[name] = port
+        return name, f"http://127.0.0.1:{port}"
+
+    records = []
+    router = None
+    try:
+        ports["r0"] = _replica_port(first)
+        router = Router(
+            FleetConfig.from_dict({
+                "replicas": [
+                    {"url": f"http://127.0.0.1:{ports['r0']}", "name": "r0"},
+                ],
+                "block_size": 4, "probe_interval_s": 30.0,
+                "retry_budget": 2,
+            }),
+            on_record=records.append,
+            autoscale_config=AutoscaleConfig.from_dict({
+                "enabled": True, "min_replicas": 1, "max_replicas": 2,
+                "scale_up_consecutive": 1, "scale_down_consecutive": 2,
+                "cooldown_s": 0.0, "window_s": 5.0,
+                "retire_deadline_s": 60.0,
+            }),
+            scale_backend=LocalProcessBackend(spawn),  # default /retire
+        )
+        router.probe_once()
+        prompt = list(range(1, 14))
+        code, body0 = router.handle_generate(
+            {"prompt_ids": prompt, "max_new_tokens": 6, "id": "seed"}
+        )
+        assert code == 200
+        # deterministic overload signal through the real control path
+        router._fleet_signals = lambda now: FleetSignals(
+            ready_replicas=1, queue_depth=99.0
+        )
+        router.probe_once()  # blocks on the spawn, replica warm-starts
+        ups = [r for r in records if r.get("event") == "scale_event"]
+        assert len(ups) == 1 and ups[0]["direction"] == "up"
+        survivor = ups[0]["replica"]
+        s_new = _http_json_raw(ports[survivor], "/stats")
+        assert s_new["boot_source"] == "peer_warm_start"
+        # hold band: probe the new replica ready, keep serving
+        router._fleet_signals = lambda now: _MID
+        router.probe_once()
+        survivor_touched = False
+        for i in range(3):
+            code, b = router.handle_generate(
+                {"prompt_ids": prompt, "max_new_tokens": 6, "id": f"m{i}"}
+            )
+            assert code == 200 and b["tokens"] == body0["tokens"]
+            if b["route"]["replica"] != "r0":
+                survivor_touched = True
+        # idle → scale down. r0 (first registered, least loaded) drains,
+        # migrates its hot prefix to the survivor, and exits cleanly.
+        router._fleet_signals = lambda now: FleetSignals(
+            ready_replicas=2, queue_depth=0.0, shed_rate=0.0, occupancy=0.0
+        )
+        router.probe_once()
+        router.probe_once()
+        downs = [
+            r for r in records
+            if r.get("event") == "scale_event" and r["direction"] == "down"
+        ]
+        assert len(downs) == 1 and downs[0]["replica"] == "r0"
+        assert procs["r0"].wait(timeout=120) == 0
+        router.probe_once()
+        # zero lost requests: the fleet still answers, and the survivor —
+        # which never computed this prefix — serves it from the migrated
+        # blocks with a full hit and identical tokens
+        code, body1 = router.handle_generate(
+            {"prompt_ids": prompt, "max_new_tokens": 6, "id": "after"}
+        )
+        assert code == 200
+        assert body1["tokens"] == body0["tokens"]
+        assert body1["route"]["replica"] == survivor
+        assert body1["prefix_hit_tokens"] == 12
+        if not survivor_touched:
+            # the survivor never served this prompt, so the full hit above
+            # can only have come from the migrated rows in its spill tier
+            alloc = _http_json_raw(ports[survivor], "/stats")["allocator"]
+            assert alloc["spilled_blocks"] >= 3
+    finally:
+        if router is not None:
+            router.close()
+        _kill_all(list(procs.values()))

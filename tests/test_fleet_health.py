@@ -177,6 +177,8 @@ def _wait_slo_state(router, name, want, timeout_s):
     )
 
 
+@pytest.mark.slow  # two replica subprocess boots + Poisson waves: well
+# over the tier-1 per-test budget (conftest enforces it)
 def test_fleet_health_e2e_breach_fires_and_resolves(tmp_path):
     """ISSUE 17 acceptance: both replicas get a wall-clock-bounded
     fault_injection prefill stall; under Poisson load the ttft objective

@@ -27,6 +27,11 @@ _EXTRA_KEYS = (
     "nonfinite",
     "val_loss",
     "steps_spanned",
+    # elastic fleet (serving/fleet/autoscale.py): replica boot provenance
+    # + the scale_event envelope's string fields
+    "boot_source",
+    "direction",
+    "trigger",
 )
 
 

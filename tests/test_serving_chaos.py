@@ -471,6 +471,8 @@ def _replica_port(proc, timeout_s=300.0):
     return box["port"]
 
 
+@pytest.mark.slow  # two replica subprocess boots + Poisson workload: well
+# over the tier-1 per-test budget (conftest enforces it)
 def test_chaos_fleet_replica_kill_zero_lost_requests(tmp_path):
     """Acceptance (ISSUE 12): router + 2 engine replica SUBPROCESSES under
     a Poisson workload; one replica is SIGKILLed mid-decode. The router
