@@ -13,14 +13,16 @@ import sys
 
 from automodel_tpu.config.arg_parser import parse_args_and_load_config
 
-COMMANDS = ("finetune", "pretrain", "kd", "benchmark", "mine")
+COMMANDS = ("finetune", "pretrain", "kd", "dpo", "grpo", "benchmark", "mine")
 DOMAINS = ("llm", "vlm", "biencoder")
 
 
 def _usage() -> str:
     return (
-        "usage: automodel_tpu <finetune|pretrain|kd|benchmark|mine> <llm|vlm|biencoder> "
+        "usage: automodel_tpu <finetune|pretrain|kd|dpo|grpo|benchmark|mine> <llm|vlm|biencoder> "
         "-c config.yaml [--dotted.key=value ...]\n"
+        "       automodel_tpu dpo llm -c config.yaml   (preference optimization — DPO/ORPO over chosen/rejected pairs; posttrain: section)\n"
+        "       automodel_tpu grpo llm -c config.yaml  (RL post-training — serving-engine rollouts, pluggable reward:, group-relative advantages, live weight hot-swap)\n"
         "       automodel_tpu generate -c config.yaml [--prompt '...'] [--dotted.key=value ...]\n"
         "       automodel_tpu serve -c config.yaml [--dotted.key=value ...]  (stdin-JSONL; serving.http.port for HTTP; GET /metrics /healthz /readyz; SIGTERM drains gracefully)\n"
         "       automodel_tpu route -c config.yaml [--dotted.key=value ...]  (fleet router over N serve replicas: fleet.replicas/fleet.dns; prefix-affinity + retry; same HTTP front contract; slo: section arms burn-rate alerting)\n"
@@ -183,6 +185,8 @@ def main(argv: list[str] | None = None) -> int:
         ("pretrain", "llm"): "automodel_tpu.recipes.train_ft",
         ("benchmark", "llm"): "automodel_tpu.recipes.benchmark",
         ("kd", "llm"): "automodel_tpu.recipes.kd",
+        ("dpo", "llm"): "automodel_tpu.posttrain.dpo",
+        ("grpo", "llm"): "automodel_tpu.posttrain.grpo",
         ("finetune", "vlm"): "automodel_tpu.recipes.finetune_vlm",
         ("finetune", "biencoder"): "automodel_tpu.recipes.train_biencoder",
         ("mine", "biencoder"): "automodel_tpu.recipes.mine_hard_negatives",

@@ -96,6 +96,85 @@ class ChatDataset:
             yield self[i]
 
 
+def tokenize_preference_pair(
+    tokenizer: Any,
+    prompt: Any,
+    chosen: Any,
+    rejected: Any,
+    chat_template_kwargs: Optional[dict] = None,
+) -> dict:
+    """One preference pair → per-side token arrays with a SHARED prompt mask.
+
+    Both sides tokenize the identical prompt prefix through the same chat
+    template; labels carry IGNORE_INDEX over that prefix on BOTH sides, so
+    neither policy's per-sequence logprob sum counts prompt tokens — the
+    DPO/ORPO margin compares response likelihoods only. Keys are prefixed
+    (``chosen_input_ids``/``chosen_labels``/``rejected_...``) so the pair
+    rides one example dict through ``preference_collater``.
+    """
+    kw = dict(chat_template_kwargs or {})
+    if isinstance(prompt, str):
+        prompt_msgs = [{"role": "user", "content": prompt}]
+    else:
+        prompt_msgs = ChatDataset._normalize(prompt)
+    prompt_len = _template_len(tokenizer, prompt_msgs, **kw)
+    out: dict[str, Any] = {}
+    for side, response in (("chosen", chosen), ("rejected", rejected)):
+        if isinstance(response, list):  # full-conversation column (HH style)
+            response = response[-1]
+        if isinstance(response, dict):
+            msg = ChatDataset._normalize([response])[0]
+        else:
+            msg = {"role": "assistant", "content": str(response)}
+        ids = tokenizer.apply_chat_template(prompt_msgs + [msg], tokenize=True, **kw)
+        if isinstance(ids, dict):
+            ids = ids["input_ids"]
+        ids = np.asarray(ids).reshape(-1)
+        labels = np.full_like(ids, IGNORE_INDEX)
+        labels[prompt_len:] = ids[prompt_len:]
+        out[f"{side}_input_ids"] = ids.tolist()
+        out[f"{side}_labels"] = labels.tolist()
+    return out
+
+
+class PreferenceDataset:
+    """Column-mapped preference-pair dataset (the UltraFeedback/HH shape):
+    each row carries a prompt plus a chosen and a rejected response."""
+
+    def __init__(
+        self,
+        dataset: Any,
+        tokenizer: Any,
+        prompt_column: str = "prompt",
+        chosen_column: str = "chosen",
+        rejected_column: str = "rejected",
+        chat_template_kwargs: Optional[dict] = None,
+    ):
+        self.dataset = dataset
+        self.tokenizer = tokenizer
+        self.prompt_column = prompt_column
+        self.chosen_column = chosen_column
+        self.rejected_column = rejected_column
+        self.chat_template_kwargs = chat_template_kwargs
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def __getitem__(self, idx: int) -> dict:
+        row = self.dataset[idx]
+        return tokenize_preference_pair(
+            self.tokenizer,
+            row[self.prompt_column],
+            row[self.chosen_column],
+            row[self.rejected_column],
+            self.chat_template_kwargs,
+        )
+
+    def __iter__(self) -> Iterator[dict]:
+        for i in range(len(self)):
+            yield self[i]
+
+
 class XLamDataset:
     """Salesforce xLAM function-calling rows → tool-call SFT conversations
     (reference datasets/llm/xlam.py:199). Rows: ``query`` (str), ``tools``

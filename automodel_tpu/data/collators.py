@@ -87,6 +87,53 @@ def packed_collater(
     }
 
 
+def preference_collater(
+    examples: Iterable[dict[str, Any]],
+    pad_token_id: int = 0,
+    pad_seq_len_divisible: int | None = None,
+    max_seq_len: int | None = None,
+) -> dict[str, np.ndarray]:
+    """Collate preference pairs (data/chat.py tokenize_preference_pair):
+    chosen and rejected sides each get the default_collater treatment
+    (padding, label shift, position_ids) under prefixed keys. Both sides pad
+    to ONE shared length so the two policy forwards share a jit shape, and
+    the shared-prompt mask survives the shift — prompt positions stay
+    IGNORE_INDEX in both ``chosen_labels`` and ``rejected_labels``."""
+    examples = list(examples)
+    seq = max(
+        len(e[k])
+        for e in examples
+        for k in ("chosen_input_ids", "rejected_input_ids")
+    )
+    if max_seq_len is not None:
+        seq = min(seq, max_seq_len)
+    if pad_seq_len_divisible:
+        seq = _round_up(seq, pad_seq_len_divisible)
+    out: dict[str, Any] = {}
+    for side in ("chosen", "rejected"):
+        sub = default_collater(
+            [
+                {
+                    "input_ids": e[f"{side}_input_ids"],
+                    "labels": e[f"{side}_labels"],
+                }
+                for e in examples
+            ],
+            pad_token_id=pad_token_id,
+            # force both sides up to the common length
+            max_seq_len=seq,
+            pad_seq_len_divisible=seq,
+        )
+        for k, v in sub.items():
+            if k != "num_label_tokens":
+                out[f"{side}_{k}"] = v
+    out["num_label_tokens"] = int(
+        (out["chosen_labels"] != IGNORE_INDEX).sum()
+        + (out["rejected_labels"] != IGNORE_INDEX).sum()
+    )
+    return out
+
+
 def seq_cls_collater(
     examples: Iterable[dict[str, Any]],
     pad_token_id: int = 0,

@@ -30,6 +30,15 @@ BATCH_KEY_SPECS: dict[str, tuple] = {
     "labels": ("batch", "seq"),
     "position_ids": ("batch", "seq"),
     "segment_ids": ("batch", "seq"),
+    # preference pairs (data/chat.py tokenize_preference_pair) and GRPO
+    # rollout batches (posttrain/grpo.py) carry prefixed [B, S] leaves
+    **{
+        f"{side}_{key}": ("batch", "seq")
+        for side in ("chosen", "rejected")
+        for key in ("input_ids", "labels", "position_ids")
+    },
+    "behavior_logprobs": ("batch", "seq"),
+    "ref_logprobs": ("batch", "seq"),
 }
 
 

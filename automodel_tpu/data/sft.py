@@ -186,3 +186,83 @@ class MockSeqClsDataset:
         n = int(rng.integers(self.seq_length // 2, self.seq_length + 1))
         ids = rng.integers(1, self.vocab_size, size=n)
         return {"input_ids": ids, "label": int(ids.sum() % self.num_labels)}
+
+
+class MockPreferenceDataset:
+    """Deterministic preference pairs for posttrain tests/examples — no
+    tokenizer, no network. The pair carries a REAL learnable signal:
+    both sides share the prompt, the chosen response is drawn from the
+    lower vocab half and the rejected from the upper, so a DPO margin
+    that rises is evidence of actual preference learning, not noise.
+
+    Emits the keys `data/collators.preference_collater` consumes
+    (UNSHIFTED labels, IGNORE_INDEX over the shared prompt — the collator
+    applies the next-token shift)."""
+
+    def __init__(
+        self,
+        vocab_size: int = 64,
+        prompt_length: int = 8,
+        response_length: int = 8,
+        num_samples: int = 256,
+        seed: int = 0,
+    ):
+        if vocab_size < 8:
+            raise ValueError(f"vocab_size={vocab_size} too small to split")
+        self.vocab_size = vocab_size
+        self.prompt_length = prompt_length
+        self.response_length = response_length
+        self.num_samples = num_samples
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        rng = np.random.default_rng(self.seed * 100003 + idx)
+        half = self.vocab_size // 2
+        prompt = rng.integers(3, self.vocab_size, size=self.prompt_length)
+        chosen = rng.integers(3, half, size=self.response_length)
+        rejected = rng.integers(half, self.vocab_size, size=self.response_length)
+        out = {}
+        for side, resp in (("chosen", chosen), ("rejected", rejected)):
+            ids = np.concatenate([prompt, resp])
+            labels = np.concatenate(
+                [np.full(self.prompt_length, IGNORE_INDEX, dtype=np.int64), resp]
+            )
+            out[f"{side}_input_ids"] = ids.tolist()
+            out[f"{side}_labels"] = labels.tolist()
+        return out
+
+    def __iter__(self) -> Iterator[dict]:
+        for i in range(len(self)):
+            yield self[i]
+
+
+class MockPromptDataset:
+    """Deterministic prompt-only dataset for GRPO rollouts: plain
+    `input_ids` examples (the recipe generates the completions)."""
+
+    def __init__(
+        self,
+        vocab_size: int = 64,
+        prompt_length: int = 8,
+        num_samples: int = 256,
+        seed: int = 0,
+    ):
+        self.vocab_size = vocab_size
+        self.prompt_length = prompt_length
+        self.num_samples = num_samples
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __getitem__(self, idx: int) -> dict:
+        rng = np.random.default_rng(self.seed * 100003 + idx)
+        ids = rng.integers(3, self.vocab_size, size=self.prompt_length)
+        return {"input_ids": ids.tolist()}
+
+    def __iter__(self) -> Iterator[dict]:
+        for i in range(len(self)):
+            yield self[i]

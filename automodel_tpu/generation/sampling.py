@@ -89,6 +89,25 @@ def sample(
     ).astype(jnp.int32)
 
 
+def sample_with_logprobs(
+    logits: jnp.ndarray, key: jax.Array, config: SamplingConfig
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """logits [B, V] → (token ids [B] int32, logprobs [B] float32).
+
+    The logprob is ``log_softmax`` of the RAW (unfiltered, untempered)
+    logits gathered at the sampled id — i.e. log π(a|s) under the model's
+    full distribution, which is what importance ratios (GRPO/PPO) need and
+    what a full-forward recompute reproduces exactly. Filtering/temperature
+    shape WHICH token is drawn (identical stream to ``sample`` for the same
+    key), not the reported probability."""
+    logits = logits.astype(jnp.float32)
+    ids = sample(logits, key, config)
+    logp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), ids[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    return ids, logp
+
+
 def speculative_verify(
     target_logits: jnp.ndarray,
     draft_logits: Optional[jnp.ndarray],

@@ -1,0 +1,141 @@
+"""Strict config sections for the post-training subsystem.
+
+Same unknown-key discipline as the serving sections (engine._cfg_dict):
+a typo'd key raises TypeError at construction, and the example-YAML
+walker (tests/test_examples_yaml.py) pins that behavior for the
+``posttrain:`` / ``rollout:`` / ``reward:`` sections.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+_ALGOS = ("dpo", "orpo", "grpo")
+
+
+def _strict(cls, d: Optional[dict], section: str):
+    d = dict(d or {})
+    d.pop("_target_", None)
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(d) - known
+    if unknown:
+        raise TypeError(f"unknown {section} keys: {sorted(unknown)}")
+    return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class PosttrainConfig:
+    """The ``posttrain:`` YAML section — algorithm + objective knobs."""
+
+    algo: str = "dpo"  # dpo | orpo | grpo
+    # DPO/ORPO: preference-margin scale (β); ORPO: odds-ratio penalty weight
+    beta: float = 0.1
+    # DPO: mass given to the flipped pair (conservative labels)
+    label_smoothing: float = 0.0
+    # GRPO: PPO-style ratio clip half-width
+    clip_eps: float = 0.2
+    # GRPO: weight of the KL-to-reference penalty
+    kl_coef: float = 0.05
+    # GRPO: hot-swap the rollout engine onto the current policy every N
+    # optimizer steps (1 = fully on-policy)
+    sync_weights_every_steps: int = 1
+
+    def __post_init__(self):
+        if self.algo not in _ALGOS:
+            raise ValueError(
+                f"posttrain.algo={self.algo!r} (want one of {_ALGOS})"
+            )
+        if self.beta <= 0:
+            raise ValueError(f"posttrain.beta={self.beta} must be > 0")
+        if not (0.0 <= self.label_smoothing < 0.5):
+            raise ValueError(
+                f"posttrain.label_smoothing={self.label_smoothing} "
+                "(want 0 <= ls < 0.5)"
+            )
+        if self.clip_eps <= 0:
+            raise ValueError(f"posttrain.clip_eps={self.clip_eps}")
+        if self.kl_coef < 0:
+            raise ValueError(f"posttrain.kl_coef={self.kl_coef}")
+        if self.sync_weights_every_steps < 1:
+            raise ValueError(
+                "posttrain.sync_weights_every_steps="
+                f"{self.sync_weights_every_steps} must be >= 1"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "PosttrainConfig":
+        return _strict(cls, d, "posttrain")
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutConfig:
+    """The ``rollout:`` YAML section — how GRPO generates completions.
+
+    ``engine: in_process`` builds a ``ServingEngine`` inside the trainer
+    process over (a hot-swapped copy of) the current policy; ``engine:
+    fleet`` POSTs to a running fleet router (``router_url``) whose replicas
+    are kept current by the router's rolling update."""
+
+    group_size: int = 4  # G completions per prompt
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    engine: str = "in_process"  # in_process | fleet
+    router_url: Optional[str] = None
+    timeout_s: float = 120.0  # per-request budget on the fleet path
+    # overrides for the in-process ServingEngine's serving section
+    # (slots/block_size/num_blocks/...), validated by ServeConfig itself
+    serving: Optional[dict] = None
+
+    def __post_init__(self):
+        if self.group_size < 2:
+            # a 1-completion group has zero-variance advantages — the
+            # group-relative baseline needs at least a pair
+            raise ValueError(
+                f"rollout.group_size={self.group_size} must be >= 2"
+            )
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"rollout.max_new_tokens={self.max_new_tokens}"
+            )
+        if self.engine not in ("in_process", "fleet"):
+            raise ValueError(
+                f"rollout.engine={self.engine!r} (want in_process|fleet)"
+            )
+        if self.engine == "fleet" and not self.router_url:
+            raise ValueError(
+                "rollout.engine=fleet requires rollout.router_url"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "RolloutConfig":
+        return _strict(cls, d, "rollout")
+
+
+@dataclasses.dataclass(frozen=True)
+class RewardConfig:
+    """The ``reward:`` YAML section — a pluggable reward function.
+
+    ``fn`` is a bare name resolved against ``posttrain.rewards`` or a
+    dotted import path; the callable receives
+    ``(prompt_ids, completion_ids, **kwargs)`` and returns a float."""
+
+    fn: str = "target_token_frequency"
+    kwargs: Any = None  # dict of keyword arguments bound onto fn
+
+    def __post_init__(self):
+        if not self.fn:
+            raise ValueError("reward.fn must name a reward function")
+        if self.kwargs is not None and not isinstance(self.kwargs, dict):
+            raise ValueError(
+                f"reward.kwargs must be a mapping, got {type(self.kwargs).__name__}"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "RewardConfig":
+        d = dict(d or {})
+        if "kwargs" in d and d["kwargs"] is not None:
+            d["kwargs"] = dict(d["kwargs"])
+        return _strict(cls, d, "reward")

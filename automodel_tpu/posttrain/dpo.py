@@ -1,0 +1,193 @@
+"""Preference optimization (DPO / ORPO) recipe.
+
+A thin subclass of the finetune recipe: the preference loss replaces the
+CE loss at the ``_make_train_step`` seam, the preference collator replaces
+the default collator at the ``_build_dataloader`` seam, and EVERYTHING
+else — checkpointing, telemetry, anomaly flags, non-finite policy, goodput
+ledger, prefetch pipeline — is inherited unchanged.
+
+DPO (Rafailov et al. 2023): the frozen reference policy is a COPY of the
+initial params passed to the jitted step as the ``bound`` argument (the
+LoRA-base pattern — a closure over a device tree would bake it into every
+lowering as a constant), so one forward per side per policy:
+
+    margin = β·((logπ_c − logπref_c) − (logπ_r − logπref_r))
+    loss   = −[(1−ls)·logσ(margin) + ls·logσ(−margin)]
+
+ORPO (Hong et al. 2024): reference-free — CE on the chosen response plus a
+β-weighted odds-ratio penalty over length-normalized likelihoods; no bound
+tree, half the memory.
+
+The loss returns n = PAIR count (not tokens): build_train_step's global
+normalization then turns the summed pair losses into the mean per-pair
+loss, exactly as it turns summed token losses into mean token loss.
+
+YAML over train_ft: the dataset yields preference examples
+(chosen_/rejected_ input_ids+labels — data/chat.py PreferenceDataset, or
+any dataset emitting those keys), plus:
+
+  posttrain: {algo: dpo|orpo, beta: 0.1, label_smoothing: 0.0}
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.data.collators import IGNORE_INDEX, preference_collater
+from automodel_tpu.data.loader import DataLoader
+from automodel_tpu.posttrain.config import PosttrainConfig
+from automodel_tpu.recipes.train_ft import (
+    TrainFinetuneRecipeForNextTokenPrediction,
+)
+from automodel_tpu.training.train_step import build_eval_step
+
+logger = logging.getLogger(__name__)
+
+
+def sequence_logprobs(model, params, mb, side, constrain):
+    """Per-side forward → (summed response logprob [B], token count [B]).
+
+    Labels follow the collator convention (already shifted, IGNORE_INDEX
+    off-response), so the label mask IS the response mask."""
+    ids = mb[f"{side}_input_ids"]
+    labels = mb[f"{side}_labels"]
+    kw = {}
+    pos = mb.get(f"{side}_position_ids")
+    if pos is not None:
+        kw["position_ids"] = pos
+    out = model(params, ids, constrain=constrain, **kw)
+    logits = out[0] if isinstance(out, tuple) else out
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    mask = labels != IGNORE_INDEX
+    safe = jnp.where(mask, labels, 0)
+    tok_lp = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    tok_lp = jnp.where(mask, tok_lp, 0.0)
+    return tok_lp.sum(axis=-1), mask.sum(axis=-1)
+
+
+def _log1mexp(x):
+    """log(1 − eˣ) for x < 0, stable near 0 (clamped: a response with
+    mean logprob ≈ 0 would otherwise produce −inf odds)."""
+    x = jnp.minimum(x, -1e-6)
+    return jnp.log(-jnp.expm1(x))
+
+
+def make_preference_loss(model, constrain, algo, beta, label_smoothing):
+    """Build the (params, mb[, ref]) → (loss_sum, n_pairs, extras) loss.
+
+    extras carry pair-summed auxiliaries; ``metric_extras`` (consumed
+    in-jit by build_train_step) renormalizes them by the PAIR count — the
+    same denominator the loss uses — into ``dpo_loss`` and
+    ``accept_margin`` (docs/observability.md)."""
+    ls = float(label_smoothing)
+
+    def dpo_loss(params, mb, ref):
+        pi_c, _ = sequence_logprobs(model, params, mb, "chosen", constrain)
+        pi_r, _ = sequence_logprobs(model, params, mb, "rejected", constrain)
+        ref_c, _ = sequence_logprobs(model, ref, mb, "chosen", constrain)
+        ref_r, _ = sequence_logprobs(model, ref, mb, "rejected", constrain)
+        margin = beta * ((pi_c - ref_c) - (pi_r - ref_r))
+        pair_loss = -(
+            (1.0 - ls) * jax.nn.log_sigmoid(margin)
+            + ls * jax.nn.log_sigmoid(-margin)
+        )
+        n = jnp.int32(margin.shape[0])
+        extras = {
+            "dpo_loss_sum": pair_loss.sum(),
+            "margin_sum": margin.sum(),
+            "pairs": jnp.float32(margin.shape[0]),
+        }
+        return pair_loss.sum(), n, extras
+
+    def orpo_loss(params, mb):
+        pi_c, n_c = sequence_logprobs(model, params, mb, "chosen", constrain)
+        pi_r, n_r = sequence_logprobs(model, params, mb, "rejected", constrain)
+        # length-normalized (mean per-token) logprobs → odds ratio
+        mean_c = pi_c / jnp.maximum(n_c, 1)
+        mean_r = pi_r / jnp.maximum(n_r, 1)
+        odds_c = mean_c - _log1mexp(mean_c)
+        odds_r = mean_r - _log1mexp(mean_r)
+        margin = odds_c - odds_r
+        # NLL on the chosen response (per-token mean keeps the two terms on
+        # comparable scales regardless of response length) + OR penalty
+        pair_loss = -mean_c - beta * jax.nn.log_sigmoid(margin)
+        n = jnp.int32(margin.shape[0])
+        extras = {
+            "dpo_loss_sum": pair_loss.sum(),
+            "margin_sum": margin.sum(),
+            "pairs": jnp.float32(margin.shape[0]),
+        }
+        return pair_loss.sum(), n, extras
+
+    loss_fn = dpo_loss if algo == "dpo" else orpo_loss
+
+    def metric_extras(extras_sum, denom):
+        pairs = jnp.maximum(extras_sum["pairs"], 1.0)
+        return {
+            "dpo_loss": extras_sum["dpo_loss_sum"] / pairs,
+            "accept_margin": extras_sum["margin_sum"] / pairs,
+        }
+
+    loss_fn.metric_extras = metric_extras
+    return loss_fn
+
+
+class TrainPreferenceRecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    """DPO/ORPO over preference-pair batches."""
+
+    def setup(self) -> None:
+        super().setup()
+        cfg = self.cfg
+        self.pt_cfg = PosttrainConfig.from_dict(dict(cfg.get("posttrain") or {}))
+        if self.pt_cfg.algo not in ("dpo", "orpo"):
+            raise ValueError(
+                f"posttrain.algo={self.pt_cfg.algo!r}: this recipe runs "
+                "dpo|orpo (grpo has its own recipe — `automodel grpo`)"
+            )
+        if self.peft_config is not None:
+            raise ValueError(
+                "posttrain + peft is not supported yet: the DPO reference "
+                "tree and the LoRA base tree would both ride the single "
+                "`bound` argument of the jitted step"
+            )
+        self.loss_fn = make_preference_loss(
+            self.model,
+            self.auto.constrain,
+            self.pt_cfg.algo,
+            self.pt_cfg.beta,
+            self.pt_cfg.label_smoothing,
+        )
+        if self.pt_cfg.algo == "dpo":
+            # frozen reference = the pre-posttraining policy. A DEEP copy:
+            # build_train_step donates state.params, and at step 1 those
+            # are the very buffers self.auto.params still points at — an
+            # aliased reference tree would be invalidated by the first
+            # optimizer step.
+            self.loss_fn.bound_params = jax.tree.map(
+                jnp.copy, self.auto.params
+            )
+        self.train_step = self._make_train_step(self.loss_fn)
+        self.eval_step = build_eval_step(self.loss_fn)
+        logger.info(
+            "%s: beta=%.3f label_smoothing=%.2f",
+            self.pt_cfg.algo.upper(), self.pt_cfg.beta,
+            self.pt_cfg.label_smoothing,
+        )
+
+    def _build_dataloader(self, dataset_cfg, dl_cfg) -> DataLoader:
+        loader = super()._build_dataloader(dataset_cfg, dl_cfg)
+        # pair collation (chosen_/rejected_ keys, one shared pad length so
+        # the two per-side forwards share a jit shape); called from
+        # super().setup(), so the override is live from the first batch
+        loader.collate_fn = preference_collater
+        return loader
+
+
+def main(cfg: ConfigNode) -> dict:
+    recipe = TrainPreferenceRecipe(cfg)
+    recipe.setup()
+    return recipe.run_train_validation_loop()

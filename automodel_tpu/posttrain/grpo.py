@@ -1,0 +1,445 @@
+"""GRPO recipe: the serving stack as the rollout generator.
+
+Each optimizer step is a closed loop (Shao et al. 2024, DeepSeekMath):
+
+  1. hot-swap the CURRENT policy into the rollout engine
+     (``engine.swap_weights`` — the same live-swap primitive the fleet's
+     rolling update uses), every ``posttrain.sync_weights_every_steps``
+  2. sample ``rollout.group_size`` completions per prompt from a
+     ``ServingEngine`` (in-process) or a fleet router (``rollout.engine:
+     fleet``), with per-token behavior logprobs (``return_logprobs``)
+  3. score completions with the pluggable ``reward:`` fn, normalize
+     group-relative: adv = (r − mean_group) / (std_group + ε)
+  4. one PPO-style clipped update with a k3 KL penalty to the FROZEN
+     initial policy, through the inherited ``_make_train_step`` seam —
+     anomaly flags, the non-finite policy, and checkpointing all apply
+     to the RL update exactly as they do to supervised steps.
+
+``train_step`` here is a HOST wrapper around the inner jitted step: the
+base loop keeps driving batches (of prompts), telemetry, and resilience
+unchanged; the wrapper turns each prompt batch into a rollout batch.
+Rollout and reward wall time are first-class goodput segments
+(``rollout``/``reward``, telemetry/goodput.py) and the rollout phase is a
+trace span whose children are the engine's per-request spans.
+
+Behavior logprobs are log π under the model's RAW distribution
+(generation/sampling.py sample_with_logprobs), so at sync steps the
+importance ratios start at exactly 1 and the update is on-policy.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.data.collators import IGNORE_INDEX, _round_up
+from automodel_tpu.posttrain.config import (
+    PosttrainConfig,
+    RewardConfig,
+    RolloutConfig,
+)
+from automodel_tpu.posttrain.rewards import resolve_reward_fn
+from automodel_tpu.recipes.train_ft import (
+    TrainFinetuneRecipeForNextTokenPrediction,
+)
+from automodel_tpu.telemetry.tracing import Tracer, TracingConfig
+
+logger = logging.getLogger(__name__)
+
+# rollout batches pad the time axis up to this multiple: one XLA program
+# per bucket instead of one per (prompt+completion) length
+_SEQ_BUCKET = 16
+
+
+def make_grpo_loss(model, constrain, clip_eps, kl_coef):
+    """(params, mb) → (loss_sum, n_completion_tokens, extras).
+
+    mb carries input_ids/labels/position_ids [B, S] (labels = next-token
+    ids on completion positions, IGNORE_INDEX elsewhere), behavior_ and
+    ref_logprobs [B, S] aligned with labels, advantages [B]. n = completion
+    token count, so build_train_step's global normalization yields the
+    mean per-token objective."""
+    eps = float(clip_eps)
+    beta = float(kl_coef)
+
+    def loss_fn(params, mb):
+        ids, labels = mb["input_ids"], mb["labels"]
+        out = model(
+            params, ids, constrain=constrain, position_ids=mb["position_ids"]
+        )
+        logits = out[0] if isinstance(out, tuple) else out
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        mask = labels != IGNORE_INDEX
+        safe = jnp.where(mask, labels, 0)
+        pi_lp = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        ratio = jnp.exp(pi_lp - mb["behavior_logprobs"])
+        adv = mb["advantages"][:, None].astype(jnp.float32)
+        clipped = jnp.clip(ratio, 1.0 - eps, 1.0 + eps)
+        obj = jnp.minimum(ratio * adv, clipped * adv)
+        # k3 estimator (Schulman): unbiased, guaranteed non-negative —
+        # exp(Δ) − Δ − 1 with Δ = ref − π
+        d = mb["ref_logprobs"] - pi_lp
+        kl = jnp.exp(d) - d - 1.0
+        loss_tok = -(obj - beta * kl)
+        loss_sum = jnp.where(mask, loss_tok, 0.0).sum()
+        n = mask.sum().astype(jnp.int32)
+        extras = {"kl_sum": jnp.where(mask, kl, 0.0).sum()}
+        return loss_sum, n, extras
+
+    # in-jit (build_train_step): mean per-token KL over the SAME global
+    # token denominator as the loss
+    loss_fn.metric_extras = lambda ex, denom: {
+        "kl_to_ref": ex["kl_sum"] / denom
+    }
+    return loss_fn
+
+
+def _build_ref_logprob_fn(model, constrain):
+    """Jitted (ref_params, ids, pos, labels) → per-token ref logprobs
+    [B, S] (0 off-mask). The frozen tree is a REAL argument, not a
+    closure — a captured device tree would be baked into the lowering."""
+
+    @jax.jit
+    def ref_lp(ref_params, ids, pos, labels):
+        out = model(ref_params, ids, constrain=constrain, position_ids=pos)
+        logits = out[0] if isinstance(out, tuple) else out
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        mask = labels != IGNORE_INDEX
+        safe = jnp.where(mask, labels, 0)
+        tok = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.where(mask, tok, 0.0)
+
+    return ref_lp
+
+
+def _post_json(url: str, payload: dict, timeout_s: float) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        return json.loads(r.read().decode())
+
+
+def _get_json(url: str, timeout_s: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return json.loads(r.read().decode())
+
+
+class GRPORecipe(TrainFinetuneRecipeForNextTokenPrediction):
+    """The dataset yields PROMPTS (plain ``input_ids`` examples); the
+    wrapper turns each prompt batch into a G-way rollout batch."""
+
+    def setup(self) -> None:
+        super().setup()
+        cfg = self.cfg
+        self.pt_cfg = PosttrainConfig.from_dict(dict(cfg.get("posttrain") or {}))
+        if self.pt_cfg.algo != "grpo":
+            raise ValueError(
+                f"posttrain.algo={self.pt_cfg.algo!r}: this recipe runs "
+                "grpo (dpo/orpo have their own recipe — `automodel dpo`)"
+            )
+        if self.peft_config is not None:
+            raise ValueError("posttrain + peft is not supported yet")
+        self.rollout_cfg = RolloutConfig.from_dict(dict(cfg.get("rollout") or {}))
+        self.reward_fn = resolve_reward_fn(
+            RewardConfig.from_dict(dict(cfg.get("reward") or {}))
+        )
+
+        # frozen KL reference = the pre-RL policy. Deep copy: the inner
+        # step donates state.params, which at step 1 ARE these buffers.
+        self._ref_params = jax.tree.map(jnp.copy, self.auto.params)
+        self.loss_fn = make_grpo_loss(
+            self.model, self.auto.constrain,
+            self.pt_cfg.clip_eps, self.pt_cfg.kl_coef,
+        )
+        self._inner_step = self._make_train_step(self.loss_fn)
+        self._ref_lp_fn = _build_ref_logprob_fn(self.model, self.auto.constrain)
+        # the loop drives THIS; it runs rollout+reward on the host, then
+        # the inner jitted update (a bound method carries no `.trace`, so
+        # cost attribution skips itself automatically)
+        self.train_step = self._grpo_step
+        self._opt_steps = 0
+
+        # rollout-phase spans (+ the engine's per-request child spans) go
+        # to the metrics JSONL like every other span in the system
+        self.tracer = Tracer.from_config(
+            TracingConfig.from_dict(dict(cfg.get("tracing") or {})),
+            f"grpo-{os.getpid()}",
+            lambda rec: self.metric_logger.log(rec),
+        )
+        if self.rollout_cfg.engine == "in_process":
+            self._setup_in_process_engine()
+        else:
+            self._setup_fleet()
+        logger.info(
+            "GRPO: G=%d max_new_tokens=%d clip_eps=%.2f kl_coef=%.3f "
+            "engine=%s sync_every=%d",
+            self.rollout_cfg.group_size, self.rollout_cfg.max_new_tokens,
+            self.pt_cfg.clip_eps, self.pt_cfg.kl_coef,
+            self.rollout_cfg.engine, self.pt_cfg.sync_weights_every_steps,
+        )
+
+    # -- rollout backends ---------------------------------------------------
+    def _setup_in_process_engine(self) -> None:
+        from automodel_tpu.generation.engine import GenerationConfig
+        from automodel_tpu.serving.engine import ServeConfig, ServingEngine
+
+        rcfg = self.rollout_cfg
+        # a SEPARATE AutoModel view with COPIED params: swap_weights
+        # rebinds rollout_auto.params (must not touch the trainer's auto),
+        # and the copies mean a donated trainer buffer can never be the
+        # engine's serving tree
+        rollout_auto = copy.copy(self.auto)
+        rollout_auto.params = jax.tree.map(jnp.copy, self.auto.params)
+        serve_cfg = ServeConfig.from_dict(dict(rcfg.serving or {}))
+        gen_cfg = GenerationConfig(
+            max_new_tokens=rcfg.max_new_tokens,
+            temperature=rcfg.temperature,
+            top_k=rcfg.top_k,
+            top_p=rcfg.top_p,
+            seed=self.cfg.get("seed", 42),
+        )
+        self._engine = ServingEngine(
+            rollout_auto, serve_cfg, gen_cfg, tracer=self.tracer
+        )
+
+    def _setup_fleet(self) -> None:
+        """Fleet mode: completions come from a running router; weight sync
+        is the router's ROLLING UPDATE, with this trainer process as the
+        AKV1 ``weights_fetch`` peer (the replicas pull the new tree from
+        us, leaf-streamed)."""
+        from automodel_tpu.serving.fleet.kv_transfer import KVTransferServer
+
+        self._live_params = jax.tree.map(jnp.copy, self.auto.params)
+        # geometry is validated only for KV handoff frames; a weights-only
+        # listener never receives one
+        self._kv_server = KVTransferServer(
+            {
+                "layers": 1, "block_size": 1, "num_kv_heads": 1,
+                "head_dim": 1, "kv_cache_dtype": "bf16",
+            },
+            weights_handler=self._serve_weights,
+        ).start()
+        logger.info(
+            "GRPO fleet mode: weights peer on port %d, router %s",
+            self._kv_server.port, self.rollout_cfg.router_url,
+        )
+
+    def _serve_weights(self):
+        from automodel_tpu.checkpoint.checkpointer import param_tree_signature
+        from automodel_tpu.serving.engine import _tree_path_name
+
+        params = self._live_params  # GIL-atomic snapshot, never mutated
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        return param_tree_signature(params), [
+            (_tree_path_name(path), leaf) for path, leaf in leaves
+        ]
+
+    def _sync_weights(self, state) -> None:
+        """Push the CURRENT policy into the rollout backend. Copies first:
+        swap_weights/device_put on an already-placed tree aliases it, and
+        the next optimizer step donates these exact buffers."""
+        snapshot = jax.tree.map(jnp.copy, state.params)
+        if self.rollout_cfg.engine == "in_process":
+            self._engine.swap_weights(snapshot)
+            return
+        self._live_params = snapshot
+        url = self.rollout_cfg.router_url.rstrip("/")
+        _post_json(
+            url + "/rolling_update",
+            {
+                "peer": {"host": "127.0.0.1", "port": self._kv_server.port},
+                "timeout_s": self.rollout_cfg.timeout_s,
+            },
+            timeout_s=self.rollout_cfg.timeout_s,
+        )
+        # the update runs on a router background thread; rollouts must not
+        # start until the fleet converges (on-policy sampling is the point)
+        deadline = time.monotonic() + self.rollout_cfg.timeout_s
+        while time.monotonic() < deadline:
+            st = _get_json(url + "/stats", timeout_s=5.0)
+            ru = st.get("rolling_update")
+            if ru is not None and not ru.get("active"):
+                if ru.get("failed"):
+                    raise RuntimeError(
+                        f"rolling update left replicas on OLD weights: "
+                        f"{ru['failed']} — refusing off-policy rollouts"
+                    )
+                return
+            time.sleep(0.05)
+        raise RuntimeError(
+            "fleet rolling update did not converge within "
+            f"{self.rollout_cfg.timeout_s}s"
+        )
+
+    def _rollout(self, prompts: list, trace_ctx) -> list:
+        """prompts → ``groups[b][g] = {"tokens", "logprobs"}``."""
+        G = self.rollout_cfg.group_size
+        if self.rollout_cfg.engine == "fleet":
+            url = self.rollout_cfg.router_url.rstrip("/") + "/generate"
+
+            def one(p):
+                resp = _post_json(
+                    url,
+                    {
+                        "prompt_ids": [int(t) for t in p],
+                        "max_new_tokens": self.rollout_cfg.max_new_tokens,
+                        "return_logprobs": True,
+                    },
+                    timeout_s=self.rollout_cfg.timeout_s,
+                )
+                if "tokens" not in resp:
+                    raise RuntimeError(f"fleet rollout failed: {resp}")
+                return {
+                    "tokens": [int(t) for t in resp["tokens"]],
+                    "logprobs": [float(x) for x in resp.get("logprobs") or []],
+                }
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                flat = list(pool.map(one, [p for p in prompts for _ in range(G)]))
+            return [flat[b * G : (b + 1) * G] for b in range(len(prompts))]
+
+        eng = self._engine
+        rid_of: dict[str, tuple] = {}
+        for b, p in enumerate(prompts):
+            for g in range(G):
+                rid = eng.submit(
+                    [int(t) for t in p],
+                    max_new_tokens=self.rollout_cfg.max_new_tokens,
+                    return_logprobs=True,
+                    trace=trace_ctx,
+                )
+                rid_of[rid] = (b, g)
+        groups = [[None] * G for _ in prompts]
+        while not eng.idle():
+            for rec in eng.step():
+                b, g = rid_of[rec["request_id"]]
+                if rec.get("completion_reason") not in ("stop", "length"):
+                    raise RuntimeError(
+                        f"rollout request {rec['request_id']} failed: "
+                        f"{rec.get('completion_reason')}"
+                    )
+                groups[b][g] = {
+                    "tokens": [int(t) for t in rec["tokens"]],
+                    "logprobs": [float(x) for x in rec.get("logprobs") or []],
+                }
+        return groups
+
+    # -- the step -----------------------------------------------------------
+    def _grpo_step(self, state, batch):
+        rcfg, G = self.rollout_cfg, self.rollout_cfg.group_size
+        step_no = self.step_scheduler.step
+        # prompt rows out of the placed [A, B, S] batch (A folds to its
+        # first microbatch — rollout batching replaces grad accumulation)
+        ids = np.asarray(jax.device_get(batch["input_ids"]))[0]
+        pos = np.asarray(jax.device_get(batch["position_ids"]))[0]
+        lens = pos.max(axis=-1).astype(np.int64) + 1
+        prompts = [ids[b, : lens[b]].tolist() for b in range(ids.shape[0])]
+
+        if self._opt_steps % self.pt_cfg.sync_weights_every_steps == 0:
+            self._sync_weights(state)
+
+        t0 = time.perf_counter()
+        span = (
+            self.tracer.span(
+                None, "rollout", step=step_no,
+                prompts=len(prompts), group_size=G,
+            )
+            if self.tracer is not None
+            else None
+        )
+        with self.ledger.segment("rollout", step=step_no):
+            if span is not None:
+                with span as ctx:
+                    groups = self._rollout(prompts, ctx)
+            else:
+                groups = self._rollout(prompts, None)
+        rollout_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        with self.ledger.segment("reward", step=step_no):
+            rewards = np.asarray(
+                [
+                    [self.reward_fn(p, c["tokens"]) for c in grp]
+                    for p, grp in zip(prompts, groups)
+                ],
+                dtype=np.float32,
+            )  # [B, G]
+        reward_s = time.perf_counter() - t0
+
+        # group-relative advantages: each prompt's G completions are their
+        # own baseline — no value network
+        adv = (rewards - rewards.mean(axis=1, keepdims=True)) / (
+            rewards.std(axis=1, keepdims=True) + 1e-6
+        )
+
+        stacked = self._build_rollout_batch(prompts, groups, adv.reshape(-1))
+        state, metrics = self._inner_step(state, self._place_group(stacked))
+        metrics = dict(metrics)
+        metrics["reward_mean"] = float(rewards.mean())
+        metrics["rollout_s"] = round(rollout_s, 6)
+        metrics["reward_s"] = round(reward_s, 6)
+        self._opt_steps += 1
+        return state, metrics
+
+    def _build_rollout_batch(self, prompts, groups, advantages) -> dict:
+        """Flattened [B·G] rollouts → the [1, B·G, S] arrays the inner step
+        consumes. Labels are the completion tokens under the shifted
+        convention (labels[t] = ids[t+1] when t+1 is generated), and
+        behavior_logprobs sit at the SAME positions — the logprob the
+        engine reported for generated token i aligns with label position
+        prompt_len + i − 1."""
+        flat = [
+            (p, c["tokens"], c["logprobs"])
+            for p, grp in zip(prompts, groups)
+            for c in grp
+        ]
+        B = len(flat)
+        S = _round_up(
+            max(len(p) + len(t) for p, t, _ in flat), _SEQ_BUCKET
+        )
+        input_ids = np.zeros((B, S), np.int32)
+        labels = np.full((B, S), IGNORE_INDEX, np.int32)
+        position_ids = np.zeros((B, S), np.int32)
+        behavior = np.zeros((B, S), np.float32)
+        for r, (p, toks, lps) in enumerate(flat):
+            L, total = len(p), len(p) + len(toks)
+            input_ids[r, :total] = np.asarray(list(p) + list(toks), np.int32)
+            position_ids[r, :total] = np.arange(total)
+            labels[r, L - 1 : total - 1] = input_ids[r, L:total]
+            behavior[r, L - 1 : total - 1] = np.asarray(
+                lps[: len(toks)], np.float32
+            )
+        ref = np.asarray(
+            jax.device_get(
+                self._ref_lp_fn(self._ref_params, input_ids, position_ids, labels)
+            ),
+            np.float32,
+        )
+        return {
+            "input_ids": input_ids[None],
+            "labels": labels[None],
+            "position_ids": position_ids[None],
+            "behavior_logprobs": behavior[None],
+            "ref_logprobs": ref[None],
+            "advantages": np.asarray(advantages, np.float32)[None],
+        }
+
+
+def main(cfg: ConfigNode) -> dict:
+    recipe = GRPORecipe(cfg)
+    recipe.setup()
+    return recipe.run_train_validation_loop()

@@ -447,6 +447,9 @@ class _Queued:
     # request tracing: this request's ROOT span context on this process
     # (child of the router's forward span when one propagated in)
     trace: Optional[SpanContext] = None
+    # behavior-policy logprob capture (posttrain/grpo.py): record the
+    # sampled sequence's per-token logprobs on the terminal record
+    return_logprobs: bool = False
 
 
 @dataclasses.dataclass
@@ -467,6 +470,19 @@ class _Slot:
     spec_proposed: int = 0  # draft tokens proposed for this request
     spec_accepted: int = 0  # draft tokens accepted by the verify rule
     trace: Optional[SpanContext] = None
+    # parallel to ``generated`` when the request asked for logprobs: the
+    # behavior policy's own log π(token) at each sampled position
+    logprobs: Optional[list[float]] = None
+
+
+def _tree_path_name(path) -> str:
+    """The param-tree leaf naming rule — MUST match
+    ``checkpoint.checkpointer.param_tree_signature`` exactly, so signature
+    entries and hot-swapped/wire-transferred leaves line up one-to-one
+    (server._warm_start_params applies the same rule)."""
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    )
 
 
 class ServingEngine:
@@ -586,9 +602,12 @@ class ServingEngine:
         self._chunk = paged.build_chunk_prefill_fn(
             apply, self.config.prefill_chunk, self._compute_dtype
         )
+        # the decode program always computes the sampled token's logprob
+        # beside the token (one extra gather off logits already in hand);
+        # whether it lands on the record is per-request (return_logprobs)
         self._decode = paged.build_paged_decode_fn(
             apply, self.gen_config.sampling,
-            pad_id=self.gen_config.pad_token_id, **pk,
+            pad_id=self.gen_config.pad_token_id, with_logprobs=True, **pk,
         )
         if self._spec_enabled:
             d_model = self.draft_auto.model
@@ -626,6 +645,11 @@ class ServingEngine:
         self._queue: deque[_Queued] = deque()
         self._ids = itertools.count()
         self._step_counter = 0
+        # live weight hot-swap (swap_weights): monotonic version tag
+        # advertised on /stats + /metrics, and the validated replacement
+        # tree staged until a step boundary with zero busy slots
+        self.weights_version = 0
+        self._pending_swap: Optional[Any] = None
         self.completed_total = 0  # stop/length completions
         self.failed_total = 0  # timeout/cancelled/stall/error terminations
         self.shed_total = 0
@@ -887,6 +911,95 @@ class ServingEngine:
             )
         return done
 
+    # -- live weight hot-swap (docs/posttrain.md) -----------------------------
+    def swap_weights(self, params: Any) -> int:
+        """Stage a full replacement of the policy weights without a restart.
+
+        The incoming tree is validated against the CURRENT tree's
+        param-tree signature (path/shape/dtype set — the same guard
+        warm-start and checkpoint restore use) before a single leaf is
+        touched; a mismatch raises ``ValueError`` loudly with the old
+        params bit-intact. A valid tree is device_put to the live leaves'
+        shardings and staged; the scheduler applies it at a step boundary
+        with ZERO busy slots, so every in-flight request finishes under
+        the weights it started with, and new admissions hold (the queue
+        keeps absorbing — nothing drops) until the swap lands. If no
+        request is in flight the swap applies immediately. → the
+        ``weights_version`` the engine advertises once the swap is live.
+
+        Same shapes/dtypes means the already-compiled prefill/decode
+        programs are reused as-is — a swap never recompiles."""
+        from automodel_tpu.checkpoint.checkpointer import param_tree_signature
+
+        cur_sig = param_tree_signature(self.auto.params)
+        new_sig = param_tree_signature(params)
+        if new_sig["digest"] != cur_sig["digest"]:
+            cur_e, new_e = set(cur_sig["entries"]), set(new_sig["entries"])
+            detail = (
+                f"current digest {cur_sig['digest']} != incoming "
+                f"{new_sig['digest']}; missing {sorted(cur_e - new_e)[:4]}, "
+                f"unexpected {sorted(new_e - cur_e)[:4]}"
+            )
+            self._emit_event({
+                "event": "weight_swap", "ok": False,
+                "weights_version": self.weights_version,
+                "detail": detail, "ts": self._wall_ts(),
+            })
+            raise ValueError(
+                f"swap_weights refused: param tree signature mismatch "
+                f"({detail}) — serving weights unchanged"
+            )
+        incoming = {
+            _tree_path_name(path): leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+        }
+        cur_leaves, treedef = jax.tree_util.tree_flatten_with_path(
+            self.auto.params
+        )
+        staged = jax.tree_util.tree_unflatten(
+            treedef,
+            [
+                jax.device_put(incoming[_tree_path_name(path)], leaf.sharding)
+                for path, leaf in cur_leaves
+            ],
+        )
+        self._pending_swap = staged
+        target = self.weights_version + 1
+        if self.busy_slots == 0:
+            self._apply_pending_swap()
+        return target
+
+    def _apply_pending_swap(self) -> None:
+        """Flip the staged tree in (scheduler thread / caller under the
+        serving lock): one attribute assignment — the next tick's fresh
+        ``self.auto.params`` read picks it up."""
+        if self._pending_swap is None:
+            return
+        self.auto.params = self._pending_swap
+        self._pending_swap = None
+        self.weights_version += 1
+        # every cached prefix (and its host-spilled copies) holds K/V
+        # computed under the OLD policy — serving it to a request running
+        # the new weights would silently mix two policies in one sequence
+        self.pool.clear_prefix_cache()
+        logger.info(
+            "weights hot-swapped: now serving weights_version=%d",
+            self.weights_version,
+        )
+        self._emit_event({
+            "event": "weight_swap", "ok": True,
+            "weights_version": self.weights_version, "ts": self._wall_ts(),
+        })
+
+    def _emit_event(self, rec: dict) -> None:
+        """on_record for non-request events (no completion_reason, so the
+        per-request metrics observers are wrong for these)."""
+        if self.on_record is not None:
+            try:
+                self.on_record(dict(rec))
+            except Exception:  # telemetry must never break serving
+                pass
+
     # -- submission -----------------------------------------------------------
     def submit(
         self,
@@ -899,11 +1012,21 @@ class ServingEngine:
         prefill_only: bool = False,
         trace: Optional[SpanContext] = None,
         kv_peer: Optional[dict] = None,
+        return_logprobs: bool = False,
         _payload: Optional[dict] = None,
     ) -> str:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("empty prompt (every request needs >= 1 token)")
+        if return_logprobs and self._spec_enabled:
+            # speculative commits draft+correction tokens whose per-token
+            # behavior logprobs are not the target's sampling logprobs —
+            # refuse rather than report numbers a ratio can't trust
+            raise GenerationUnsupported(
+                "return_logprobs is not supported on a speculative engine: "
+                "committed tokens mix draft proposals and verify "
+                "corrections, so no single behavior-policy logprob exists"
+            )
         max_new = (
             self.gen_config.max_new_tokens
             if max_new_tokens is None
@@ -952,6 +1075,7 @@ class ServingEngine:
             queue_deadline_at=now + qw if qw and qw > 0 else None,
             prefill_only=prefill_only, payload=_payload, trace=root,
             kv_peer=kv_peer if kv_peer else None,
+            return_logprobs=return_logprobs,
         )
         if self.draining:
             # no terminal record here (mirror of the shed seam): the
@@ -1415,6 +1539,8 @@ class ServingEngine:
                 (len(gen) - 1) / decode_s if decode_s > 0 and len(gen) > 1
                 else 0.0
             )
+        if slot.logprobs is not None:
+            rec["logprobs"] = [round(lp, 6) for lp in slot.logprobs]
         if self._spec_enabled and slot.spec_proposed:
             rec["spec_proposed"] = slot.spec_proposed
             rec["spec_accepted"] = slot.spec_accepted
@@ -1571,6 +1697,7 @@ class ServingEngine:
             prefill_pos=hit_tokens, t_submit=q.t_submit,
             t_admit=time.perf_counter(), deadline_at=q.deadline_at,
             prefill_only=q.prefill_only, trace=q.trace,
+            logprobs=[] if q.return_logprobs else None,
         )
 
     def _bind_injected_slot(
@@ -1678,6 +1805,12 @@ class ServingEngine:
                     self.gen_config.sampling,
                 )[0]
             )
+            if slot.logprobs is not None:
+                # same raw-logits rule as the decode program (the chunk
+                # already handed `last` to the host, so this is free)
+                slot.logprobs.append(
+                    float(jax.nn.log_softmax(last.astype(jnp.float32))[first])
+                )
             self.pool.register_prefix(slot.prompt, slot.blocks)
             slot.t_first = time.perf_counter()
             slot.generated = [first]
@@ -1729,13 +1862,14 @@ class ServingEngine:
                 jnp.asarray(self._cur), jnp.asarray(self._active),
                 self._base_key, jnp.int32(self._step_counter),
             )
-        tokens, self._pool = self._decode(
+        tokens, logps, self._pool = self._decode(
             params, self._pool,
             jnp.asarray(self._tables), jnp.asarray(self._lengths),
             jnp.asarray(self._cur), jnp.asarray(self._active),
             self._base_key, jnp.int32(self._step_counter),
         )
         tokens = np.asarray(jax.device_get(tokens))
+        logps = np.asarray(jax.device_get(logps))
         self.first_decode_done = True
         done: list[dict] = []
         for b, slot in enumerate(self._slots):
@@ -1743,6 +1877,8 @@ class ServingEngine:
                 continue
             tok = int(tokens[b])
             slot.generated.append(tok)
+            if slot.logprobs is not None:
+                slot.logprobs.append(float(logps[b]))
             self._lengths[b] += 1
             self._cur[b] = tok
             if tok in self._eos:
@@ -1942,7 +2078,11 @@ class ServingEngine:
                                 )
                             )
             else:
-                self._admit(done)
+                if self._pending_swap is None:
+                    # a staged weight swap holds admissions (the queue keeps
+                    # absorbing) so no request starts under weights that are
+                    # about to be replaced mid-generation
+                    self._admit(done)
             done += self._prefill_tick()
             done += self._decode_tick()
             rebuilt = False
@@ -1978,6 +2118,12 @@ class ServingEngine:
             )
         if not rebuilt:
             self._consecutive_rebuilds = 0
+        if self._pending_swap is not None and self.busy_slots == 0:
+            # the step that terminated the last in-flight request is the
+            # swap boundary: everything before this line ran (and finished)
+            # under the old weights, everything admitted after runs under
+            # the new — in-flight outputs are bit-untouched by the swap
+            self._apply_pending_swap()
         self._step_counter += 1
         self.last_step_t = time.monotonic()
         if self.draining:

@@ -196,6 +196,10 @@ class _Replica:
     kv_port: Optional[int] = None
     block_size_ok: bool = True
     last_probe_t: Optional[float] = None
+    # rolling weight update: traffic is shifted off an updating replica
+    # (excluded from placement) while its weights swap — the drain
+    # primitive that keeps in-flight requests alive through the update
+    updating: bool = False
     # probe-backoff state: failures since the last success, and (once past
     # fleet.probe_backoff_after) the monotonic time the next probe is due
     consecutive_failures: int = 0
@@ -452,6 +456,9 @@ class Router:
         self.handoffs_total = 0
         self.peer_hints_total = 0  # forwarded kv_peer prefix-fetch hints
         self._warned_block_size: set[str] = set()
+        # rolling weight update progress, surfaced on /stats while (and
+        # after) an update runs so version skew is observable fleet-wide
+        self._rolling: Optional[dict] = None
         # elastic fleet: the hysteresis state machine rides the probe
         # sweep; the backend (local subprocesses or kubectl scale) is how
         # decisions become replicas (serving/fleet/autoscale.py)
@@ -838,11 +845,13 @@ class Router:
         if pool == "prefill":
             return [
                 r for r in reps
-                if r.ready and r.role == "prefill" and r.name not in exclude
+                if r.ready and r.role == "prefill"
+                and not r.updating and r.name not in exclude
             ]
         return [
             r for r in reps
-            if r.ready and r.decode_capable() and r.name not in exclude
+            if r.ready and r.decode_capable()
+            and not r.updating and r.name not in exclude
         ]
 
     def _match_blocks(self, rep: _Replica, chains: Sequence[int]) -> int:
@@ -1284,6 +1293,125 @@ class Router:
         }
 
     # -- fronts ---------------------------------------------------------------
+    # -- rolling weight update (docs/posttrain.md) -----------------------------
+    def rolling_update(
+        self,
+        peer: dict,
+        timeout_s: float = 120.0,
+        drain_timeout_s: float = 60.0,
+    ) -> dict:
+        """Fleet-wide weight hot-swap with zero dropped requests: one
+        decode-capable replica at a time — shift traffic off it (the
+        ``updating`` placement exclusion; retries re-place in-flight
+        resubmissions onto siblings), wait for its slots and queue to
+        empty, POST its /swap_weights at ``peer`` (the trainer's AKV1
+        ``weights_fetch`` listener), confirm the version bump, re-admit.
+        Progress lands in ``stats()["rolling_update"]`` and one
+        ``rolling_update`` record per phase rides on_record, so the
+        per-replica version skew window is observable while it closes.
+
+        → summary dict {updated: [name], failed: [name], weights_version}.
+        A replica that fails to drain or swap is re-admitted on its OLD
+        weights and reported — a stalled update degrades loudly, never
+        into dropped traffic."""
+        with self._lock:
+            targets = [
+                r for r in self._replicas.values()
+                if r.ready and r.decode_capable()
+            ]
+        self._rolling = {
+            "active": True, "total": len(targets), "done": 0,
+            "current": None, "updated": [], "failed": [],
+        }
+        self._emit({
+            "event": "rolling_update", "phase": "start",
+            "replicas": len(targets), "ts": self._wall_ts(),
+        })
+        probe_t = self.config.probe_timeout_s
+        version: Optional[int] = None
+        for rep in targets:
+            t_rep0 = time.perf_counter()
+            self._rolling["current"] = rep.name
+            with self._lock:
+                rep.updating = True
+            err = None
+            try:
+                # traffic is off; wait for the replica to run dry (its own
+                # queue keeps absorbing nothing new, in-flight finish)
+                deadline = time.perf_counter() + drain_timeout_s
+                while True:
+                    _, st = _http_json(
+                        rep.url + "/stats", None, timeout_s=probe_t
+                    )
+                    if (
+                        not (st.get("busy_slots") or 0)
+                        and not (st.get("queue_depth") or 0)
+                    ):
+                        break
+                    if time.perf_counter() >= deadline:
+                        raise TimeoutError(
+                            f"{rep.name} still busy after {drain_timeout_s}s "
+                            "traffic shift-off"
+                        )
+                    time.sleep(0.05)
+                code, body = _http_json(
+                    rep.url + "/swap_weights",
+                    {"peer": dict(peer), "timeout_s": timeout_s},
+                    timeout_s=timeout_s + probe_t,
+                )
+                if code != 200 or not body.get("ok"):
+                    raise RuntimeError(
+                        f"swap_weights on {rep.name} answered {code}: "
+                        f"{body.get('error')}"
+                    )
+                version = int(body["weights_version"])
+                _, st = _http_json(
+                    rep.url + "/stats", None, timeout_s=probe_t
+                )
+                with self._lock:
+                    rep.stats = st
+            except (ReplicaUnreachable, RuntimeError, TimeoutError,
+                    ValueError, KeyError) as e:
+                err = f"{type(e).__name__}: {e}"
+            finally:
+                with self._lock:
+                    rep.updating = False
+            self._rolling["done"] += 1
+            self._rolling["current"] = None
+            if err is None:
+                self._rolling["updated"].append(rep.name)
+            else:
+                self._rolling["failed"].append(rep.name)
+                logger.error(
+                    "rolling update: %s failed (%s) — re-admitted on its "
+                    "old weights", rep.name, err,
+                )
+            rec = {
+                "event": "rolling_update", "phase": "replica",
+                "replica": rep.name, "ok": err is None,
+                "duration_s": round(time.perf_counter() - t_rep0, 6),
+                "ts": self._wall_ts(),
+            }
+            if err is None:
+                rec["weights_version"] = version
+            else:
+                rec["detail"] = err
+            self._emit(rec)
+        self._rolling["active"] = False
+        if version is not None:
+            self._rolling["weights_version"] = version
+        self._emit({
+            "event": "rolling_update", "phase": "done",
+            "updated": len(self._rolling["updated"]),
+            "failed": len(self._rolling["failed"]),
+            "weights_version": version, "ts": self._wall_ts(),
+        })
+        return {
+            "updated": list(self._rolling["updated"]),
+            "failed": list(self._rolling["failed"]),
+            "weights_version": version,
+        }
+
     def begin_drain(self) -> None:
         self.draining = True
 
@@ -1312,6 +1440,10 @@ class Router:
                     # fleet-status columns (serving/fleet/status.py)
                     "spec_accept_rate": r.stats.get("spec_accept_rate"),
                     "prefix_hit_rate": _prefix_hit_rate(r.stats),
+                    # rolling update: per-replica weights generation — the
+                    # version skew window is these values disagreeing
+                    "weights_version": r.stats.get("weights_version"),
+                    "updating": r.updating,
                 }
                 for r in self._replicas.values()
             }
@@ -1328,6 +1460,8 @@ class Router:
                 "disaggregated": self._disaggregate_active_unlocked(),
                 "draining": self.draining,
             }
+            if self._rolling is not None:
+                out["rolling_update"] = dict(self._rolling)
         out["federation"] = self.federation.status()
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
@@ -1480,6 +1614,43 @@ def serve_router_http(
             return self._json(200, router.stats())
 
         def do_POST(self):
+            if self.path == "/rolling_update":
+                # fleet-wide weight hot-swap: ``{"peer": {"host", "port"},
+                # "timeout_s": s, "drain_timeout_s": s}``. Responds 200
+                # IMMEDIATELY and runs the sequential update on a
+                # background thread (mirror of a replica's /retire) — the
+                # caller polls /stats rolling_update for progress.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(req, dict):
+                        raise ValueError("request body is not a JSON object")
+                except (ValueError, TypeError) as e:
+                    return self._json(400, {"error": str(e)})
+                peer = req.get("peer")
+                if not (
+                    isinstance(peer, dict)
+                    and peer.get("host")
+                    and peer.get("port") is not None
+                ):
+                    return self._json(400, {
+                        "error": "rolling_update needs peer.{host, port}"
+                    })
+                if router._rolling is not None and router._rolling.get("active"):
+                    return self._json(409, {
+                        "error": "a rolling update is already in progress",
+                        "rolling_update": dict(router._rolling),
+                    })
+                kw = {}
+                if req.get("timeout_s") is not None:
+                    kw["timeout_s"] = float(req["timeout_s"])
+                if req.get("drain_timeout_s") is not None:
+                    kw["drain_timeout_s"] = float(req["drain_timeout_s"])
+                threading.Thread(
+                    target=router.rolling_update, args=(peer,), kwargs=kw,
+                    name="router-rolling-update", daemon=True,
+                ).start()
+                return self._json(200, {"ok": True, "started": True})
             if self.path != "/generate":
                 return self._json(404, {"error": f"unknown path {self.path}"})
             try:

@@ -33,7 +33,7 @@ from automodel_tpu.serving.fleet.router import (
 
 _COLUMNS = (
     "REPLICA", "ROLE", "READY", "QUEUE", "BUSY", "OCC", "HIT%", "ACC%",
-    "ALERTS",
+    "WVER", "ALERTS",
 )
 
 
@@ -66,6 +66,7 @@ def _direct_snapshot(fcfg: FleetConfig, timeout_s: float) -> dict:
             "queue_depth": None, "busy_slots": None,
             "block_occupancy": None, "prefix_hit_rate": None,
             "spec_accept_rate": None, "shed_total": None,
+            "weights_version": None,
         }
         try:
             code, _ = _http_json(spec.url + "/readyz", None, timeout_s)
@@ -80,6 +81,7 @@ def _direct_snapshot(fcfg: FleetConfig, timeout_s: float) -> dict:
                 "shed_total": stats.get("shed_total"),
                 "prefix_hit_rate": _prefix_hit_rate(stats),
                 "spec_accept_rate": stats.get("spec_accept_rate"),
+                "weights_version": stats.get("weights_version"),
             })
         except ReplicaUnreachable:
             pass
@@ -119,6 +121,10 @@ def render_table(stats: dict) -> str:
             _fmt_occ(r.get("block_occupancy")),
             _fmt_pct(r.get("prefix_hit_rate")),
             _fmt_pct(r.get("spec_accept_rate")),
+            # a stalled rolling update is visible here: versions disagree,
+            # the mid-swap replica shows a trailing "*"
+            _fmt_num(r.get("weights_version"))
+            + ("*" if r.get("updating") else ""),
             alerts,
         ])
     widths = [max(len(row[i]) for row in rows) for i in range(len(_COLUMNS))]
@@ -143,6 +149,17 @@ def render_table(stats: dict) -> str:
     total = len(stats.get("replicas") or {})
     lines.append("")
     lines.append(f"{ready}/{total} replicas ready")
+    ru = stats.get("rolling_update")
+    if ru:
+        lines.append(
+            f"rolling update: {'ACTIVE' if ru.get('active') else 'done'} "
+            f"{ru.get('done', 0)}/{ru.get('total', 0)}"
+            + (f", updating {ru['current']}" if ru.get("current") else "")
+            + (
+                f", failed: {','.join(ru['failed'])}"
+                if ru.get("failed") else ""
+            )
+        )
     asc = stats.get("autoscale")
     if asc:
         # elastic fleet footer: what the controller wants vs has, and the

@@ -59,6 +59,7 @@ from automodel_tpu.generation import kv_cache
 from automodel_tpu.generation.sampling import (
     SamplingConfig,
     sample,
+    sample_with_logprobs,
     speculative_verify,
 )
 from automodel_tpu.ops.paged_attention import dequantize_kv, quantize_kv_rows
@@ -473,9 +474,14 @@ def build_paged_decode_fn(
     block_size: int = 16,
     compute_dtype=jnp.bfloat16,
     interpret: bool = False,
+    with_logprobs: bool = False,
 ) -> Callable:
     """→ jitted ``step(params, pool, tables [B, NBseq], lengths [B], cur
-    [B], active [B] bool, key, step_idx)`` → ``(next_tokens [B], pool)``.
+    [B], active [B] bool, key, step_idx)`` → ``(next_tokens [B], pool)``,
+    or ``(next_tokens [B], logprobs [B] fp32, pool)`` when
+    ``with_logprobs`` — the sampled token's log-probability under the RAW
+    distribution (see ``sample_with_logprobs``), masked to 0.0 on
+    inactive slots.
 
     One continuous-batching decode step: every ACTIVE slot advances one
     token (its K/V written at ``(table[len // BS], len % BS)``); inactive
@@ -489,7 +495,13 @@ def build_paged_decode_fn(
         logits, pool = forward(
             params, pool, tables, lengths, cur[:, None], active
         )
-        nxt = sample(logits[:, -1], jax.random.fold_in(key, step_idx), sampling)
+        skey = jax.random.fold_in(key, step_idx)
+        if with_logprobs:
+            nxt, logp = sample_with_logprobs(logits[:, -1], skey, sampling)
+            nxt = jnp.where(active, nxt, jnp.int32(pad_id))
+            logp = jnp.where(active, logp, jnp.float32(0.0))
+            return nxt, logp, pool
+        nxt = sample(logits[:, -1], skey, sampling)
         nxt = jnp.where(active, nxt, jnp.int32(pad_id))
         return nxt, pool
 

@@ -146,6 +146,10 @@ STATS_METRIC_EQUIV = {
     # first readiness; boot_source is an info string)
     "time_to_ready_s": "automodel_serve_time_to_ready_seconds",
     "boot_source": None,
+    # live hot-swap (engine.swap_weights): monotonic weights generation —
+    # the router reads per-replica version skew off this during a rolling
+    # update
+    "weights_version": "automodel_serve_weights_version",
 }
 
 # Families deliberately absent from /stats: per-request distributions have
@@ -206,6 +210,8 @@ def stats_snapshot(engine: Any) -> dict:
         # startup→first-readiness took (the warm-vs-cold A/B number)
         "time_to_ready_s": engine.time_to_ready_s,
         "boot_source": engine.boot_source,
+        # live hot-swap: which weights generation this replica serves
+        "weights_version": engine.weights_version,
     }
 
 
@@ -280,6 +286,7 @@ class _EngineLoop:
                         max_queue_wait_s=req.get("max_queue_wait_s"),
                         trace=trace,
                         kv_peer=kvp if isinstance(kvp, dict) else None,
+                        return_logprobs=bool(req.get("return_logprobs")),
                     )
             except QueueFull:
                 # the HTTP front sheds immediately — a blocked handler
@@ -577,9 +584,83 @@ def serve_http(
                 "ttft_s": rec.get("ttft_s"),
             })
 
+        def _swap_weights(self):
+            """Live weight hot-swap: ``{"peer": {"host", "port"},
+            "timeout_s": s}``. Fetches the replacement tree over the AKV1
+            ``weights_fetch`` op from the peer (the post-training trainer
+            runs the listener), validates it against the param-tree
+            signature under the engine lock, stages the swap, then waits
+            for it to land — in-flight requests finish under the old
+            weights first. A signature mismatch answers 409 with the old
+            params untouched."""
+            from automodel_tpu.serving.fleet.kv_transfer import (
+                KVTransferError,
+                fetch_weights,
+            )
+
+            try:
+                req = self._read_req()
+            except (ValueError, TypeError) as e:
+                return self._json(400, {"error": str(e)})
+            peer = req.get("peer")
+            if not (
+                isinstance(peer, dict)
+                and peer.get("host")
+                and peer.get("port") is not None
+            ):
+                return self._json(400, {
+                    "error": "swap_weights needs peer.{host, port}"
+                })
+            timeout_s = float(req.get("timeout_s", 120.0))
+            t0 = time.perf_counter()
+            try:
+                _, arrays = fetch_weights(
+                    (str(peer["host"]), int(peer["port"])),
+                    timeout_s=timeout_s,
+                )
+            except (KVTransferError, OSError) as e:
+                return self._json(502, {"ok": False, "error": str(e)})
+            # the flat {leaf-name: array} dict IS a valid pytree whose
+            # signature matches the nested tree (dict keys are the joined
+            # path names) — swap_weights rebinds leaves by name anyway
+            try:
+                with loop.lock:
+                    target = engine.swap_weights(arrays)
+            except ValueError as e:
+                return self._json(409, {
+                    "ok": False, "error": str(e),
+                    "weights_version": engine.weights_version,
+                })
+            # the staged swap applies at the scheduler's next idle step
+            # boundary; weights_version is a GIL-atomic int, so this poll
+            # is deliberately lock-free (mirror of /healthz)
+            deadline = t0 + timeout_s
+            while (
+                engine.weights_version < target
+                and time.perf_counter() < deadline
+                and loop.alive()
+            ):
+                time.sleep(0.01)
+            if engine.weights_version < target:
+                return self._json(504, {
+                    "ok": False, "staged": True,
+                    "error": (
+                        f"swap staged but in-flight requests did not clear "
+                        f"within {timeout_s}s"
+                    ),
+                    "weights_version": engine.weights_version,
+                })
+            return self._json(200, {
+                "ok": True,
+                "weights_version": engine.weights_version,
+                "swap_s": round(time.perf_counter() - t0, 6),
+            })
+
         def do_POST(self):
             if self.path == "/prefill":
                 return self._prefill()
+            if self.path == "/swap_weights":
+                return self._swap_weights()
             if self.path == "/retire":
                 # elastic fleet scale-down: ``{"migrate": {"host", "port"}
                 # | null, "deadline_s": s}``. Responds 200 IMMEDIATELY and
@@ -888,9 +969,11 @@ def main(cfg: Any) -> int:
         logger.info("KV-transfer listener on port %d", kv_server.port)
 
         # warm-start source for joining replicas: serve this replica's
-        # param tree over ``op: weights_fetch``. Params are read-only once
-        # serving starts, so no scheduler lock is needed — the listener
-        # thread streams one host copy of one leaf at a time.
+        # param tree over ``op: weights_fetch``. A hot-swap can replace the
+        # whole tree mid-serve, but never mutates leaves in place — one
+        # GIL-atomic snapshot of the attribute up front keeps the streamed
+        # signature and leaves from one consistent generation, so no
+        # scheduler lock is needed.
         def _serve_weights():
             import jax
 
@@ -898,10 +981,9 @@ def main(cfg: Any) -> int:
                 param_tree_signature,
             )
 
-            sig = param_tree_signature(engine.auto.params)
-            leaves = jax.tree_util.tree_flatten_with_path(
-                engine.auto.params
-            )[0]
+            params = engine.auto.params
+            sig = param_tree_signature(params)
+            leaves = jax.tree_util.tree_flatten_with_path(params)[0]
             return sig, [
                 (_tree_path_name(path), leaf) for path, leaf in leaves
             ]
@@ -1158,6 +1240,7 @@ def _serve_stdin(engine, tokenizer, serve_cfg) -> int:
                         deadline_s=req.get("deadline_s"),
                         max_queue_wait_s=req.get("max_queue_wait_s"),
                         trace=ctx,
+                        return_logprobs=bool(req.get("return_logprobs")),
                     )
                     break
                 except QueueFull:

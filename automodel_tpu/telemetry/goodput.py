@@ -64,6 +64,9 @@ logger = logging.getLogger(__name__)
 #                      an `on_nonfinite: rollback` restored an older ckpt
 #   preemption_lost  — step time reclassified as lost: steps past the
 #                      checkpoint the NEXT attempt actually resumed from
+#   rollout          — post-training (posttrain/grpo.py): serving-engine
+#                      completion generation between optimizer steps
+#   reward           — post-training: scoring rollouts with the reward fn
 # plus the rollup-only residual `unattributed` (wall not covered by any
 # segment — hang time, scheduler jitter; the CLI joins flight-recorder
 # hang/desync events to name it).
@@ -79,6 +82,8 @@ SEGMENT_KINDS = (
     "generation",
     "rollback_discard",
     "preemption_lost",
+    "rollout",
+    "reward",
 )
 
 # reclassifying kinds move seconds out of this source bucket at rollup

@@ -530,6 +530,14 @@ class ServingMetrics:
             "Wall time from process start to first /readyz true "
             "(0 until ready; boot source rides /stats boot_source)",
         )
+        # live weight hot-swap (engine.swap_weights): the weights
+        # generation this replica serves — per-replica version skew during
+        # a rolling update is this gauge federated across the fleet
+        self.weights_version = r.gauge(
+            "automodel_serve_weights_version",
+            "Monotonic weights generation currently being served "
+            "(bumps on each applied hot-swap)",
+        )
         self._pool_counters = {
             key: r.counter(f"automodel_serve_block_{key}", help_text)
             for key, help_text in (
@@ -618,6 +626,9 @@ class ServingMetrics:
             self.kv_injected.set_total(getattr(engine, "kv_injected_total", 0))
             self.time_to_ready.set(
                 float(getattr(engine, "time_to_ready_s", None) or 0.0)
+            )
+            self.weights_version.set(
+                float(getattr(engine, "weights_version", 0))
             )
             proposed = getattr(engine, "spec_proposed_total", 0)
             accepted = getattr(engine, "spec_accepted_total", 0)

@@ -159,6 +159,16 @@ _NUMERIC_KEYS = (
     "migrated_blocks",
     "hot_blocks",
     "retire_s",
+    # post-training (posttrain/): DPO/ORPO preference metrics beside loss,
+    # GRPO reward/KL metrics, the per-window rollout/reward wall stamps,
+    # and the weights generation on weight_swap / rolling_update events
+    "dpo_loss",
+    "accept_margin",
+    "reward_mean",
+    "kl_to_ref",
+    "rollout_s",
+    "reward_s",
+    "weights_version",
 )
 
 # keys that are wall-time durations and can never legitimately be negative:
@@ -182,6 +192,8 @@ _DURATION_KEYS = (
     "slo_firing_s",
     "time_to_ready_s",
     "retire_s",
+    "rollout_s",
+    "reward_s",
 )
 
 # the slo_alert state machine's legal states (telemetry/slo.py) — anything

@@ -233,6 +233,13 @@ def build_train_step(
                 pinfo.get("schedule", "gpipe"), pinfo.get("zb_queue"),
                 pinfo.get("w_deferred_fraction", 1.0),
             )
+        # a loss_fn may derive its own scalar metrics from the summed extras
+        # (posttrain/: dpo_loss, accept_margin, kl_to_ref) — the callable
+        # runs in-jit over the microbatch-summed tree, so token-weighted
+        # means normalize by the SAME global denominator as the loss
+        metric_extras = getattr(loss_fn, "metric_extras", None)
+        if metric_extras is not None:
+            metrics.update(metric_extras(extras_sum, denom))
         if "expert_counts" in extras_sum:
             c = extras_sum["expert_counts"].astype(jnp.float32)  # [L, E]
             per_layer = c.max(axis=-1) / jnp.maximum(c.mean(axis=-1), 1.0)

@@ -272,6 +272,31 @@ def test_example_yaml_parses_and_dry_instantiates(path):
 
         PrefetchConfig.from_data_section(data)
 
+    # posttrain: / rollout: / reward: → the post-training subsystem's
+    # strict sections (posttrain/config.py); the reward fn must RESOLVE
+    # (a dangling dotted path in an example is exactly the drift class
+    # this test exists for), and a rollout.serving sub-section must be a
+    # valid ServeConfig for the in-process engine
+    pt = _section(cfg, "posttrain")
+    if pt is not None:
+        from automodel_tpu.posttrain.config import PosttrainConfig
+
+        PosttrainConfig.from_dict(pt)
+    ro = _section(cfg, "rollout")
+    if ro is not None:
+        from automodel_tpu.posttrain.config import RolloutConfig
+        from automodel_tpu.serving.engine import ServeConfig
+
+        rc = RolloutConfig.from_dict(ro)
+        if rc.serving is not None:
+            ServeConfig.from_dict(dict(rc.serving))
+    rw = _section(cfg, "reward")
+    if rw is not None:
+        from automodel_tpu.posttrain.config import RewardConfig
+        from automodel_tpu.posttrain.rewards import resolve_reward_fn
+
+        assert callable(resolve_reward_fn(RewardConfig.from_dict(rw)))
+
     # dataset/dataloader/logging are validated lightly: dataset needs a
     # _target_ to instantiate (network-bound targets are not constructed)
     ds = cfg.get("dataset")
@@ -384,3 +409,46 @@ def test_config_dataclasses_reject_unknown_keys():
     # the data: section is shared (mine_hard_negatives keeps its datasets
     # there) — foreign keys without a prefetch: entry mean "no prefetch"
     assert PrefetchConfig.from_data_section({"queries": {}}).enabled is False
+
+
+def test_posttrain_sections_reject_unknown_keys():
+    """The posttrain subsystem's sections follow the same strict-key
+    discipline as the serving sections — a typo fails at load, not on a
+    pod mid-run."""
+    from automodel_tpu.posttrain.config import (
+        PosttrainConfig,
+        RewardConfig,
+        RolloutConfig,
+    )
+
+    with pytest.raises(TypeError, match="unknown posttrain keys"):
+        PosttrainConfig.from_dict({"algo": "dpo", "betaa": 0.1})
+    with pytest.raises(ValueError):
+        PosttrainConfig.from_dict({"algo": "ppo"})
+    with pytest.raises(ValueError):
+        PosttrainConfig.from_dict({"label_smoothing": 0.7})
+    with pytest.raises(ValueError):
+        PosttrainConfig.from_dict({"sync_weights_every_steps": 0})
+    with pytest.raises(TypeError, match="unknown rollout keys"):
+        RolloutConfig.from_dict({"group_sizee": 4})
+    with pytest.raises(ValueError):  # 1-completion groups can't baseline
+        RolloutConfig.from_dict({"group_size": 1})
+    with pytest.raises(ValueError):  # fleet needs a router address
+        RolloutConfig.from_dict({"engine": "fleet"})
+    with pytest.raises(TypeError, match="unknown reward keys"):
+        RewardConfig.from_dict({"fnn": "target_token_frequency"})
+    with pytest.raises(ValueError):
+        RewardConfig.from_dict({"fn": ""})
+
+    from automodel_tpu.posttrain.rewards import resolve_reward_fn
+
+    with pytest.raises(ValueError, match="not a built-in reward"):
+        resolve_reward_fn(RewardConfig.from_dict({"fn": "no_such_reward"}))
+    with pytest.raises(ValueError, match="failed to import"):
+        resolve_reward_fn(RewardConfig.from_dict({"fn": "no.such.module.fn"}))
+    fn = resolve_reward_fn(
+        RewardConfig.from_dict(
+            {"fn": "target_token_frequency", "kwargs": {"token_id": 7}}
+        )
+    )
+    assert fn([1, 2], [7, 7, 3, 4]) == 0.5

@@ -1,0 +1,643 @@
+"""Post-training subsystem (posttrain/): preference pair tokenization +
+collation, the DPO/ORPO recipe learning on mock pairs, GRPO learning a toy
+reward from REAL in-process ServingEngine rollouts (with per-step weight
+hot-swap, rollout/reward goodput segments and trace spans), engine
+per-token logprob parity vs a full-forward recompute, live swap_weights
+semantics (in-flight isolation, zero drops, signature guard), the
+trainer-as-weights-peer AKV1 fetch path, and fleet-status WVER rendering.
+All CPU tier-1 except the slow-marked fleet rolling-update chaos e2e."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from automodel_tpu.auto_model import AutoModel
+from automodel_tpu.config.loader import ConfigNode
+from automodel_tpu.data.collators import IGNORE_INDEX, preference_collater
+from automodel_tpu.generation.engine import GenerationConfig
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.serving.engine import ServeConfig, ServingEngine, StallConfig
+
+FP32 = BackendConfig(attn="sdpa", param_dtype="float32", compute_dtype="float32")
+
+TINY = {
+    "architectures": ["LlamaForCausalLM"],
+    "model_type": "llama",
+    "vocab_size": 64,
+    "hidden_size": 32,
+    "intermediate_size": 64,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 8,
+    "max_position_embeddings": 128,
+}
+FP32_D = {"attn": "sdpa", "param_dtype": "float32", "compute_dtype": "float32"}
+
+
+def _tiny_auto(seed=0):
+    from automodel_tpu.models.llama import LlamaForCausalLM
+
+    model = LlamaForCausalLM(
+        TransformerConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+            num_heads=4, num_kv_heads=2, head_dim=8,
+        ),
+        FP32,
+    )
+    return AutoModel(
+        model=model, params=model.init(jax.random.key(seed)),
+        adapter=None, mesh_ctx=None,
+    )
+
+
+def _engine(auto=None, **over):
+    over.setdefault("watchdog", StallConfig(enabled=False))
+    gen = over.pop("gen", None) or GenerationConfig(max_new_tokens=8, greedy=True)
+    return ServingEngine(
+        auto or _tiny_auto(),
+        ServeConfig(
+            slots=2, block_size=4, num_blocks=32, prefill_chunk=4,
+            max_seq_len=48, **over,
+        ),
+        gen,
+    )
+
+
+def _drain(eng):
+    out = []
+    while not eng.idle():
+        out.extend(eng.step())
+    return out
+
+
+def _run_to_completion(eng, prompt, **kw):
+    rid = eng.submit(list(prompt), **kw)
+    recs = [r for r in _drain(eng) if r["request_id"] == rid]
+    assert len(recs) == 1 and recs[0]["completion_reason"] in ("stop", "length")
+    return recs[0]
+
+
+# ---------------------------------------------------------------------------
+# preference pair tokenization + collation (data/chat.py, data/collators.py)
+# ---------------------------------------------------------------------------
+
+
+def test_preference_pair_shared_prompt_mask():
+    from tests.test_chat_data import FakeTokenizer
+
+    from automodel_tpu.data.chat import tokenize_preference_pair
+
+    tok = FakeTokenizer()
+    out = tokenize_preference_pair(
+        tok, "compare these", "good answer here", "bad one"
+    )
+    prompt_len = len(tok.apply_chat_template(
+        [{"role": "user", "content": "compare these"}]
+    ))
+    for side in ("chosen", "rejected"):
+        ids = np.asarray(out[f"{side}_input_ids"])
+        labels = np.asarray(out[f"{side}_labels"])
+        assert len(ids) == len(labels) and len(ids) > prompt_len
+        # SHARED prompt prefix: both sides start with the identical
+        # template tokens, and that prefix is IGNORE on both sides
+        assert (labels[:prompt_len] == IGNORE_INDEX).all()
+        assert (labels[prompt_len:] == ids[prompt_len:]).all()
+        np.testing.assert_array_equal(
+            ids[:prompt_len],
+            np.asarray(out["chosen_input_ids"])[:prompt_len],
+        )
+    # HH-style columns: the response may arrive as a full conversation
+    # list — the last (assistant) message is the scored response
+    hh = tokenize_preference_pair(
+        tok, "q",
+        [{"role": "user", "content": "q"}, {"role": "assistant", "content": "yes"}],
+        {"role": "assistant", "content": "no"},
+    )
+    assert hh["chosen_input_ids"] != hh["rejected_input_ids"]
+
+
+def test_preference_collater_shared_shape_and_shift():
+    from tests.test_chat_data import FakeTokenizer
+
+    from automodel_tpu.data.chat import tokenize_preference_pair
+
+    tok = FakeTokenizer()
+    ex = [
+        tokenize_preference_pair(tok, "a b c", "one two three four", "x"),
+        tokenize_preference_pair(tok, "d", "short", "much longer rejected side"),
+    ]
+    batch = preference_collater(ex, pad_token_id=0)
+    c_ids, c_lab = batch["chosen_input_ids"], batch["chosen_labels"]
+    r_ids, r_lab = batch["rejected_input_ids"], batch["rejected_labels"]
+    # both sides pad to ONE shared length: the two policy forwards in the
+    # DPO loss share a single jit shape
+    assert c_ids.shape == r_ids.shape == c_lab.shape == r_lab.shape
+    for i, e in enumerate(ex):
+        for ids, lab, side in ((c_ids, c_lab, "chosen"), (r_ids, r_lab, "rejected")):
+            raw_ids = np.asarray(e[f"{side}_input_ids"])
+            raw_lab = np.asarray(e[f"{side}_labels"])
+            n = len(raw_ids)
+            np.testing.assert_array_equal(ids[i, :n], raw_ids)
+            # labels come out ALREADY SHIFTED (labels[t] = ids[t+1]) and
+            # the shared-prompt mask survives the shift
+            np.testing.assert_array_equal(lab[i, : n - 1], raw_lab[1:])
+            assert (lab[i, n - 1:] == IGNORE_INDEX).all()
+    assert batch["num_label_tokens"] == int(
+        sum(
+            (np.asarray(e[f"{s}_labels"][1:]) != IGNORE_INDEX).sum()
+            for e in ex
+            for s in ("chosen", "rejected")
+        )
+    )
+    # position_ids zero out past each row's true length (prompt-length
+    # recovery rule shared with default_collater)
+    assert (batch["chosen_position_ids"][0, : c_ids.shape[1]] >= 0).all()
+
+
+# ---------------------------------------------------------------------------
+# DPO / ORPO recipe e2e (posttrain/dpo.py)
+# ---------------------------------------------------------------------------
+
+
+def _dpo_cfg(tmp_path, **posttrain):
+    return ConfigNode({
+        "seed": 0,
+        "model": {"hf_config": TINY, "backend": FP32_D},
+        "distributed": {"dp_shard": -1},
+        "posttrain": dict({"algo": "dpo", "beta": 0.1}, **posttrain),
+        "dataset": {
+            "_target_": "automodel_tpu.data.sft.MockPreferenceDataset",
+            "vocab_size": 64, "prompt_length": 8, "response_length": 8,
+            "num_samples": 96,
+        },
+        "dataloader": {"global_batch_size": 8},
+        "step_scheduler": {"max_steps": 12, "log_every_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 1.0e-3},
+        "logging": {"metrics_path": str(tmp_path / "m.jsonl")},
+    })
+
+
+def test_dpo_recipe_learns_margin_rises(tmp_path):
+    """Acceptance: DPO on mock preference pairs — loss falls AND the
+    chosen-minus-rejected implicit-reward margin rises; the frozen
+    reference copy stays bit-identical through training (the donation
+    hazard guard)."""
+    from automodel_tpu.posttrain.dpo import TrainPreferenceRecipe
+
+    r = TrainPreferenceRecipe(_dpo_cfg(tmp_path))
+    r.setup()
+    ref_before = jax.tree.map(np.asarray, r.loss_fn.bound_params)
+    last = r.run_train_validation_loop()
+    assert np.isfinite(last["loss"])
+    recs = [
+        json.loads(line)
+        for line in (tmp_path / "m.jsonl").read_text().splitlines()
+        if "dpo_loss" in line
+    ]
+    losses = [x["dpo_loss"] for x in recs if "dpo_loss" in x]
+    margins = [x["accept_margin"] for x in recs if "accept_margin" in x]
+    assert len(losses) >= 10
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    assert margins[-1] > margins[0] and margins[-1] > 0.2, (
+        margins[0], margins[-1],
+    )
+    # the reference never trains — every margin is against step-0 policy
+    for (p, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(ref_before),
+        jax.tree.leaves(r.loss_fn.bound_params),
+    ):
+        np.testing.assert_array_equal(a, np.asarray(b), err_msg=str(p))
+
+
+def test_orpo_recipe_learns_reference_free(tmp_path):
+    from automodel_tpu.posttrain.dpo import TrainPreferenceRecipe
+
+    cfg = _dpo_cfg(tmp_path, algo="orpo", beta=0.25)
+    cfg["step_scheduler"]["max_steps"] = 8
+    r = TrainPreferenceRecipe(cfg)
+    r.setup()
+    # ORPO is reference-free: no second param tree rides the loss
+    assert not hasattr(r.loss_fn, "bound_params")
+    last = r.run_train_validation_loop()
+    assert np.isfinite(last["loss"])
+    recs = [
+        json.loads(line)
+        for line in (tmp_path / "m.jsonl").read_text().splitlines()
+        if "dpo_loss" in line
+    ]
+    losses = [x["dpo_loss"] for x in recs]
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# GRPO recipe e2e (posttrain/grpo.py): real rollouts, hot-swap, telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_grpo_reward_rises_with_real_rollouts(tmp_path):
+    """Acceptance: GRPO with an in-process ServingEngine as the rollout
+    generator — the toy target-token-frequency reward RISES over training;
+    the engine is hot-swapped onto the current policy every step; rollout
+    and reward phases land as goodput segments AND as trace spans in the
+    metrics JSONL."""
+    from automodel_tpu.posttrain.grpo import GRPORecipe
+
+    cfg = ConfigNode({
+        "seed": 0,
+        "model": {"hf_config": TINY, "backend": FP32_D},
+        "distributed": {"dp_shard": -1},
+        "posttrain": {
+            "algo": "grpo", "clip_eps": 0.2, "kl_coef": 0.005,
+            "sync_weights_every_steps": 1,
+        },
+        "rollout": {
+            "engine": "in_process", "group_size": 4, "max_new_tokens": 8,
+            "temperature": 1.0,
+            "serving": {
+                "slots": 4, "block_size": 4, "num_blocks": 96,
+                "prefill_chunk": 8, "max_seq_len": 48,
+                "watchdog": {"enabled": False},
+            },
+        },
+        "reward": {"fn": "target_token_frequency", "kwargs": {"token_id": 7}},
+        "dataset": {
+            "_target_": "automodel_tpu.data.sft.MockPromptDataset",
+            "vocab_size": 64, "prompt_length": 6, "num_samples": 256,
+        },
+        "dataloader": {"global_batch_size": 8},
+        "step_scheduler": {"max_steps": 30, "log_every_steps": 1},
+        "optimizer": {"name": "adamw", "lr": 5.0e-3},
+        "logging": {"metrics_path": str(tmp_path / "m.jsonl")},
+    })
+    r = GRPORecipe(cfg)
+    r.setup()
+    last = r.run_train_validation_loop()
+    assert np.isfinite(last["loss"])
+
+    recs = [
+        json.loads(line)
+        for line in (tmp_path / "m.jsonl").read_text().splitlines()
+    ]
+    trains = [x for x in recs if "reward_mean" in x]
+    rewards = [x["reward_mean"] for x in trains]
+    assert len(rewards) >= 25
+    # the policy learns to emit token 7: near-chance early (1/64 per
+    # token), dominant late — a wide margin so sampling noise can't flake
+    assert np.mean(rewards[:5]) < 0.3, rewards[:5]
+    assert np.mean(rewards[-5:]) > 0.6, rewards[-5:]
+    assert np.mean(rewards[-5:]) > np.mean(rewards[:5]) + 0.3
+    # rollout/reward wall time is first-class telemetry on every record
+    assert all(x["rollout_s"] > 0 and x["reward_s"] >= 0 for x in trains)
+    # fully on-policy: one hot-swap per optimizer step
+    assert r._engine.weights_version == 30
+
+    # goodput ledger: rollout + reward are segment kinds of this run
+    gp_path = tmp_path / "goodput.jsonl"
+    assert gp_path.exists()
+    kinds = {
+        json.loads(line).get("kind")
+        for line in gp_path.read_text().splitlines()
+    }
+    assert {"rollout", "reward", "step"} <= kinds, kinds
+    # trace spans ride the metrics JSONL: the recipe's rollout span plus
+    # the engine's per-request spans parented under it
+    spans = [x for x in recs if x.get("event") == "span"or "span_id" in x]
+    stages = {x.get("stage") for x in spans}
+    assert "rollout" in stages, stages
+
+
+# ---------------------------------------------------------------------------
+# engine per-token logprob parity (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_logprobs_match_full_forward_recompute():
+    """The serving engine's return_logprobs stream must equal what a full
+    forward recompute of prompt+completion yields — raw-distribution
+    log-softmax at each sampled id (exactly what GRPO importance ratios
+    consume: ratio == 1 on perfectly synced weights)."""
+    auto = _tiny_auto()
+    eng = _engine(auto)
+    prompt = [5, 11, 23, 42]
+    rec = _run_to_completion(eng, prompt, return_logprobs=True)
+    toks = rec["tokens"]
+    lps = rec["logprobs"]
+    assert len(lps) == len(toks) == rec["n_generated"]
+
+    full = jnp.asarray([prompt + toks], dtype=jnp.int32)
+    out = auto.model(auto.params, full)
+    logits = out[0] if isinstance(out, tuple) else out
+    ref_lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)[0]
+    for i, (tok, lp) in enumerate(zip(toks, lps)):
+        # the row at position p predicts token p+1: completion token i
+        # (absolute position len(prompt)+i) is scored by row before it
+        want = float(ref_lp[len(prompt) + i - 1, tok])
+        # records round to 6dp; paged-KV vs full-attention fp32 math may
+        # differ in the last few ulps on top of that
+        assert abs(lp - want) < 5e-4, (i, tok, lp, want)
+
+
+# ---------------------------------------------------------------------------
+# live weight hot-swap semantics (engine.swap_weights)
+# ---------------------------------------------------------------------------
+
+
+def test_swap_weights_mid_serve_inflight_isolated_zero_drops():
+    """Acceptance: a swap landing mid-serve changes the greedy output of
+    SUBSEQUENT requests, leaves the in-flight request's completion
+    bit-identical to the old weights, drops nothing, and bumps the
+    monotonic weights_version."""
+    prompt = [9, 3, 27, 14, 50]
+    # reference completions under each weight generation
+    old_ref = _run_to_completion(
+        _engine(_tiny_auto(0), gen=GenerationConfig(max_new_tokens=12, greedy=True)),
+        prompt,
+    )["tokens"]
+    new_ref = _run_to_completion(
+        _engine(_tiny_auto(1), gen=GenerationConfig(max_new_tokens=12, greedy=True)),
+        prompt,
+    )["tokens"]
+    assert old_ref != new_ref, "seed-1 weights must change the greedy path"
+
+    eng = _engine(
+        _tiny_auto(0), gen=GenerationConfig(max_new_tokens=12, greedy=True)
+    )
+    rid_inflight = eng.submit(list(prompt))
+    out = []
+    for _ in range(3):  # genuinely mid-decode
+        out.extend(eng.step())
+    assert eng.busy_slots > 0
+    new_params = jax.tree.map(jnp.copy, _tiny_auto(1).params)
+    target = eng.swap_weights(new_params)
+    assert target == 1
+    # busy slots: the swap is STAGED, not applied — the in-flight request
+    # keeps the weights it started under
+    assert eng.weights_version == 0
+    out.extend(_drain(eng))
+    by_id = {r["request_id"]: r for r in out}
+    assert by_id[rid_inflight]["tokens"] == old_ref
+    # drained: the staged tree is live now
+    rec2 = _run_to_completion(eng, prompt)
+    assert eng.weights_version == 1
+    assert rec2["tokens"] == new_ref
+    # zero drops: every submission has exactly one terminal record
+    assert by_id[rid_inflight]["completion_reason"] in ("stop", "length")
+
+
+def test_swap_weights_signature_mismatch_refused_old_params_intact():
+    eng = _engine(_tiny_auto(0))
+    before = jax.tree.map(np.asarray, eng.auto.params)
+    bad = jax.tree.map(jnp.copy, _tiny_auto(1).params)
+    # drop a leaf: the param-tree signature digest can no longer match
+    key = next(iter(bad))
+    bad = {k: v for k, v in bad.items() if k != key}
+    with pytest.raises(ValueError, match="signature mismatch"):
+        eng.swap_weights(bad)
+    assert eng.weights_version == 0
+    for (p, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(before),
+        jax.tree.leaves(eng.auto.params),
+    ):
+        np.testing.assert_array_equal(a, np.asarray(b), err_msg=str(p))
+    # the engine still serves after the refusal
+    rec = _run_to_completion(eng, [1, 2, 3])
+    assert rec["completion_reason"] in ("stop", "length")
+
+
+def test_trainer_weights_peer_fetch_then_swap():
+    """The GRPO fleet seam without HTTP: a trainer-side AKV1 listener
+    (dummy KV geometry — geometry only guards KV handoff frames) serves
+    its param tree over ``op: weights_fetch``; the fetched flat tree
+    digest-matches and swaps into a serving engine, flipping its greedy
+    output to the trainer's policy."""
+    from automodel_tpu.checkpoint.checkpointer import param_tree_signature
+    from automodel_tpu.serving.engine import _tree_path_name
+    from automodel_tpu.serving.fleet.kv_transfer import (
+        KVTransferServer,
+        fetch_weights,
+    )
+
+    trainer_params = _tiny_auto(1).params
+
+    def _serve_weights():
+        sig = param_tree_signature(trainer_params)
+        leaves = jax.tree_util.tree_flatten_with_path(trainer_params)[0]
+        return sig, [(_tree_path_name(p), leaf) for p, leaf in leaves]
+
+    kv = KVTransferServer(
+        {"layers": 1, "block_size": 1, "num_kv_heads": 1, "head_dim": 1,
+         "kv_cache_dtype": "bf16"},
+        weights_handler=_serve_weights,
+    ).start()
+    try:
+        sig, arrays = fetch_weights(("127.0.0.1", kv.port), timeout_s=30)
+        assert sig["digest"] == param_tree_signature(trainer_params)["digest"]
+        # bit-exact over the wire
+        for path, leaf in jax.tree_util.tree_flatten_with_path(trainer_params)[0]:
+            np.testing.assert_array_equal(
+                arrays[_tree_path_name(path)], np.asarray(leaf)
+            )
+        eng = _engine(_tiny_auto(0))
+        want = _run_to_completion(_engine(_tiny_auto(1)), [7, 8, 9])["tokens"]
+        eng.swap_weights(arrays)  # a flat name->array dict rides fine
+        assert eng.weights_version == 1
+        assert _run_to_completion(eng, [7, 8, 9])["tokens"] == want
+    finally:
+        kv.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet-status WVER rendering (satellite 4)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_status_renders_wver_and_rolling_footer():
+    from automodel_tpu.serving.fleet.status import render_table
+
+    stats = {
+        "replicas": {
+            "r0": {"role": "mixed", "alive": True, "ready": True,
+                   "queue_depth": 0, "busy_slots": 1, "weights_version": 3},
+            "r1": {"role": "mixed", "alive": True, "ready": True,
+                   "queue_depth": 2, "busy_slots": 0, "weights_version": 2,
+                   "updating": True},
+        },
+        "replicas_ready": 2,
+        "rolling_update": {
+            "active": True, "total": 2, "done": 1, "current": "r1",
+            "updated": ["r0"], "failed": [],
+        },
+    }
+    table = render_table(stats)
+    header = table.splitlines()[0]
+    assert "WVER" in header
+    r0_line = next(line for line in table.splitlines() if line.startswith("r0"))
+    r1_line = next(line for line in table.splitlines() if line.startswith("r1"))
+    assert " 3" in r0_line and "3*" not in r0_line
+    # the mid-swap replica is flagged: version skew is visible while the
+    # rolling update's window closes
+    assert "2*" in r1_line
+    assert "rolling update: ACTIVE 1/2, updating r1" in table
+    # done + failed variant
+    stats["rolling_update"] = {
+        "active": False, "total": 2, "done": 2, "current": None,
+        "updated": ["r0"], "failed": ["r1"], "weights_version": 3,
+    }
+    table = render_table(stats)
+    assert "rolling update: done 2/2, failed: r1" in table
+
+
+# ---------------------------------------------------------------------------
+# fleet rolling update under load (slow: 2 replica subprocess boots)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # two replica subprocess boots + Poisson workload
+def test_rolling_update_under_poisson_load_zero_lost(tmp_path):
+    """Acceptance: rolling weight update across 2 serve replica
+    SUBPROCESSES while a Poisson workload runs through the router —
+    exactly-once terminal accounting, zero lost requests, BOTH replicas
+    converge to the new weights_version (the /stats skew window closes),
+    and the router's rolling_update stats land the full progression."""
+    from automodel_tpu.generation.engine import build_auto_from_cfg
+    from automodel_tpu.serving.fleet.kv_transfer import KVTransferServer
+    from automodel_tpu.serving.fleet.router import (
+        FleetConfig,
+        Router,
+        _http_json,
+    )
+    from tests.test_serving_chaos import (
+        _clean_env,
+        _replica_cfg,
+        _spawn_replica,
+        _replica_port,
+    )
+
+    # the "trainer": same architecture as the replicas' cfg, different
+    # seed — a real weight delta for the fleet to converge onto
+    trainer_cfg = ConfigNode(dict(
+        _replica_cfg(tmp_path, 0), seed=1,
+        # this process runs conftest's 8 virtual devices; the param-tree
+        # signature is sharding-independent, so the digest still matches
+        # the replicas' single-device trees
+        distributed={"dp_shard": -1},
+    ))
+    trainer_auto = build_auto_from_cfg(trainer_cfg)
+
+    def _serve_weights():
+        from automodel_tpu.checkpoint.checkpointer import param_tree_signature
+        from automodel_tpu.serving.engine import _tree_path_name
+
+        params = trainer_auto.params
+        sig = param_tree_signature(params)
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        return sig, [(_tree_path_name(p), leaf) for p, leaf in leaves]
+
+    kv = KVTransferServer(
+        {"layers": 1, "block_size": 1, "num_kv_heads": 1, "head_dim": 1,
+         "kv_cache_dtype": "bf16"},
+        weights_handler=_serve_weights,
+    ).start()
+
+    procs = [_spawn_replica(tmp_path, i) for i in range(2)]
+    router = None
+    try:
+        ports = [_replica_port(p) for p in procs]
+        records = []
+        router = Router(
+            FleetConfig.from_dict({
+                "replicas": [
+                    {"url": f"http://127.0.0.1:{port}", "name": f"r{i}"}
+                    for i, port in enumerate(ports)
+                ],
+                "block_size": 4,
+                "probe_interval_s": 0.2,
+                "probe_timeout_s": 5.0,
+                "retry_budget": 3,
+                "request_timeout_s": 120.0,
+            }),
+            on_record=records.append,
+        ).start()
+        assert router.ready()
+
+        rng = np.random.default_rng(0)
+        n_requests = 14
+        arrivals = []
+        t = 0.0
+        for _ in range(n_requests):
+            t += float(rng.exponential(0.25))
+            arrivals.append((
+                t,
+                rng.integers(1, 64, size=int(rng.integers(3, 9))).tolist(),
+                24,
+            ))
+        out_box = {}
+
+        def drive():
+            out_box["result"] = router.run_workload(arrivals)
+
+        worker = threading.Thread(target=drive, daemon=True)
+        worker.start()
+        # wait until traffic demonstrably flows, then roll the fleet
+        deadline = time.monotonic() + 240
+        while (
+            not any(r.get("event") == "route_request" for r in records)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert records, "no routed completion before the update"
+        summary = router.rolling_update(
+            {"host": "127.0.0.1", "port": kv.port},
+            timeout_s=120.0, drain_timeout_s=120.0,
+        )
+        assert sorted(summary["updated"]) == ["r0", "r1"], summary
+        assert summary["failed"] == [] and summary["weights_version"] == 1
+
+        worker.join(timeout=240)
+        assert "result" in out_box, "routed workload did not finish"
+        _, stats = out_box["result"]
+        # zero lost requests under the rolling update
+        assert stats["requests"] == n_requests, stats
+        assert stats["failed_requests"] == 0, stats
+        by_id = {}
+        for rec in records:
+            if rec.get("event") != "route_request":
+                continue
+            assert rec["request_id"] not in by_id, "duplicate terminal record"
+            by_id[rec["request_id"]] = rec
+        assert sorted(by_id) == sorted(f"bench-{i}" for i in range(n_requests))
+        assert all(
+            r["completion_reason"] in ("stop", "length")
+            for r in by_id.values()
+        )
+        # the skew window CLOSED: both replicas now serve version 1
+        for port in ports:
+            _, st = _http_json(
+                f"http://127.0.0.1:{port}/stats", None, timeout_s=5.0
+            )
+            assert st.get("weights_version") == 1, (port, st)
+        # router-side observability: the full phase progression rode
+        # on_record, and /stats carries the finished rolling_update block
+        phases = [
+            r["phase"] for r in records if r.get("event") == "rolling_update"
+        ]
+        assert phases[0] == "start" and phases[-1] == "done"
+        assert phases.count("replica") == 2
+        ru = router.stats().get("rolling_update")
+        assert ru and not ru["active"] and ru["weights_version"] == 1
+        assert sorted(ru["updated"]) == ["r0", "r1"]
+    finally:
+        if router is not None:
+            router.close()
+        kv.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
