@@ -49,6 +49,11 @@ wrong, deterministically, on CPU, in tier-1. Four fault classes:
   zero on the tiny test models, so peer warm-start's time_to_ready_s win
   is measurable on CPU (the same role ``slow_collate_ms`` plays for the
   input-pipeline overlap proof)
+- ``serve_tenant_flood_at_step`` — one tenant floods the serving admission
+  queue with ``serve_tenant_flood_requests`` tiny requests at scheduler
+  step k (the noisy-neighbor chaos knob): per-tenant quotas, tiered
+  shedding and the anti-starvation aging bound (serving.qos) must keep
+  every other tenant live
 
 Activation: a ``fault_injection:`` YAML section (recipes call
 ``activate_from_config``) or the ``AUTOMODEL_FAULT_INJECTION`` env var
@@ -137,6 +142,15 @@ class FaultInjectionConfig:
     weights_stream_abort_after: Optional[int] = None
     kv_push_drop_ack: bool = False
     hf_load_delay_ms: float = 0.0
+    # noisy-neighbor knob (multi-tenant QoS, tests/test_qos.py): at serving
+    # scheduler step k, one tenant floods the admission queue with
+    # serve_tenant_flood_requests tiny requests (tier defaults to the
+    # flooding tenant's configured/default tier) — quotas, lowest-tier-first
+    # shedding and the aging bound must keep every OTHER tenant live
+    serve_tenant_flood_at_step: Optional[int] = None
+    serve_tenant_flood_requests: int = 32
+    serve_tenant_flood_tenant: str = "flood"
+    serve_tenant_flood_tier: Optional[str] = None
 
 
 def _process_index() -> int:
@@ -154,6 +168,7 @@ class FaultInjector:
         self._io_attempts: dict[str, int] = {}
         self._hung = False
         self._serve_hung = False
+        self._flooded = False
         # slo_breach_for_s window bookkeeping (maybe_slo_breach)
         self._breach_started_t: Optional[float] = None
         self._breach_closed = False
@@ -220,6 +235,29 @@ class FaultInjector:
         import time
 
         time.sleep(c.serve_hang_seconds)
+
+    def maybe_tenant_flood(self, step: int) -> Optional[tuple]:
+        """Noisy neighbor: at serving step k, → ``(tenant, n, tier)`` for
+        the engine to submit as a burst of tiny requests from that tenant
+        (tier None = the tenant's configured default). Fires once."""
+        c = self.config
+        if (
+            c.serve_tenant_flood_at_step is None
+            or step != c.serve_tenant_flood_at_step
+            or self._flooded
+        ):
+            return None
+        self._flooded = True
+        logger.error(
+            "fault injection: tenant %r flooding %d requests at serving "
+            "step %d",
+            c.serve_tenant_flood_tenant, c.serve_tenant_flood_requests, step,
+        )
+        return (
+            c.serve_tenant_flood_tenant,
+            max(int(c.serve_tenant_flood_requests), 0),
+            c.serve_tenant_flood_tier,
+        )
 
     def maybe_serve_exception(self, step: int) -> None:
         """Mid-request engine exception at serving step k (fires once: the
@@ -382,6 +420,7 @@ def activate(config: FaultInjectionConfig | dict | None) -> Optional[FaultInject
         or config.weights_stream_abort_after is not None
         or config.kv_push_drop_ack
         or config.hf_load_delay_ms > 0
+        or config.serve_tenant_flood_at_step is not None
     )
     if not armed:
         # an empty `fault_injection: {}` section (the docs' example form)

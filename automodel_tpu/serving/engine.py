@@ -94,18 +94,39 @@ logger = logging.getLogger(__name__)
 #   timeout      — deadline_s / max_queue_wait_s expired (not retriable:
 #                  the client's own budget ran out)
 #   shed         — rejected at submit, admission queue full (retriable)
+#   quota        — rejected at submit, the tenant's token-bucket quota is
+#                  exhausted (retriable — after the Retry-After window the
+#                  bucket has refilled)
 #   draining     — rejected because the server is draining (retriable)
 #   cancelled    — in flight when the drain grace expired (retriable)
 #   engine_stall — failed by a watchdog-detected wedged step (retriable)
 #   engine_error — failed by a scheduler/program exception (retriable)
 COMPLETION_REASONS = (
-    "stop", "length", "prefilled", "timeout", "shed", "draining",
+    "stop", "length", "prefilled", "timeout", "shed", "quota", "draining",
     "cancelled", "engine_stall", "engine_error",
 )
 _COMPLETED_REASONS = frozenset({"stop", "length", "prefilled"})
 _RETRIABLE_REASONS = frozenset(
-    {"shed", "draining", "cancelled", "engine_stall", "engine_error"}
+    {"shed", "quota", "draining", "cancelled", "engine_stall", "engine_error"}
 )
+
+# QoS tiers, highest priority first (serving.qos / docs/serving.md
+# "Multi-tenant QoS"): admission, shedding, and Retry-After scaling all key
+# off the tier's INDEX in this tuple — interactive work is admitted first
+# and shed last.
+TIERS = ("interactive", "batch", "best_effort")
+_TIER_INDEX = {t: i for i, t in enumerate(TIERS)}
+
+
+def tier_index(tier: str) -> int:
+    """Priority rank of a tier (0 = highest). Unknown tiers raise — a typo
+    must never silently demote (or promote) a tenant."""
+    try:
+        return _TIER_INDEX[tier]
+    except KeyError:
+        raise ValueError(
+            f"unknown QoS tier {tier!r} (want one of {'|'.join(TIERS)})"
+        ) from None
 
 
 class QueueFull(RuntimeError):
@@ -118,6 +139,18 @@ class EngineDraining(RuntimeError):
     """Submissions rejected while the server drains (SIGTERM received):
     retriable — the client should go to another replica. HTTP maps this to
     503 + Retry-After, stdin-JSONL to an error record."""
+
+
+class QuotaExceeded(RuntimeError):
+    """The tenant's token-bucket quota (requests/s or decode-tokens/s) is
+    exhausted: retriable after the bucket refills. HTTP maps this to 429 +
+    a tier-scaled Retry-After with ``reason: quota``. Carries ``tenant`` and
+    ``tier`` so the front can label the rejection."""
+
+    def __init__(self, message: str, tenant: str, tier: str):
+        super().__init__(message)
+        self.tenant = tenant
+        self.tier = tier
 
 
 def _cfg_dict(cls, d: Optional[dict], section: str):
@@ -321,6 +354,114 @@ class SpeculativeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TenantConfig:
+    """One ``serving.qos.tenants:`` entry — the tenant's default tier, its
+    weighted-fair-queuing share, and its token-bucket quotas. A quota of
+    None means unlimited (the bucket never rejects)."""
+
+    tier: Optional[str] = None  # default tier; null = qos.default_tier
+    weight: float = 1.0  # WFQ share within the tenant's tier
+    requests_per_s: Optional[float] = None  # admission token bucket
+    decode_tokens_per_s: Optional[float] = None  # decode-budget bucket
+    burst_s: float = 2.0  # bucket depth, in seconds of the rate
+
+    def __post_init__(self):
+        if self.tier is not None:
+            tier_index(self.tier)  # raises on a typo
+        if self.weight <= 0:
+            raise ValueError(f"qos tenant weight={self.weight} (want > 0)")
+        for name in ("requests_per_s", "decode_tokens_per_s"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"qos tenant {name}={v} (want > 0 or null)")
+        if self.burst_s <= 0:
+            raise ValueError(f"qos tenant burst_s={self.burst_s} (want > 0)")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "TenantConfig":
+        return _cfg_dict(cls, d, "serving.qos.tenants entry")
+
+
+@dataclasses.dataclass(frozen=True)
+class QoSConfig:
+    """The ``serving.qos:`` section — multi-tenant quality of service
+    (docs/serving.md "Multi-tenant QoS"). When enabled, the admission queue
+    becomes priority-tiered (``TIERS`` order) with EDF ordering inside each
+    tier and weighted fair queuing across tenants; per-tenant token buckets
+    reject over-quota submissions with the retriable ``quota`` reason; a
+    full queue sheds strictly lowest-tier-first; and ``aging_s`` bounds
+    starvation by promoting long-waiting low-tier work to the top tier.
+    Disabled (the default), admission is exactly the FIFO it always was."""
+
+    enabled: bool = False
+    default_tier: str = "interactive"  # tier when request + tenant name none
+    default_tenant: str = "anonymous"  # tenant when the request names none
+    aging_s: float = 30.0  # queued longer than this → ordered as top tier
+    tenants: Any = dataclasses.field(default_factory=dict)  # name → TenantConfig
+
+    def __post_init__(self):
+        tier_index(self.default_tier)
+        if self.aging_s <= 0:
+            raise ValueError(f"serving.qos.aging_s={self.aging_s} (want > 0)")
+        from automodel_tpu.telemetry.prometheus import _LABEL_VALUE_OK
+
+        for name in list(self.tenants) + [self.default_tenant]:
+            # tenant names become /metrics label values — refuse anything
+            # the exposition sanitizer would mangle, loudly and up front
+            if not _LABEL_VALUE_OK.match(str(name)):
+                raise ValueError(
+                    f"qos tenant name {name!r} is not a valid metrics label "
+                    "value (want [a-zA-Z0-9_.+-]+)"
+                )
+
+    def tenant(self, name: str) -> TenantConfig:
+        return self.tenants.get(name) or TenantConfig()
+
+    def tier_for(self, name: str) -> str:
+        t = self.tenants.get(name)
+        return t.tier if t is not None and t.tier else self.default_tier
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "QoSConfig":
+        d = dict(d or {})
+        tenants = d.get("tenants")
+        if tenants is not None:
+            d["tenants"] = {
+                str(name): (
+                    sub if isinstance(sub, TenantConfig)
+                    else TenantConfig.from_dict(dict(sub or {}))
+                )
+                for name, sub in dict(tenants).items()
+            }
+        return _cfg_dict(cls, d, "serving.qos")
+
+
+class _TokenBucket:
+    """Per-tenant rate limiter: ``rate`` units/s refill into a bucket of
+    ``rate * burst_s`` depth; ``take`` spends or refuses. rate None =
+    unlimited. Timestamps are the caller's perf_counter values."""
+
+    def __init__(self, rate: Optional[float], burst_s: float):
+        self.rate = rate
+        self.capacity = (rate or 0.0) * burst_s
+        self.tokens = self.capacity
+        self.t_last: Optional[float] = None
+
+    def take(self, n: float, now: float) -> bool:
+        if self.rate is None:
+            return True
+        if self.t_last is not None and now > self.t_last:
+            self.tokens = min(
+                self.capacity, self.tokens + (now - self.t_last) * self.rate
+            )
+        self.t_last = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """The `serving:` YAML section (scheduler/allocator knobs; sampling and
     stop tokens come from the `generation:` section)."""
@@ -360,6 +501,7 @@ class ServeConfig:
     warm_start: WarmStartConfig = dataclasses.field(
         default_factory=WarmStartConfig
     )
+    qos: QoSConfig = dataclasses.field(default_factory=QoSConfig)
 
     def __post_init__(self):
         if self.slots < 1 or self.block_size < 1 or self.prefill_chunk < 1:
@@ -405,6 +547,7 @@ class ServeConfig:
             ("kv_transfer", KVTransferConfig),
             ("kv_spill", KVSpillConfig),
             ("warm_start", WarmStartConfig),
+            ("qos", QoSConfig),
         ):
             v = d.get(key)
             if v is not None and not isinstance(v, sub):
@@ -450,6 +593,11 @@ class _Queued:
     # behavior-policy logprob capture (posttrain/grpo.py): record the
     # sampled sequence's per-token logprobs on the terminal record
     return_logprobs: bool = False
+    # multi-tenant QoS (serving.qos): who submitted, at what priority —
+    # stamped on the terminal record and on every tier/tenant metric label
+    tenant: str = "anonymous"
+    tier: str = "interactive"
+    tier_idx: int = 0
 
 
 @dataclasses.dataclass
@@ -473,6 +621,8 @@ class _Slot:
     # parallel to ``generated`` when the request asked for logprobs: the
     # behavior policy's own log π(token) at each sampled position
     logprobs: Optional[list[float]] = None
+    tenant: str = "anonymous"
+    tier: str = "interactive"
 
 
 def _tree_path_name(path) -> str:
@@ -653,7 +803,22 @@ class ServingEngine:
         self.completed_total = 0  # stop/length completions
         self.failed_total = 0  # timeout/cancelled/stall/error terminations
         self.shed_total = 0
+        self.quota_total = 0  # tenant token-bucket rejections
         self.timeout_total = 0
+        # multi-tenant QoS (serving.qos): per-tenant token buckets (lazily
+        # built from TenantConfig on first submission), per-(tier, tenant)
+        # weighted-fair-queuing service accumulators (request token cost /
+        # weight — reset never; relative order is all WFQ needs), and
+        # cumulative per-tier / per-tenant terminal-outcome rollups for
+        # /stats (the labeled /metrics families mirror them)
+        self._req_buckets: dict[str, _TokenBucket] = {}
+        self._decode_buckets: dict[str, _TokenBucket] = {}
+        self._wfq_served: dict[tuple[str, str], float] = {}
+        self.tier_counters: dict[str, dict[str, int]] = {
+            t: {"completed": 0, "shed": 0, "timeout": 0, "quota": 0}
+            for t in TIERS
+        }
+        self.tenant_counters: dict[str, dict[str, int]] = {}
         self.stall_total = 0  # watchdog-detected wedged steps
         self.error_total = 0  # recovered scheduler exceptions
         # drain state (begin_drain / drain_complete)
@@ -1013,11 +1178,25 @@ class ServingEngine:
         trace: Optional[SpanContext] = None,
         kv_peer: Optional[dict] = None,
         return_logprobs: bool = False,
+        tenant: Optional[str] = None,
+        tier: Optional[str] = None,
         _payload: Optional[dict] = None,
     ) -> str:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("empty prompt (every request needs >= 1 token)")
+        qos = self.config.qos
+        tenant = str(tenant) if tenant is not None else qos.default_tenant
+        tier = str(tier) if tier is not None else qos.tier_for(tenant)
+        tier_idx = tier_index(tier)  # raises 400-ably on a typo
+        if qos.enabled:
+            from automodel_tpu.telemetry.prometheus import _LABEL_VALUE_OK
+
+            if not _LABEL_VALUE_OK.match(tenant):
+                raise ValueError(
+                    f"tenant {tenant!r} is not a valid metrics label value "
+                    "(want [a-zA-Z0-9_.+-]+)"
+                )
         if return_logprobs and self._spec_enabled:
             # speculative commits draft+correction tokens whose per-token
             # behavior logprobs are not the target's sampling logprobs —
@@ -1076,6 +1255,7 @@ class ServingEngine:
             prefill_only=prefill_only, payload=_payload, trace=root,
             kv_peer=kv_peer if kv_peer else None,
             return_logprobs=return_logprobs,
+            tenant=tenant, tier=tier, tier_idx=tier_idx,
         )
         if self.draining:
             # no terminal record here (mirror of the shed seam): the
@@ -1083,16 +1263,90 @@ class ServingEngine:
             # honoring Retry-After would otherwise inflate failed_total and
             # the JSONL with one synthetic record per retry attempt.
             # ACCEPTED-then-drained requests do get records (step's queue
-            # flush) — that is the no-silent-drop contract's scope.
+            # flush) — that is the no-silent-drop contract's scope. The
+            # draining check comes BEFORE any priority handling: no tier,
+            # however high, jumps a drain (tests/test_qos.py pins it).
             raise EngineDraining(
                 "server is draining — retry against another replica"
             )
+        if qos.enabled:
+            # token-bucket quotas, charged up front: one admission token and
+            # the request's whole decode budget (max_new) — a worst-case
+            # reservation, so a flooding tenant is bounded by what it COULD
+            # decode, not by what its requests happen to generate
+            tc = qos.tenant(tenant)
+            rb = self._req_buckets.get(tenant)
+            if rb is None:
+                rb = self._req_buckets[tenant] = _TokenBucket(
+                    tc.requests_per_s, tc.burst_s
+                )
+            db = self._decode_buckets.get(tenant)
+            if db is None:
+                db = self._decode_buckets[tenant] = _TokenBucket(
+                    tc.decode_tokens_per_s, tc.burst_s
+                )
+            if not rb.take(1.0, now):
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} over requests_per_s="
+                    f"{tc.requests_per_s} quota",
+                    tenant=tenant, tier=tier,
+                )
+            if not db.take(float(0 if prefill_only else max_new), now):
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} over decode_tokens_per_s="
+                    f"{tc.decode_tokens_per_s} quota",
+                    tenant=tenant, tier=tier,
+                )
         if len(self._queue) >= self.config.max_queue:
-            raise QueueFull(
-                f"admission queue at serving.max_queue={self.config.max_queue}"
-            )
+            if not qos.enabled:
+                raise QueueFull(
+                    "admission queue at serving.max_queue="
+                    f"{self.config.max_queue}"
+                )
+            # overload sheds strictly lowest-tier-first: evict the worst
+            # queued entry (lowest EFFECTIVE tier — aging promotion counts —
+            # latest-submitted among those) when it ranks strictly below the
+            # newcomer; otherwise the newcomer IS the lowest tier and is
+            # refused. The evicted entry was accepted earlier, so the
+            # no-silent-drop contract owes it a terminal `shed` record here.
+            victim = self._shed_victim(tier_idx, now)
+            if victim is None:
+                raise QueueFull(
+                    "admission queue at serving.max_queue="
+                    f"{self.config.max_queue} (tier {tier!r} sheds first)"
+                )
+            self._queue.remove(victim)
+            self.shed_total += 1
+            self._rejection_record(victim, "shed")
         self._queue.append(q)
         return rid
+
+    def _effective_tier(self, q: _Queued, now: float) -> int:
+        """Tier rank used for ordering and shedding: the anti-starvation
+        aging bound promotes work queued past ``qos.aging_s`` to the top
+        tier, so a busy high tier can delay low-tier work but never starve
+        it (and an aged entry is never the preferred shed victim)."""
+        if now - q.t_submit >= self.config.qos.aging_s:
+            return 0
+        return q.tier_idx
+
+    def _shed_victim(
+        self, newcomer_tier_idx: int, now: float
+    ) -> Optional[_Queued]:
+        """The queued entry a full queue evicts to make room for a
+        strictly-higher-tier newcomer: lowest effective tier, latest
+        submission among ties (shedding the newest low-tier entry keeps
+        the oldest closest to its aging promotion). None when nothing
+        queued ranks strictly below the newcomer."""
+        victim = None
+        victim_key = None
+        for q in self._queue:
+            key = (self._effective_tier(q, now), q.t_submit)
+            if victim_key is None or key > victim_key:
+                victim, victim_key = q, key
+        if victim is None or victim_key[0] <= newcomer_tier_idx:
+            return None
+        return victim
 
     # -- disaggregated prefill/decode (serving/fleet/) ------------------------
     def kv_geometry(self) -> dict:
@@ -1417,19 +1671,50 @@ class ServingEngine:
         self,
         request_id: Optional[str] = None,
         prompt_ids: Optional[Sequence[int]] = None,
+        tenant: Optional[str] = None,
+        tier: Optional[str] = None,
     ) -> dict:
         """Account an ACTUAL shed — the caller gave up on a ``QueueFull``
         and returned the overload signal to the client. Kept out of
         ``submit`` so a front that absorbs backpressure by retrying (the
         stdin batch mode) doesn't inflate ``requests_shed_total`` with
-        retry attempts."""
+        retry attempts. ``tenant``/``tier`` label the record (and the
+        per-tier /metrics families) with who was shed."""
         self.shed_total += 1
+        qos = self.config.qos
+        tenant = tenant if tenant is not None else qos.default_tenant
+        tier = tier if tier is not None else qos.tier_for(tenant)
         q = _Queued(
             rid=request_id if request_id is not None else f"req-{next(self._ids)}",
             prompt=[int(t) for t in (prompt_ids or [])],
             max_new=0, t_submit=time.perf_counter(),
+            tenant=tenant, tier=tier, tier_idx=tier_index(tier),
         )
         return self._rejection_record(q, "shed")
+
+    def record_quota(
+        self,
+        request_id: Optional[str] = None,
+        prompt_ids: Optional[Sequence[int]] = None,
+        tenant: Optional[str] = None,
+        tier: Optional[str] = None,
+    ) -> dict:
+        """Account a quota rejection the caller returned to the client —
+        the ``record_shed`` seam's twin for ``QuotaExceeded``: ``submit``
+        raises without a record so retrying fronts don't inflate the
+        count; the front that actually answers the client calls this
+        exactly once."""
+        self.quota_total += 1
+        qos = self.config.qos
+        tenant = tenant if tenant is not None else qos.default_tenant
+        tier = tier if tier is not None else qos.tier_for(tenant)
+        q = _Queued(
+            rid=request_id if request_id is not None else f"req-{next(self._ids)}",
+            prompt=[int(t) for t in (prompt_ids or [])],
+            max_new=0, t_submit=time.perf_counter(),
+            tenant=tenant, tier=tier, tier_idx=tier_index(tier),
+        )
+        return self._rejection_record(q, "quota")
 
     # -- terminal records -----------------------------------------------------
     def _wall_ts(self) -> float:
@@ -1477,6 +1762,8 @@ class ServingEngine:
             "prompt_tokens": len(q.prompt),
             "completion_reason": reason,
             "retriable": reason in _RETRIABLE_REASONS,
+            "tenant": q.tenant,
+            "tier": q.tier,
             "queue_s": now - q.t_submit,
             "queue_depth": self.queue_depth,
             "ts": self._wall_ts(),
@@ -1526,6 +1813,8 @@ class ServingEngine:
             "prefix_hit_tokens": slot.hit_tokens,
             "completion_reason": reason,
             "retriable": reason in _RETRIABLE_REASONS,
+            "tenant": slot.tenant,
+            "tier": slot.tier,
             "queue_s": slot.t_admit - slot.t_submit,
             "queue_depth": self.queue_depth,
             "block_occupancy": round(self.pool.occupancy(), 4),
@@ -1572,6 +1861,8 @@ class ServingEngine:
                 self.metrics.observe_request(rec)
             else:
                 self.metrics.observe_failure(rec.get("completion_reason", ""))
+            self.metrics.observe_qos(rec)
+            self._note_qos(rec)
         except Exception:  # telemetry must never break serving
             pass
         if self.on_record is not None:
@@ -1579,6 +1870,66 @@ class ServingEngine:
                 self.on_record(dict(rec))
             except Exception:  # telemetry must never break serving
                 pass
+
+    def _note_qos(self, rec: dict) -> None:
+        """Fold one terminal record into the per-tier / per-tenant /stats
+        rollups (the labeled /metrics families are observed beside this in
+        ``ServingMetrics.observe_qos``)."""
+        tier = rec.get("tier")
+        tenant = rec.get("tenant")
+        reason = rec.get("completion_reason")
+        if tier is None or tenant is None or reason is None:
+            return
+        tc = self.tier_counters.get(tier)
+        if tc is not None:
+            if reason in _COMPLETED_REASONS:
+                tc["completed"] += 1
+            elif reason in tc:
+                tc[reason] += 1
+        nc = self.tenant_counters.setdefault(
+            tenant,
+            {"requests": 0, "completed": 0, "shed": 0, "quota": 0,
+             "timeout": 0},
+        )
+        nc["requests"] += 1
+        if reason in _COMPLETED_REASONS:
+            nc["completed"] += 1
+        elif reason in nc:
+            nc[reason] += 1
+
+    def qos_snapshot(self) -> dict:
+        """The /stats ``qos`` block: live queue composition by tier and
+        tenant plus the cumulative terminal rollups — the numbers
+        fleet-status's TIER/TENANT summary and the noisy-neighbor tests
+        read."""
+        queued_by_tier: dict[str, int] = {t: 0 for t in TIERS}
+        queued_by_tenant: dict[str, int] = {}
+        for q in self._queue:
+            queued_by_tier[q.tier] = queued_by_tier.get(q.tier, 0) + 1
+            queued_by_tenant[q.tenant] = queued_by_tenant.get(q.tenant, 0) + 1
+        return {
+            "enabled": self.config.qos.enabled,
+            "queued_by_tier": queued_by_tier,
+            "queued_by_tenant": queued_by_tenant,
+            "tiers": {t: dict(c) for t, c in self.tier_counters.items()},
+            "tenants": {n: dict(c) for n, c in self.tenant_counters.items()},
+        }
+
+    def check_invariants(self) -> None:
+        """Allocator + scheduler audit for the chaos suite: the pool's own
+        invariants, queue entries unique by request id, and every queued
+        entry carrying a valid tier. Raises on violation."""
+        self.pool.check_invariants()
+        rids = [q.rid for q in self._queue]
+        if len(rids) != len(set(rids)):
+            raise AssertionError(f"duplicate queued request ids: {rids}")
+        for q in self._queue:
+            tier_index(q.tier)
+        for served in self._wfq_served.values():
+            if served < 0:
+                raise AssertionError(
+                    f"negative WFQ service accumulator: {self._wfq_served}"
+                )
 
     # -- scheduler ------------------------------------------------------------
     def _expire_tick(self) -> list[dict]:
@@ -1613,11 +1964,36 @@ class ServingEngine:
                 done.append(self._terminate(b, "timeout"))
         return done
 
+    def _select_queued(self, now: float) -> int:
+        """Index of the next queued request to admit. FIFO (index 0) when
+        QoS is off — bit-identical to the engine before serving.qos
+        existed. With QoS on the order is: effective tier (aging promotion
+        counts) → weighted-fair service across tenants within the tier
+        (least normalized service first) → EDF (earliest deadline) → FIFO.
+        One O(queue) scan per free slot — max_queue bounds it."""
+        if not self.config.qos.enabled or len(self._queue) <= 1:
+            return 0
+        best_i = 0
+        best_key = None
+        for i, q in enumerate(self._queue):
+            key = (
+                self._effective_tier(q, now),
+                self._wfq_served.get((q.tier, q.tenant), 0.0)
+                / self.config.qos.tenant(q.tenant).weight,
+                q.deadline_at if q.deadline_at is not None else float("inf"),
+                q.t_submit,
+                i,
+            )
+            if best_key is None or key < best_key:
+                best_key, best_i = key, i
+        return best_i
+
     def _admit(self, done: list[dict]) -> None:
         for b in range(self.config.slots):
             if self._slots[b] is not None or not self._queue:
                 continue
-            q = self._queue[0]
+            idx = self._select_queued(time.perf_counter())
+            q = self._queue[idx]
             t_adm0 = time.perf_counter()  # tracing: admission stage start
             if q.payload is not None:
                 # KV handoff: the prompt's rows arrive pre-computed, so the
@@ -1634,12 +2010,21 @@ class ServingEngine:
             )
             fresh = self.pool.allocate(need - len(hits))
             if fresh is None:
-                # pool can't cover the head of the queue: undo the hit refs
-                # and keep FIFO order (no overtaking — ttft fairness)
+                # pool can't cover the selected head of the queue: undo the
+                # hit refs and stop admitting this step (no overtaking past
+                # the scheduling order's head — with QoS off that is plain
+                # FIFO ttft fairness; with QoS on the head is the
+                # tier/WFQ/EDF winner and overtaking it would invert the
+                # priority order under exactly the pressure it exists for)
                 if hits:
                     self.pool.free(hits)
                 break
-            self._queue.popleft()
+            del self._queue[idx]
+            # WFQ accounting: charge the admitted request's whole token
+            # budget to its (tier, tenant) lane — what "service" means here
+            self._wfq_served[(q.tier, q.tenant)] = self._wfq_served.get(
+                (q.tier, q.tenant), 0.0
+            ) + float(len(q.prompt) + (0 if q.prefill_only else q.max_new))
             blocks = hits + fresh
             try:
                 if q.payload is not None:
@@ -1698,6 +2083,7 @@ class ServingEngine:
             t_admit=time.perf_counter(), deadline_at=q.deadline_at,
             prefill_only=q.prefill_only, trace=q.trace,
             logprobs=[] if q.return_logprobs else None,
+            tenant=q.tenant, tier=q.tier,
         )
 
     def _bind_injected_slot(
@@ -1735,6 +2121,7 @@ class ServingEngine:
             blocks=blocks, hit_tokens=0, prefill_pos=p,
             t_submit=q.t_submit, t_admit=now, deadline_at=q.deadline_at,
             decoding=True, generated=[first], t_first=now, trace=q.trace,
+            tenant=q.tenant, tier=q.tier,
         )
         # the injected prefix is as matchable as a locally-computed one —
         # future affinity-routed requests hit it without another transfer
@@ -2015,10 +2402,33 @@ class ServingEngine:
 
     def _injection_tick(self, inj: Any) -> None:
         """Serving fault hooks (resilience/fault_injection.py): allocator
-        exhaustion, a slow/hung step, a mid-request engine exception. Each
-        is a cheap None-check when unarmed."""
+        exhaustion, a slow/hung step, a mid-request engine exception, a
+        noisy-neighbor tenant flood. Each is a cheap None-check when
+        unarmed."""
         c = inj.config
         step = self._step_counter
+        flood = inj.maybe_tenant_flood(step)
+        if flood is not None:
+            # noisy neighbor: one tenant slams the admission path with a
+            # burst of real submissions — quotas, tiering, and shedding are
+            # expected to contain it (tests/test_qos.py proves isolation).
+            # Rejections are accounted through the same seams a front uses.
+            tenant, n, tier = flood
+            for i in range(n):
+                rid = f"flood-{tenant}-{step}-{i}"
+                try:
+                    self.submit(
+                        [1, 2, 3], request_id=rid, max_new_tokens=4,
+                        tenant=tenant, tier=tier,
+                    )
+                except QuotaExceeded as e:
+                    self.record_quota(
+                        request_id=rid, tenant=e.tenant, tier=e.tier
+                    )
+                except QueueFull:
+                    self.record_shed(request_id=rid, tenant=tenant, tier=tier)
+                except EngineDraining:
+                    break
         if self._exhaust_hold is not None and step >= self._exhaust_hold[1]:
             self.pool.free(self._exhaust_hold[0])
             self._exhaust_hold = None

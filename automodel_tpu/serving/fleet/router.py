@@ -61,6 +61,51 @@ logger = logging.getLogger(__name__)
 
 RETRY_AFTER_S = 5
 
+# QoS tier order — MUST match serving/engine.py TIERS (not imported: the
+# engine module pulls jax, and a router pod needs no accelerator). Unknown
+# tiers rank as interactive here; the replica rejects them with a 400.
+_TIER_ORDER = {"interactive": 0, "batch": 1, "best_effort": 2}
+
+
+def _tier_label(tier: Any) -> str:
+    """Bounded metrics label for a request's tier (arbitrary client
+    strings must not mint label values)."""
+    return tier if tier in _TIER_ORDER else "interactive"
+
+
+def _tier_retry_after(tier: Any) -> int:
+    """Tier-scaled Retry-After advice (mirror of serving/server.py):
+    lower tiers back off longer, so freed capacity goes uphill first."""
+    return RETRY_AFTER_S * (_TIER_ORDER.get(tier, 0) + 1)
+
+
+def aggregate_qos(snapshots: Sequence[dict]) -> dict:
+    """Fleet-wide QoS rollup: sum the per-replica /stats ``qos`` blocks
+    (engine ``qos_snapshot``) into one queued/outcome view by tier and by
+    tenant. Pure — fleet-status and its unit tests call it directly."""
+    agg: dict = {
+        "enabled": False,
+        "queued_by_tier": {},
+        "queued_by_tenant": {},
+        "tiers": {},
+        "tenants": {},
+    }
+
+    def _merge(dst: dict, src: dict) -> None:
+        for k, v in (src or {}).items():
+            if isinstance(v, dict):
+                _merge(dst.setdefault(k, {}), v)
+            elif v is not None:
+                dst[k] = dst.get(k, 0) + v
+
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        agg["enabled"] = agg["enabled"] or bool(snap.get("enabled"))
+        for key in ("queued_by_tier", "queued_by_tenant", "tiers", "tenants"):
+            _merge(agg[key], snap.get(key) or {})
+    return agg
+
 
 class ReplicaUnreachable(RuntimeError):
     """TCP-level failure talking to a replica (dead pod, reset socket):
@@ -352,6 +397,17 @@ class RouterMetrics:
             "Scale events executed by the autoscaler, by direction "
             "(up | down)",
             "direction",
+        )
+        # multi-tenant QoS: terminal outcomes by tier — the router-front
+        # mirror of the replicas' automodel_serve_tier_requests, so
+        # per-tier burn is observable even for requests no replica ever
+        # accepted (unroutable)
+        self.tier_requests = self.registry.labeled_counter(
+            "automodel_route_tier_requests",
+            "Requests routed to a terminal response, by QoS tier and "
+            "outcome (ok | retried | unroutable | terminal "
+            "completion_reason)",
+            ("tier", "outcome"),
         )
         self.latency = self.registry.labeled_histogram(
             "automodel_route_request_seconds",
@@ -868,11 +924,19 @@ class Router:
         return n
 
     def place_decode(
-        self, chains: Sequence[int], exclude: Optional[set] = None
+        self,
+        chains: Sequence[int],
+        exclude: Optional[set] = None,
+        tier_idx: int = 0,
     ) -> tuple[Optional[_Replica], int]:
         """→ (replica, matched chain blocks). Affinity first (longest
         advertised prefix match, ties to the least loaded), else
-        power-of-two-choices on load."""
+        power-of-two-choices on load — except for non-interactive tiers
+        (``tier_idx > 0``), which take a FULL least-loaded scan: batch and
+        best_effort work is latency-insensitive, so it can afford the O(N)
+        probe to land on the true minimum and keep the lightly-loaded tail
+        of the fleet absorbing it instead of contending with interactive
+        traffic on a random pair."""
         cands = self._candidates(exclude or set(), "decode")
         if not cands:
             return None, 0
@@ -882,7 +946,7 @@ class Router:
             if best > 0:
                 tied = [r for m, r in matched if m == best]
                 return min(tied, key=lambda r: r.load), best
-        if len(cands) <= 2:
+        if tier_idx > 0 or len(cands) <= 2:
             return min(cands, key=lambda r: r.load), 0
         with self._lock:
             two = self._rng.sample(cands, 2)
@@ -1002,6 +1066,20 @@ class Router:
             prompt_chain(ids, self.config.block_size)
             if ids and self.config.affinity else []
         )
+        # multi-tenant QoS: tenant/tier ride the body (the HTTP front
+        # stashes the X-Tenant-Id / X-Tier headers there, same vehicle as
+        # traceparent) and forward to every replica in the retry chain
+        tenant = str(req["tenant"]) if req.get("tenant") is not None else None
+        tier = str(req["tier"]) if req.get("tier") is not None else None
+        tier_label = _tier_label(tier)
+        tier_idx = _TIER_ORDER.get(tier, 0)
+        # tier-aware retry budget: best_effort work is exactly the traffic
+        # the fleet sheds first under pressure — burning the full budget
+        # re-offering it to replicas that just refused it steals forward
+        # capacity from the tiers the operator ranked higher
+        retry_budget = self.config.retry_budget
+        if tier_idx >= _TIER_ORDER["best_effort"]:
+            retry_budget = min(retry_budget, 1)
         with self._lock:
             self.requests_total += 1
         tried: set = set()
@@ -1023,11 +1101,13 @@ class Router:
         from automodel_tpu.resilience.fault_injection import active_injector
 
         inj = active_injector()
-        while retries <= self.config.retry_budget:
+        while retries <= retry_budget:
             t_place0 = time.perf_counter()
             if inj is not None:
                 inj.maybe_trace_delay("placement")
-            rep, match = self.place_decode(chains, exclude=tried)
+            rep, match = self.place_decode(
+                chains, exclude=tried, tier_idx=tier_idx
+            )
             if tr is not None and rep is not None:
                 # the placement decision, incl. WHY: affinity (and how deep
                 # the match) vs pure load — one span per retry attempt
@@ -1144,6 +1224,7 @@ class Router:
                             body.get("completion_reason") or "prefill_failed"
                         )
                         self.metrics.requests.inc((pre.name, outcome))
+                        self.metrics.tier_requests.inc((tier_label, outcome))
                         self.metrics.latency.observe(
                             outcome, time.perf_counter() - t0
                         )
@@ -1161,6 +1242,8 @@ class Router:
                             "completion_reason": body.get(
                                 "completion_reason", "prefill_failed"
                             ),
+                            "tenant": tenant,
+                            "tier": tier_label,
                             "status": code,
                             "route_s": round(time.perf_counter() - t0, 6),
                             "ts": self._wall_ts(),
@@ -1175,10 +1258,18 @@ class Router:
             t_fwd0 = time.perf_counter()
             if inj is not None:
                 inj.maybe_trace_delay("forward")
+            # tenant/tier forward as headers AND body fields: headers keep
+            # the contract visible to middleboxes, the body survives
+            # header-stripping fronts
+            fwd_headers = dict(_trace_headers(fwd_ctx) or {})
+            if tenant is not None:
+                fwd_headers["X-Tenant-Id"] = tenant
+            if tier is not None:
+                fwd_headers["X-Tier"] = tier
             try:
                 code, body = _http_json(
                     rep.url + "/generate", fwd, fwd_timeout,
-                    headers=_trace_headers(fwd_ctx),
+                    headers=fwd_headers or None,
                 )
             except ReplicaUnreachable as e:
                 # TCP-level death: the replica never answered — always
@@ -1230,6 +1321,7 @@ class Router:
                     or body.get("reason") or f"http_{code}"
                 )
             self.metrics.requests.inc((rep.name, outcome))
+            self.metrics.tier_requests.inc((tier_label, outcome))
             self.metrics.latency.observe(outcome, time.perf_counter() - t0)
             if code == 200:
                 with self._lock:
@@ -1255,6 +1347,8 @@ class Router:
                 "prefill_replica": used_prefill,
                 "completion_reason": body.get("completion_reason"),
                 "n_generated": body.get("n_generated"),
+                "tenant": tenant,
+                "tier": tier_label,
                 "status": code,
                 "route_s": round(time.perf_counter() - t0, 6),
                 "ts": self._wall_ts(),
@@ -1266,6 +1360,7 @@ class Router:
         self.metrics.requests.inc(
             (rep.name if rep is not None else "none", "unroutable")
         )
+        self.metrics.tier_requests.inc((tier_label, "unroutable"))
         self.metrics.latency.observe("unroutable", time.perf_counter() - t0)
         with self._lock:
             self.unroutable_total += 1
@@ -1280,6 +1375,8 @@ class Router:
             "retries": retries,
             "prefix_match_blocks": match,
             "completion_reason": "unroutable",
+            "tenant": tenant,
+            "tier": tier_label,
             "status": 503,
             "route_s": round(time.perf_counter() - t0, 6),
             "ts": self._wall_ts(),
@@ -1290,6 +1387,7 @@ class Router:
                 f"retr{'y' if retries == 1 else 'ies'}: {last_error}"
             ),
             "retriable": True, "reason": "unroutable", "id": rid,
+            "tier": tier_label,
         }
 
     # -- fronts ---------------------------------------------------------------
@@ -1435,6 +1533,9 @@ class Router:
                     "busy_slots": r.stats.get("busy_slots"),
                     "block_occupancy": r.stats.get("block_occupancy"),
                     "shed_total": r.stats.get("shed_total"),
+                    "quota_total": r.stats.get("quota_total"),
+                    # multi-tenant QoS: this replica's qos_snapshot block
+                    "qos": r.stats.get("qos"),
                     "hot_prefixes": len(r.hot),
                     "kv_transfer_port": r.kv_port,
                     # fleet-status columns (serving/fleet/status.py)
@@ -1462,6 +1563,11 @@ class Router:
             }
             if self._rolling is not None:
                 out["rolling_update"] = dict(self._rolling)
+        # fleet-wide QoS rollup: the per-replica qos blocks summed — the
+        # numbers fleet-status's TIER/TENANT summary renders
+        out["qos"] = aggregate_qos(
+            [v.get("qos") for v in reps.values() if v.get("qos")]
+        )
         out["federation"] = self.federation.status()
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
@@ -1569,13 +1675,18 @@ def serve_router_http(
         def log_message(self, fmt, *args):
             logger.debug("router http: " + fmt, *args)
 
-        def _json(self, code: int, obj: dict, retry_after: bool = False):
+        def _json(self, code: int, obj: dict, retry_after: Any = False):
+            # retry_after: False = no header, True = flat advice, a
+            # number = that many seconds (tier-scaled QoS advice)
             body = (json.dumps(obj) + "\n").encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             if retry_after:
-                self.send_header("Retry-After", str(RETRY_AFTER_S))
+                secs = (
+                    RETRY_AFTER_S if retry_after is True else int(retry_after)
+                )
+                self.send_header("Retry-After", str(secs))
             self.end_headers()
             self.wfile.write(body)
 
@@ -1665,8 +1776,20 @@ def serve_router_http(
             tp = self.headers.get("traceparent")
             if tp is not None and "traceparent" not in req:
                 req["traceparent"] = tp
+            # tenant/tier headers stash into the body the same way (body
+            # fields from bare-bones clients stay authoritative)
+            for header, field in (("X-Tenant-Id", "tenant"), ("X-Tier", "tier")):
+                hv = self.headers.get(header)
+                if hv is not None and req.get(field) is None:
+                    req[field] = hv
             code, body = router.handle_generate(req)
-            self._json(code, body, retry_after=code == 503)
+            self._json(
+                code, body,
+                retry_after=(
+                    _tier_retry_after(req.get("tier"))
+                    if code in (429, 503) else False
+                ),
+            )
 
     server = ThreadingHTTPServer((host, port), Handler)
     return server

@@ -29,6 +29,7 @@ from automodel_tpu.serving.fleet.router import (
     ReplicaUnreachable,
     _http_json,
     _prefix_hit_rate,
+    aggregate_qos,
 )
 
 _COLUMNS = (
@@ -66,6 +67,7 @@ def _direct_snapshot(fcfg: FleetConfig, timeout_s: float) -> dict:
             "queue_depth": None, "busy_slots": None,
             "block_occupancy": None, "prefix_hit_rate": None,
             "spec_accept_rate": None, "shed_total": None,
+            "quota_total": None, "qos": None,
             "weights_version": None,
         }
         try:
@@ -79,6 +81,8 @@ def _direct_snapshot(fcfg: FleetConfig, timeout_s: float) -> dict:
                 "busy_slots": stats.get("busy_slots"),
                 "block_occupancy": stats.get("block_occupancy"),
                 "shed_total": stats.get("shed_total"),
+                "quota_total": stats.get("quota_total"),
+                "qos": stats.get("qos"),
                 "prefix_hit_rate": _prefix_hit_rate(stats),
                 "spec_accept_rate": stats.get("spec_accept_rate"),
                 "weights_version": stats.get("weights_version"),
@@ -89,6 +93,9 @@ def _direct_snapshot(fcfg: FleetConfig, timeout_s: float) -> dict:
     return {
         "replicas": reps,
         "replicas_ready": sum(1 for r in reps.values() if r["ready"]),
+        "qos": aggregate_qos(
+            [r.get("qos") for r in reps.values() if r.get("qos")]
+        ),
         "source": "direct",
     }
 
@@ -105,6 +112,63 @@ def _alerts_for(stats: dict) -> str:
     )
     parts = [f"{n}!" for n in firing] + [f"{n}?" for n in pending]
     return ",".join(parts) if parts else "ok"
+
+
+_TIER_ROWS = ("interactive", "batch", "best_effort")
+_TOP_TENANTS = 5
+
+
+def qos_summary_lines(stats: dict) -> list[str]:
+    """The TIER/TENANT summary block: per-tier queued/outcome rollups and
+    the top tenants by queued then shed. Empty when no replica reports an
+    enabled ``serving.qos`` (the table stays exactly as it was)."""
+    qos = stats.get("qos") or {}
+    if not qos.get("enabled"):
+        return []
+    lines = ["", "QoS tiers:"]
+    queued = qos.get("queued_by_tier") or {}
+    tiers = qos.get("tiers") or {}
+    header = ("TIER", "QUEUED", "DONE", "SHED", "QUOTA", "TIMEOUT")
+    rows = [header]
+    for tier in _TIER_ROWS:
+        c = tiers.get(tier) or {}
+        rows.append((
+            tier, str(queued.get(tier, 0)), str(c.get("completed", 0)),
+            str(c.get("shed", 0)), str(c.get("quota", 0)),
+            str(c.get("timeout", 0)),
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
+    lines += [
+        "  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        for r in rows
+    ]
+    tenants = qos.get("tenants") or {}
+    queued_t = qos.get("queued_by_tenant") or {}
+    names = sorted(
+        set(tenants) | set(queued_t),
+        key=lambda n: (
+            -queued_t.get(n, 0),
+            -(tenants.get(n) or {}).get("shed", 0),
+            n,
+        ),
+    )[:_TOP_TENANTS]
+    if names:
+        lines.append(f"QoS tenants (top {len(names)} by queued/shed):")
+        header = ("TENANT", "QUEUED", "DONE", "SHED", "QUOTA", "TIMEOUT")
+        rows = [header]
+        for name in names:
+            c = tenants.get(name) or {}
+            rows.append((
+                name, str(queued_t.get(name, 0)),
+                str(c.get("completed", 0)), str(c.get("shed", 0)),
+                str(c.get("quota", 0)), str(c.get("timeout", 0)),
+            ))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
+        lines += [
+            "  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+            for r in rows
+        ]
+    return lines
 
 
 def render_table(stats: dict) -> str:
@@ -145,6 +209,7 @@ def render_table(stats: dict) -> str:
                 f"threshold={'-' if th is None else f'{th:.4g}'} "
                 f"fired={st.get('fired_count', 0)}"
             )
+    lines.extend(qos_summary_lines(stats))
     ready = stats.get("replicas_ready")
     total = len(stats.get("replicas") or {})
     lines.append("")

@@ -56,8 +56,22 @@ logger = logging.getLogger(__name__)
 
 # Retry-After advice on retriable rejections (503s): long enough for a
 # drain to finish or a queue burst to clear, short enough to keep clients
-# live. A load balancer should prefer another replica immediately.
+# live. A load balancer should prefer another replica immediately. With
+# QoS enabled the advice is SCALED BY TIER (interactive 1×, batch 2×,
+# best_effort 3×): lower tiers back off longer, so the first capacity
+# that frees up goes to the tier the operator ranked higher.
 RETRY_AFTER_S = 5
+
+
+def _tier_retry_after(tier: Any) -> int:
+    """Tier-scaled Retry-After seconds (falls back to the flat advice on
+    an unknown/absent tier — a rejection must never raise over advice)."""
+    from automodel_tpu.serving.engine import tier_index
+
+    try:
+        return RETRY_AFTER_S * (tier_index(str(tier)) + 1)
+    except (TypeError, ValueError):
+        return RETRY_AFTER_S
 
 
 def _encode_prompt(req: dict, tokenizer: Any) -> list[int]:
@@ -146,6 +160,11 @@ STATS_METRIC_EQUIV = {
     # first readiness; boot_source is an info string)
     "time_to_ready_s": "automodel_serve_time_to_ready_seconds",
     "boot_source": None,
+    # multi-tenant QoS: over-quota rejections, plus the per-tier/per-tenant
+    # queue/served breakdown (an info dict — the numeric facts ride the
+    # labeled automodel_serve_tier_*/tenant_* families below)
+    "quota_total": "automodel_serve_requests_quota",
+    "qos": None,
     # live hot-swap (engine.swap_weights): monotonic weights generation —
     # the router reads per-replica version skew off this during a rolling
     # update
@@ -161,6 +180,11 @@ STATS_METRICS_ONLY = (
     "automodel_serve_queue_seconds",
     "automodel_serve_stage_seconds",
     "automodel_serve_generated_tokens",
+    # QoS labeled families: per-tier/per-tenant breakdowns whose /stats
+    # shape is the "qos" info dict, not a single number
+    "automodel_serve_tier_requests",
+    "automodel_serve_tenant_requests",
+    "automodel_serve_tier_ttft_seconds",
 )
 
 
@@ -212,6 +236,10 @@ def stats_snapshot(engine: Any) -> dict:
         "boot_source": engine.boot_source,
         # live hot-swap: which weights generation this replica serves
         "weights_version": engine.weights_version,
+        # multi-tenant QoS: over-quota rejections + per-tier/per-tenant
+        # queue and outcome breakdown (fleet-status renders these)
+        "quota_total": engine.quota_total,
+        "qos": engine.qos_snapshot(),
     }
 
 
@@ -224,6 +252,8 @@ def _reason_status(reason: str) -> int:
         return 200
     if reason == "timeout":
         return 504  # the client's own budget expired — not retriable
+    if reason == "quota":
+        return 429  # over-quota: retriable AFTER Retry-After, not elsewhere
     return 503  # draining / cancelled / engine_stall / engine_error: retry
 
 
@@ -268,7 +298,7 @@ class _EngineLoop:
         through their own entry points but share this wait machinery.
         ``trace`` is the propagated traceparent context (the engine mints
         its root span as a child of it)."""
-        from automodel_tpu.serving.engine import QueueFull
+        from automodel_tpu.serving.engine import QueueFull, QuotaExceeded
 
         ev = threading.Event()
         with self.lock:
@@ -287,12 +317,26 @@ class _EngineLoop:
                         trace=trace,
                         kv_peer=kvp if isinstance(kvp, dict) else None,
                         return_logprobs=bool(req.get("return_logprobs")),
+                        tenant=req.get("tenant"),
+                        tier=req.get("tier"),
                     )
             except QueueFull:
                 # the HTTP front sheds immediately — a blocked handler
                 # thread per queued-out client is exactly the unbounded
-                # latency shedding exists to prevent
-                self.engine.record_shed(prompt_ids=prompt_ids)
+                # latency shedding exists to prevent. ONE tier-labeled
+                # record per give-up, never per retry (tests/test_qos.py
+                # pins this seam).
+                self.engine.record_shed(
+                    prompt_ids=prompt_ids,
+                    tenant=req.get("tenant"), tier=req.get("tier"),
+                )
+                raise
+            except QuotaExceeded as e:
+                # same seam doctrine as record_shed: submit raised without
+                # a record, the answering front counts exactly one
+                self.engine.record_quota(
+                    prompt_ids=prompt_ids, tenant=e.tenant, tier=e.tier
+                )
                 raise
             self._events[rid] = ev
         if not ev.wait(timeout=timeout_s):
@@ -365,16 +409,48 @@ def serve_http(
             logger.debug("http: " + fmt, *args)
 
         def _json(
-            self, code: int, obj: dict, retry_after: bool = False
+            self, code: int, obj: dict, retry_after: Any = False
         ) -> None:
+            # retry_after: False = no header, True = flat advice, a
+            # number = that many seconds (the tier-scaled QoS advice)
             body = (json.dumps(obj) + "\n").encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             if retry_after:
-                self.send_header("Retry-After", str(RETRY_AFTER_S))
+                secs = (
+                    RETRY_AFTER_S if retry_after is True else int(retry_after)
+                )
+                self.send_header("Retry-After", str(secs))
             self.end_headers()
             self.wfile.write(body)
+
+        def _retry_advice(self, req: dict) -> int:
+            """Tier-scaled Retry-After for this request: explicit tier,
+            else the tenant's configured default, else the global one."""
+            qos = engine.config.qos
+            tier = req.get("tier")
+            if tier is None:
+                tenant = req.get("tenant")
+                tier = (
+                    qos.tier_for(str(tenant))
+                    if tenant is not None else qos.default_tier
+                )
+            return _tier_retry_after(tier)
+
+        def _stash_qos_headers(self, req: dict) -> None:
+            """The router forwards tenant/tier as X-Tenant-Id / X-Tier
+            headers (same vehicle as traceparent); body fields from
+            bare-bones clients win so a direct caller stays authoritative
+            over a middlebox."""
+            if req.get("tenant") is None:
+                h = self.headers.get("X-Tenant-Id")
+                if h is not None:
+                    req["tenant"] = h
+            if req.get("tier") is None:
+                h = self.headers.get("X-Tier")
+                if h is not None:
+                    req["tier"] = h
 
         def do_GET(self):
             if self.path == "/metrics":
@@ -698,10 +774,16 @@ def serve_http(
                 })
             if self.path != "/generate":
                 return self._json(404, {"error": f"unknown path {self.path}"})
-            from automodel_tpu.serving.engine import EngineDraining, QueueFull
+            from automodel_tpu.serving.engine import (
+                EngineDraining,
+                QueueFull,
+                QuotaExceeded,
+            )
 
+            req = {}
             try:
                 req = self._read_req()
+                self._stash_qos_headers(req)
                 ids = _encode_prompt(req, tokenizer)
                 ctx = self._trace_ctx(req)
                 submit = None
@@ -741,13 +823,24 @@ def serve_http(
                 # connection, never an unbounded queue
                 return self._json(
                     503, {"error": str(e), "retriable": True, "reason": "shed"},
-                    retry_after=True,
+                    retry_after=self._retry_advice(req),
+                )
+            except QuotaExceeded as e:
+                # over-quota: retriable after the (tier-scaled) Retry-After
+                # on THIS replica — a 429, not a 503, so load balancers
+                # don't burn retry budget hopping replicas that share the
+                # same per-tenant policy
+                return self._json(
+                    429,
+                    {"error": str(e), "retriable": True, "reason": "quota",
+                     "tenant": e.tenant, "tier": e.tier},
+                    retry_after=_tier_retry_after(e.tier),
                 )
             except EngineDraining as e:
                 return self._json(
                     503,
                     {"error": str(e), "retriable": True, "reason": "draining"},
-                    retry_after=True,
+                    retry_after=self._retry_advice(req),
                 )
             except TimeoutError as e:
                 return self._json(504, {"error": str(e)})
@@ -759,7 +852,10 @@ def serve_http(
                 out["id"] = req["id"]
             reason = rec.get("completion_reason", "length")
             code = _reason_status(reason)
-            self._json(code, out, retry_after=code == 503)
+            self._json(
+                code, out,
+                retry_after=self._retry_advice(req) if code == 503 else False,
+            )
 
     server = ThreadingHTTPServer((host, port), Handler)
     server._engine_loop = loop  # for the caller's shutdown path
@@ -1193,7 +1289,11 @@ def _serve_stdin(engine, tokenizer, serve_cfg) -> int:
     within the grace."""
     import queue as queue_mod
 
-    from automodel_tpu.serving.engine import EngineDraining, QueueFull
+    from automodel_tpu.serving.engine import (
+        EngineDraining,
+        QueueFull,
+        QuotaExceeded,
+    )
 
     drain_cfg = serve_cfg.drain
     handler = _install_drain_handler(engine)
@@ -1241,6 +1341,8 @@ def _serve_stdin(engine, tokenizer, serve_cfg) -> int:
                         max_queue_wait_s=req.get("max_queue_wait_s"),
                         trace=ctx,
                         return_logprobs=bool(req.get("return_logprobs")),
+                        tenant=req.get("tenant"),
+                        tier=req.get("tier"),
                     )
                     break
                 except QueueFull:
@@ -1257,12 +1359,28 @@ def _serve_stdin(engine, tokenizer, serve_cfg) -> int:
                     ):
                         raise
         except QueueFull as e:
+            # exactly ONE tier-labeled shed per given-up request, however
+            # many backpressure retries the loop above absorbed
             engine.record_shed(
-                request_id=str(rid) if rid is not None else None
+                request_id=str(rid) if rid is not None else None,
+                tenant=req.get("tenant"), tier=req.get("tier"),
             )
             err = {
                 "error": f"line {lineno}: {e}",
                 "retriable": True, "reason": "shed",
+            }
+            if rid is not None:
+                err["id"] = rid
+            print(json.dumps(err), flush=True)
+        except QuotaExceeded as e:
+            engine.record_quota(
+                request_id=str(rid) if rid is not None else None,
+                tenant=e.tenant, tier=e.tier,
+            )
+            err = {
+                "error": f"line {lineno}: {e}",
+                "retriable": True, "reason": "quota",
+                "tenant": e.tenant, "tier": e.tier,
             }
             if rid is not None:
                 err["id"] = rid

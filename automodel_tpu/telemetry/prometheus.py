@@ -468,6 +468,31 @@ class ServingMetrics:
             "automodel_serve_requests_timeout",
             "Requests cancelled by deadline_s / max_queue_wait_s expiry",
         )
+        # multi-tenant QoS (serving.qos: — docs/serving.md "Multi-tenant
+        # QoS"): per-tier / per-tenant terminal outcomes plus the per-tier
+        # ttft histogram the per-tier SLO burn objectives judge. Labeled
+        # families federate into automodel_fleet_* with labels intact.
+        self.quota = r.counter(
+            "automodel_serve_requests_quota",
+            "Requests rejected by a tenant token-bucket quota",
+        )
+        self.tier_requests = r.labeled_counter(
+            "automodel_serve_tier_requests",
+            "Terminal requests by QoS tier and completion_reason",
+            ("tier", "reason"),
+        )
+        self.tenant_requests = r.labeled_counter(
+            "automodel_serve_tenant_requests",
+            "Terminal requests by tenant and completion_reason",
+            ("tenant", "reason"),
+        )
+        self.tier_ttft = r.labeled_histogram(
+            "automodel_serve_tier_ttft_seconds",
+            "Time from submit to first token by QoS tier, per completed "
+            "request",
+            "tier",
+            buckets=LATENCY_BUCKETS,
+        )
         self.stalls = r.counter(
             "automodel_serve_engine_stalls",
             "Wedged decode/prefill steps detected by the engine watchdog",
@@ -591,6 +616,22 @@ class ServingMetrics:
                 self.timeouts.inc()
             elif reason == "shed":
                 self.shed.inc()
+            elif reason == "quota":
+                self.quota.inc()
+
+    def observe_qos(self, rec: dict) -> None:
+        """Per-terminal tier/tenant observation (every serve_request record
+        carries both; records without them — engine events — no-op). The
+        labeled metrics take their own per-metric locks."""
+        tier = rec.get("tier")
+        tenant = rec.get("tenant")
+        reason = rec.get("completion_reason")
+        if not tier or not tenant or not reason:
+            return
+        self.tier_requests.inc((str(tier), str(reason)))
+        self.tenant_requests.inc((str(tenant), str(reason)))
+        if isinstance(rec.get("ttft_s"), (int, float)):
+            self.tier_ttft.observe(str(tier), rec["ttft_s"])
 
     def observe_engine_event(self, reason: str) -> None:
         """Once per engine-level recovery (pool rebuild after a stall or a

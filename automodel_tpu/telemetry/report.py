@@ -300,6 +300,15 @@ def lint_metrics_jsonl(path: str) -> tuple[list[dict], list[str]]:
                     "monotonic differences and cannot go backwards; a "
                     "negative value means mixed wall/monotonic clocks"
                 )
+        if rec.get("event") in ("serve_request", "route_request"):
+            # multi-tenant QoS labels ride the request records as plain
+            # strings; anything else means a foreign writer or corruption
+            for k in ("tenant", "tier"):
+                v = rec.get(k)
+                if v is not None and not isinstance(v, str):
+                    problems.append(
+                        f"line {i}: {k} is not a string: {v!r}"
+                    )
         if rec.get("event") == "slo_alert":
             if not isinstance(rec.get("slo"), str) or not rec.get("slo"):
                 problems.append(f"line {i}: slo_alert record has no slo name")
@@ -490,9 +499,37 @@ def summarize_metrics(records: list[dict]) -> dict[str, Any]:
             for reason, key in (
                 ("shed", "serve_shed"),
                 ("timeout", "serve_timeouts"),
+                ("quota", "serve_quota"),
             ):
                 if reasons.get(reason):
                     out[key] = reasons[reason]
+        # multi-tenant QoS rollups: per-tier shed/timeout histograms (the
+        # overload story — which tier paid for the pressure) and the
+        # per-tenant quota bill
+        by_tier: dict[str, dict[str, int]] = {}
+        for r in serves:
+            tier, cr = r.get("tier"), r.get("completion_reason")
+            if isinstance(tier, str) and isinstance(cr, str):
+                c = by_tier.setdefault(tier, {})
+                c[cr] = c.get(cr, 0) + 1
+        for reason, key in (
+            ("shed", "serve_shed_by_tier"),
+            ("timeout", "serve_timeouts_by_tier"),
+        ):
+            hist = {
+                t: c[reason] for t, c in sorted(by_tier.items())
+                if c.get(reason)
+            }
+            if hist:
+                out[key] = hist
+        quotas: dict[str, int] = {}
+        for r in serves:
+            if r.get("completion_reason") == "quota" and isinstance(
+                r.get("tenant"), str
+            ):
+                quotas[r["tenant"]] = quotas.get(r["tenant"], 0) + 1
+        if quotas:
+            out["serve_quota_by_tenant"] = dict(sorted(quotas.items()))
     routes = [r for r in records if r.get("event") == "route_request"]
     if routes:
         # fleet router records: every routed request's terminal outcome —

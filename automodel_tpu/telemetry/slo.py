@@ -27,6 +27,12 @@ fleet series (telemetry/federation.py):
           kind: gauge
           metric: automodel_train_goodput_fraction
           min_value: 0.8
+        - name: ttft_p99_interactive  # per-tier: one labeled child
+          kind: latency
+          metric: automodel_serve_tier_ttft_seconds
+          labels: {tier: interactive}
+          q: 0.99
+          threshold_s: 1.0
 
 Burn-rate math (docs/observability.md "Fleet health plane"): a latency
 objective ``pXX < T`` grants an error budget of ``1 - q`` requests over
@@ -96,8 +102,27 @@ class SLOObjective:
     min_value: Optional[float] = None
     max_value: Optional[float] = None
     aggregate: str = "sum"  # which fleet series a gauge objective reads
+    # optional label selector: a per-tier / per-tenant objective watches
+    # one labeled child of the fleet family (e.g.
+    # metric: automodel_serve_tier_ttft_seconds, labels: {tier: interactive})
+    labels: Any = None
 
     def __post_init__(self):
+        if self.labels is not None:
+            # accept any mapping shape the config loader hands over (plain
+            # dict, config node, pre-canonical tuple) — everything else is
+            # a typo'd selector
+            items = getattr(self.labels, "items", None)
+            if callable(items):
+                items = items()
+            elif isinstance(self.labels, (tuple, list)):
+                items = self.labels
+            else:
+                raise TypeError(
+                    f"slo objective {self.name}: labels must be a mapping, "
+                    f"got {type(self.labels).__name__}"
+                )
+            self.labels = tuple(sorted((str(k), str(v)) for k, v in items))
         if not self.name:
             raise TypeError("slo objective: empty name")
         if self.kind not in _KINDS:
@@ -291,8 +316,11 @@ class SLOEngine:
     ) -> tuple[bool, Optional[float]]:
         """→ (window breached, reported value) for one window."""
         fed = self.federation
+        labels = o.labels or ()
         if o.kind == "latency":
-            h = fed.histogram_increase(fleet_name(o.metric), window_s, now)
+            h = fed.histogram_increase(
+                fleet_name(o.metric), window_s, now, labels=labels
+            )
             if h is None:
                 return False, None
             frac = _fraction_over(h, o.threshold_s)
@@ -304,12 +332,12 @@ class SLOEngine:
             num = den = 0.0
             saw = False
             for fam in o.numerator:
-                inc = fed.increase(fleet_name(fam), window_s, now)
+                inc = fed.increase(fleet_name(fam), window_s, now, labels=labels)
                 if inc is not None:
                     num += inc
                     saw = True
             for fam in o.denominator:
-                inc = fed.increase(fleet_name(fam), window_s, now)
+                inc = fed.increase(fleet_name(fam), window_s, now, labels=labels)
                 if inc is not None:
                     den += inc
                     saw = True
@@ -327,7 +355,7 @@ class SLOEngine:
         family = fleet_name(o.metric)
         if o.aggregate == "max":
             family += "_max"
-        v = fed.latest(family)
+        v = fed.latest(family, labels=labels)
         if v is None:
             return False, None
         bad = (o.min_value is not None and v < o.min_value) or (

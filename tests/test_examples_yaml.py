@@ -150,12 +150,17 @@ def test_example_yaml_parses_and_dry_instantiates(path):
         from automodel_tpu.serving.engine import (
             KVSpillConfig,
             KVTransferConfig,
+            QoSConfig,
+            TenantConfig,
             WarmStartConfig,
         )
 
         assert isinstance(sc.kv_transfer, KVTransferConfig)
         assert isinstance(sc.kv_spill, KVSpillConfig)
         assert isinstance(sc.warm_start, WarmStartConfig)
+        assert isinstance(sc.qos, QoSConfig)
+        for t in sc.qos.tenants.values():
+            assert isinstance(t, TenantConfig)
         for key, sub in (
             ("limits", LimitsConfig),
             ("drain", DrainConfig),
@@ -164,6 +169,7 @@ def test_example_yaml_parses_and_dry_instantiates(path):
             ("kv_transfer", KVTransferConfig),
             ("kv_spill", KVSpillConfig),
             ("warm_start", WarmStartConfig),
+            ("qos", QoSConfig),
         ):
             if srv.get(key) is not None:
                 sub.from_dict(dict(srv[key]))
@@ -345,6 +351,26 @@ def test_config_dataclasses_reject_unknown_keys():
         ServeConfig.from_dict({"warm_start": {"peer_hostt": "x"}})
     with pytest.raises(ValueError):  # host without port is half an address
         ServeConfig.from_dict({"warm_start": {"peer_host": "127.0.0.1"}})
+    with pytest.raises(TypeError):  # qos: strict at the section level
+        ServeConfig.from_dict({"qos": {"default_tierr": "batch"}})
+    with pytest.raises(TypeError):  # ... and through the tenants map
+        ServeConfig.from_dict(
+            {"qos": {"tenants": {"a": {"weightt": 2.0}}}}
+        )
+    with pytest.raises(ValueError):  # a typo'd tier is a scheduling bug
+        ServeConfig.from_dict({"qos": {"default_tier": "interactivee"}})
+    with pytest.raises(ValueError):
+        ServeConfig.from_dict(
+            {"qos": {"tenants": {"a": {"tier": "batchh"}}}}
+        )
+    with pytest.raises(ValueError):  # quotas must be positive or null
+        ServeConfig.from_dict(
+            {"qos": {"tenants": {"a": {"requests_per_s": 0}}}}
+        )
+    with pytest.raises(ValueError):  # tenant names become metrics labels
+        ServeConfig.from_dict(
+            {"qos": {"tenants": {'bad"name': {}}}}
+        )
     from automodel_tpu.serving.fleet.autoscale import AutoscaleConfig
 
     with pytest.raises(TypeError):
@@ -386,6 +412,18 @@ def test_config_dataclasses_reject_unknown_keys():
         )
     with pytest.raises(TypeError):  # slow window must cover the fast one
         SLOConfig.from_dict({"fast_window_s": 60.0, "slow_window_s": 10.0})
+    with pytest.raises(TypeError):  # labels must be a mapping
+        SLOConfig.from_dict(
+            {"objectives": [{"name": "x", "kind": "latency", "metric": "m",
+                             "threshold_s": 1.0, "labels": "tier"}]}
+        )
+    labeled = SLOConfig.from_dict(
+        {"objectives": [{"name": "x", "kind": "latency", "metric": "m",
+                         "threshold_s": 1.0,
+                         "labels": {"tier": "interactive"}}]}
+    ).objectives[0]
+    # canonical form: the sorted label tuple the federation keys series by
+    assert labeled.labels == (("tier", "interactive"),)
     from automodel_tpu.telemetry.tracing import TracingConfig
 
     with pytest.raises(TypeError):

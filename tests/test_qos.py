@@ -1,0 +1,858 @@
+"""Multi-tenant QoS acceptance (docs/serving.md "Multi-tenant QoS").
+
+Engine side: tiered admission (EDF within tier, WFQ across tenants,
+aging promotion), per-tenant token-bucket quotas with the retriable
+`quota` reason, strictly lowest-tier-first overload shedding, the
+record_shed/record_quota one-record-per-give-up seams, and the
+drain-beats-every-tier rule. The overload e2e drives ~2x-capacity
+Poisson mixed-tier load with invariants audited after every scheduler
+event; the noisy-neighbor chaos leg floods one tenant via
+fault_injection `serve_tenant_flood` and proves isolation.
+
+Fleet side: per-tier/per-tenant /metrics labels, federation rollups of
+the labeled families, the per-tier SLO burn objective (labels:
+selector), the fleet-status TIER/TENANT tables, the report summary's
+per-tier histograms, and the docs reason-table drift guard.
+
+All CPU-fast, tier-1."""
+
+import json
+import re
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from automodel_tpu.auto_model import AutoModel
+from automodel_tpu.generation.engine import GenerationConfig
+from automodel_tpu.models.common.config import BackendConfig, TransformerConfig
+from automodel_tpu.resilience import fault_injection as fi
+from automodel_tpu.serving.engine import (
+    COMPLETION_REASONS,
+    TIERS,
+    EngineDraining,
+    QoSConfig,
+    QueueFull,
+    QuotaExceeded,
+    ServeConfig,
+    ServingEngine,
+    TenantConfig,
+    tier_index,
+)
+from automodel_tpu.telemetry.federation import (
+    Federation,
+    fleet_name,
+    parse_exposition,
+)
+from automodel_tpu.telemetry.prometheus import MetricsRegistry
+
+FP32 = BackendConfig(attn="sdpa", param_dtype="float32", compute_dtype="float32")
+
+DOCS = Path(__file__).resolve().parent.parent / "docs"
+
+
+@pytest.fixture(autouse=True)
+def _clear_injector():
+    yield
+    fi.activate(None)
+
+
+def _tiny_auto():
+    from automodel_tpu.models.llama import LlamaForCausalLM
+
+    model = LlamaForCausalLM(
+        TransformerConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+            num_heads=4, num_kv_heads=2, head_dim=8,
+        ),
+        FP32,
+    )
+    return AutoModel(
+        model=model, params=model.init(jax.random.key(0)),
+        adapter=None, mesh_ctx=None,
+    )
+
+
+def _tenants(**extra):
+    base = {
+        "chat": TenantConfig(tier="interactive", weight=2.0),
+        "ebatch": TenantConfig(tier="batch"),
+        "scraper": TenantConfig(tier="best_effort"),
+    }
+    base.update(extra)
+    return base
+
+
+def _qos_engine(records, qos=None, **serve_over):
+    serve_over.setdefault("slots", 2)
+    return ServingEngine(
+        _tiny_auto(),
+        ServeConfig(
+            block_size=4, num_blocks=48, prefill_chunk=4, max_seq_len=32,
+            qos=qos if qos is not None else QoSConfig(
+                enabled=True, tenants=_tenants()
+            ),
+            **serve_over,
+        ),
+        GenerationConfig(max_new_tokens=4, greedy=True),
+        on_record=records.append,
+    )
+
+
+# ---------------------------------------------------------------------------
+# tier order / config
+# ---------------------------------------------------------------------------
+
+
+def test_tier_order_and_unknown_tier_rejected():
+    assert TIERS == ("interactive", "batch", "best_effort")
+    assert [tier_index(t) for t in TIERS] == [0, 1, 2]
+    with pytest.raises(ValueError, match="unknown QoS tier"):
+        tier_index("interactivee")
+    # a submit typo is the same loud error, not a silent demotion
+    records = []
+    srv = _qos_engine(records)
+    with pytest.raises(ValueError, match="unknown QoS tier"):
+        srv.submit([1, 2, 3], tier="premium")
+    assert records == [] and srv.queue_depth == 0
+
+
+def test_qos_off_is_fifo():
+    """Disabled QoS must schedule exactly as the pre-QoS engine: the
+    selection is always the queue head, whatever tiers requests name."""
+    records = []
+    srv = _qos_engine(records, qos=QoSConfig(enabled=False), slots=1)
+    rids = [
+        srv.submit([1, 2, 3], tier=t)
+        for t in ("best_effort", "batch", "interactive", "best_effort")
+    ]
+    while srv.queue_depth:
+        assert srv._select_queued(time.perf_counter()) == 0
+        srv.step()
+    srv.run()
+    for rec in records:
+        assert rec["completion_reason"] in ("stop", "length")
+    assert sorted(r["request_id"] for r in records) == sorted(rids)
+
+
+# ---------------------------------------------------------------------------
+# admission ordering: tier -> WFQ -> EDF -> FIFO, aging promotion
+# ---------------------------------------------------------------------------
+
+
+def test_tiered_admission_order_and_edf():
+    records = []
+    srv = _qos_engine(records)
+    now = time.perf_counter()
+    srv.submit([1, 2, 3], request_id="be", tenant="scraper", t_submit=now)
+    srv.submit([1, 2, 3], request_id="b-late", tenant="ebatch",
+               t_submit=now, deadline_s=100.0)
+    srv.submit([1, 2, 3], request_id="b-soon", tenant="ebatch",
+               t_submit=now + 0.001, deadline_s=5.0)
+    srv.submit([1, 2, 3], request_id="i", tenant="chat", t_submit=now)
+    q = list(srv._queue)
+    # highest tier first, regardless of submission order
+    assert q[srv._select_queued(now + 0.01)].rid == "i"
+    srv._queue.remove(q[3])
+    # within a tier: EDF beats FIFO (b-soon arrived later but is due first)
+    q = list(srv._queue)
+    assert q[srv._select_queued(now + 0.01)].rid == "b-soon"
+    srv.run()  # drain so the engine ends idle
+
+
+def test_wfq_least_normalized_service_wins():
+    records = []
+    qos = QoSConfig(enabled=True, tenants={
+        "heavy": TenantConfig(tier="interactive", weight=2.0),
+        "light": TenantConfig(tier="interactive", weight=1.0),
+    })
+    srv = _qos_engine(records, qos=qos)
+    now = time.perf_counter()
+    srv.submit([1, 2, 3], request_id="l", tenant="light", t_submit=now)
+    srv.submit([1, 2, 3], request_id="h", tenant="heavy", t_submit=now + 0.001)
+    # equal raw service 100: heavy's normalized share (100/2) is below
+    # light's (100/1), so heavy is next despite submitting later
+    srv._wfq_served[("interactive", "heavy")] = 100.0
+    srv._wfq_served[("interactive", "light")] = 100.0
+    q = list(srv._queue)
+    assert q[srv._select_queued(now + 0.01)].rid == "h"
+    srv.run()
+
+
+def test_aging_promotes_to_top_tier():
+    records = []
+    srv = _qos_engine(records)
+    now = time.perf_counter()
+    # a best_effort request queued past aging_s orders as tier 0 — and
+    # wins the FIFO tiebreak against fresh interactive work
+    srv.submit([1, 2, 3], request_id="old-be", tenant="scraper",
+               t_submit=now - srv.config.qos.aging_s - 1.0)
+    srv.submit([1, 2, 3], request_id="i", tenant="chat", t_submit=now)
+    old = next(q for q in srv._queue if q.rid == "old-be")
+    assert old.tier_idx == 2
+    assert srv._effective_tier(old, now) == 0
+    q = list(srv._queue)
+    assert q[srv._select_queued(now)].rid == "old-be"
+    srv.run()
+
+
+# ---------------------------------------------------------------------------
+# quotas: token buckets, the retriable `quota` reason, the record seam
+# ---------------------------------------------------------------------------
+
+
+def test_quota_buckets_reject_and_refill():
+    records = []
+    qos = QoSConfig(enabled=True, tenants={
+        "limited": TenantConfig(
+            tier="interactive", requests_per_s=1.0, burst_s=1.0
+        ),
+        "decoder": TenantConfig(
+            tier="batch", decode_tokens_per_s=8.0, burst_s=1.0
+        ),
+    })
+    srv = _qos_engine(records, qos=qos)
+    t0 = time.perf_counter()
+    # admission bucket: capacity 1 -> second take at the same instant fails
+    srv.submit([1, 2, 3], tenant="limited", t_submit=t0 - 10.0)
+    with pytest.raises(QuotaExceeded) as ei:
+        srv.submit([1, 2, 3], tenant="limited", t_submit=t0 - 10.0)
+    assert ei.value.tenant == "limited" and ei.value.tier == "interactive"
+    # submit raised RECORDLESS: retries must not inflate any counter
+    assert srv.quota_total == 0 and records == []
+    # 9s later the bucket refilled -> admitted again
+    srv.submit([1, 2, 3], tenant="limited", t_submit=t0 - 1.0)
+    # decode budget is charged worst-case (max_new) at admission
+    srv.submit([1, 2], tenant="decoder", max_new_tokens=6,
+               t_submit=t0 - 0.5)
+    with pytest.raises(QuotaExceeded) as ei:
+        srv.submit([1, 2], tenant="decoder", max_new_tokens=6,
+                   t_submit=t0 - 0.49)
+    assert ei.value.tenant == "decoder" and ei.value.tier == "batch"
+    # the answering front gives up -> exactly one labeled quota record
+    rec = srv.record_quota(
+        request_id="gave-up", tenant="decoder", tier="batch"
+    )
+    assert rec["completion_reason"] == "quota" and rec["retriable"] is True
+    assert rec["tenant"] == "decoder" and rec["tier"] == "batch"
+    assert srv.quota_total == 1
+    assert [r["request_id"] for r in records
+            if r["completion_reason"] == "quota"] == ["gave-up"]
+    srv.run()
+    # the quota landed on /metrics: the plain counter and both labeled
+    # families (quota is event-driven — sync() must not double it)
+    srv.metrics.sync(srv)
+    fams = parse_exposition(srv.metrics.registry.render())
+    assert fams["automodel_serve_requests_quota"].samples[()] == 1.0
+    assert fams["automodel_serve_tier_requests"].samples[
+        (("reason", "quota"), ("tier", "batch"))
+    ] == 1.0
+    assert fams["automodel_serve_tenant_requests"].samples[
+        (("reason", "quota"), ("tenant", "decoder"))
+    ] == 1.0
+    assert srv.qos_snapshot()["tenants"]["decoder"]["quota"] == 1
+
+
+# ---------------------------------------------------------------------------
+# overload shedding: strictly lowest-tier-first
+# ---------------------------------------------------------------------------
+
+
+def test_shed_lowest_tier_first_and_newcomer_refused():
+    records = []
+    srv = _qos_engine(records, max_queue=3)
+    now = time.perf_counter()
+    srv.submit([1, 2, 3], request_id="be-1", tenant="scraper", t_submit=now)
+    srv.submit([1, 2, 3], request_id="b-1", tenant="ebatch",
+               t_submit=now + 0.001)
+    srv.submit([1, 2, 3], request_id="be-2", tenant="scraper",
+               t_submit=now + 0.002)
+    # full queue + higher-tier newcomer: the LATEST-submitted lowest-tier
+    # entry is evicted with a terminal shed record (it was accepted — the
+    # no-silent-drop contract owes it one)
+    srv.submit([1, 2, 3], request_id="i-1", tenant="chat")
+    assert srv.shed_total == 1
+    shed = [r for r in records if r["completion_reason"] == "shed"]
+    assert [r["request_id"] for r in shed] == ["be-2"]
+    assert shed[0]["tier"] == "best_effort"
+    assert shed[0]["tenant"] == "scraper"
+    assert shed[0]["retriable"] is True
+    rids = {q.rid for q in srv._queue}
+    assert "i-1" in rids and "be-2" not in rids
+    # equal tier is NOT strictly lower: a best_effort newcomer against a
+    # queue whose worst entry is best_effort is itself refused, recordless
+    with pytest.raises(QueueFull):
+        srv.submit([1, 2, 3], request_id="be-3", tenant="scraper")
+    assert srv.shed_total == 1 and len(records) == 1
+    # batch newcomer still evicts the remaining best_effort entry
+    srv.submit([1, 2, 3], request_id="b-2", tenant="ebatch")
+    assert srv.shed_total == 2
+    assert records[-1]["request_id"] == "be-1"
+    assert records[-1]["tier"] == "best_effort"
+    # nothing queued below batch -> a batch newcomer is refused
+    with pytest.raises(QueueFull):
+        srv.submit([1, 2, 3], request_id="b-3", tenant="ebatch")
+    srv.run()
+
+
+def test_record_shed_exactly_once_after_retries():
+    """The record seam pin: a front absorbing backpressure by retrying
+    submit() sees recordless QueueFull every time; only its final
+    give-up (record_shed) produces the one tier-labeled record."""
+    records = []
+    srv = _qos_engine(records, max_queue=1)
+    srv.submit([1, 2, 3], tenant="chat")
+    for _ in range(3):  # the retrying front: 3 attempts, same tier
+        with pytest.raises(QueueFull):
+            srv.submit([1, 2, 3], tenant="chat")
+    assert srv.shed_total == 0 and records == []
+    rec = srv.record_shed(
+        request_id="gave-up", tenant="scraper", tier="best_effort"
+    )
+    assert rec["completion_reason"] == "shed" and rec["retriable"] is True
+    assert rec["tier"] == "best_effort" and rec["tenant"] == "scraper"
+    assert srv.shed_total == 1
+    assert len([r for r in records if r["completion_reason"] == "shed"]) == 1
+    srv.run()
+    srv.metrics.sync(srv)
+    fams = parse_exposition(srv.metrics.registry.render())
+    # ONE shed on every surface — not one per retry attempt
+    assert fams["automodel_serve_requests_shed"].samples[()] == 1.0
+    assert fams["automodel_serve_tier_requests"].samples[
+        (("reason", "shed"), ("tier", "best_effort"))
+    ] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# drain: no tier jumps it
+# ---------------------------------------------------------------------------
+
+
+def test_drain_rejects_every_tier_and_flushes_queue_retriable():
+    records = []
+    srv = _qos_engine(records)
+    accepted = [
+        srv.submit([1, 2, 3], request_id="q-i", tenant="chat"),
+        srv.submit([1, 2, 3], request_id="q-b", tenant="ebatch"),
+    ]
+    srv.begin_drain()
+    # the draining check comes BEFORE any priority handling: the highest
+    # tier is refused exactly like everything else, recordless
+    with pytest.raises(EngineDraining):
+        srv.submit([1, 2, 3], request_id="jumper", tenant="chat",
+                   tier="interactive")
+    assert records == []
+    done = srv.step()
+    drained = {r["request_id"]: r for r in done
+               if r["completion_reason"] == "draining"}
+    assert sorted(drained) == sorted(accepted)
+    for rec in drained.values():
+        assert rec["retriable"] is True
+        assert rec["tier"] in TIERS and isinstance(rec["tenant"], str)
+    # the refused submission never got a record anywhere
+    assert all(r["request_id"] != "jumper" for r in records)
+    assert srv.idle() and srv.drain_complete()
+
+
+# ---------------------------------------------------------------------------
+# overload e2e: ~2x capacity, Poisson, mixed tiers
+# ---------------------------------------------------------------------------
+
+
+def test_overload_poisson_mixed_tiers_sheds_lowest_first():
+    records = []
+    srv = _qos_engine(records, max_queue=6)
+    rng = np.random.default_rng(20)
+    tenants = ("chat", "ebatch", "scraper")
+    tier_of = {"chat": "interactive", "ebatch": "batch",
+               "scraper": "best_effort"}
+    submitted, gave_up = {}, {}
+    n_arr = 0
+    i = 0
+    while n_arr < 60 or not srv.idle():
+        if n_arr < 60:
+            # Poisson arrivals well past the 2-slot service rate: the
+            # queue MUST overflow and the overflow must go downhill
+            for _ in range(int(rng.poisson(2.0))):
+                if n_arr >= 60:
+                    break
+                tenant = tenants[n_arr % 3]
+                rid = f"req-{n_arr}-{tenant}"
+                prompt = rng.integers(1, 64, size=int(rng.integers(2, 7)))
+                try:
+                    srv.submit(prompt.tolist(), request_id=rid, tenant=tenant)
+                    submitted[rid] = tenant
+                except QueueFull:
+                    # the front gives up immediately: one shed record
+                    srv.record_shed(request_id=rid, tenant=tenant,
+                                    tier=tier_of[tenant])
+                    gave_up[rid] = tenant
+                n_arr += 1
+        srv.step()
+        srv.check_invariants()  # after EVERY scheduler event
+        i += 1
+        assert i < 100_000, "overload workload wedged"
+    by_id = {r["request_id"]: r for r in records}
+    # every request accounted exactly ONCE — accepted or refused
+    assert len(records) == len(by_id)
+    assert sorted(by_id) == sorted(set(submitted) | set(gave_up))
+    # every terminal record carries its QoS labels
+    for rec in records:
+        assert rec["tier"] in TIERS, rec
+        assert isinstance(rec["tenant"], str)
+        if rec["completion_reason"] == "shed":
+            assert rec["retriable"] is True
+    # sheds went strictly downhill: per-tier shed fraction is monotone in
+    # tier rank, and the protected tier completed at least as often as
+    # the tier the fleet ranks last
+    frac = {}
+    for tenant in tenants:
+        tier = tier_of[tenant]
+        total = [r for r in by_id.values() if r["tenant"] == tenant]
+        shed = [r for r in total if r["completion_reason"] == "shed"]
+        comp = [r for r in total
+                if r["completion_reason"] in ("stop", "length")]
+        frac[tier] = (
+            len(shed) / len(total), len(comp) / len(total), comp
+        )
+    assert frac["best_effort"][0] > 0, "overload never shed the bottom tier"
+    assert frac["interactive"][0] <= frac["batch"][0] <= frac["best_effort"][0]
+    assert frac["interactive"][1] >= frac["best_effort"][1]
+    # the high tier held its latency: queue wait (the ttft component
+    # admission control owns) stays at-or-below the bottom tier's
+    i_wait = [r["queue_s"] for r in frac["interactive"][2]]
+    be_wait = [r["queue_s"] for r in frac["best_effort"][2]]
+    if len(i_wait) >= 3 and len(be_wait) >= 3:
+        assert float(np.median(i_wait)) <= float(np.median(be_wait)) + 1e-9
+    # the engine's own rollups agree with the records
+    snap = srv.qos_snapshot()
+    assert snap["enabled"] is True
+    assert sum(c.get("completed", 0) for c in snap["tiers"].values()) == (
+        srv.completed_total
+    )
+    assert srv.shed_total == sum(
+        1 for r in records if r["completion_reason"] == "shed"
+    )
+
+
+# ---------------------------------------------------------------------------
+# noisy neighbor: fault_injection serve_tenant_flood
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_flood_quota_isolates_and_ages():
+    records = []
+    qos = QoSConfig(
+        enabled=True, aging_s=0.3,
+        tenants=_tenants(
+            flood=TenantConfig(
+                tier="best_effort", requests_per_s=5.0, burst_s=1.0
+            ),
+        ),
+    )
+    srv = _qos_engine(records, qos=qos)
+    fi.activate({
+        "serve_tenant_flood_at_step": 2,
+        "serve_tenant_flood_requests": 12,
+        "serve_tenant_flood_tenant": "flood",
+    })
+    demo = [
+        srv.submit(
+            rng_prompt.tolist(), request_id=f"demo-{i}", tenant="chat"
+        )
+        for i, rng_prompt in enumerate(
+            np.random.default_rng(3).integers(1, 64, size=(6, 4))
+        )
+    ]
+    aged_checked = False
+    for i in range(100_000):
+        if srv.idle():
+            break
+        srv.step()
+        srv.check_invariants()  # after EVERY scheduler event
+        flooded = [q for q in srv._queue if q.tenant == "flood"]
+        if flooded and not aged_checked:
+            # anti-starvation: once queued past aging_s the flood's
+            # ADMITTED requests order as top tier — bounded delay, not
+            # starvation, even while interactive traffic is live
+            time.sleep(qos.aging_s + 0.05)
+            now = time.perf_counter()
+            assert srv._effective_tier(flooded[0], now) == 0
+            aged_checked = True
+    else:
+        raise AssertionError("flood workload wedged")
+    assert aged_checked, "flood requests never queued — injection missed"
+    by_id = {r["request_id"]: r for r in records}
+    assert len(by_id) == len(records), "a request got two terminal records"
+    # the flood: every injected id accounted exactly once — admitted ones
+    # completed, over-quota ones got ONE labeled quota record each
+    flood_recs = {r for r in by_id if r.startswith("flood-")}
+    assert len(flood_recs) == 12
+    quota_recs = [r for r in records if r["completion_reason"] == "quota"]
+    assert quota_recs and all(
+        r["tenant"] == "flood" and r["tier"] == "best_effort"
+        and r["retriable"] is True for r in quota_recs
+    )
+    admitted = [
+        r for r in records
+        if r["request_id"].startswith("flood-")
+        and r["completion_reason"] in ("stop", "length")
+    ]
+    assert len(admitted) + len(quota_recs) == 12
+    assert len(admitted) >= 1, "the whole flood was quota-rejected"
+    assert srv.quota_total == len(quota_recs)
+    # isolation: the victim tenant's work all completed, none shed
+    for rid in demo:
+        assert by_id[rid]["completion_reason"] in ("stop", "length")
+    snap = srv.qos_snapshot()
+    assert snap["tenants"]["flood"]["quota"] == len(quota_recs)
+    srv.metrics.sync(srv)
+    fams = parse_exposition(srv.metrics.registry.render())
+    assert fams["automodel_serve_requests_quota"].samples[()] == float(
+        len(quota_recs)
+    )
+
+
+# ---------------------------------------------------------------------------
+# /metrics labels: engine scrape, federation rollup, per-tier SLO burn
+# ---------------------------------------------------------------------------
+
+
+def test_engine_scrape_carries_tier_and_tenant_labels():
+    records = []
+    srv = _qos_engine(records)
+    srv.submit([1, 2, 3, 4], tenant="chat")
+    srv.submit([2, 3, 4], tenant="ebatch")
+    srv.run()
+    assert srv.completed_total == 2
+    srv.metrics.sync(srv)
+    fams = parse_exposition(srv.metrics.registry.render())
+    reasons = {r["completion_reason"] for r in records}
+    for rec in records:
+        key = (("reason", rec["completion_reason"]), ("tier", rec["tier"]))
+        assert fams["automodel_serve_tier_requests"].samples[key] >= 1.0
+        tkey = (
+            ("reason", rec["completion_reason"]), ("tenant", rec["tenant"])
+        )
+        assert fams["automodel_serve_tenant_requests"].samples[tkey] >= 1.0
+    assert reasons <= {"stop", "length"}
+    # the per-tier ttft histogram — the per-tier SLO burn target
+    hists = fams["automodel_serve_tier_ttft_seconds"].histograms
+    assert hists[(("tier", "interactive"),)].count == 1
+    assert hists[(("tier", "batch"),)].count == 1
+
+
+def _replica_body(tier_ttft):
+    """A replica /metrics body with the labeled QoS families populated:
+    {tier: [ttft observations]} (one terminal per observation)."""
+    reg = MetricsRegistry()
+    tr = reg.labeled_counter(
+        "automodel_serve_tier_requests", "by tier+reason", ("tier", "reason")
+    )
+    h = reg.labeled_histogram(
+        "automodel_serve_tier_ttft_seconds", "ttft by tier", "tier",
+        buckets=(0.05, 0.1, 0.5, 1.0),
+    )
+    reg.counter("automodel_serve_requests_completed", "done").inc(
+        sum(len(v) for v in tier_ttft.values())
+    )
+    for tier, obs in tier_ttft.items():
+        tr.inc((tier, "stop"), len(obs))
+        for v in obs:
+            h.observe(tier, v)
+    return reg.render()
+
+
+def test_federation_rolls_up_labeled_qos_families():
+    fed = Federation(retention_s=120.0)
+    fed.ingest("r0", _replica_body(
+        {"interactive": [0.01, 0.02], "batch": [0.3]}
+    ), now=1.0)
+    fed.ingest("r1", _replica_body({"interactive": [0.04]}), now=1.0)
+    fed.roll(1.0)
+    # fleet aggregates keep the label tuples: one series per (tier, reason)
+    fleet = fleet_name("automodel_serve_tier_requests")
+    assert fed.latest(
+        fleet, labels=(("reason", "stop"), ("tier", "interactive"))
+    ) == 3.0
+    assert fed.latest(
+        fleet, labels=(("reason", "stop"), ("tier", "batch"))
+    ) == 1.0
+    # ingest a later sweep -> windowed increase per labeled series
+    fed.ingest("r0", _replica_body(
+        {"interactive": [0.01, 0.02, 0.03, 0.05], "batch": [0.3]}
+    ), now=6.0)
+    fed.ingest("r1", _replica_body({"interactive": [0.04]}), now=6.0)
+    fed.roll(6.0)
+    assert fed.increase(
+        fleet, 10.0, 6.0, labels=(("reason", "stop"), ("tier", "interactive"))
+    ) == 2.0
+    hist = fed.histogram_increase(
+        fleet_name("automodel_serve_tier_ttft_seconds"), 10.0, 6.0,
+        labels=(("tier", "interactive"),),
+    )
+    assert hist is not None and hist.count == 2.0
+    # the re-export round-trips: the federated body parses back with the
+    # labeled fleet families AND the replica-labeled originals intact
+    fams = parse_exposition(fed.render_federated())
+    assert fams[fleet].samples[
+        (("reason", "stop"), ("tier", "interactive"))
+    ] == 5.0
+    assert fams["automodel_serve_tier_requests"].samples[
+        (("reason", "stop"), ("replica", "r1"), ("tier", "interactive"))
+    ] == 1.0
+
+
+class _SLOHarness:
+    """SLO engine + federation with an injected scripted clock (the
+    test_slo.py harness, fed the labeled tier histogram)."""
+
+    def __init__(self, cfg):
+        from automodel_tpu.telemetry.slo import SLOEngine
+
+        self.fed = Federation(retention_s=cfg.retention_s)
+        self.registry = MetricsRegistry()
+        self.events = []
+        self.now = 0.0
+        self.engine = SLOEngine(
+            cfg, self.fed, registry=self.registry,
+            emit=self.events.append, wall=lambda: self.now,
+        )
+
+    def step(self, now, tier_ttft):
+        self.now = now
+        self.fed.ingest("r0", _replica_body(tier_ttft), now=now)
+        self.fed.roll(now)
+        self.engine.evaluate(now)
+
+
+def test_per_tier_slo_burn_alert_fires_on_the_labeled_child():
+    """The labels: selector judges ONE labeled child of the tier ttft
+    histogram: an interactive regression fires even while the unlabeled
+    traffic mix looks healthy, and slow batch traffic alone cannot."""
+    from automodel_tpu.telemetry.slo import SLOConfig
+
+    cfg = SLOConfig.from_dict({
+        "fast_window_s": 10.0, "slow_window_s": 30.0,
+        "for_s": 0.0, "resolve_s": 10.0,
+        "objectives": [
+            {"name": "ttft_p50_interactive", "kind": "latency",
+             "metric": "automodel_serve_tier_ttft_seconds",
+             "labels": {"tier": "interactive"},
+             "q": 0.5, "threshold_s": 0.2},
+            {"name": "ttft_p50_batch", "kind": "latency",
+             "metric": "automodel_serve_tier_ttft_seconds",
+             "labels": {"tier": "batch"},
+             "q": 0.5, "threshold_s": 0.2},
+        ],
+    })
+    assert cfg.objectives[0].labels == (("tier", "interactive"),)
+    good, bad = [0.01], [0.7]
+    h = _SLOHarness(cfg)
+    # healthy warm-up in both windows, both tiers
+    h.step(0.0, {"interactive": good * 5, "batch": good * 5})
+    h.step(5.0, {"interactive": good * 10, "batch": good * 10})
+    # the interactive child degrades; batch stays fast. Cumulative bodies:
+    # 40 of interactive's fast-window observations are over threshold
+    h.step(10.0, {"interactive": good * 10 + bad * 40,
+                  "batch": good * 50})
+    assert h.engine.firing() == ["ttft_p50_interactive"]
+    ev = [e for e in h.events if e["state"] == "firing"]
+    assert len(ev) == 1 and ev[0]["slo"] == "ttft_p50_interactive"
+    assert ev[0]["slo_value"] > 0.2
+    snap = h.engine.snapshot()
+    assert snap["ttft_p50_batch"]["state"] == "ok"
+    # the mirror case: only batch burning never pages the interactive SLO
+    h2 = _SLOHarness(cfg)
+    h2.step(0.0, {"interactive": good * 5, "batch": good * 5})
+    h2.step(5.0, {"interactive": good * 10, "batch": good * 10})
+    h2.step(10.0, {"interactive": good * 50,
+                   "batch": good * 10 + bad * 40})
+    assert h2.engine.firing() == ["ttft_p50_batch"]
+    assert h2.engine.snapshot()["ttft_p50_interactive"]["state"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# fleet: router helpers, aggregate_qos, fleet-status TIER/TENANT tables
+# ---------------------------------------------------------------------------
+
+
+def test_router_tier_helpers_and_retry_after_scaling():
+    from automodel_tpu.serving.fleet.router import (
+        RETRY_AFTER_S,
+        _tier_label,
+        _tier_retry_after,
+    )
+    from automodel_tpu.serving.server import (
+        _tier_retry_after as server_retry_after,
+    )
+
+    # arbitrary client strings must not mint unbounded label values
+    assert _tier_label("interactive") == "interactive"
+    assert _tier_label("premium<script>") == "interactive"
+    assert _tier_label(None) == "interactive"
+    # Retry-After goes uphill: lower tiers back off longer, and the
+    # router's jax-free mirror agrees with the serving front's
+    advice = [_tier_retry_after(t) for t in TIERS]
+    assert advice == [RETRY_AFTER_S, 2 * RETRY_AFTER_S, 3 * RETRY_AFTER_S]
+    assert [server_retry_after(t) for t in TIERS] == advice
+    assert server_retry_after("garbage") == RETRY_AFTER_S
+
+
+def test_aggregate_qos_sums_replica_snapshots():
+    from automodel_tpu.serving.fleet.router import aggregate_qos
+
+    s0 = {
+        "enabled": True,
+        "queued_by_tier": {"interactive": 2, "batch": 1, "best_effort": 0},
+        "queued_by_tenant": {"chat": 2, "ebatch": 1},
+        "tiers": {"interactive": {"completed": 5, "shed": 0, "timeout": 0,
+                                  "quota": 0}},
+        "tenants": {"chat": {"requests": 5, "completed": 5, "shed": 0,
+                             "quota": 0, "timeout": 0}},
+    }
+    s1 = {
+        "enabled": True,
+        "queued_by_tier": {"interactive": 1, "batch": 0, "best_effort": 3},
+        "queued_by_tenant": {"chat": 1, "scraper": 3},
+        "tiers": {"interactive": {"completed": 2, "shed": 1, "timeout": 0,
+                                  "quota": 0},
+                  "best_effort": {"completed": 0, "shed": 4, "timeout": 0,
+                                  "quota": 2}},
+        "tenants": {"chat": {"requests": 3, "completed": 2, "shed": 1,
+                             "quota": 0, "timeout": 0}},
+    }
+    agg = aggregate_qos([s0, None, "junk", s1])
+    assert agg["enabled"] is True
+    assert agg["queued_by_tier"]["interactive"] == 3
+    assert agg["queued_by_tier"]["best_effort"] == 3
+    assert agg["queued_by_tenant"] == {"chat": 3, "ebatch": 1, "scraper": 3}
+    assert agg["tiers"]["interactive"]["completed"] == 7
+    assert agg["tiers"]["interactive"]["shed"] == 1
+    assert agg["tiers"]["best_effort"]["quota"] == 2
+    assert agg["tenants"]["chat"]["requests"] == 8
+    # all replicas disabled (or no qos block at all) -> disabled rollup
+    assert aggregate_qos([{"enabled": False}, {}])["enabled"] is False
+
+
+def test_fleet_status_renders_tier_and_tenant_tables():
+    from automodel_tpu.serving.fleet.status import (
+        qos_summary_lines,
+        render_table,
+    )
+
+    stats = {
+        "replicas": {
+            "r0": {"role": "mixed", "ready": True, "alive": True,
+                   "queue_depth": 1, "busy_slots": 2,
+                   "block_occupancy": 0.5},
+        },
+        "replicas_ready": 1,
+        "qos": {
+            "enabled": True,
+            "queued_by_tier": {"interactive": 2, "batch": 0,
+                               "best_effort": 5},
+            "queued_by_tenant": {"chat": 2, "scraper": 5},
+            "tiers": {
+                "interactive": {"completed": 9, "shed": 0, "timeout": 0,
+                                "quota": 0},
+                "best_effort": {"completed": 1, "shed": 7, "timeout": 1,
+                                "quota": 3},
+            },
+            "tenants": {
+                "chat": {"requests": 9, "completed": 9, "shed": 0,
+                         "quota": 0, "timeout": 0},
+                "scraper": {"requests": 12, "completed": 1, "shed": 7,
+                            "quota": 3, "timeout": 1},
+            },
+        },
+    }
+    lines = qos_summary_lines(stats)
+    text = "\n".join(lines)
+    assert "QoS tiers:" in text and "QoS tenants" in text
+    # every tier is a row (zero rows included), columns carry the numbers
+    for tier in TIERS:
+        assert any(line.strip().startswith(tier) for line in lines), tier
+    be_row = next(l for l in lines if l.strip().startswith("best_effort"))
+    assert be_row.split() == ["best_effort", "5", "1", "7", "3", "1"]
+    scraper_row = next(l for l in lines if l.strip().startswith("scraper"))
+    assert scraper_row.split() == ["scraper", "5", "1", "7", "3", "1"]
+    # the full table embeds the block; disabled QoS leaves it untouched
+    assert "QoS tiers:" in render_table(stats)
+    assert qos_summary_lines({"qos": {"enabled": False}}) == []
+    assert qos_summary_lines({}) == []
+
+
+# ---------------------------------------------------------------------------
+# report: per-tier histograms in the summary, label lint
+# ---------------------------------------------------------------------------
+
+
+def test_report_summarizes_per_tier_sheds_and_lints_labels(tmp_path):
+    from automodel_tpu.telemetry.report import (
+        lint_metrics_jsonl,
+        summarize_metrics,
+    )
+
+    records = []
+    srv = _qos_engine(records, max_queue=2)
+    srv.submit([1, 2, 3], request_id="be-1", tenant="scraper")
+    srv.submit([1, 2, 3], request_id="be-2", tenant="scraper")
+    srv.submit([1, 2, 3], request_id="i-1", tenant="chat")  # evicts be-2
+    srv.record_quota(request_id="q-1", tenant="scraper", tier="best_effort")
+    srv.run()
+    path = tmp_path / "m.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    recs, problems = lint_metrics_jsonl(str(path))
+    assert problems == []
+    summary = summarize_metrics(recs)
+    assert summary["serve_shed"] == 1
+    assert summary["serve_quota"] == 1
+    assert summary["serve_shed_by_tier"] == {"best_effort": 1}
+    assert summary["serve_quota_by_tenant"] == {"scraper": 1}
+    assert "serve_timeouts_by_tier" not in summary  # nothing timed out
+    # a non-string QoS label is a foreign writer: report --strict flags it
+    bad = dict(records[-1])
+    bad["tenant"] = 123
+    path.write_text(json.dumps(bad) + "\n")
+    _, problems = lint_metrics_jsonl(str(path))
+    assert any("tenant" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# docs drift guard: every emittable reason is in the runbook table
+# ---------------------------------------------------------------------------
+
+
+def test_every_completion_reason_documented_in_serving_runbook():
+    """docs/serving.md's reason table must name every reason the engine
+    can stamp on a terminal record — `quota` included. A new reason that
+    ships without its runbook row fails here, not in an operator's
+    incident."""
+    text = (DOCS / "serving.md").read_text()
+    m = re.search(
+        r"^\| reason \|.*?\n\|[-| ]+\|\n(.*?)\n\n",
+        text, re.M | re.S,
+    )
+    assert m, "docs/serving.md lost its completion_reason runbook table"
+    documented = set()
+    for row in m.group(1).splitlines():
+        first_cell = row.split("|")[1] if row.count("|") >= 2 else ""
+        documented.update(re.findall(r"`([a-z_]+)`", first_cell))
+    missing = [r for r in COMPLETION_REASONS if r not in documented]
+    assert not missing, (
+        "engine completion_reasons absent from the docs/serving.md "
+        f"runbook table: {missing}"
+    )
+    # the glossary side: the QoS label names and counters are documented
+    obs = (DOCS / "observability.md").read_text()
+    for needle in (
+        "`tenant`", "`tier`", "automodel_serve_requests_quota",
+        "automodel_serve_tier_requests", "automodel_serve_tenant_requests",
+        "automodel_serve_tier_ttft_seconds",
+        "automodel_route_tier_requests",
+    ):
+        assert needle in obs, f"docs/observability.md lost {needle}"
